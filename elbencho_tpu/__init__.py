@@ -1,0 +1,27 @@
+"""elbencho-tpu: TPU-native distributed storage benchmark.
+
+A brand-new framework with the capabilities of breuner/elbencho (reference:
+/root/reference, C++17): throughput/IOPS/latency benchmarking of files, block
+devices and object storage (S3/GCS), locally or coordinated across many hosts
+via an HTTP service mode — with the GPU data path (CUDA/cuFile) re-designed
+TPU-first: per-worker HBM buffer allocation and host->device DMA via PjRt/JAX
+(``--tpuids``), Pallas kernels for on-device block fill/verify, and a
+``jax.sharding.Mesh`` pod-wide ingest path.
+
+Package layout (reference layer map: SURVEY.md section 1):
+  toolkits/   L1 pure-logic toolkits (offset gens, PRNGs, units, treefile, ...)
+  config/     L6 flag/config system (ProgArgs parity incl. JSON round-trip)
+  workers/    L3/L4 workload engine + worker runtime
+  stats/      L0 statistics, latency histograms, CPU util
+  service/    L5 HTTP control plane (service + master/RemoteWorker)
+  tpu/        TPU data path: HBM buffers, H2D/D2H transfer seam (PjRt via JAX)
+  ops/        on-device ops (Pallas / jax): block fill PRNG, verify checksum
+  parallel/   device-mesh sharded ingest (multi-chip / pod-slice scaling)
+  models/     benchmark workload pipelines ("flagship" = HBM ingest pipeline)
+"""
+
+__version__ = "0.1.0"
+
+# Messaging protocol version for master<->service compatibility checks.
+# (Reference: HTTP_PROTOCOLVERSION, source/Common.h:91 — exact match required.)
+HTTP_PROTOCOL_VERSION = "tpu-0.1"

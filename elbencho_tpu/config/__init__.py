@@ -1,0 +1,1 @@
+from .args import BenchConfig, ConfigError  # noqa: F401

@@ -1,0 +1,640 @@
+"""Flag/config system — single source of truth for every benchmark setting.
+
+Reference: source/ProgArgs.{h,cpp} (~5.2 kLoC; 238 flags declared in
+defineAllowedArgs() ProgArgs.cpp:216-860, defaults :861, config-file merge,
+unit-suffix conversion, implicit derivation initImplicitValues() :1148,
+cross-validation checkArgs() :1349, and — crucially — JSON serialization of
+the full effective config for the service protocol:
+getAsPropertyTreeForService() :3921 / setFromPropertyTreeForService() :3754
+with per-host rank offsets).
+
+Here: a table-driven flag registry builds both the argparse CLI and the
+JSON round-trip, so every flag automatically ships to remote services.
+The reference's ``--gpuids`` GPU data path becomes ``--tpuids`` (worker ->
+TPU chip mapping; BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import stat as stat_mod
+from dataclasses import dataclass, field
+
+from ..phases import BenchMode, BenchPathType, BenchPhase
+from ..toolkits.units import parse_size, parse_uint_list
+
+
+class ConfigError(ValueError):
+    """Reference: ProgException for invalid argument combinations."""
+
+
+# ---------------------------------------------------------------------------
+# flag registry: (flag, short, dest, kind, default, category, help)
+# kind: bool | int | size | float | str | strlist | intlist
+# category: essential | multi | large | dist | s3 | tpu | misc  (help tiers)
+# ---------------------------------------------------------------------------
+
+FLAG_DEFS = [
+    # essential workload selection
+    ("write", "w", "run_create_files", "bool", False, "essential",
+     "Run write phase (create files / upload objects)"),
+    ("read", "r", "run_read_files", "bool", False, "essential",
+     "Run read phase"),
+    ("mkdirs", "d", "run_create_dirs", "bool", False, "essential",
+     "Run create-directories phase (or create buckets in S3 mode)"),
+    ("deldirs", "D", "run_delete_dirs", "bool", False, "essential",
+     "Run delete-directories phase"),
+    ("delfiles", "F", "run_delete_files", "bool", False, "essential",
+     "Run delete-files phase"),
+    ("stat", None, "run_stat_files", "bool", False, "essential",
+     "Run stat/getattr phase"),
+    ("statdirs", None, "run_stat_dirs", "bool", False, "multi",
+     "Run stat-directories phase"),
+    ("sync", None, "run_sync_phase", "bool", False, "misc",
+     "Sync write caches to stable storage between phases"),
+    ("dropcaches", None, "run_drop_caches_phase", "bool", False, "misc",
+     "Drop kernel page/dentry/inode caches between phases"),
+    ("netbench", None, "run_netbench", "bool", False, "dist",
+     "Run network benchmarking (first hosts are servers, rest clients)"),
+
+    # geometry
+    ("threads", "t", "num_threads", "int", 1, "essential",
+     "Number of I/O worker threads per host"),
+    ("dirs", "n", "num_dirs", "int", 1, "essential",
+     "Number of directories per thread (dir mode)"),
+    ("files", "N", "num_files", "int", 1, "essential",
+     "Number of files per directory (dir mode)"),
+    ("size", "s", "file_size", "size", 0, "essential",
+     "File / object size (unit suffixes allowed, e.g. 4K, 1M, 10g)"),
+    ("block", "b", "block_size", "size", 1 << 20, "essential",
+     "Number of bytes per read/write op"),
+    ("iodepth", None, "io_depth", "int", 1, "large",
+     "Async I/O depth (queued ops per thread; 1 = sync I/O)"),
+
+    # access pattern
+    ("rand", None, "use_random_offsets", "bool", False, "large",
+     "Random offsets instead of sequential"),
+    ("randamount", None, "random_amount", "size", 0, "large",
+     "Total bytes to read/write in random mode (default: full size)"),
+    ("norandalign", None, "no_random_align", "bool", False, "large",
+     "Do not align random offsets to block size"),
+    ("randalgo", None, "rand_offset_algo", "str", "fast", "large",
+     "Random offset generator: strong|balanced_single|balanced|fast"),
+    ("backward", None, "do_reverse_seq_offsets", "bool", False, "large",
+     "Do backward sequential reads/writes"),
+    ("strided", None, "do_strided_access", "bool", False, "large",
+     "Strided access across shared files"),
+    ("infloop", None, "do_infinite_io_loop", "bool", False, "misc",
+     "Let each worker loop its workload forever (until time limit/interrupt)"),
+
+    # file handling
+    ("direct", None, "use_direct_io", "bool", False, "essential",
+     "Use direct I/O (O_DIRECT), bypassing page cache"),
+    ("nodiocheck", None, "no_direct_io_check", "bool", False, "misc",
+     "Skip direct-I/O alignment sanity checks"),
+    ("mmap", None, "use_mmap", "bool", False, "large",
+     "Use memory-mapped I/O instead of read/write syscalls"),
+    ("flock", None, "use_file_locks", "str", "", "misc",
+     "File range locking mode: range|full"),
+    ("fadv", None, "fadvise_flags", "str", "", "misc",
+     "posix_fadvise flags (comma-sep: seq,rand,willneed,dontneed,noreuse)"),
+    ("madv", None, "madvise_flags", "str", "", "misc",
+     "madvise flags for mmap (comma-sep: seq,rand,willneed,dontneed)"),
+    ("trunc", None, "do_truncate", "bool", False, "misc",
+     "Truncate files to 0 on open for write"),
+    ("trunctosize", None, "do_truncate_to_size", "bool", False, "misc",
+     "Truncate files to full size on open for write"),
+    ("preallocfile", None, "do_prealloc_file", "bool", False, "misc",
+     "Preallocate file disk space on creation (fallocate)"),
+    ("nofdsharing", None, "no_fd_sharing", "bool", False, "misc",
+     "Each worker opens its own FDs for given file/bdev paths"),
+    ("dirsharing", None, "do_dir_sharing", "bool", False, "multi",
+     "All threads share the same dirs (d0..dN) instead of per-rank dirs"),
+    ("dirstats", None, "show_dirs_stats", "bool", False, "multi",
+     "Show dirs/s in write phase results"),
+    ("nodelerr", None, "ignore_delete_errors", "bool", False, "misc",
+     "Do not treat deletion of non-existing files as error"),
+    ("no0usecerr", None, "ignore_0usec_errors", "bool", False, "misc",
+     "Do not warn about operations completing in 0 microseconds"),
+
+    # integrity / variance
+    ("verify", None, "integrity_check_salt", "int", 0, "misc",
+     "Enable data integrity check with given salt (!=0)"),
+    ("verifydirect", None, "do_direct_verify", "bool", False, "misc",
+     "Verify data by reading immediately after each write"),
+    ("readinline", None, "do_read_inline", "bool", False, "misc",
+     "Read each block immediately after writing it (same FD)"),
+    ("blockvarpct", None, "block_variance_pct", "int", 0, "large",
+     "Percentage of each block to refill with random data between writes"),
+    ("blockvaralgo", None, "block_variance_algo", "str", "fast", "large",
+     "PRNG for block variance: strong|balanced_single|balanced|fast"),
+
+    # rwmix
+    ("rwmixpct", None, "rwmix_read_pct", "int", 0, "large",
+     "Percentage of reads in write phase (per-op modulo split)"),
+    ("rwmixthr", None, "num_rwmix_read_threads", "int", 0, "large",
+     "Number of threads of the write phase that do reads instead"),
+    ("rwmixthrpct", None, "rwmix_thr_read_pct", "int", 0, "large",
+     "Target read byte percentage for rwmixthr balancing"),
+
+    # rate limiting
+    ("limitread", None, "limit_read_bps", "size", 0, "misc",
+     "Per-thread read bandwidth limit (bytes/sec, unit suffixes allowed)"),
+    ("limitwrite", None, "limit_write_bps", "size", 0, "misc",
+     "Per-thread write bandwidth limit (bytes/sec)"),
+
+    # results & stats
+    ("iterations", "i", "iterations", "int", 1, "misc",
+     "Number of iterations of the full phase set"),
+    ("timelimit", None, "time_limit_secs", "int", 0, "misc",
+     "Phase time limit in seconds"),
+    ("phasedelay", None, "next_phase_delay_secs", "int", 0, "misc",
+     "Delay between phases in seconds"),
+    ("lat", None, "show_latency", "bool", False, "essential",
+     "Show min/avg/max latency"),
+    ("lathisto", None, "show_latency_histogram", "bool", False, "misc",
+     "Show latency histogram"),
+    ("latpercent", None, "show_latency_percentiles", "bool", False, "misc",
+     "Show latency percentiles"),
+    ("latpercent9s", None, "num_latency_percentile_9s", "int", 2, "misc",
+     "Number of nines for top latency percentile (2=99, 3=99.9, ...)"),
+    ("allelapsed", None, "show_all_elapsed", "bool", False, "misc",
+     "Show elapsed time of every single worker thread"),
+    ("cpu", None, "show_cpu_util", "bool", False, "misc",
+     "Show CPU utilization in live stats and results"),
+    ("resfile", None, "res_file_path", "str", "", "misc",
+     "Also write human-readable results to this file"),
+    ("csvfile", None, "csv_file_path", "str", "", "misc",
+     "Also write results to this CSV file"),
+    ("jsonfile", None, "json_file_path", "str", "", "misc",
+     "Also write results to this JSON file"),
+    ("nocsvlabels", None, "no_csv_labels", "bool", False, "misc",
+     "Do not print config labels line to CSV file"),
+    ("livecsv", None, "live_csv_file_path", "str", "", "misc",
+     "Write live stats to this CSV file ('stdout' allowed)"),
+    ("livejson", None, "live_json_file_path", "str", "", "misc",
+     "Write live stats to this JSON file ('stdout' allowed)"),
+    ("livecsvex", None, "live_csv_extended", "bool", False, "misc",
+     "Live CSV: one row per worker instead of totals"),
+    ("livejsonex", None, "live_json_extended", "bool", False, "misc",
+     "Live JSON: one entry per worker instead of totals"),
+    ("liveint", None, "live_stats_interval_ms", "int", 2000, "misc",
+     "Live statistics refresh interval in milliseconds"),
+    ("live1", None, "use_single_line_live_stats", "bool", False, "misc",
+     "Single-line live stats instead of fullscreen"),
+    ("live1n", None, "single_line_live_stats_no_erase", "bool", False, "misc",
+     "Single-line live stats, new line per update (for logs/pipes)"),
+    ("nolive", None, "disable_live_stats", "bool", False, "misc",
+     "Disable live statistics"),
+    ("label", None, "bench_label", "str", "", "misc",
+     "Custom benchmark label for result files"),
+    ("base10", None, "use_base10_units", "bool", False, "misc",
+     "Use base-10 (MB/s) instead of base-2 (MiB/s) units in output"),
+    ("log", None, "log_level", "int", 0, "misc",
+     "Log level (0=normal, 1=verbose, 2=debug)"),
+    ("dryrun", None, "do_dry_run", "bool", False, "misc",
+     "Show workload totals and config without running any phase"),
+    ("opslog", None, "ops_log_path", "str", "", "misc",
+     "Log every single I/O operation as JSONL to this file"),
+    ("opsloglock", None, "ops_log_lock", "bool", False, "misc",
+     "Serialize ops log writes via file lock (for shared-file logs)"),
+
+    # distribution
+    ("hosts", None, "hosts_str", "str", "", "dist",
+     "Comma-separated service hosts (host[:port])"),
+    ("hostsfile", None, "hosts_file_path", "str", "", "dist",
+     "File with one service host per line"),
+    ("numhosts", None, "num_hosts_limit", "int", -1, "dist",
+     "Use only this many of the given hosts"),
+    ("service", None, "run_as_service", "bool", False, "dist",
+     "Run as service (daemonized HTTP server for remote workers)"),
+    ("foreground", None, "run_service_in_foreground", "bool", False, "dist",
+     "Run service in foreground (don't daemonize)"),
+    ("port", None, "service_port", "int", 1611, "dist",
+     "TCP port of service HTTP server"),
+    ("quit", None, "quit_services", "bool", False, "dist",
+     "Tell given hosts' services to quit"),
+    ("rankoffset", None, "rank_offset", "int", 0, "dist",
+     "Offset for worker thread rank numbers"),
+    ("nosvcshare", None, "no_shared_service_path", "bool", False, "dist",
+     "Bench paths are not shared between service instances"),
+    ("svcupint", None, "svc_update_interval_ms", "int", 500, "dist",
+     "Service status poll interval in milliseconds"),
+    ("svcwait", None, "svc_wait_secs", "int", 0, "dist",
+     "Seconds to wait for services to come up at start"),
+    ("svcpwfile", None, "svc_password_file", "str", "", "dist",
+     "File with shared secret for service authorization"),
+    ("svcelapsed", None, "show_svc_elapsed", "bool", False, "dist",
+     "Show per-service elapsed times in results"),
+    ("rotatehosts", None, "rotate_hosts_num", "int", 0, "dist",
+     "Rotate hosts list by this many positions between phases"),
+    ("datasetthreads", None, "num_dataset_threads_override", "int", 0, "dist",
+     "Override number of dataset partitioning threads"),
+    ("start", None, "start_time_utc", "str", "", "dist",
+     "Synchronized start time (HH:MM[:SS] UTC or unix timestamp)"),
+    ("netdevs", None, "netdevs_str", "str", "", "dist",
+     "Comma-separated network devices for netbench client binding"),
+    ("netbenchservers", None, "num_netbench_servers", "int", 1, "dist",
+     "Number of hosts acting as netbench servers"),
+    ("respsize", None, "netbench_response_size", "size", 1, "dist",
+     "Netbench server response size in bytes"),
+    ("recvbuf", None, "sock_recv_buf_size", "size", 0, "dist",
+     "Socket receive buffer size"),
+    ("sendbuf", None, "sock_send_buf_size", "size", 0, "dist",
+     "Socket send buffer size"),
+
+    # TPU data path (reference GPU flags --gpuids/--gpuperservice/--cufile/
+    # --gdsbufreg become the PjRt/HBM path; SURVEY.md section 2.5 "GPU staging")
+    ("tpuids", None, "tpu_ids_str", "str", "", "tpu",
+     "Comma-separated TPU chip ids to use for HBM buffer staging "
+     "(round-robin worker->chip by rank, like reference --gpuids)"),
+    ("tpuperservice", None, "assign_tpu_per_service", "bool", False, "tpu",
+     "Round-robin TPU chips across service instances instead of workers"),
+    ("tpudirect", None, "use_tpu_direct", "bool", False, "tpu",
+     "Direct host->HBM DMA path, skipping the bounce buffer where possible "
+     "(cuFile/GDS analogue on PjRt)"),
+    ("tpuverify", None, "do_tpu_verify", "bool", False, "tpu",
+     "Run integrity verification on-device (Pallas kernel) instead of host"),
+    ("tpuhbmpct", None, "tpu_hbm_limit_pct", "int", 90, "tpu",
+     "Max percentage of per-chip HBM to use for staging buffers"),
+
+    # NUMA/core binding
+    ("zones", None, "numa_zones_str", "str", "", "multi",
+     "Comma-separated NUMA zones to bind workers to (round-robin)"),
+    ("cores", None, "cpu_cores_str", "str", "", "multi",
+     "Comma-separated CPU cores to bind workers to (round-robin)"),
+
+    # custom tree
+    ("treefile", None, "tree_file_path", "str", "", "multi",
+     "Path to custom tree file (see elbencho-tpu-scan-path)"),
+    ("treerand", None, "use_custom_tree_rand", "bool", False, "multi",
+     "Randomize custom tree file order"),
+    ("treeroundrob", None, "use_custom_tree_round_robin", "bool", False, "multi",
+     "Round-robin block assignment for shared custom tree files"),
+    ("treeroundup", None, "tree_round_up_size", "size", 0, "multi",
+     "Round file sizes in tree file up to multiple of this"),
+    ("sharesize", None, "file_share_size", "size", 0, "multi",
+     "Custom tree: files >= this size are shared between workers"),
+
+    # S3/object storage (front-end parity; stdlib SigV4 client)
+    ("s3endpoints", None, "s3_endpoints_str", "str", "", "s3",
+     "Comma-separated S3 endpoint URLs"),
+    ("s3key", None, "s3_access_key", "str", "", "s3", "S3 access key"),
+    ("s3secret", None, "s3_secret_key", "str", "", "s3", "S3 secret key"),
+    ("s3region", None, "s3_region", "str", "us-east-1", "s3", "S3 region"),
+    ("s3objprefix", None, "s3_object_prefix", "str", "", "s3",
+     "Prefix for object names in bucket"),
+    ("s3randobj", None, "s3_rand_obj_select", "bool", False, "s3",
+     "Read at random offsets of random objects"),
+    ("s3single", None, "s3_no_mpu", "bool", False, "s3",
+     "Single-part upload even for large objects"),
+    ("s3listobj", None, "run_list_objects_num", "int", 0, "s3",
+     "Run bucket listing phase for this many objects"),
+    ("s3listobjpar", None, "run_list_objects_parallel", "bool", False, "s3",
+     "Run parallel bucket listing phase"),
+    ("s3listverify", None, "do_list_objects_verify", "bool", False, "s3",
+     "Verify listing results against expected object set"),
+    ("s3multidel", None, "run_multi_delete_num", "int", 0, "s3",
+     "Run multi-object delete phase with this many objects per request"),
+    ("s3virtaddr", None, "s3_virtual_hosted", "bool", False, "s3",
+     "Use virtual-hosted-style addressing instead of path-style"),
+    ("s3sign", None, "s3_sign_policy", "int", 0, "s3",
+     "Request signing policy (0=signed v4)"),
+    ("s3maxconns", None, "s3_max_connections", "int", 0, "s3",
+     "Max parallel S3 connections per worker (0=iodepth)"),
+    ("s3ignoreerrors", None, "s3_ignore_errors", "bool", False, "s3",
+     "Continue on S3 request errors (stress mode)"),
+
+    # misc
+    ("configfile", "c", "config_file_path", "str", "", "misc",
+     "Read benchmark settings from this file (ini-style: flag = value)"),
+    ("interrupt", None, "interrupt_services", "bool", False, "dist",
+     "Interrupt the current phase of the given service hosts"),
+]
+
+_KIND_PARSERS = {
+    "int": int,
+    "float": float,
+    "str": str,
+    "size": parse_size,
+}
+
+
+def _make_field(flag_def):
+    _, _, dest, kind, default, _, _ = flag_def
+    if kind in ("strlist", "intlist"):
+        return (dest, list, field(default_factory=list))
+    py_type = {"bool": bool, "int": int, "float": float,
+               "str": str, "size": int}[kind]
+    return (dest, py_type, field(default=default))
+
+
+_CONFIG_FIELDS = [_make_field(fd) for fd in FLAG_DEFS]
+_CONFIG_FIELDS.append(("paths", list, field(default_factory=list)))
+
+
+@dataclass
+class _BenchConfigBase:
+    pass
+
+
+BenchConfigBase = dataclasses.make_dataclass(
+    "BenchConfigBase", _CONFIG_FIELDS, bases=(_BenchConfigBase,))
+
+
+class BenchConfig(BenchConfigBase):
+    """Typed effective configuration (ProgArgs equivalent).
+
+    Constructed from CLI args (parse_cli), a config file, or a service
+    protocol dict (from_service_dict). Derived values (bench mode, path
+    type, dataset threads, per-host ranks) are computed by derive().
+    """
+
+    def __init__(self, **kwargs):
+        unknown = set(kwargs) - {f.name for f in dataclasses.fields(self)}
+        if unknown:
+            raise ConfigError(f"unknown config keys: {sorted(unknown)}")
+        super().__init__(**kwargs)
+        # derived state (not part of the flag registry)
+        self.bench_mode: BenchMode = BenchMode.UNDEFINED
+        self.bench_path_type: BenchPathType = BenchPathType.DIR
+        self.hosts: "list[str]" = []
+        self.tpu_ids: "list[int]" = []
+        self.num_dataset_threads: int = self.num_threads
+        self.bench_path_fds: "list[int]" = []   # opened by worker prep
+        self.derived_done = False
+
+    # -- derivation (reference: initImplicitValues/checkArgs) ---------------
+
+    def derive(self, probe_paths: bool = True) -> "BenchConfig":
+        self._parse_hosts()
+        self.tpu_ids = parse_uint_list(self.tpu_ids_str)
+        self._init_bench_mode()
+        if probe_paths and self.bench_mode == BenchMode.POSIX and self.paths:
+            self._find_bench_path_type()
+        self._calc_dataset_threads()
+        self._apply_implicit_values()
+        self.derived_done = True
+        return self
+
+    def _parse_hosts(self) -> None:
+        hosts: "list[str]" = []
+        if self.hosts_file_path:
+            with open(self.hosts_file_path) as f:
+                hosts += [ln.strip() for ln in f
+                          if ln.strip() and not ln.startswith("#")]
+        if self.hosts_str:
+            hosts += [h.strip() for h in self.hosts_str.split(",") if h.strip()]
+        if 0 <= self.num_hosts_limit < len(hosts):
+            hosts = hosts[:self.num_hosts_limit]
+        self.hosts = hosts
+
+    def _init_bench_mode(self) -> None:
+        """Bench mode from flags/path prefixes (reference: initBenchMode,
+        ProgArgs.cpp:1112 — s3:// and hdfs:// prefixes, --netbench flag)."""
+        if self.run_netbench:
+            self.bench_mode = BenchMode.NETBENCH
+            return
+        if self.s3_endpoints_str or any(
+                p.startswith("s3://") for p in self.paths):
+            self.bench_mode = BenchMode.S3
+            self.paths = [p[len("s3://"):] if p.startswith("s3://") else p
+                          for p in self.paths]
+            return
+        if any(p.startswith("hdfs://") for p in self.paths):
+            self.bench_mode = BenchMode.HDFS
+            self.paths = [p[len("hdfs://"):] for p in self.paths]
+            return
+        self.paths = [p[len("file://"):] if p.startswith("file://") else p
+                      for p in self.paths]
+        self.bench_mode = BenchMode.POSIX
+
+    def _find_bench_path_type(self) -> None:
+        """DIR|FILE|BLOCKDEV via stat; all paths must agree
+        (reference: findBenchPathType, ProgArgs.cpp:3062)."""
+        types = set()
+        for p in self.paths:
+            try:
+                st = os.stat(p)
+                if stat_mod.S_ISDIR(st.st_mode):
+                    types.add(BenchPathType.DIR)
+                elif stat_mod.S_ISBLK(st.st_mode):
+                    types.add(BenchPathType.BLOCKDEV)
+                else:
+                    types.add(BenchPathType.FILE)
+            except FileNotFoundError:
+                # non-existing => will be created as file in write phase
+                types.add(BenchPathType.FILE)
+        if len(types) > 1:
+            raise ConfigError(
+                f"all bench paths must have the same type, got: "
+                f"{[t.name for t in types]}")
+        self.bench_path_type = types.pop() if types else BenchPathType.DIR
+
+    def _calc_dataset_threads(self) -> None:
+        """numDataSetThreads = threads * hosts if paths shared between
+        services, else threads (reference: ProgArgs.cpp:1408-1409)."""
+        if self.num_dataset_threads_override > 0:
+            self.num_dataset_threads = self.num_dataset_threads_override
+        elif self.hosts and not self.no_shared_service_path:
+            self.num_dataset_threads = self.num_threads * len(self.hosts)
+        else:
+            self.num_dataset_threads = self.num_threads
+
+    def _apply_implicit_values(self) -> None:
+        if self.use_random_offsets and not self.random_amount:
+            # default random amount = full dataset size
+            if self.bench_path_type != BenchPathType.DIR:
+                self.random_amount = self.file_size * max(1, len(self.paths))
+            else:
+                self.random_amount = self.file_size
+        if self.run_as_service:
+            self.disable_live_stats = True
+        if self.num_rwmix_read_threads and not self.run_create_files:
+            raise ConfigError("--rwmixthr requires the write phase (-w)")
+
+    # -- validation (reference: checkArgs/checkPathDependentArgs) -----------
+
+    def check(self) -> None:
+        if self.num_threads < 1:
+            raise ConfigError("--threads must be >= 1")
+        if self.block_size < 1 and self.file_size > 0:
+            raise ConfigError("--block must be >= 1")
+        if self.file_size and self.block_size > self.file_size:
+            # reference reduces blocksize to filesize with a note
+            self.block_size = self.file_size
+        if self.use_direct_io and not self.no_direct_io_check:
+            align = 512
+            if self.file_size % align or self.block_size % align:
+                raise ConfigError(
+                    "direct I/O requires file size and block size to be "
+                    "multiples of 512 bytes (use --nodiocheck to override)")
+        if self.rwmix_read_pct and not (0 <= self.rwmix_read_pct <= 100):
+            raise ConfigError("--rwmixpct must be in 0..100")
+        if self.num_rwmix_read_threads >= max(1, self.num_threads):
+            if self.num_rwmix_read_threads:
+                raise ConfigError("--rwmixthr must be < number of threads")
+        if self.integrity_check_salt and self.block_variance_pct:
+            raise ConfigError("--verify and --blockvarpct are incompatible")
+        if self.use_random_offsets and self.integrity_check_salt \
+                and not self.no_random_align and self.run_create_files \
+                and self.run_read_files:
+            pass  # full-coverage LCG makes this safe (every block exactly once)
+        if self.use_mmap and self.use_direct_io:
+            raise ConfigError("--mmap and --direct are incompatible")
+        if self.tpu_ids_str and self.bench_mode == BenchMode.NETBENCH:
+            raise ConfigError("--tpuids not supported in netbench mode")
+
+    # -- phase selection getters (used by Coordinator ordering table) --------
+
+    def enabled_phases(self) -> "list[BenchPhase]":
+        """Ordered phase list (reference: Coordinator.cpp:311-334 —
+        creates before deletes; listing after write/read setup)."""
+        p = []
+        if self.run_create_dirs:
+            p.append(BenchPhase.CREATEDIRS)
+        if self.run_stat_dirs:
+            p.append(BenchPhase.STATDIRS)
+        if self.run_create_files:
+            p.append(BenchPhase.CREATEFILES)
+        if self.run_stat_files:
+            p.append(BenchPhase.STATFILES)
+        if self.run_list_objects_num and not self.run_list_objects_parallel:
+            p.append(BenchPhase.LISTOBJECTS)
+        if self.run_list_objects_parallel:
+            p.append(BenchPhase.LISTOBJPARALLEL)
+        if self.run_read_files:
+            p.append(BenchPhase.READFILES)
+        if self.run_multi_delete_num:
+            p.append(BenchPhase.MULTIDELOBJ)
+        if self.run_delete_files:
+            p.append(BenchPhase.DELETEFILES)
+        if self.run_delete_dirs:
+            p.append(BenchPhase.DELETEDIRS)
+        if self.run_netbench:
+            p.append(BenchPhase.NETBENCH)
+        return p
+
+    # -- service protocol round-trip ----------------------------------------
+
+    def to_service_dict(self, service_rank_offset: int = 0,
+                        protocol_version: "str | None" = None) -> dict:
+        """Full effective config as a JSON-able dict for POST /preparephase
+        (reference: getAsPropertyTreeForService, ProgArgs.cpp:3921 — ships
+        every flag plus the per-host rank offset)."""
+        from .. import HTTP_PROTOCOL_VERSION
+        d = {f.name: getattr(self, f.name)
+             for f in dataclasses.fields(self)}
+        d["rank_offset"] = self.rank_offset + service_rank_offset
+        d["ProtocolVersion"] = protocol_version or HTTP_PROTOCOL_VERSION
+        # master never ships its own hosts list / service flags to services
+        d["hosts_str"] = ""
+        d["hosts_file_path"] = ""
+        d["run_as_service"] = False
+        d["num_dataset_threads_override"] = self.num_dataset_threads
+        return d
+
+    @classmethod
+    def from_service_dict(cls, d: dict) -> "BenchConfig":
+        """Rebuild effective config on the service side
+        (reference: setFromPropertyTreeForService, ProgArgs.cpp:3754)."""
+        d = dict(d)
+        d.pop("ProtocolVersion", None)
+        cfg = cls(**{k: v for k, v in d.items()
+                     if k in {f.name for f in dataclasses.fields(cls)}})
+        cfg.derive()
+        cfg.check()
+        return cfg
+
+    def config_labels(self) -> "dict[str, str]":
+        """Flat config key/value labels for CSV/JSON results
+        (reference: getAsStringVec, ProgArgs.cpp:4065)."""
+        out = {}
+        for f in dataclasses.fields(self):
+            val = getattr(self, f.name)
+            if isinstance(val, list):
+                val = ",".join(str(v) for v in val)
+            out[f.name] = str(val)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# CLI building
+# ---------------------------------------------------------------------------
+
+HELP_CATEGORIES = {
+    "help": "essential",
+    "help-multi": "multi",
+    "help-large": "large",
+    "help-dist": "dist",
+    "help-s3": "s3",
+    "help-tpu": "tpu",
+    "help-all": None,  # all categories
+}
+
+
+def build_arg_parser():
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="elbencho-tpu", add_help=False,
+        description="TPU-native distributed storage benchmark "
+                    "(files, block devices, object storage; HBM data path)")
+    parser.add_argument("paths", nargs="*", help="Benchmark paths "
+                        "(dirs, files, block devices, or s3:// buckets)")
+    for hf in HELP_CATEGORIES:
+        names = [f"--{hf}"] + (["-h"] if hf == "help" else [])
+        parser.add_argument(*names, action="store_true",
+                            dest=hf.replace("-", "_"),
+                            help=argparse.SUPPRESS)
+    parser.add_argument("--version", action="store_true",
+                        help="Show version and build info")
+    for flag, short, dest, kind, default, _cat, help_txt in FLAG_DEFS:
+        names = [f"--{flag}"] + ([f"-{short}"] if short else [])
+        if kind == "bool":
+            parser.add_argument(*names, dest=dest, action="store_true",
+                                default=default, help=help_txt)
+        else:
+            parser.add_argument(*names, dest=dest, metavar="V",
+                                type=_KIND_PARSERS[kind], default=default,
+                                help=help_txt)
+    return parser
+
+
+def _apply_config_file(cfg_path: str, namespace, parser) -> None:
+    """ini-style "flag = value" config file merge (reference: --configfile,
+    docs/example_configuration/random-write.elbencho). CLI args win."""
+    import argparse
+    defaults = parser.parse_args([])
+    with open(cfg_path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith(("#", ";", "[")):
+                continue
+            key, _, val = line.partition("=")
+            key, val = key.strip(), val.strip()
+            match = next((fd for fd in FLAG_DEFS if fd[0] == key), None)
+            if match is None:
+                raise ConfigError(f"unknown flag in config file: {key!r}")
+            _, _, dest, kind, _, _, _ = match
+            # only apply if user did not override on the CLI
+            if getattr(namespace, dest) != getattr(defaults, dest):
+                continue
+            if kind == "bool":
+                parsed = val.lower() not in ("0", "false", "no", "")
+            else:
+                parsed = _KIND_PARSERS[kind](val)
+            setattr(namespace, dest, parsed)
+
+
+def parse_cli(argv: "list[str] | None" = None) -> "tuple[BenchConfig, object]":
+    """Parse CLI into (BenchConfig, raw_namespace). Help/version handling is
+    the caller's job (cli.py) so it can render tiered help."""
+    parser = build_arg_parser()
+    ns = parser.parse_args(argv)
+    if ns.config_file_path:
+        _apply_config_file(ns.config_file_path, ns, parser)
+    field_names = {f.name for f in dataclasses.fields(BenchConfig)}
+    kwargs = {k: v for k, v in vars(ns).items() if k in field_names}
+    cfg = BenchConfig(**kwargs)
+    return cfg, ns

@@ -1,0 +1,40 @@
+"""CPU utilization from /proc/stat deltas between update() calls.
+
+Reference: source/CPUUtil.{h,cpp} (CPUUtil.h:14-46). Used to bracket each
+benchmark phase (stonewall + last-done snapshots, WorkersSharedData.h:57-58)
+and for live ``--cpu`` display.
+"""
+
+from __future__ import annotations
+
+
+class CPUUtil:
+    def __init__(self):
+        self._last_busy = 0
+        self._last_total = 0
+        self._current_pct = 0.0
+
+    @staticmethod
+    def _read_proc_stat() -> "tuple[int, int]":
+        try:
+            with open("/proc/stat", "r") as f:
+                fields = f.readline().split()[1:]
+            vals = [int(v) for v in fields]
+        except (OSError, ValueError, IndexError):
+            return (0, 0)
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0)  # idle + iowait
+        total = sum(vals)
+        return (total - idle, total)
+
+    def update(self) -> float:
+        """Refresh utilization percentage from the delta since last update."""
+        busy, total = self._read_proc_stat()
+        d_busy = busy - self._last_busy
+        d_total = total - self._last_total
+        self._last_busy, self._last_total = busy, total
+        self._current_pct = (100.0 * d_busy / d_total) if d_total > 0 else 0.0
+        return self._current_pct
+
+    @property
+    def percent(self) -> float:
+        return self._current_pct

@@ -1,0 +1,66 @@
+"""Leveled logger with static, thread-safe error history.
+
+Reference: source/Logger.{h,cpp} — level-filtered timestamped console
+streams plus a process-wide error history that service instances replay to
+the master over the prep protocol (XFER_PREP_ERRORHISTORY; Logger.h:33-161).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+LOG_NORMAL = 0
+LOG_VERBOSE = 1
+LOG_DEBUG = 2
+
+_LEVEL_NAMES = {LOG_NORMAL: "NORMAL", LOG_VERBOSE: "VERBOSE", LOG_DEBUG: "DEBUG"}
+
+_lock = threading.Lock()
+_log_level = LOG_NORMAL
+_error_history: "list[str]" = []
+_error_history_enabled = False
+
+
+def set_log_level(level: int) -> None:
+    global _log_level
+    _log_level = int(level)
+
+
+def get_log_level() -> int:
+    return _log_level
+
+
+def enable_error_history(enabled: bool = True) -> None:
+    """Services keep error history for replay to the master."""
+    global _error_history_enabled
+    _error_history_enabled = enabled
+
+
+def log(level: int, message: str, *, stream=None) -> None:
+    if level > _log_level:
+        return
+    ts = time.strftime("%Y-%m-%d %H:%M:%S")
+    out = stream or sys.stdout
+    with _lock:
+        print(f"{ts} {message}", file=out, flush=True)
+
+
+def log_error(message: str) -> None:
+    ts = time.strftime("%Y-%m-%d %H:%M:%S")
+    line = f"{ts} ERROR: {message}"
+    with _lock:
+        print(line, file=sys.stderr, flush=True)
+        if _error_history_enabled:
+            _error_history.append(line)
+
+
+def get_error_history() -> "list[str]":
+    with _lock:
+        return list(_error_history)
+
+
+def clear_error_history() -> None:
+    with _lock:
+        _error_history.clear()

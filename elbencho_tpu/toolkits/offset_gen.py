@@ -1,0 +1,231 @@
+"""Offset generators for the block I/O hot loops.
+
+Reference: source/toolkits/offsetgen/OffsetGenerator.h (Sequential :48,
+ReverseSeq :106, Random :185, RandomAligned :252, Strided :323) and
+OffsetGenRandomAlignedFullCoverageV2.h (LCG permutation over block indices,
+power-of-2 modulus — the default for aligned random *writes* so every block
+is hit exactly once; LocalWorker.cpp:1177-1184).
+
+Interface: each generator yields (offset, length) pairs via next_block();
+returns None when the configured amount of bytes has been generated.
+"""
+
+from __future__ import annotations
+
+from .random_algos import RandAlgo
+
+
+class OffsetGenerator:
+    def next_block(self) -> "tuple[int, int] | None":
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        while True:
+            blk = self.next_block()
+            if blk is None:
+                return
+            yield blk
+
+
+class OffsetGenSequential(OffsetGenerator):
+    """Forward sequential over [start, start+num_bytes); final block may be
+    short (reference: OffsetGenerator.h:48-104)."""
+
+    def __init__(self, num_bytes: int, block_size: int, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.start = start
+        self.reset()
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def next_block(self):
+        if self._pos >= self.num_bytes:
+            return None
+        length = min(self.block_size, self.num_bytes - self._pos)
+        off = self.start + self._pos
+        self._pos += length
+        return (off, length)
+
+
+class OffsetGenReverseSeq(OffsetGenerator):
+    """Backward sequential (``--backward``): last block first
+    (reference: OffsetGenerator.h:106-183)."""
+
+    def __init__(self, num_bytes: int, block_size: int, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.start = start
+        self.reset()
+
+    def reset(self) -> None:
+        self._bytes_left = self.num_bytes
+        # first (i.e. last-in-file) block absorbs the remainder
+        rem = self.num_bytes % self.block_size
+        self._next_len = rem if rem else min(self.block_size, self.num_bytes)
+
+    def next_block(self):
+        if self._bytes_left <= 0:
+            return None
+        length = self._next_len
+        self._bytes_left -= length
+        off = self.start + self._bytes_left
+        self._next_len = min(self.block_size, self._bytes_left)
+        return (off, length)
+
+
+class OffsetGenRandom(OffsetGenerator):
+    """Unaligned uniform-random offsets; generates ``num_bytes`` total over a
+    range of ``range_len`` bytes (reference: OffsetGenerator.h:185-250)."""
+
+    def __init__(self, rand: RandAlgo, num_bytes: int, block_size: int,
+                 range_len: int, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        if range_len < block_size:
+            raise ValueError("range smaller than block size")
+        self.rand = rand
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.range_len = range_len
+        self.start = start
+        self.reset()
+
+    def reset(self) -> None:
+        self._bytes_left = self.num_bytes
+
+    def next_block(self):
+        if self._bytes_left <= 0:
+            return None
+        length = min(self.block_size, self._bytes_left)
+        max_off = self.range_len - length
+        off = self.start + (self.rand.next64() % (max_off + 1) if max_off else 0)
+        self._bytes_left -= length
+        return (off, length)
+
+
+class OffsetGenRandomAligned(OffsetGenerator):
+    """Block-aligned uniform-random offsets (may repeat/miss blocks)
+    (reference: OffsetGenerator.h:252-321)."""
+
+    def __init__(self, rand: RandAlgo, num_bytes: int, block_size: int,
+                 range_len: int, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        if range_len < block_size:
+            raise ValueError("range smaller than block size")
+        self.rand = rand
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.num_blocks_in_range = range_len // block_size
+        self.start = start
+        self.reset()
+
+    def reset(self) -> None:
+        self._bytes_left = self.num_bytes
+
+    def next_block(self):
+        if self._bytes_left <= 0:
+            return None
+        length = min(self.block_size, self._bytes_left)
+        blk = self.rand.next64() % self.num_blocks_in_range
+        self._bytes_left -= length
+        return (self.start + blk * self.block_size, length)
+
+
+class OffsetGenRandomAlignedFullCoverage(OffsetGenerator):
+    """Aligned random permutation hitting every block exactly once.
+
+    Uses an LCG with power-of-2 modulus m >= num_blocks; with c odd and
+    a % 4 == 1 the LCG is full-period (Hull-Dobell), so iterating it visits
+    every value in [0, m) exactly once; values >= num_blocks are skipped.
+    This mirrors the reference's OffsetGenRandomAlignedFullCoverageV2.h:9-100
+    (default generator for aligned random writes) without sharing its
+    constants.
+    """
+
+    def __init__(self, rand: RandAlgo, num_bytes: int, block_size: int,
+                 range_len: int, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.num_blocks = max(1, range_len // block_size)
+        self.start = start
+        # power-of-2 modulus >= num_blocks
+        self._m = 1
+        while self._m < self.num_blocks:
+            self._m <<= 1
+        self._mask = self._m - 1
+        # full-period LCG params derived from the PRNG (Hull-Dobell for m=2^k)
+        self._a = ((rand.next64() << 2) | 1) & self._mask
+        if self._a % 4 != 1:
+            self._a = (self._a + 2) & self._mask  # force a % 4 == 1
+        if self._m >= 4 and self._a % 4 != 1:
+            self._a = 5
+        self._c = (rand.next64() | 1) & self._mask  # odd
+        self._x0 = rand.next64() & self._mask
+        self.reset()
+
+    def reset(self) -> None:
+        self._bytes_left = self.num_bytes
+        self._x = self._x0
+        self._emitted = 0
+
+    def next_block(self):
+        if self._bytes_left <= 0:
+            return None
+        # advance LCG until a value < num_blocks appears (wraps if generator
+        # asked for more than one full coverage)
+        while True:
+            if self._emitted >= self._m:  # completed a full period: restart
+                self._emitted = 0
+            self._x = (self._a * self._x + self._c) & self._mask
+            self._emitted += 1
+            if self._x < self.num_blocks:
+                break
+        length = min(self.block_size, self._bytes_left)
+        self._bytes_left -= length
+        return (self.start + self._x * self.block_size, length)
+
+
+class OffsetGenStrided(OffsetGenerator):
+    """Strided access for shared files (``--strided``): worker ``rank`` starts
+    at rank*block_size and advances by block_size*num_dataset_threads
+    (reference: OffsetGenerator.h:323-378; SURVEY.md section 2.4)."""
+
+    def __init__(self, num_bytes: int, block_size: int, rank: int,
+                 num_dataset_threads: int, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.rank = rank
+        self.stride = block_size * num_dataset_threads
+        self.start = start
+        self.reset()
+
+    def reset(self) -> None:
+        self._bytes_done = 0
+        self._off = self.start + self.rank * self.block_size
+
+    def next_block(self):
+        if self._bytes_done >= self.num_bytes:
+            return None
+        length = min(self.block_size, self.num_bytes - self._bytes_done)
+        off = self._off
+        self._off += self.stride
+        self._bytes_done += length
+        return (off, length)
+
+
+def num_blocks_for(num_bytes: int, block_size: int) -> int:
+    return (num_bytes + block_size - 1) // block_size

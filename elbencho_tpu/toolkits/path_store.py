@@ -1,0 +1,209 @@
+"""PathStore: custom-tree file lists and worker sublist math.
+
+Reference: source/PathStore.{h,cpp} — treefile parsing ("d <path>" dir lines,
+"f <size> <path>" file lines, '#' comments, optional "# encoding=base64"
+header so names with newlines survive, PathStore.h:12-16), sorting, shuffle,
+and the worker sublist computations: non-shared (whole files round-robin by
+aggregate size), shared (block-granular slices of large files), and
+shared-round-robin (--treeroundrob). The --sharesize threshold splits files
+into a shared set (sliced by blocks) and non-shared set (PathStore.h:107-112).
+"""
+
+from __future__ import annotations
+
+import base64
+import random
+from dataclasses import dataclass, field
+
+TREEFILE_COMMENT_CHAR = "#"
+TREEFILE_BASE64_HEADER = "# encoding=base64"
+DIR_LINE_PREFIX = "d"
+FILE_LINE_PREFIX = "f"
+
+
+@dataclass
+class PathStoreElem:
+    path: str
+    total_len: int = 0      # total size of the file/object
+    range_start: int = 0    # slice offset (shared files)
+    range_len: int = 0      # slice length (shared files)
+
+
+@dataclass
+class PathStore:
+    elems: "list[PathStoreElem]" = field(default_factory=list)
+    block_size: int = 1
+
+    # -- loading ------------------------------------------------------------
+
+    @staticmethod
+    def _treefile_is_base64(text: str) -> bool:
+        for line in text.splitlines():
+            if line.startswith(TREEFILE_BASE64_HEADER):
+                return True
+            if line and not line.startswith(TREEFILE_COMMENT_CHAR):
+                break
+        return False
+
+    @classmethod
+    def _decode_name(cls, name: str, is_b64: bool) -> str:
+        if not is_b64:
+            return name
+        return base64.b64decode(name).decode("utf-8", errors="surrogateescape")
+
+    def load_dirs_from_text(self, text: str) -> None:
+        """Parse "d <relative_path>" lines; others ignored
+        (reference: PathStore.cpp:27-80)."""
+        is_b64 = self._treefile_is_base64(text)
+        for line in text.splitlines():
+            parts = line.split(maxsplit=1)
+            if len(parts) != 2 or parts[0] != DIR_LINE_PREFIX:
+                continue
+            self.elems.append(PathStoreElem(self._decode_name(parts[1], is_b64)))
+
+    def load_files_from_text(self, text: str, min_size: int = 0,
+                             max_size: "int | None" = None,
+                             round_up_size: int = 0) -> None:
+        """Parse "f <size_in_bytes> <relative_path>" lines with size filter
+        and optional round-up (reference: PathStore.cpp:85-170)."""
+        is_b64 = self._treefile_is_base64(text)
+        for line in text.splitlines():
+            parts = line.split(maxsplit=2)
+            if len(parts) != 3 or parts[0] != FILE_LINE_PREFIX:
+                continue
+            size = int(parts[1])
+            if size < min_size or (max_size is not None and size > max_size):
+                continue
+            if round_up_size and size % round_up_size:
+                size += round_up_size - (size % round_up_size)
+            self.elems.append(PathStoreElem(
+                self._decode_name(parts[2], is_b64), total_len=size,
+                range_start=0, range_len=size))
+
+    def load_dirs_from_file(self, path: str) -> None:
+        with open(path, "r", encoding="utf-8", errors="surrogateescape") as f:
+            self.load_dirs_from_text(f.read())
+
+    def load_files_from_file(self, path: str, min_size: int = 0,
+                             max_size: "int | None" = None,
+                             round_up_size: int = 0) -> None:
+        with open(path, "r", encoding="utf-8", errors="surrogateescape") as f:
+            self.load_files_from_text(f.read(), min_size, max_size, round_up_size)
+
+    @staticmethod
+    def generate_file_line(path: str, file_size: int) -> str:
+        return f"{FILE_LINE_PREFIX} {file_size} {path}"
+
+    @staticmethod
+    def generate_dir_line(path: str) -> str:
+        return f"{DIR_LINE_PREFIX} {path}"
+
+    # -- ordering -----------------------------------------------------------
+
+    def sort_by_path_len(self) -> None:
+        self.elems.sort(key=lambda e: (len(e.path), e.path))
+
+    def sort_by_file_size(self) -> None:
+        self.elems.sort(key=lambda e: (e.total_len, e.path))
+
+    def random_shuffle(self, seed: "int | None" = None) -> None:
+        random.Random(seed).shuffle(self.elems)
+
+    # -- worker sublists (SURVEY.md section 2.4 "custom-tree sharding") ------
+
+    def get_worker_sublist_non_shared(self, worker_rank: int,
+                                      num_dataset_threads: int) -> "PathStore":
+        """Whole files distributed by greedy least-loaded assignment with a
+        deterministic tie-break, so every worker gets a near-equal byte share
+        (reference: getWorkerSublistNonShared, PathStore.h:53)."""
+        loads = [0] * num_dataset_threads
+        out = PathStore(block_size=self.block_size)
+        # deterministic: process big files first for balance
+        order = sorted(range(len(self.elems)),
+                       key=lambda i: (-self.elems[i].total_len, i))
+        for i in order:
+            tgt = min(range(num_dataset_threads), key=lambda r: (loads[r], r))
+            loads[tgt] += max(self.elems[i].total_len, 1)
+            if tgt == worker_rank:
+                out.elems.append(self.elems[i])
+        # keep stable original ordering within the worker's share
+        out.elems.sort(key=lambda e: e.path)
+        return out
+
+    def get_worker_sublist_shared(self, worker_rank: int,
+                                  num_dataset_threads: int) -> "PathStore":
+        """Block-granular contiguous slices: the store's total block count is
+        divided evenly; each worker receives a contiguous run of blocks which
+        maps to (possibly partial) per-file ranges
+        (reference: getWorkerSublistShared, PathStore.h:55)."""
+        bs = self.block_size
+        file_blocks = [max(1, (e.total_len + bs - 1) // bs) for e in self.elems]
+        total_blocks = sum(file_blocks)
+        base, rem = divmod(total_blocks, num_dataset_threads)
+        start_block = worker_rank * base + min(worker_rank, rem)
+        my_blocks = base + (1 if worker_rank < rem else 0)
+        end_block = start_block + my_blocks
+
+        out = PathStore(block_size=bs)
+        cursor = 0
+        for elem, nblocks in zip(self.elems, file_blocks):
+            file_start, file_end = cursor, cursor + nblocks
+            cursor = file_end
+            lo = max(start_block, file_start)
+            hi = min(end_block, file_end)
+            if lo >= hi:
+                continue
+            range_start = (lo - file_start) * bs
+            range_len = min((hi - lo) * bs, elem.total_len - range_start)
+            out.elems.append(PathStoreElem(elem.path, elem.total_len,
+                                           range_start, range_len))
+        return out
+
+    def get_worker_sublist_shared_round_robin(self, worker_rank: int,
+                                              num_dataset_threads: int
+                                              ) -> "PathStore":
+        """Round-robin block assignment (--treeroundrob): worker takes every
+        num_dataset_threads-th block. Represented as per-file strided ranges;
+        consumers use OffsetGenStrided over each file's local block index
+        (reference: getWorkerSublistSharedRoundRobin, PathStore.h:57)."""
+        bs = self.block_size
+        out = PathStore(block_size=bs)
+        global_block = 0
+        for elem in self.elems:
+            nblocks = max(1, (elem.total_len + bs - 1) // bs)
+            # blocks of this file whose global index % threads == rank
+            first = None
+            count = 0
+            for b in range(nblocks):
+                if (global_block + b) % num_dataset_threads == worker_rank:
+                    if first is None:
+                        first = b
+                    count += 1
+            global_block += nblocks
+            if first is None:
+                continue
+            range_start = first * bs
+            range_len = min(count * bs, elem.total_len - range_start)
+            out.elems.append(PathStoreElem(elem.path, elem.total_len,
+                                           range_start, range_len))
+        return out
+
+    # -- misc ---------------------------------------------------------------
+
+    def split_by_share_size(self, share_size: int
+                            ) -> "tuple[PathStore, PathStore]":
+        """(non_shared, shared): files >= share_size go to the shared
+        (block-sliced) set (reference: --sharesize, PathStore.h:107-112)."""
+        non_shared = PathStore(block_size=self.block_size)
+        shared = PathStore(block_size=self.block_size)
+        for e in self.elems:
+            (shared if e.total_len >= share_size else non_shared).elems.append(e)
+        return non_shared, shared
+
+    @property
+    def num_paths(self) -> int:
+        return len(self.elems)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(e.range_len or e.total_len for e in self.elems)

@@ -1,0 +1,198 @@
+"""PRNG quartet (reference: source/toolkits/random/RandAlgo*.h).
+
+User-selectable random generators, same tiers as the reference
+(RandAlgoSelectorTk.h:12-24):
+  strong           - MT19937 (RandAlgoMT19937.h)
+  balanced_single  - xoshiro256** (RandAlgoXoshiro256ss.h)
+  balanced         - xoshiro256++ N-way (RandAlgoXoshiro256ppSIMD.h); here the
+                     vectorization is numpy-based for buffer fills
+  fast             - golden-prime multiplicative (RandAlgoGoldenPrime.h:
+                     multiply-shift, reseeds every 256 KiB of output)
+
+Used for ``--randalgo`` (offset generation) and ``--blockvaralgo`` (buffer
+refill / block variance). The hot-path buffer fills go through
+``fill_buffer``, which uses numpy vectorization; the C++ ioengine has its own
+native implementations of the same algorithms.
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+_MASK64 = (1 << 64) - 1
+
+# golden-ratio prime multiplier (fast/weak tier). The generator emits
+# value*=prime; out = value rotated, and reseeds every 256 KiB like the
+# reference's RandAlgoGoldenPrime.h.
+_GOLDEN_PRIME = 0x9E3779B97F4A7C15
+_GOLDEN_RESEED_BYTES = 256 * 1024
+
+
+class RandAlgo:
+    """Interface: next64() -> int in [0, 2^64); fill_buffer(n) -> bytes."""
+
+    name = "base"
+
+    def next64(self) -> int:
+        raise NotImplementedError
+
+    def next_in_range(self, lo: int, hi: int) -> int:
+        """Uniform value in [lo, hi] (inclusive), like RandAlgoRange.h."""
+        span = hi - lo + 1
+        return lo + (self.next64() % span)
+
+    def fill_buffer(self, num_bytes: int) -> bytes:
+        out = bytearray()
+        while len(out) < num_bytes:
+            out += self.next64().to_bytes(8, "little")
+        return bytes(out[:num_bytes])
+
+
+class RandAlgoMT19937(RandAlgo):
+    """'strong' tier: Mersenne Twister."""
+
+    name = "strong"
+
+    def __init__(self, seed: int | None = None):
+        self._rng = _pyrandom.Random(seed)
+
+    def next64(self) -> int:
+        return self._rng.getrandbits(64)
+
+    def fill_buffer(self, num_bytes: int) -> bytes:
+        return self._rng.randbytes(num_bytes)
+
+
+def _rotl(x: int, k: int) -> int:
+    return ((x << k) | (x >> (64 - k))) & _MASK64
+
+
+def _splitmix64_stream(seed: int, n: int) -> "list[int]":
+    out = []
+    state = seed & _MASK64
+    for _ in range(n):
+        state = (state + 0x9E3779B97F4A7C15) & _MASK64
+        z = state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+        out.append(z ^ (z >> 31))
+    return out
+
+
+class RandAlgoXoshiro256ss(RandAlgo):
+    """'balanced_single' tier: xoshiro256** scalar."""
+
+    name = "balanced_single"
+
+    def __init__(self, seed: int | None = None):
+        if seed is None:
+            seed = _pyrandom.getrandbits(64)
+        self._s = _splitmix64_stream(seed, 4)
+
+    def next64(self) -> int:
+        s = self._s
+        result = (_rotl((s[1] * 5) & _MASK64, 7) * 9) & _MASK64
+        t = (s[1] << 17) & _MASK64
+        s[2] ^= s[0]
+        s[3] ^= s[1]
+        s[1] ^= s[2]
+        s[0] ^= s[3]
+        s[2] ^= t
+        s[3] = _rotl(s[3], 45)
+        return result
+
+
+class RandAlgoXoshiro256pp(RandAlgo):
+    """'balanced' tier: xoshiro256++; fill_buffer is vectorized via numpy
+    (the reference vectorizes N lanes with compiler auto-vectorization,
+    RandAlgoXoshiro256ppSIMD.h / Makefile:72-77)."""
+
+    name = "balanced"
+    LANES = 8
+
+    def __init__(self, seed: int | None = None):
+        if seed is None:
+            seed = _pyrandom.getrandbits(64)
+        states = _splitmix64_stream(seed, 4 * self.LANES)
+        self._s = np.array(states, dtype=np.uint64).reshape(4, self.LANES)
+        self._scalar = RandAlgoXoshiro256ss(seed)
+
+    def next64(self) -> int:
+        return self._scalar.next64()
+
+    def _next_vec(self) -> np.ndarray:
+        s = self._s
+        with np.errstate(over="ignore"):
+            tot = s[0] + s[3]
+            result = ((tot << np.uint64(23)) | (tot >> np.uint64(41))) + s[0]
+            t = s[1] << np.uint64(17)
+            s[2] ^= s[0]
+            s[3] ^= s[1]
+            s[1] ^= s[2]
+            s[0] ^= s[3]
+            s[2] ^= t
+            s[3] = (s[3] << np.uint64(45)) | (s[3] >> np.uint64(19))
+        return result
+
+    def fill_buffer(self, num_bytes: int) -> bytes:
+        n_vecs = (num_bytes + 8 * self.LANES - 1) // (8 * self.LANES)
+        chunks = np.empty((n_vecs, self.LANES), dtype=np.uint64)
+        for i in range(n_vecs):
+            chunks[i] = self._next_vec()
+        return chunks.tobytes()[:num_bytes]
+
+
+class RandAlgoGoldenPrime(RandAlgo):
+    """'fast' tier: golden-prime multiplicative generator; weak randomness,
+    reseeds from the strong generator every 256 KiB of generated data
+    (reference: RandAlgoGoldenPrime.h:14-40)."""
+
+    name = "fast"
+
+    def __init__(self, seed: int | None = None):
+        self._reseed_src = RandAlgoMT19937(seed)
+        self._state = self._reseed_src.next64() | 1
+        self._bytes_since_reseed = 0
+
+    def next64(self) -> int:
+        self._bytes_since_reseed += 8
+        if self._bytes_since_reseed >= _GOLDEN_RESEED_BYTES:
+            self._state = self._reseed_src.next64() | 1
+            self._bytes_since_reseed = 0
+        self._state = (self._state * _GOLDEN_PRIME) & _MASK64
+        return _rotl(self._state, 32)
+
+    def fill_buffer(self, num_bytes: int) -> bytes:
+        n = (num_bytes + 7) // 8
+        out = np.empty(n, dtype=np.uint64)
+        state = np.uint64(self._state)
+        prime = np.uint64(_GOLDEN_PRIME)
+        with np.errstate(over="ignore"):
+            for i in range(n):
+                state = state * prime
+                out[i] = (state << np.uint64(32)) | (state >> np.uint64(32))
+        self._state = int(state)
+        self._bytes_since_reseed += n * 8
+        if self._bytes_since_reseed >= _GOLDEN_RESEED_BYTES:
+            self._state = self._reseed_src.next64() | 1
+            self._bytes_since_reseed = 0
+        return out.tobytes()[:num_bytes]
+
+
+RAND_ALGO_NAMES = ("strong", "balanced_single", "balanced", "fast")
+
+
+def create_rand_algo(name: str, seed: int | None = None) -> RandAlgo:
+    """Factory, like RandAlgoSelectorTk::stringToAlgo."""
+    table = {
+        "strong": RandAlgoMT19937,
+        "balanced_single": RandAlgoXoshiro256ss,
+        "balanced": RandAlgoXoshiro256pp,
+        "fast": RandAlgoGoldenPrime,
+    }
+    if name not in table:
+        raise ValueError(f"unknown random algorithm: {name!r} "
+                         f"(choose from {', '.join(RAND_ALGO_NAMES)})")
+    return table[name](seed)

@@ -1,0 +1,96 @@
+"""Rate limiting toolkits.
+
+Reference: source/toolkits/RateLimiter.h (per-thread bytes/sec with
+sleep-to-second-boundary) and RateLimiterRWMixThreads.{h,cpp} (process-wide
+read/write byte-ratio balancer for --rwmixthrpct with headroom + condvars).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class RateLimiter:
+    """Per-thread bytes-per-second limiter (reference: RateLimiter.h:1-72).
+
+    Tokens refill once per wall-clock second; wait() blocks until the block's
+    bytes fit in the current second's budget.
+    """
+
+    def __init__(self, bytes_per_sec: int):
+        self.bytes_per_sec = bytes_per_sec
+        self._window_start = time.monotonic()
+        self._bytes_in_window = 0
+
+    def wait(self, num_bytes: int) -> None:
+        if self.bytes_per_sec <= 0:
+            return
+        now = time.monotonic()
+        elapsed = now - self._window_start
+        if elapsed >= 1.0:
+            self._window_start = now
+            self._bytes_in_window = 0
+        elif self._bytes_in_window + num_bytes > self.bytes_per_sec:
+            # sleep to the next second boundary, then open a fresh window
+            time.sleep(max(0.0, 1.0 - elapsed))
+            self._window_start = time.monotonic()
+            self._bytes_in_window = 0
+        self._bytes_in_window += num_bytes
+
+
+class RateLimiterRWMixThreads:
+    """Keeps the read:write *byte ratio* of a mixed-threads phase near the
+    requested percentage (``--rwmixthrpct``).
+
+    Process-wide shared counters (the reference uses static atomics +
+    condvars, RateLimiterRWMixThreads.h:22-200): readers wait while reads are
+    ahead of the target ratio beyond a headroom allowance, writers wait in
+    the symmetric case. Waiters are woken whenever the other side makes
+    progress.
+    """
+
+    _HEADROOM_BYTES = 16 * 1024 * 1024
+
+    def __init__(self, read_pct: int):
+        if not 0 < read_pct < 100:
+            raise ValueError("read percentage must be in (0, 100)")
+        self.read_pct = read_pct
+        self._lock = threading.Condition()
+        self._read_bytes = 0
+        self._write_bytes = 0
+        self._interrupted = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._read_bytes = 0
+            self._write_bytes = 0
+            self._interrupted = False
+
+    def interrupt(self) -> None:
+        with self._lock:
+            self._interrupted = True
+            self._lock.notify_all()
+
+    def _read_target(self) -> int:
+        total = self._read_bytes + self._write_bytes
+        return int(total * self.read_pct / 100)
+
+    def wait_read(self, num_bytes: int, timeout: float = 0.5) -> None:
+        with self._lock:
+            while (not self._interrupted
+                   and self._read_bytes > self._read_target() + self._HEADROOM_BYTES):
+                if not self._lock.wait(timeout):
+                    break
+            self._read_bytes += num_bytes
+            self._lock.notify_all()
+
+    def wait_write(self, num_bytes: int, timeout: float = 0.5) -> None:
+        with self._lock:
+            while (not self._interrupted
+                   and self._write_bytes > (self._read_bytes + self._write_bytes
+                                            - self._read_target()) + self._HEADROOM_BYTES):
+                if not self._lock.wait(timeout):
+                    break
+            self._write_bytes += num_bytes
+            self._lock.notify_all()

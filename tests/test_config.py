@@ -1,0 +1,132 @@
+import pytest
+
+from elbencho_tpu.config import BenchConfig, ConfigError
+from elbencho_tpu.config.args import parse_cli
+from elbencho_tpu.phases import BenchMode, BenchPathType, BenchPhase
+
+
+def test_parse_basic_cli(tmp_path):
+    cfg, _ = parse_cli(["-w", "-r", "-t", "4", "-b", "1M", "-s", "10g",
+                        str(tmp_path)])
+    cfg.derive()
+    cfg.check()
+    assert cfg.run_create_files and cfg.run_read_files
+    assert cfg.num_threads == 4
+    assert cfg.block_size == 1 << 20
+    assert cfg.file_size == 10 << 30
+    assert cfg.bench_mode == BenchMode.POSIX
+    assert cfg.bench_path_type == BenchPathType.DIR
+
+
+def test_phase_ordering(tmp_path):
+    cfg, _ = parse_cli(["-w", "-r", "-d", "-D", "-F", "--stat",
+                        str(tmp_path)])
+    cfg.derive()
+    phases = cfg.enabled_phases()
+    assert phases == [BenchPhase.CREATEDIRS, BenchPhase.CREATEFILES,
+                      BenchPhase.STATFILES, BenchPhase.READFILES,
+                      BenchPhase.DELETEFILES, BenchPhase.DELETEDIRS]
+
+
+def test_path_type_detection(tmp_path):
+    f = tmp_path / "file.bin"
+    f.write_bytes(b"x")
+    cfg, _ = parse_cli(["-r", str(f)])
+    cfg.derive()
+    assert cfg.bench_path_type == BenchPathType.FILE
+
+    cfg2, _ = parse_cli(["-r", str(tmp_path)])
+    cfg2.derive()
+    assert cfg2.bench_path_type == BenchPathType.DIR
+
+
+def test_mixed_path_types_rejected(tmp_path):
+    f = tmp_path / "file.bin"
+    f.write_bytes(b"x")
+    cfg, _ = parse_cli(["-r", str(f), str(tmp_path)])
+    with pytest.raises(ConfigError):
+        cfg.derive()
+
+
+def test_s3_mode_from_prefix():
+    cfg, _ = parse_cli(["-w", "s3://mybucket"])
+    cfg.derive(probe_paths=False)
+    assert cfg.bench_mode == BenchMode.S3
+    assert cfg.paths == ["mybucket"]
+
+
+def test_dataset_threads_with_hosts():
+    cfg, _ = parse_cli(["-w", "--hosts", "h1,h2,h3", "-t", "4", "/tmp"])
+    cfg.derive(probe_paths=False)
+    assert cfg.hosts == ["h1", "h2", "h3"]
+    assert cfg.num_dataset_threads == 12
+
+    cfg2, _ = parse_cli(["-w", "--hosts", "h1,h2", "--nosvcshare", "-t", "4",
+                         "/tmp"])
+    cfg2.derive(probe_paths=False)
+    assert cfg2.num_dataset_threads == 4
+
+
+def test_numhosts_limit():
+    cfg, _ = parse_cli(["-w", "--hosts", "a,b,c,d", "--numhosts", "2", "/t"])
+    cfg.derive(probe_paths=False)
+    assert cfg.hosts == ["a", "b"]
+
+
+def test_direct_io_alignment_check():
+    cfg, _ = parse_cli(["-w", "--direct", "-s", "1000", "-b", "100", "/t"])
+    cfg.derive(probe_paths=False)
+    with pytest.raises(ConfigError):
+        cfg.check()
+    cfg2, _ = parse_cli(["-w", "--direct", "-s", "1M", "-b", "4K", "/t"])
+    cfg2.derive(probe_paths=False)
+    cfg2.check()  # no raise
+
+
+def test_service_roundtrip():
+    cfg, _ = parse_cli(["-w", "-t", "3", "-s", "4K", "-b", "4K",
+                        "--tpuids", "0,1", "--hosts", "h1,h2", "/t"])
+    cfg.derive(probe_paths=False)
+    d = cfg.to_service_dict(service_rank_offset=3)
+    import json
+    d2 = json.loads(json.dumps(d))  # must be JSON-able
+    svc_cfg = BenchConfig.from_service_dict(d2)
+    assert svc_cfg.rank_offset == 3
+    assert svc_cfg.num_threads == 3
+    assert svc_cfg.tpu_ids == [0, 1]
+    assert svc_cfg.hosts == []  # services don't inherit the hosts list
+    # dataset threads survive via override (2 hosts x 3 threads)
+    assert svc_cfg.num_dataset_threads == 6
+
+
+def test_random_amount_default(tmp_path):
+    f = tmp_path / "x"
+    f.write_bytes(b"0" * 4096)
+    cfg, _ = parse_cli(["-r", "--rand", "-s", "1M", "-b", "4K", str(f)])
+    cfg.derive()
+    assert cfg.random_amount == 1 << 20
+
+
+def test_config_file_merge(tmp_path):
+    cfgfile = tmp_path / "bench.conf"
+    cfgfile.write_text("threads = 8\nblock = 64K\nwrite = true\n")
+    cfg, _ = parse_cli(["-c", str(cfgfile), "/t"])
+    assert cfg.num_threads == 8
+    assert cfg.block_size == 65536
+    assert cfg.run_create_files is True
+    # CLI overrides config file
+    cfg2, _ = parse_cli(["-c", str(cfgfile), "-t", "2", "/t"])
+    assert cfg2.num_threads == 2
+
+
+def test_tpu_ids_parsing():
+    cfg, _ = parse_cli(["-w", "--tpuids", "0,2,3", "/t"])
+    cfg.derive(probe_paths=False)
+    assert cfg.tpu_ids == [0, 2, 3]
+
+
+def test_mmap_direct_incompatible():
+    cfg, _ = parse_cli(["-w", "--mmap", "--direct", "-s", "1M", "/t"])
+    cfg.derive(probe_paths=False)
+    with pytest.raises(ConfigError):
+        cfg.check()

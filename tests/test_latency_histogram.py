@@ -1,0 +1,88 @@
+from elbencho_tpu.stats.latency_histogram import (
+    NUM_BUCKETS, LatencyHistogram, bucket_index, bucket_lower_bound)
+
+
+def test_bucket_index_monotonic():
+    last = -1
+    for v in [1, 2, 3, 5, 10, 100, 1000, 10 ** 6, 10 ** 8]:
+        idx = bucket_index(v)
+        assert idx >= last
+        last = idx
+    assert bucket_index(0.5) == 0
+    assert bucket_index(10 ** 12) == NUM_BUCKETS - 1
+
+
+def test_quarter_log2_resolution():
+    # 4 buckets per power of two
+    assert bucket_index(2) - bucket_index(1) == 4
+    assert bucket_index(1024) - bucket_index(512) == 4
+
+
+def test_min_avg_max():
+    h = LatencyHistogram()
+    for v in [10, 20, 30]:
+        h.add_latency(v)
+    assert h.min_micro == 10
+    assert h.max_micro == 30
+    assert h.avg_micro == 20
+    assert h.num_values == 3
+
+
+def test_percentiles():
+    h = LatencyHistogram()
+    for v in range(1, 1001):
+        h.add_latency(v)
+    p50 = h.percentile(50)
+    p99 = h.percentile(99)
+    assert p50 < p99
+    # bucket lower bound of p50 should be within a bucket of 500
+    assert 250 <= p50 <= 500
+    assert 500 <= p99 <= 1000
+
+
+def test_percentiles_nines():
+    h = LatencyHistogram()
+    for v in range(1, 10001):
+        h.add_latency(v)
+    nines = h.percentiles_nines(3)
+    assert set(nines) == {"p50", "p75", "p99", "p99.9"}
+    assert nines["p99"] <= nines["p99.9"]
+
+
+def test_merge():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    a.add_latency(5)
+    a.add_latency(100)
+    b.add_latency(1)
+    b.add_latency(1000)
+    a.merge(b)
+    assert a.num_values == 4
+    assert a.min_micro == 1
+    assert a.max_micro == 1000
+    assert a.sum_micro == 1106
+
+
+def test_merge_into_empty():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    b.add_latency(7)
+    a.merge(b)
+    assert a.min_micro == 7 and a.max_micro == 7
+
+
+def test_serialization_roundtrip():
+    h = LatencyHistogram()
+    for v in [3, 14, 159, 2653]:
+        h.add_latency(v)
+    d = h.to_dict()
+    h2 = LatencyHistogram.from_dict(d)
+    assert h2.num_values == h.num_values
+    assert h2.sum_micro == h.sum_micro
+    assert h2.min_micro == h.min_micro
+    assert h2.max_micro == h.max_micro
+    assert h2.buckets == h.buckets
+
+
+def test_bucket_lower_bound_inverse():
+    for idx in range(0, NUM_BUCKETS, 7):
+        v = bucket_lower_bound(idx)
+        assert bucket_index(v * 1.001) == idx

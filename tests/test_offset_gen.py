@@ -1,0 +1,86 @@
+import pytest
+
+from elbencho_tpu.toolkits.offset_gen import (
+    OffsetGenRandom, OffsetGenRandomAligned,
+    OffsetGenRandomAlignedFullCoverage, OffsetGenReverseSeq,
+    OffsetGenSequential, OffsetGenStrided)
+from elbencho_tpu.toolkits.random_algos import create_rand_algo
+
+
+def test_sequential_exact_blocks():
+    gen = OffsetGenSequential(num_bytes=8192, block_size=4096)
+    assert list(gen) == [(0, 4096), (4096, 4096)]
+
+
+def test_sequential_partial_tail():
+    gen = OffsetGenSequential(num_bytes=10000, block_size=4096)
+    blocks = list(gen)
+    assert blocks == [(0, 4096), (4096, 4096), (8192, 1808)]
+    assert sum(length for _, length in blocks) == 10000
+
+
+def test_sequential_with_start():
+    gen = OffsetGenSequential(num_bytes=4096, block_size=4096, start=1 << 20)
+    assert list(gen) == [(1 << 20, 4096)]
+
+
+def test_reverse_seq_covers_same_blocks():
+    fwd = list(OffsetGenSequential(10000, 4096))
+    rev = list(OffsetGenReverseSeq(10000, 4096))
+    assert sorted(rev) == sorted(fwd)
+    # first emitted block is the one at the end of the file
+    assert rev[0][0] > rev[-1][0]
+
+
+def test_random_unaligned_bounds():
+    rng = create_rand_algo("fast", seed=1)
+    gen = OffsetGenRandom(rng, num_bytes=1 << 20, block_size=4096,
+                          range_len=1 << 24)
+    total = 0
+    for off, length in gen:
+        assert 0 <= off <= (1 << 24) - length
+        total += length
+    assert total == 1 << 20
+
+
+def test_random_aligned_bounds():
+    rng = create_rand_algo("fast", seed=2)
+    gen = OffsetGenRandomAligned(rng, num_bytes=1 << 20, block_size=4096,
+                                 range_len=1 << 24)
+    for off, length in gen:
+        assert off % 4096 == 0
+        assert off + length <= 1 << 24
+
+
+@pytest.mark.parametrize("num_blocks", [1, 2, 5, 8, 64, 1000])
+def test_full_coverage_hits_every_block_once(num_blocks):
+    rng = create_rand_algo("balanced_single", seed=42)
+    bs = 4096
+    gen = OffsetGenRandomAlignedFullCoverage(
+        rng, num_bytes=num_blocks * bs, block_size=bs,
+        range_len=num_blocks * bs)
+    offsets = [off for off, _ in gen]
+    assert len(offsets) == num_blocks
+    assert sorted(offsets) == [i * bs for i in range(num_blocks)]
+
+
+def test_full_coverage_is_permuted():
+    rng = create_rand_algo("balanced_single", seed=43)
+    gen = OffsetGenRandomAlignedFullCoverage(
+        rng, num_bytes=256 * 4096, block_size=4096, range_len=256 * 4096)
+    offsets = [off for off, _ in gen]
+    assert offsets != sorted(offsets)  # actually shuffled
+
+
+def test_strided():
+    # 2 dataset threads, rank 1: offsets 4096, 12288, ... stride 8192
+    gen = OffsetGenStrided(num_bytes=3 * 4096, block_size=4096, rank=1,
+                           num_dataset_threads=2)
+    assert list(gen) == [(4096, 4096), (12288, 4096), (20480, 4096)]
+
+
+def test_reset_reproduces():
+    gen = OffsetGenSequential(8192, 4096)
+    first = list(gen)
+    gen.reset()
+    assert list(gen) == first

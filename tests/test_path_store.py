@@ -1,0 +1,108 @@
+import base64
+
+from elbencho_tpu.toolkits.path_store import PathStore
+
+TREE_TEXT = """# a comment
+d dir1
+d dir1/sub
+f 100 dir1/small.txt
+f 5000 dir1/sub/big.bin
+f 12288 shared.dat
+x ignored line
+"""
+
+
+def test_load_dirs():
+    ps = PathStore()
+    ps.load_dirs_from_text(TREE_TEXT)
+    assert [e.path for e in ps.elems] == ["dir1", "dir1/sub"]
+
+
+def test_load_files_with_filter_and_roundup():
+    ps = PathStore()
+    ps.load_files_from_text(TREE_TEXT)
+    assert [(e.path, e.total_len) for e in ps.elems] == [
+        ("dir1/small.txt", 100), ("dir1/sub/big.bin", 5000),
+        ("shared.dat", 12288)]
+
+    ps2 = PathStore()
+    ps2.load_files_from_text(TREE_TEXT, min_size=1000)
+    assert len(ps2.elems) == 2
+
+    ps3 = PathStore()
+    ps3.load_files_from_text(TREE_TEXT, round_up_size=4096)
+    assert ps3.elems[0].total_len == 4096
+    assert ps3.elems[1].total_len == 8192
+
+
+def test_base64_names():
+    name = "weird\nname.txt"
+    enc = base64.b64encode(name.encode()).decode()
+    text = f"# encoding=base64\nf 10 {enc}\n"
+    ps = PathStore()
+    ps.load_files_from_text(text)
+    assert ps.elems[0].path == name
+
+
+def test_non_shared_sublists_partition_everything():
+    ps = PathStore()
+    sizes = [100, 5000, 12288, 7, 90000, 4096]
+    for i, size in enumerate(sizes):
+        ps.load_files_from_text(f"f {size} file{i}\n")
+    nthreads = 3
+    seen = []
+    for rank in range(nthreads):
+        sub = ps.get_worker_sublist_non_shared(rank, nthreads)
+        seen += [e.path for e in sub.elems]
+    assert sorted(seen) == sorted(f"file{i}" for i in range(len(sizes)))
+
+
+def test_non_shared_sublists_balanced():
+    ps = PathStore()
+    for i in range(8):
+        ps.load_files_from_text(f"f 1000 f{i}\n")
+    loads = [ps.get_worker_sublist_non_shared(r, 4).total_bytes
+             for r in range(4)]
+    assert loads == [2000] * 4
+
+
+def test_shared_sublists_cover_all_blocks():
+    ps = PathStore(block_size=4096)
+    ps.load_files_from_text("f 12288 a\nf 8192 b\nf 4000 c\n")
+    nthreads = 2
+    covered = {}
+    for rank in range(nthreads):
+        sub = ps.get_worker_sublist_shared(rank, nthreads)
+        for e in sub.elems:
+            covered.setdefault(e.path, 0)
+            covered[e.path] += e.range_len
+    assert covered == {"a": 12288, "b": 8192, "c": 4000}
+
+
+def test_shared_round_robin_disjoint_and_complete():
+    ps = PathStore(block_size=4096)
+    ps.load_files_from_text("f 16384 a\nf 8192 b\n")
+    tot = 0
+    for rank in range(2):
+        sub = ps.get_worker_sublist_shared_round_robin(rank, 2)
+        tot += sum(e.range_len for e in sub.elems)
+    assert tot == 16384 + 8192
+
+
+def test_split_by_share_size():
+    ps = PathStore()
+    ps.load_files_from_text("f 100 small\nf 99999 big\n")
+    non_shared, shared = ps.split_by_share_size(4096)
+    assert [e.path for e in non_shared.elems] == ["small"]
+    assert [e.path for e in shared.elems] == ["big"]
+
+
+def test_sorts_and_line_generation():
+    ps = PathStore()
+    ps.load_files_from_text("f 500 bb\nf 100 a\n")
+    ps.sort_by_file_size()
+    assert ps.elems[0].path == "a"
+    ps.sort_by_path_len()
+    assert ps.elems[0].path == "a"
+    assert PathStore.generate_file_line("x", 5) == "f 5 x"
+    assert PathStore.generate_dir_line("y") == "d y"

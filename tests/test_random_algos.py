@@ -1,0 +1,40 @@
+import pytest
+
+from elbencho_tpu.toolkits.random_algos import (
+    RAND_ALGO_NAMES, create_rand_algo)
+
+
+@pytest.mark.parametrize("name", RAND_ALGO_NAMES)
+def test_next64_range_and_variety(name):
+    rng = create_rand_algo(name, seed=7)
+    vals = [rng.next64() for _ in range(100)]
+    assert all(0 <= v < (1 << 64) for v in vals)
+    assert len(set(vals)) > 90  # not constant / tiny cycle
+
+
+@pytest.mark.parametrize("name", RAND_ALGO_NAMES)
+def test_fill_buffer_len_and_entropy(name):
+    rng = create_rand_algo(name, seed=11)
+    buf = rng.fill_buffer(4096 + 3)
+    assert len(buf) == 4099
+    # rough entropy check: many distinct byte values
+    assert len(set(buf)) > 100
+
+
+@pytest.mark.parametrize("name", RAND_ALGO_NAMES)
+def test_deterministic_with_seed(name):
+    a = create_rand_algo(name, seed=5)
+    b = create_rand_algo(name, seed=5)
+    assert [a.next64() for _ in range(10)] == [b.next64() for _ in range(10)]
+
+
+def test_next_in_range():
+    rng = create_rand_algo("balanced_single", seed=3)
+    for _ in range(100):
+        v = rng.next_in_range(10, 20)
+        assert 10 <= v <= 20
+
+
+def test_unknown_algo():
+    with pytest.raises(ValueError):
+        create_rand_algo("nope")

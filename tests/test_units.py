@@ -1,0 +1,58 @@
+from elbencho_tpu.toolkits.units import (
+    UnitParseError, format_bytes, format_duration_secs, parse_size,
+    parse_uint_list)
+
+import pytest
+
+
+def test_parse_plain():
+    assert parse_size("0") == 0
+    assert parse_size("123") == 123
+    assert parse_size(42) == 42
+    assert parse_size(None) == 0
+
+
+def test_parse_base2_suffixes():
+    assert parse_size("4K") == 4096
+    assert parse_size("4k") == 4096
+    assert parse_size("1M") == 1 << 20
+    assert parse_size("10g") == 10 << 30
+    assert parse_size("2T") == 2 << 40
+    assert parse_size("1KiB") == 1024
+    assert parse_size("1MiB") == 1 << 20
+
+
+def test_parse_base10_suffixes():
+    assert parse_size("1KB") == 1000
+    assert parse_size("2MB") == 2_000_000
+    assert parse_size("3GB") == 3_000_000_000
+
+
+def test_parse_float():
+    assert parse_size("1.5K") == 1536
+    assert parse_size("0.5M") == 512 * 1024
+
+
+def test_parse_errors():
+    with pytest.raises(UnitParseError):
+        parse_size("12Q")
+    with pytest.raises(UnitParseError):
+        parse_size("abc")
+
+
+def test_format_bytes():
+    assert format_bytes(4096) == "4K"
+    assert format_bytes(1536) == "1.5K"
+    assert format_bytes(1 << 30) == "1G"
+    assert format_bytes(500) == "500"
+
+
+def test_format_duration():
+    assert format_duration_secs(6013) == "1h:40m:13s"
+    assert format_duration_secs(75) == "1m:15s"
+    assert format_duration_secs(9) == "9s"
+
+
+def test_parse_uint_list():
+    assert parse_uint_list("0,1,2") == [0, 1, 2]
+    assert parse_uint_list("") == []
