@@ -1,0 +1,101 @@
+"""CLI entry point (reference: source/Main.cpp:14-69 — parse args,
+help/version handling, delegate to Coordinator)."""
+
+from __future__ import annotations
+
+import sys
+
+from . import __version__
+from .config.args import (FLAG_DEFS, HELP_CATEGORIES, ConfigError, parse_cli)
+from .phases import BenchPathType
+from .toolkits import logger
+from .toolkits.units import format_bytes
+
+
+def _print_help(category: "str | None") -> None:
+    print(f"elbencho-tpu {__version__} — TPU-native distributed storage "
+          f"benchmark\n")
+    print("Usage: elbencho-tpu [OPTIONS] PATH [MORE_PATHS]\n")
+    tier_info = {
+        "essential": "Basic options", "multi": "Multi-dir/custom-tree",
+        "large": "Large file / random I/O", "dist": "Distributed mode",
+        "s3": "S3/object storage", "tpu": "TPU HBM data path",
+        "misc": "Miscellaneous"}
+    for cat, title in tier_info.items():
+        if category is not None and cat != category:
+            continue
+        print(f"{title}:")
+        for flag, short, _dest, kind, default, fcat, help_txt in FLAG_DEFS:
+            if fcat != cat:
+                continue
+            names = f"--{flag}" + (f", -{short}" if short else "")
+            arg = "" if kind == "bool" else " V"
+            print(f"  {names + arg:<26} {help_txt}")
+        print()
+    if category is None or category == "essential":
+        print("Help tiers: --help-multi --help-large --help-dist --help-s3 "
+              "--help-tpu --help-all")
+        print("\nExamples:")
+        print("  elbencho-tpu -w -r -t 4 -b 1M -s 10g /mnt/scratch/file")
+        print("  elbencho-tpu -w -d -t 8 -n 2 -N 4 -s 4K /mnt/scratch")
+        print("  elbencho-tpu -r -b 1M -s 10g --tpuids 0 /mnt/file  "
+              "# read into TPU HBM")
+        print("  elbencho-tpu --service --foreground --port 1611")
+        print("  elbencho-tpu --hosts h1,h2 -w -t 16 -s 1g /mnt/shared")
+
+
+def _print_dry_run(cfg) -> None:
+    """--dryrun: show workload totals without running (reference:
+    Statistics::printDryRunInfo, Statistics.cpp:2865)."""
+    from .workers.manager import WorkerManager
+    manager = WorkerManager(cfg)
+    print("Dry run — workload overview:")
+    print(f"  bench mode     : {cfg.bench_mode.name}")
+    print(f"  path type      : {cfg.bench_path_type.name}")
+    print(f"  hosts          : {len(cfg.hosts) or 1}")
+    print(f"  threads/host   : {cfg.num_threads}")
+    print(f"  dataset threads: {cfg.num_dataset_threads}")
+    if cfg.tpu_ids:
+        print(f"  tpu chips      : {cfg.tpu_ids}")
+    for phase in cfg.enabled_phases():
+        entries, num_bytes = manager.get_phase_num_entries_and_bytes(phase)
+        from .phases import phase_name
+        print(f"  {phase_name(phase):<10}: {entries} entries, "
+              f"{format_bytes(num_bytes)}B")
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    try:
+        cfg, ns = parse_cli(argv)
+    except ConfigError as err:
+        print(f"ERROR: {err}", file=sys.stderr)
+        return 1
+    if ns.version:
+        print(f"elbencho-tpu {__version__} (jax-based TPU data path; "
+              f"C++ ioengine optional)")
+        return 0
+    for help_flag, cat in HELP_CATEGORIES.items():
+        if getattr(ns, help_flag.replace("-", "_")):
+            _print_help(cat)
+            return 0
+    if not cfg.paths and not (cfg.run_as_service or cfg.quit_services
+                              or cfg.interrupt_services
+                              or cfg.run_netbench):
+        _print_help("essential")
+        return 1
+    try:
+        cfg.derive()
+        cfg.check()
+    except (ConfigError, OSError) as err:
+        print(f"ERROR: {err}", file=sys.stderr)
+        return 1
+    logger.set_log_level(cfg.log_level)
+    if cfg.do_dry_run:
+        _print_dry_run(cfg)
+        return 0
+    from .coordinator import Coordinator
+    return Coordinator(cfg).main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -483,6 +483,14 @@ class BenchConfig(BenchConfigBase):
             pass  # full-coverage LCG makes this safe (every block exactly once)
         if self.use_mmap and self.use_direct_io:
             raise ConfigError("--mmap and --direct are incompatible")
+        if self.bench_mode == BenchMode.POSIX \
+                and self.bench_path_type != BenchPathType.DIR \
+                and (self.run_create_dirs or self.run_delete_dirs
+                     or self.run_stat_dirs):
+            raise ConfigError(
+                "directory phases (--mkdirs/--deldirs/--statdirs) require "
+                "directory bench paths (path does not exist or is a file/"
+                "blockdev)")
         if self.tpu_ids_str and self.bench_mode == BenchMode.NETBENCH:
             raise ConfigError("--tpuids not supported in netbench mode")
 

@@ -1,0 +1,180 @@
+"""Coordinator: role dispatch and benchmark phase ordering.
+
+Reference: source/Coordinator.{h,cpp} — main() :32 (service vs master vs
+local role), runBenchmarks() :299 with the ordered phase table :311-334
+(creates before deletes), sync/dropcaches interleave after every phase,
+host rotation :384, SIGINT graceful shutdown :420-442, synchronized start
+time :150-159, service-ready wait :165.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import time
+
+from .phases import BenchPhase
+from .stats.statistics import Statistics
+from .toolkits import logger
+from .workers.manager import WorkerManager
+from .workers.shared import WorkerException
+
+
+class Coordinator:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.manager = WorkerManager(cfg)
+        self.statistics = Statistics(cfg, self.manager)
+        self._interrupted = False
+        self._old_sigint = None
+
+    # ------------------------------------------------------------------
+
+    def main(self) -> int:
+        cfg = self.cfg
+        if cfg.run_as_service:
+            from .service.http_service import HTTPService
+            return HTTPService(cfg).start()
+        if cfg.quit_services or cfg.interrupt_services:
+            from .service.remote_worker import send_interrupt_to_hosts
+            send_interrupt_to_hosts(cfg.hosts, cfg.service_port,
+                                    quit=cfg.quit_services)
+            return 0
+        return self._run_master_or_local()
+
+    def _run_master_or_local(self) -> int:
+        cfg = self.cfg
+        self._install_signal_handler()
+        try:
+            if cfg.hosts:
+                from .service.remote_worker import wait_for_services_ready
+                wait_for_services_ready(cfg.hosts, cfg.service_port,
+                                        cfg.svc_wait_secs)
+            self._wait_for_sync_start()
+            self.manager.prepare_threads()
+            self.run_benchmarks()
+            return 0
+        except WorkerException as err:
+            logger.log_error(f"Aborting due to worker error: {err}")
+            self.manager.interrupt_and_notify_workers()
+            return 1
+        except KeyboardInterrupt:
+            logger.log_error("Interrupted. Shutting down workers...")
+            self.manager.interrupt_and_notify_workers()
+            return 3
+        finally:
+            try:
+                self.manager.join_all_threads()
+            except Exception:  # noqa: BLE001 - teardown must not mask errors
+                pass
+            self.statistics.close()
+            self._restore_signal_handler()
+
+    def _wait_for_sync_start(self) -> None:
+        """--start: cross-host synchronized start (reference: :150-159;
+        accepts "HH:MM[:SS]" UTC or a unix timestamp)."""
+        spec = self.cfg.start_time_utc
+        if not spec:
+            return
+        if ":" in spec:
+            parts = [int(x) for x in spec.split(":")]
+            now = time.gmtime()
+            target_secs = parts[0] * 3600 + parts[1] * 60 + \
+                (parts[2] if len(parts) > 2 else 0)
+            now_secs = now.tm_hour * 3600 + now.tm_min * 60 + now.tm_sec
+            delay = target_secs - now_secs
+            if delay < 0:
+                raise WorkerException("--start time is in the past")
+        else:
+            delay = float(spec) - time.time()
+            if delay < 0:
+                raise WorkerException("--start time is in the past")
+        logger.log(0, f"Waiting {delay:.0f}s for synchronized start...")
+        time.sleep(delay)
+
+    # ------------------------------------------------------------------
+
+    def run_benchmarks(self) -> None:
+        """Iterations x ordered phases with sync/dropcaches interleave
+        (reference: runBenchmarks, Coordinator.cpp:299-376)."""
+        cfg = self.cfg
+        phases = cfg.enabled_phases()
+        for iteration in range(cfg.iterations):
+            if cfg.iterations > 1:
+                logger.log(0, f"[Starting iteration {iteration + 1} of "
+                              f"{cfg.iterations}...]")
+            self.statistics.print_phase_results_table_header()
+            self._run_sync_and_drop_caches()
+            for idx, phase in enumerate(phases):
+                self.run_benchmark_phase(phase)
+                self._run_sync_and_drop_caches()
+                if idx < len(phases) - 1:
+                    if cfg.next_phase_delay_secs:
+                        time.sleep(cfg.next_phase_delay_secs)
+                    self._rotate_hosts()
+
+    def _run_sync_and_drop_caches(self) -> None:
+        if self.cfg.run_sync_phase:
+            self.run_benchmark_phase(BenchPhase.SYNC)
+        if self.cfg.run_drop_caches_phase:
+            self.run_benchmark_phase(BenchPhase.DROPCACHES)
+
+    def run_benchmark_phase(self, phase: BenchPhase) -> None:
+        """Start phase -> live stats -> wait done -> print results
+        (reference: runBenchmarkPhase, Coordinator.cpp:249)."""
+        phase_start = time.monotonic()
+        self.manager.start_next_phase(phase)
+        self.statistics.live_stats_loop(phase, phase_start)
+        self.manager.wait_for_workers_done(phase_start)
+        self.statistics.print_phase_results(phase)
+        if self._interrupted:
+            # user Ctrl-C: print what we have for this phase, then abort the
+            # remaining phases (reference: handleInterruptSignal semantics)
+            raise KeyboardInterrupt
+
+    def _rotate_hosts(self) -> None:
+        """--rotatehosts: shift the hosts list between phases, which
+        re-ranks all remote workers (reference: rotateHosts :384-408 —
+        requires a fresh prep phase)."""
+        cfg = self.cfg
+        if not cfg.rotate_hosts_num or not cfg.hosts:
+            return
+        k = cfg.rotate_hosts_num % len(cfg.hosts)
+        if not k:
+            return
+        cfg.hosts = cfg.hosts[k:] + cfg.hosts[:k]
+        self.manager.join_all_threads()
+        self.manager = WorkerManager(cfg)
+        self.statistics = Statistics(cfg, self.manager)
+        self.manager.prepare_threads()
+
+    # ------------------------------------------------------------------
+
+    def _install_signal_handler(self) -> None:
+        """First SIGINT interrupts workers gracefully; another SIGINT >5s
+        later restores the default handler (reference: Coordinator.cpp:23,
+        :420-442)."""
+        self._last_sigint = 0.0
+
+        def handler(signum, frame):
+            now = time.monotonic()
+            if self._interrupted and now - self._last_sigint > 5:
+                signal.signal(signal.SIGINT, signal.SIG_DFL)
+            self._interrupted = True
+            self._last_sigint = now
+            print("Interrupt received. Finishing up... "
+                  "(Ctrl-C again after 5s to force quit)", file=sys.stderr)
+            self.manager.shared.request_interrupt()
+            self.manager.interrupt_and_notify_workers()
+
+        try:
+            self._old_sigint = signal.signal(signal.SIGINT, handler)
+        except ValueError:
+            self._old_sigint = None  # not on main thread (tests)
+
+    def _restore_signal_handler(self) -> None:
+        if self._old_sigint is not None:
+            try:
+                signal.signal(signal.SIGINT, self._old_sigint)
+            except ValueError:
+                pass

@@ -1,0 +1,489 @@
+"""Statistics: live stats + first-done/last-done phase results + CSV/JSON.
+
+Reference: source/Statistics.{h,cpp} (3.5 kLoC) — live render paths
+(fullscreen/single-line/no-console, :182-1249), live CSV/JSON streams
+(:3000-3292), and the two-column result model: **first done** (the moment
+the fastest worker finished = stonewall snapshots of everyone at that
+instant) vs **last done** (all workers finished)
+(docs/result-columns-explanation.md; generatePhaseResults :1695).
+
+TPU extension: per-chip HBM ingest bandwidth rows when ``--tpuids`` staging
+is active (BASELINE.json north-star metric).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from ..phases import BenchMode, BenchPhase, phase_entry_type, phase_name
+from .latency_histogram import LatencyHistogram
+
+
+def _fmt_elapsed_usec(usec: int) -> str:
+    secs = usec / 1_000_000
+    if secs >= 60:
+        m, s = divmod(secs, 60)
+        return f"{int(m)}m{s:.1f}s"
+    if secs >= 1:
+        return f"{secs:.3f}s"
+    return f"{usec / 1000:.2f}ms"
+
+
+class PhaseResults:
+    """Aggregated first-done/last-done numbers for one finished phase."""
+
+    def __init__(self):
+        self.phase: BenchPhase = BenchPhase.IDLE
+        self.phase_name = ""
+        self.entry_type = "files"
+        self.first_done_usec = 0
+        self.last_done_usec = 0
+        self.stonewall = {}     # first-done totals dict
+        self.final = {}         # last-done totals dict
+        self.stonewall_rwmix = {}
+        self.final_rwmix = {}
+        self.iops_histo = LatencyHistogram()
+        self.entries_histo = LatencyHistogram()
+        self.iops_histo_rwmix = LatencyHistogram()
+        self.cpu_stonewall = 0.0
+        self.cpu_last_done = 0.0
+        self.elapsed_usec_vec: "list[int]" = []
+        self.tpu_bytes = 0
+        self.tpu_usec = 0
+        self.tpu_per_chip: "dict[int, tuple[int, int]]" = {}
+        self.num_workers = 0
+
+
+class Statistics:
+    def __init__(self, cfg, worker_manager):
+        self.cfg = cfg
+        self.manager = worker_manager
+        self._header_printed = False
+        self._live_csv_fh = None
+        self._live_json_fh = None
+        self._live_started = 0.0
+
+    # ------------------------------------------------------------------
+    # live statistics (reference: printLiveStats, Statistics.cpp:1337)
+    # ------------------------------------------------------------------
+
+    def _sum_live_ops(self) -> "tuple[int, int, int, int]":
+        entries = num_bytes = iops = 0
+        for w in self.manager.workers:
+            entries += (w.live_ops.num_entries_done
+                        + w.live_ops_rwmix_read.num_entries_done)
+            num_bytes += (w.live_ops.num_bytes_done
+                          + w.live_ops_rwmix_read.num_bytes_done)
+            iops += (w.live_ops.num_iops_done
+                     + w.live_ops_rwmix_read.num_iops_done)
+        done = self.manager.shared.num_workers_done \
+            + self.manager.shared.num_workers_done_with_error
+        return entries, num_bytes, iops, done
+
+    def live_stats_loop(self, phase: BenchPhase,
+                        phase_start: "float | None" = None) -> None:
+        """Poll worker counters until the phase completes; render according
+        to the configured live mode. Runs on the coordinator thread."""
+        cfg = self.cfg
+        interval = max(cfg.live_stats_interval_ms, 50) / 1000.0
+        use_line = not cfg.disable_live_stats
+        is_tty = sys.stdout.isatty()
+        self._live_started = time.monotonic()
+        last_bytes = last_iops = 0
+        last_t = self._live_started
+        next_render = self._live_started + interval
+        while not self.manager.all_workers_done():
+            time.sleep(0.02)  # fine-grained poll so short phases don't stall
+            if phase_start is not None:
+                self.manager.check_phase_time_limit(phase_start)
+            if time.monotonic() < next_render:
+                continue
+            next_render = time.monotonic() + interval
+            entries, num_bytes, iops, done = self._sum_live_ops()
+            now = time.monotonic()
+            dt = max(now - last_t, 1e-9)
+            bps = (num_bytes - last_bytes) / dt
+            ops_per_s = (iops - last_iops) / dt
+            last_bytes, last_iops, last_t = num_bytes, iops, now
+            elapsed = int(now - self._live_started)
+            # live CSV/JSON files are written even when console live stats
+            # are off (--nolive / service mode)
+            self._write_live_files(phase, entries, num_bytes, iops, elapsed)
+            if not use_line:
+                continue
+            unit, div = ("MB", 1000 ** 2) if cfg.use_base10_units \
+                else ("MiB", 1 << 20)
+            line = (f"{phase_name(phase, cfg.bench_mode == BenchMode.S3)}: "
+                    f"{elapsed}s; {bps / div:,.0f} {unit}/s; "
+                    f"{ops_per_s:,.0f} IOPS; {entries} entries; "
+                    f"{num_bytes / div:,.0f} {unit} total; "
+                    f"{done}/{len(self.manager.workers)} done")
+            if cfg.show_cpu_util:
+                line += f"; CPU {self.manager.shared.cpu_util.update():.0f}%"
+            if is_tty and not cfg.single_line_live_stats_no_erase:
+                print("\r\x1b[2K" + line, end="", flush=True)
+            else:
+                print(line, flush=True)
+        if use_line and is_tty and not cfg.single_line_live_stats_no_erase:
+            print("\r\x1b[2K", end="", flush=True)
+
+    def _write_live_files(self, phase, entries, num_bytes, iops,
+                          elapsed) -> None:
+        cfg = self.cfg
+        if cfg.live_csv_file_path:
+            if self._live_csv_fh is None:
+                self._live_csv_fh = (sys.stdout
+                                     if cfg.live_csv_file_path == "stdout"
+                                     else open(cfg.live_csv_file_path, "a"))
+                print("ISODate,Label,Phase,Seconds,Entries,Bytes,IOPS",
+                      file=self._live_csv_fh, flush=True)
+            print(f"{time.strftime('%Y-%m-%dT%H:%M:%S')},"
+                  f"{cfg.bench_label},{phase_name(phase)},{elapsed},"
+                  f"{entries},{num_bytes},{iops}",
+                  file=self._live_csv_fh, flush=True)
+        if cfg.live_json_file_path:
+            if self._live_json_fh is None:
+                self._live_json_fh = (sys.stdout
+                                      if cfg.live_json_file_path == "stdout"
+                                      else open(cfg.live_json_file_path, "a"))
+            rec = {"ISODate": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                   "Label": cfg.bench_label, "Phase": phase_name(phase),
+                   "Seconds": elapsed, "Entries": entries,
+                   "Bytes": num_bytes, "IOPS": iops}
+            if cfg.live_json_extended or cfg.live_csv_extended:
+                rec["Workers"] = [
+                    {"Rank": w.rank, **w.live_ops.as_dict()}
+                    for w in self.manager.workers]
+            print(json.dumps(rec), file=self._live_json_fh, flush=True)
+
+    # ------------------------------------------------------------------
+    # phase results (reference: printPhaseResults :1619 /
+    # generatePhaseResults :1695)
+    # ------------------------------------------------------------------
+
+    def generate_phase_results(self, phase: BenchPhase) -> PhaseResults:
+        cfg = self.cfg
+        res = PhaseResults()
+        res.phase = phase
+        s3 = cfg.bench_mode == BenchMode.S3
+        res.phase_name = phase_name(phase, s3)
+        res.entry_type = phase_entry_type(phase, s3)
+        res.cpu_stonewall = self.manager.shared.cpu_util_stonewall
+        res.cpu_last_done = self.manager.shared.cpu_util_last_done
+
+        stonewall_totals = {"entries": 0, "bytes": 0, "iops": 0}
+        final_totals = {"entries": 0, "bytes": 0, "iops": 0}
+        stonewall_rwmix = {"entries": 0, "bytes": 0, "iops": 0}
+        final_rwmix = {"entries": 0, "bytes": 0, "iops": 0}
+        workers = [w for w in self.manager.workers if w.got_phase_work]
+        res.num_workers = len(workers)
+        for w in workers:
+            res.elapsed_usec_vec.extend(w.elapsed_usec_vec)
+            stonewall_totals["entries"] += w.stonewall_ops.num_entries_done
+            stonewall_totals["bytes"] += w.stonewall_ops.num_bytes_done
+            stonewall_totals["iops"] += w.stonewall_ops.num_iops_done
+            final_totals["entries"] += w.live_ops.num_entries_done
+            final_totals["bytes"] += w.live_ops.num_bytes_done
+            final_totals["iops"] += w.live_ops.num_iops_done
+            stonewall_rwmix["entries"] += \
+                w.stonewall_ops_rwmix_read.num_entries_done
+            stonewall_rwmix["bytes"] += \
+                w.stonewall_ops_rwmix_read.num_bytes_done
+            stonewall_rwmix["iops"] += \
+                w.stonewall_ops_rwmix_read.num_iops_done
+            final_rwmix["entries"] += w.live_ops_rwmix_read.num_entries_done
+            final_rwmix["bytes"] += w.live_ops_rwmix_read.num_bytes_done
+            final_rwmix["iops"] += w.live_ops_rwmix_read.num_iops_done
+            res.iops_histo.merge(w.iops_latency_histo)
+            res.entries_histo.merge(w.entries_latency_histo)
+            res.iops_histo_rwmix.merge(w.iops_latency_histo_rwmix)
+            res.tpu_bytes += w.tpu_transfer_bytes
+            res.tpu_usec += w.tpu_transfer_usec
+            if getattr(w, "_tpu", None) is not None:
+                chip = w._tpu.chip_id
+                b, u = res.tpu_per_chip.get(chip, (0, 0))
+                res.tpu_per_chip[chip] = (b + w.tpu_transfer_bytes,
+                                          u + w.tpu_transfer_usec)
+        stonewall_elapsed = [w.stonewall_elapsed_usec for w in workers
+                             if w.stonewall_taken]
+        res.first_done_usec = min(res.elapsed_usec_vec, default=0)
+        if stonewall_elapsed:
+            res.first_done_usec = min(stonewall_elapsed)
+        res.last_done_usec = max(res.elapsed_usec_vec, default=0)
+        res.stonewall = stonewall_totals
+        res.final = final_totals
+        res.stonewall_rwmix = stonewall_rwmix
+        res.final_rwmix = final_rwmix
+        return res
+
+    # -- rendering ----------------------------------------------------------
+
+    def print_phase_results_table_header(self) -> None:
+        line = (f"{'OPERATION':<10}{'RESULT TYPE':<20}"
+                f"{'FIRST DONE':>14}{'LAST DONE':>14}")
+        print(line)
+        print(f"{'=' * 9:<10}{'=' * 18:<20}{'=' * 12:>14}{'=' * 12:>14}")
+        self._print_to_res_file(line)
+
+    def print_phase_results(self, phase: BenchPhase) -> PhaseResults:
+        res = self.generate_phase_results(phase)
+        self._render_result_rows(res)
+        if self.cfg.csv_file_path:
+            self._write_csv(res)
+        if self.cfg.json_file_path:
+            self._write_json(res)
+        return res
+
+    def _row(self, op: str, rtype: str, first, last) -> str:
+        return f"{op:<10}{rtype + ' :':<20}{first:>14}{last:>14}"
+
+    def _render_result_rows(self, res: PhaseResults) -> None:
+        cfg = self.cfg
+        unit, div = ("MB", 1000 ** 2) if cfg.use_base10_units \
+            else ("MiB", 1 << 20)
+        rows = []
+        first_s = res.first_done_usec / 1e6 or 1e-9
+        last_s = res.last_done_usec / 1e6 or 1e-9
+        op = res.phase_name
+        rows.append(self._row(op, "Elapsed time",
+                              _fmt_elapsed_usec(res.first_done_usec),
+                              _fmt_elapsed_usec(res.last_done_usec)))
+        if res.final["entries"]:
+            rows.append(self._row(
+                "", f"{res.entry_type}/s",
+                f"{res.stonewall['entries'] / first_s:,.0f}",
+                f"{res.final['entries'] / last_s:,.0f}"))
+            rows.append(self._row(
+                "", f"{res.entry_type} total",
+                f"{res.stonewall['entries']}", f"{res.final['entries']}"))
+        if res.final["iops"]:
+            rows.append(self._row(
+                "", "IOPS", f"{res.stonewall['iops'] / first_s:,.0f}",
+                f"{res.final['iops'] / last_s:,.0f}"))
+        if res.final["bytes"]:
+            rows.append(self._row(
+                "", f"Throughput {unit}/s",
+                f"{res.stonewall['bytes'] / first_s / div:,.0f}",
+                f"{res.final['bytes'] / last_s / div:,.0f}"))
+            rows.append(self._row(
+                "", f"Total {unit}",
+                f"{res.stonewall['bytes'] / div:,.0f}",
+                f"{res.final['bytes'] / div:,.0f}"))
+        if res.final_rwmix["iops"]:
+            rows.append(self._row(
+                "", "Read IOPS (rwmix)",
+                f"{res.stonewall_rwmix['iops'] / first_s:,.0f}",
+                f"{res.final_rwmix['iops'] / last_s:,.0f}"))
+            rows.append(self._row(
+                "", f"Read {unit}/s (rwmix)",
+                f"{res.stonewall_rwmix['bytes'] / first_s / div:,.0f}",
+                f"{res.final_rwmix['bytes'] / last_s / div:,.0f}"))
+        if res.tpu_bytes:
+            # HBM ingest rows: the TPU-native headline metric
+            rows.append(self._row(
+                "", f"HBM ingest {unit}/s", "-",
+                f"{res.tpu_bytes / last_s / div:,.0f}"))
+            for chip, (b, u) in sorted(res.tpu_per_chip.items()):
+                rows.append(self._row(
+                    "", f"  chip {chip} {unit}/s", "-",
+                    f"{b / last_s / div:,.0f}"))
+        if cfg.show_cpu_util:
+            rows.append(self._row("", "CPU util %",
+                                  f"{res.cpu_stonewall:.0f}",
+                                  f"{res.cpu_last_done:.0f}"))
+        if cfg.show_latency and res.iops_histo.num_values:
+            h = res.iops_histo
+            rows.append(f"{'':10}{'IO latency us :':<20}"
+                        f"min={h.min_micro} avg={h.avg_micro:.0f} "
+                        f"max={h.max_micro}")
+        if cfg.show_latency and res.entries_histo.num_values:
+            h = res.entries_histo
+            rows.append(f"{'':10}{'Ent latency us :':<20}"
+                        f"min={h.min_micro} avg={h.avg_micro:.0f} "
+                        f"max={h.max_micro}")
+        if cfg.show_latency_percentiles and res.iops_histo.num_values:
+            nines = res.iops_histo.percentiles_nines(
+                cfg.num_latency_percentile_9s)
+            txt = " ".join(f"{k}={v:.0f}" for k, v in nines.items())
+            rows.append(f"{'':10}{'IO lat pcts :':<20}{txt}")
+        if cfg.show_latency_histogram and res.iops_histo.num_values:
+            rows.append(f"{'':10}IO lat histogram : "
+                        f"{res.iops_histo.histogram_str()}")
+        if cfg.show_all_elapsed:
+            txt = ", ".join(_fmt_elapsed_usec(u)
+                            for u in sorted(res.elapsed_usec_vec))
+            rows.append(f"{'':10}Worker elapsed   : {txt}")
+        for row in rows:
+            print(row)
+            self._print_to_res_file(row)
+
+    def _print_to_res_file(self, line: str) -> None:
+        if self.cfg.res_file_path:
+            with open(self.cfg.res_file_path, "a") as f:
+                f.write(line + "\n")
+
+    # -- CSV / JSON output (reference: Statistics.cpp:2485-2783 + csv-docs) --
+
+    def _result_record(self, res: PhaseResults) -> dict:
+        first_s = res.first_done_usec / 1e6 or 1e-9
+        last_s = res.last_done_usec / 1e6 or 1e-9
+        rec = {
+            "ISODate": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "Label": self.cfg.bench_label,
+            "Phase": res.phase_name,
+            "EntryType": res.entry_type,
+            "NumWorkers": res.num_workers,
+            "ElapsedUSecFirst": res.first_done_usec,
+            "ElapsedUSecLast": res.last_done_usec,
+            "EntriesFirst": res.stonewall["entries"],
+            "EntriesLast": res.final["entries"],
+            "EntriesPerSecFirst": round(res.stonewall["entries"] / first_s, 2),
+            "EntriesPerSecLast": round(res.final["entries"] / last_s, 2),
+            "IOPSFirst": round(res.stonewall["iops"] / first_s, 2),
+            "IOPSLast": round(res.final["iops"] / last_s, 2),
+            "BytesFirst": res.stonewall["bytes"],
+            "BytesLast": res.final["bytes"],
+            "MiBPerSecFirst": round(
+                res.stonewall["bytes"] / first_s / (1 << 20), 2),
+            "MiBPerSecLast": round(
+                res.final["bytes"] / last_s / (1 << 20), 2),
+            "CPUUtilStoneWall": round(res.cpu_stonewall, 1),
+            "CPUUtil": round(res.cpu_last_done, 1),
+            "IOLatUSecMin": res.iops_histo.min_micro,
+            "IOLatUSecAvg": round(res.iops_histo.avg_micro, 1),
+            "IOLatUSecMax": res.iops_histo.max_micro,
+            "IOLatUSecP99": round(res.iops_histo.percentile(99), 1),
+            "EntLatUSecMin": res.entries_histo.min_micro,
+            "EntLatUSecAvg": round(res.entries_histo.avg_micro, 1),
+            "EntLatUSecMax": res.entries_histo.max_micro,
+            "TpuHbmBytes": res.tpu_bytes,
+            "TpuHbmMiBPerSec": round(
+                res.tpu_bytes / last_s / (1 << 20), 2) if res.tpu_bytes else 0,
+            "TpuPerChip": {str(k): {"Bytes": b, "USec": u}
+                           for k, (b, u) in res.tpu_per_chip.items()},
+        }
+        # unconditional so CSV rows keep a fixed column count
+        rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
+        rec["RWMixReadMiBPerSecLast"] = round(
+            res.final_rwmix["bytes"] / last_s / (1 << 20), 2)
+        return rec
+
+    def _write_csv(self, res: PhaseResults) -> None:
+        rec = self._result_record(res)
+        rec.pop("TpuPerChip")
+        labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
+        path = self.cfg.csv_file_path
+        new_file = not os.path.exists(path) or os.path.getsize(path) == 0
+        with open(path, "a") as f:
+            if new_file:
+                f.write(",".join(list(rec) + list(labels)) + "\n")
+            vals = [str(v) for v in rec.values()] + \
+                [str(v).replace(",", ";") for v in labels.values()]
+            f.write(",".join(vals) + "\n")
+
+    def _write_json(self, res: PhaseResults) -> None:
+        """JSONL: one JSON object per phase result (consumed by
+        tools/elbencho-tpu-summarize-json)."""
+        rec = self._result_record(res)
+        rec["Config"] = self.cfg.config_labels()
+        rec["ElapsedUSecList"] = res.elapsed_usec_vec
+        rec["IOLatHisto"] = res.iops_histo.to_dict()
+        rec["EntLatHisto"] = res.entries_histo.to_dict()
+        with open(self.cfg.json_file_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # -- service protocol views (used by HTTP /status & /benchresult) --------
+
+    def get_live_stats_dict(self) -> dict:
+        entries, num_bytes, iops, done = self._sum_live_ops()
+        shared = self.manager.shared
+        lat_sums = {"NumIOLatUSec": 0, "SumIOLatUSec": 0,
+                    "NumEntLatUSec": 0, "SumEntLatUSec": 0}
+        for w in self.manager.workers:
+            lat_sums["NumIOLatUSec"] += w.iops_latency_histo.num_values
+            lat_sums["SumIOLatUSec"] += w.iops_latency_histo.sum_micro
+            lat_sums["NumEntLatUSec"] += w.entries_latency_histo.num_values
+            lat_sums["SumEntLatUSec"] += w.entries_latency_histo.sum_micro
+        return {
+            "BenchID": shared.bench_uuid,
+            "PhaseCode": int(shared.current_phase),
+            "PhaseName": phase_name(shared.current_phase),
+            "NumWorkersDone": shared.num_workers_done,
+            "NumWorkersDoneWithError": shared.num_workers_done_with_error,
+            "NumEntriesDone": entries,
+            "NumBytesDone": num_bytes,
+            "NumIOPSDone": iops,
+            "CPUUtil": round(shared.cpu_util.percent, 1),
+            **lat_sums,
+        }
+
+    def get_bench_result_dict(self) -> dict:
+        """Final per-phase result for the master (per-worker elapsed vec +
+        mergeable histograms, reference: getBenchResultAsPropertyTreeForService
+        Statistics.cpp:2784)."""
+        shared = self.manager.shared
+        elapsed_vec = []
+        tpu_bytes = tpu_usec = 0
+        for w in self.manager.workers:
+            if w.got_phase_work:
+                elapsed_vec.extend(w.elapsed_usec_vec)
+            tpu_bytes += w.tpu_transfer_bytes
+            tpu_usec += w.tpu_transfer_usec
+        iops_histo = LatencyHistogram()
+        entries_histo = LatencyHistogram()
+        iops_histo_rwmix = LatencyHistogram()
+        final = {"entries": 0, "bytes": 0, "iops": 0}
+        stonewall = {"entries": 0, "bytes": 0, "iops": 0}
+        final_rwmix = {"entries": 0, "bytes": 0, "iops": 0}
+        stonewall_rwmix = {"entries": 0, "bytes": 0, "iops": 0}
+        for w in self.manager.workers:
+            if not w.got_phase_work:
+                continue
+            iops_histo.merge(w.iops_latency_histo)
+            entries_histo.merge(w.entries_latency_histo)
+            iops_histo_rwmix.merge(w.iops_latency_histo_rwmix)
+            final["entries"] += w.live_ops.num_entries_done
+            final["bytes"] += w.live_ops.num_bytes_done
+            final["iops"] += w.live_ops.num_iops_done
+            stonewall["entries"] += w.stonewall_ops.num_entries_done
+            stonewall["bytes"] += w.stonewall_ops.num_bytes_done
+            stonewall["iops"] += w.stonewall_ops.num_iops_done
+            final_rwmix["entries"] += w.live_ops_rwmix_read.num_entries_done
+            final_rwmix["bytes"] += w.live_ops_rwmix_read.num_bytes_done
+            final_rwmix["iops"] += w.live_ops_rwmix_read.num_iops_done
+            stonewall_rwmix["bytes"] += \
+                w.stonewall_ops_rwmix_read.num_bytes_done
+            stonewall_rwmix["iops"] += \
+                w.stonewall_ops_rwmix_read.num_iops_done
+            stonewall_rwmix["entries"] += \
+                w.stonewall_ops_rwmix_read.num_entries_done
+        stonewall_elapsed = [w.stonewall_elapsed_usec
+                             for w in self.manager.workers
+                             if w.got_phase_work and w.stonewall_taken]
+        return {
+            "BenchID": shared.bench_uuid,
+            "PhaseCode": int(shared.current_phase),
+            "NumWorkersDone": shared.num_workers_done,
+            "NumWorkersDoneWithError": shared.num_workers_done_with_error,
+            "ElapsedUSecList": elapsed_vec,
+            "StoneWallUSec": min(stonewall_elapsed, default=0),
+            "Final": final,
+            "StoneWall": stonewall,
+            "FinalRWMixRead": final_rwmix,
+            "StoneWallRWMixRead": stonewall_rwmix,
+            "IOLatHisto": iops_histo.to_dict(),
+            "EntLatHisto": entries_histo.to_dict(),
+            "IOLatHistoRWMixRead": iops_histo_rwmix.to_dict(),
+            "CPUUtilStoneWall": round(shared.cpu_util_stonewall, 1),
+            "CPUUtil": round(shared.cpu_util_last_done, 1),
+            "TpuHbmBytes": tpu_bytes,
+            "TpuHbmUSec": tpu_usec,
+        }
+
+    def close(self) -> None:
+        for fh in (self._live_csv_fh, self._live_json_fh):
+            if fh is not None and fh is not sys.stdout:
+                fh.close()

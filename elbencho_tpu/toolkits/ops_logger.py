@@ -1,0 +1,61 @@
+"""Per-operation JSONL trace (``--opslog``).
+
+Reference: source/toolkits/OpsLogger.{h,cpp} — one JSON line per record with
+date, worker_rank, op_name, entry_name, offset, length, is_finished,
+is_error; pre- and post-op records; optional flock for shared log files
+(``--opsloglock``); near-zero overhead when disabled (OpsLogger.h:19-36).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+
+
+class OpsLogger:
+    def __init__(self, path: str, worker_rank: int, use_lock: bool = False):
+        self.worker_rank = worker_rank
+        self.use_lock = use_lock
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                           0o644)
+
+    def _write(self, record: dict) -> None:
+        line = (json.dumps(record, separators=(",", ":")) + "\n").encode()
+        if self.use_lock:
+            fcntl.flock(self._fd, fcntl.LOCK_EX)
+            try:
+                os.write(self._fd, line)
+            finally:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+        else:
+            os.write(self._fd, line)
+
+    def _record(self, op_name: str, entry_name: str, offset: int,
+                length: int, is_finished: bool, is_error: bool) -> dict:
+        return {
+            "date": time.strftime("%Y%m%dT%H%M%S") + f".{time.time_ns() % 1_000_000_000:09d}",
+            "worker_rank": self.worker_rank,
+            "op_name": op_name,
+            "entry_name": entry_name,
+            "offset": offset,
+            "length": length,
+            "is_finished": is_finished,
+            "is_error": is_error,
+        }
+
+    def log_op_pre(self, op_name: str, entry_name: str = "",
+                   offset: int = 0, length: int = 0) -> None:
+        self._write(self._record(op_name, entry_name, offset, length,
+                                 is_finished=False, is_error=False))
+
+    def log_op(self, op_name: str, entry_name: str = "", offset: int = 0,
+               length: int = 0, is_error: bool = False) -> None:
+        self._write(self._record(op_name, entry_name, offset, length,
+                                 is_finished=True, is_error=is_error))
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1

@@ -1,0 +1,137 @@
+"""TPU HBM data path: per-worker device buffers + host<->HBM transfers.
+
+This is the TPU-native replacement for the reference's CUDA staging
+(SURVEY.md section 2.5 "GPU staging" — the north-star port target):
+
+  cudaSetDevice / workerRank % gpuIDs  ->  worker rank % tpu_ids chip pick
+                                           (reference LocalWorker.cpp:1444)
+  cudaMalloc per iodepth               ->  jax device_put-allocated HBM
+                                           staging arrays on the chosen chip
+  cudaMemcpy H2D after reads           ->  jax.device_put onto the chip +
+                                           block_until_ready (completion wait
+                                           keeps per-block latency honest)
+  cudaMemcpy D2H before writes         ->  np.asarray(device_array) D2H; the
+                                           write-source data originates in
+                                           HBM via on-device PRNG (curand
+                                           analogue, ops/fill.py)
+  cuFileRead (GPUDirect)               ->  --tpudirect: zero-bounce path
+                                           using jax dlpack-view of the
+                                           page-aligned I/O buffer
+  CuFileHandleData register/deregister ->  TpuWorkerContext lifecycle
+
+Per-chip ingest bandwidth is accounted by the worker (tpu_transfer_bytes /
+tpu_transfer_usec) and reported by Statistics as "HBM ingest" rows.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+_jax_lock = threading.Lock()
+_jax_mod = None
+
+
+def _get_jax():
+    """Lazy jax import so CPU-only workloads never pay for it."""
+    global _jax_mod
+    if _jax_mod is None:
+        with _jax_lock:
+            if _jax_mod is None:
+                import jax
+                _jax_mod = jax
+    return _jax_mod
+
+
+def available_tpu_devices() -> list:
+    jax = _get_jax()
+    return list(jax.devices())
+
+
+class TpuWorkerContext:
+    """Per-worker handle to one TPU chip's HBM (CuFileHandleData analogue,
+    reference source/CuFileHandleData.h:18-73)."""
+
+    def __init__(self, chip_id: int, block_size: int, direct: bool = False,
+                 verify_on_device: bool = False):
+        jax = _get_jax()
+        devices = jax.devices()
+        if not devices:
+            raise RuntimeError("no TPU/XLA devices available")
+        self.chip_id = chip_id
+        self.device = devices[chip_id % len(devices)]
+        self.block_size = block_size
+        self.direct = direct
+        self.verify_on_device = verify_on_device
+        self._key = jax.random.PRNGKey(chip_id)
+        self._fill_counter = 0
+        # device-resident staging target for reads; rotated per transfer
+        self._last_ingested = None
+        # pre-warm the on-device fill (first jit compile is slow)
+        self._num_words = max(block_size // 4, 1)
+
+    # -- read path: host buffer -> HBM --------------------------------------
+
+    def host_to_device(self, buf: memoryview, length: int,
+                       verify_salt: int = 0, file_offset: int = 0) -> None:
+        """DMA the freshly-read block into HBM and wait for completion
+        (replaces cudaMemcpyAsync H2D + sync, LocalWorker.cpp:2437-2490).
+        With --tpuverify, run the on-device fingerprint check instead of a
+        host-side memcmp."""
+        jax = _get_jax()
+        n_words = length // 4
+        np_view = np.frombuffer(buf[:n_words * 4], dtype=np.uint32)
+        arr = jax.device_put(np_view, self.device)
+        arr.block_until_ready()
+        self._last_ingested = arr  # keep resident (benchmark sink)
+        if verify_salt and self.verify_on_device:
+            from ..ops.verify import verify_block_on_device
+            verify_block_on_device(arr, file_offset, length, verify_salt)
+
+    # -- write path: HBM -> host buffer --------------------------------------
+
+    def device_to_host(self, buf: memoryview, length: int,
+                       verify_salt: int = 0, file_offset: int = 0) -> None:
+        """Write-source block originates in HBM (on-device PRNG fill, or the
+        on-device verify pattern when --verify is active) and is DMA'd to
+        the host I/O buffer (replaces curandGenerate + cudaMemcpy D2H,
+        LocalWorker.cpp:1427-1537 / :2437)."""
+        jax = _get_jax()
+        n_words = max(length // 4, 1)
+        if verify_salt:
+            from ..ops.fill import verify_pattern_block_u32
+            params = _split_u64_params(file_offset, verify_salt)
+            arr = verify_pattern_block_u32(params, n_words)
+        else:
+            from ..ops.fill import random_block_u32
+            self._fill_counter += 1
+            key = jax.random.fold_in(self._key, self._fill_counter)
+            arr = random_block_u32(key, n_words)
+        host = np.asarray(arr)  # D2H transfer
+        raw = host.tobytes()
+        buf[:len(raw[:length])] = raw[:length]
+        if verify_salt and length % 8:
+            buf[(length // 8) * 8:length] = bytes(length - (length // 8) * 8)
+
+    def close(self) -> None:
+        self._last_ingested = None
+
+
+def _split_u64_params(file_offset: int, salt: int):
+    """(base_lo, base_hi) uint32 halves of (offset + salt) mod 2^64 for the
+    on-device pattern kernel."""
+    base = (file_offset + salt) & ((1 << 64) - 1)
+    return (np.uint32(base & 0xFFFFFFFF), np.uint32(base >> 32))
+
+
+def hbm_bytes_limit(device, pct: int) -> int:
+    """--tpuhbmpct: usable HBM staging budget for a chip."""
+    try:
+        stats = device.memory_stats()
+        total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if total:
+            return int(total) * pct // 100
+    except Exception:  # pragma: no cover - backend without memory_stats
+        pass
+    return 1 << 30  # conservative 1 GiB default

@@ -1,0 +1,91 @@
+"""ctypes loader for the native C++ ioengine (csrc/libioengine.so).
+
+The reference's hot I/O loops are native C++ (rwBlockSized
+LocalWorker.cpp:1702, aioBlockSized :1828 via libaio); this framework keeps
+that property: the block loop runs in C++ when available and falls back to
+the pure-Python loop otherwise (tests, unsupported workload features).
+
+Build: ``make -C csrc`` (g++; no external deps beyond libaio if present).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_lock = threading.Lock()
+_engine = None
+_engine_checked = False
+
+_SO_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc", "libioengine.so")
+
+
+class _NativeEngine:
+    """Thin wrapper over libioengine.so. See csrc/ioengine.cpp for the ABI."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.ioengine_run_block_loop.restype = ctypes.c_int
+        lib.ioengine_run_block_loop.argtypes = [
+            ctypes.c_int,                     # fd
+            ctypes.POINTER(ctypes.c_uint64),  # offsets
+            ctypes.POINTER(ctypes.c_uint64),  # lengths
+            ctypes.c_uint64,                  # num_blocks
+            ctypes.c_int,                     # is_write
+            ctypes.c_void_p,                  # buffer
+            ctypes.c_uint64,                  # buffer size
+            ctypes.c_int,                     # iodepth
+            ctypes.POINTER(ctypes.c_uint64),  # out: latencies (usec/block)
+            ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
+            ctypes.POINTER(ctypes.c_int),     # interrupt flag
+        ]
+
+    def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,
+                       buf_addr: int, iodepth: int, worker) -> bool:
+        n = len(offsets)
+        off_arr = (ctypes.c_uint64 * n)(*offsets)
+        len_arr = (ctypes.c_uint64 * n)(*lengths)
+        lat_arr = (ctypes.c_uint64 * n)()
+        bytes_done = ctypes.c_uint64(0)
+        interrupt = ctypes.c_int(0)
+        buf_size = max(lengths)
+        ret = self._lib.ioengine_run_block_loop(
+            fd, off_arr, len_arr, n, 1 if is_write else 0,
+            ctypes.c_void_p(buf_addr), buf_size, iodepth,
+            lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt))
+        if ret < 0:
+            raise OSError(-ret, os.strerror(-ret))
+        for i in range(n):
+            worker.iops_latency_histo.add_latency(lat_arr[i])
+        worker.live_ops.num_iops_done += n
+        worker.live_ops.num_bytes_done += bytes_done.value
+        worker.create_stonewall_stats_if_triggered()
+        return True
+
+
+def get_native_engine() -> "_NativeEngine | None":
+    """Lazily load the native engine; None if not built or disabled via
+    ELBENCHO_TPU_NO_NATIVE=1."""
+    global _engine, _engine_checked
+    if _engine_checked:
+        return _engine
+    with _lock:
+        if _engine_checked:
+            return _engine
+        if os.environ.get("ELBENCHO_TPU_NO_NATIVE") != "1" \
+                and os.path.exists(_SO_PATH):
+            try:
+                _engine = _NativeEngine(ctypes.CDLL(_SO_PATH))
+            except OSError:
+                _engine = None
+        _engine_checked = True
+        return _engine
+
+
+def reset_native_engine_cache() -> None:
+    global _engine, _engine_checked
+    with _lock:
+        _engine = None
+        _engine_checked = False

@@ -1,0 +1,47 @@
+"""NUMA zone binding (reference: source/toolkits/NumaTk.h via libnuma).
+
+Pure-Python equivalent: bind the calling thread's CPU affinity to the CPUs
+of the given NUMA node (from sysfs), which is what the reference's
+``--zones`` round-robin binding achieves for worker threads.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..toolkits import logger
+
+
+def _node_cpus(zone: int) -> "set[int]":
+    path = f"/sys/devices/system/node/node{zone}/cpulist"
+    try:
+        with open(path) as f:
+            spec = f.read().strip()
+    except OSError:
+        return set()
+    cpus: "set[int]" = set()
+    for part in spec.split(","):
+        if "-" in part:
+            lo, hi = part.split("-")
+            cpus.update(range(int(lo), int(hi) + 1))
+        elif part:
+            cpus.add(int(part))
+    return cpus
+
+
+def bind_to_numa_zone(zone: int) -> bool:
+    cpus = _node_cpus(zone)
+    if not cpus:
+        logger.log_error(f"NUMA zone {zone} not found or empty; "
+                         "skipping binding")
+        return False
+    try:
+        os.sched_setaffinity(0, cpus)
+        return True
+    except OSError as err:
+        logger.log_error(f"NUMA binding to zone {zone} failed: {err}")
+        return False
+
+
+def numa_is_available() -> bool:
+    return os.path.isdir("/sys/devices/system/node/node0")

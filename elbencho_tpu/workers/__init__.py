@@ -1,0 +1,5 @@
+from .shared import (WorkerException, WorkerInterruptedException,  # noqa: F401
+                     WorkersSharedData)
+from .base import Worker  # noqa: F401
+from .local_worker import LocalWorker  # noqa: F401
+from .manager import WorkerManager  # noqa: F401

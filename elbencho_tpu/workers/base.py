@@ -1,0 +1,159 @@
+"""Worker base class: per-worker stats container + phase wait loop.
+
+Reference: source/workers/Worker.{h,cpp} — atomic LiveOps (entries/bytes/
+iops) x2 (normal + rwmix-read), stonewall snapshots for first-done results
+(Worker.h:203), 4 latency histograms (iops/entries x normal/rwmix),
+per-phase elapsed time, interruption flag (Worker.h:48-60,167-219).
+
+In CPython the GIL makes single-value counter updates effectively atomic,
+so LiveOps are plain ints written by the owning worker thread and read by
+the statistics thread; the C++ ioengine writes its counters into a shared
+memoryview that the worker syncs from.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..stats.latency_histogram import LatencyHistogram
+from .shared import WorkerInterruptedException, WorkersSharedData
+
+INTERRUPT_CHECK_INTERVAL = 128  # ops between interruption checks
+                                # (reference: LocalWorker.cpp:70)
+
+
+class LiveOps:
+    """entries/bytes/iops counter triple (reference: LiveOps, Worker.h)."""
+
+    __slots__ = ("num_entries_done", "num_bytes_done", "num_iops_done")
+
+    def __init__(self):
+        self.num_entries_done = 0
+        self.num_bytes_done = 0
+        self.num_iops_done = 0
+
+    def snapshot(self) -> "LiveOps":
+        s = LiveOps()
+        s.num_entries_done = self.num_entries_done
+        s.num_bytes_done = self.num_bytes_done
+        s.num_iops_done = self.num_iops_done
+        return s
+
+    def add(self, other: "LiveOps") -> None:
+        self.num_entries_done += other.num_entries_done
+        self.num_bytes_done += other.num_bytes_done
+        self.num_iops_done += other.num_iops_done
+
+    def reset(self) -> None:
+        self.num_entries_done = 0
+        self.num_bytes_done = 0
+        self.num_iops_done = 0
+
+    def as_dict(self) -> dict:
+        return {"NumEntriesDone": self.num_entries_done,
+                "NumBytesDone": self.num_bytes_done,
+                "NumIOPSDone": self.num_iops_done}
+
+
+class Worker:
+    def __init__(self, shared: WorkersSharedData, rank: int):
+        self.shared = shared
+        self.rank = rank
+        self.live_ops = LiveOps()
+        self.live_ops_rwmix_read = LiveOps()
+        self.stonewall_ops = LiveOps()
+        self.stonewall_ops_rwmix_read = LiveOps()
+        self.stonewall_taken = False
+        self.iops_latency_histo = LatencyHistogram()
+        self.entries_latency_histo = LatencyHistogram()
+        self.iops_latency_histo_rwmix = LatencyHistogram()
+        self.entries_latency_histo_rwmix = LatencyHistogram()
+        # elapsed usec of finished workers; RemoteWorker appends one entry
+        # per remote thread (reference: Worker elapsedUSecVec)
+        self.elapsed_usec_vec: "list[int]" = []
+        self.stonewall_elapsed_usec = 0
+        self.got_phase_work = True
+        self.is_interrupted = False
+        self.phase_finished = False
+        self._ops_since_check = 0
+        self.tpu_transfer_bytes = 0   # HBM ingest accounting (TPU data path)
+        self.tpu_transfer_usec = 0
+
+    # -- stats management ---------------------------------------------------
+
+    def reset_stats(self) -> None:
+        # per-phase interrupts (e.g. --timelimit expiry) must not leak into
+        # the next phase; a user Ctrl-C persists via shared.interrupt_requested
+        self.is_interrupted = False
+        self.live_ops.reset()
+        self.live_ops_rwmix_read.reset()
+        self.stonewall_ops.reset()
+        self.stonewall_ops_rwmix_read.reset()
+        self.stonewall_taken = False
+        self.iops_latency_histo.reset()
+        self.entries_latency_histo.reset()
+        self.iops_latency_histo_rwmix.reset()
+        self.entries_latency_histo_rwmix.reset()
+        self.elapsed_usec_vec = []
+        self.stonewall_elapsed_usec = 0
+        self.got_phase_work = True
+        self.phase_finished = False
+        self._ops_since_check = 0
+        self.tpu_transfer_bytes = 0
+        self.tpu_transfer_usec = 0
+
+    def create_stonewall_stats_if_triggered(self) -> None:
+        """Snapshot current counters when the first worker finished
+        (reference: createStoneWallStats, Worker.h:203)."""
+        if self.stonewall_taken or not self.shared.stonewall_triggered:
+            return
+        self.stonewall_ops = self.live_ops.snapshot()
+        self.stonewall_ops_rwmix_read = self.live_ops_rwmix_read.snapshot()
+        self.stonewall_elapsed_usec = self.phase_elapsed_usec()
+        self.stonewall_taken = True
+
+    def finish_phase_stats(self) -> None:
+        """Called by the worker when its phase work is complete."""
+        if not self.stonewall_taken:
+            # first finisher: stonewall stats == final stats
+            self.stonewall_ops = self.live_ops.snapshot()
+            self.stonewall_ops_rwmix_read = self.live_ops_rwmix_read.snapshot()
+            self.stonewall_elapsed_usec = self.phase_elapsed_usec()
+            self.stonewall_taken = True
+        self.elapsed_usec_vec.append(self.phase_elapsed_usec())
+        self.phase_finished = True
+
+    def phase_elapsed_usec(self) -> int:
+        return int((time.monotonic()
+                    - self.shared.phase_start_monotonic) * 1_000_000)
+
+    # -- interruption -------------------------------------------------------
+
+    def interrupt_execution(self) -> None:
+        self.is_interrupted = True
+
+    def check_interruption_request(self, force: bool = False) -> None:
+        """Cheap periodic check in hot loops; also the stonewall snapshot
+        point (reference: checkInterruptionRequest + stonewall polling)."""
+        self._ops_since_check += 1
+        if not force and self._ops_since_check < INTERRUPT_CHECK_INTERVAL:
+            return
+        self._ops_since_check = 0
+        self.create_stonewall_stats_if_triggered()
+        if (self.is_interrupted or self.shared.interrupt_requested
+                or self.shared.phase_time_expired):
+            raise WorkerInterruptedException("worker interruption requested")
+
+    # -- thread entry -------------------------------------------------------
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def thread_start(self) -> None:
+        try:
+            self.run()
+        except Exception as err:  # noqa: BLE001 - worker errors are reported
+            from ..toolkits import logger
+            logger.log_error(f"Worker {self.rank} terminated on error: "
+                             f"{type(err).__name__}: {err}")
+            self.shared.inc_num_workers_done_with_error(err)

@@ -1,0 +1,814 @@
+"""LocalWorker: one I/O worker thread — the workload engine's heart.
+
+Reference: source/workers/LocalWorker.{h,cpp} (8.5 kLoC) — per-phase re-init
+of function pointers + offset generator (initPhaseFunctionPointers
+:1210-1379), the giant phase dispatch in run() (:193-418), dir-mode
+iteration with the deterministic namespace ``r<rank>/d<dir>/r<rank>-f<file>``
+(:3097), file/bdev striping (:3511-3769), the sync hot loop rwBlockSized
+(:1702-1814), integrity verify (:2124-2212), block variance refill (:2242),
+rwmix per-op split (:1741), sync/dropcaches (:8075/:8118).
+
+The TPU data path replaces the reference's CUDA staging (allocGPUIOBuffer
+:1427-1537, cudaMemcpy wrappers :2437-2490, cuFile wrappers :2633-2749):
+workers map to TPU chips by ``rank % len(tpu_ids)`` (as the reference does
+for GPUs, :1444) and stage blocks into HBM via PjRt transfers — see
+elbencho_tpu/tpu/device.py. The function seam (func_positional_read/write +
+tpu pre/post hooks) is kept so the C++ ioengine and the TPU path plug into
+the same spots.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import time
+
+import numpy as np
+
+from ..phases import BenchMode, BenchPathType, BenchPhase
+from ..toolkits import logger
+from ..toolkits.offset_gen import (OffsetGenRandom, OffsetGenRandomAligned,
+                                   OffsetGenRandomAlignedFullCoverage,
+                                   OffsetGenReverseSeq, OffsetGenSequential,
+                                   OffsetGenStrided)
+from ..toolkits.random_algos import create_rand_algo
+from ..toolkits.rate_limiter import RateLimiter
+from .base import Worker
+from .shared import WorkerException, WorkerInterruptedException
+
+MKFILE_MODE = 0o644  # reference: MKFILE_MODE, Common.h:96
+MKDIR_MODE = 0o755
+
+
+class LocalWorker(Worker):
+    def __init__(self, shared, rank: int):
+        super().__init__(shared, rank)
+        self.cfg = shared.config
+        self._io_buf_mmap: "mmap.mmap | None" = None
+        self._io_buf: "memoryview | None" = None
+        self._own_path_fds: "list[int]" = []
+        self._path_fds: "list[int]" = []
+        self._rand_offset_algo = None
+        self._block_var_algo = None
+        self._rate_limiter_read: "RateLimiter | None" = None
+        self._rate_limiter_write: "RateLimiter | None" = None
+        self._tpu = None           # TpuWorkerContext when --tpuids given
+        self._ops_log = None
+        self._num_iops_submitted = 0  # rwmix modulo counter
+        self._prepared = False
+
+    # ------------------------------------------------------------------
+    # preparation (reference: preparePhase, LocalWorker.cpp:424)
+    # ------------------------------------------------------------------
+
+    def prepare(self) -> None:
+        cfg = self.cfg
+        self._apply_core_binding()
+        if cfg.file_size > 0 or cfg.tree_file_path \
+                or cfg.bench_mode == BenchMode.NETBENCH:
+            self._alloc_io_buffer()
+        if cfg.tpu_ids:
+            from ..tpu.device import TpuWorkerContext
+            chip = cfg.tpu_ids[self.rank % len(cfg.tpu_ids)]
+            self._tpu = TpuWorkerContext(
+                chip_id=chip, block_size=cfg.block_size,
+                direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify)
+        if cfg.bench_path_type != BenchPathType.DIR \
+                and cfg.bench_mode == BenchMode.POSIX:
+            self._prepare_path_fds()
+        if cfg.ops_log_path:
+            from ..toolkits.ops_logger import OpsLogger
+            self._ops_log = OpsLogger(cfg.ops_log_path, self.rank,
+                                      use_lock=cfg.ops_log_lock)
+        self._rand_offset_algo = create_rand_algo(
+            cfg.rand_offset_algo, seed=None)
+        if cfg.block_variance_pct:
+            self._block_var_algo = create_rand_algo(cfg.block_variance_algo)
+        if cfg.limit_read_bps:
+            self._rate_limiter_read = RateLimiter(cfg.limit_read_bps)
+        if cfg.limit_write_bps:
+            self._rate_limiter_write = RateLimiter(cfg.limit_write_bps)
+        self._prepared = True
+
+    def cleanup(self) -> None:
+        for fd in self._own_path_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._own_path_fds = []
+        if self._io_buf is not None:
+            self._io_buf.release()
+            self._io_buf = None
+        if self._io_buf_mmap is not None:
+            self._io_buf_mmap.close()
+            self._io_buf_mmap = None
+        if self._ops_log is not None:
+            self._ops_log.close()
+        if self._tpu is not None:
+            self._tpu.close()
+
+    def _apply_core_binding(self) -> None:
+        """Round-robin worker->core binding (reference: --cores/--zones via
+        NumaTk; here sched_setaffinity, NUMA zones via utils/numa)."""
+        cfg = self.cfg
+        if cfg.cpu_cores_str:
+            from ..toolkits.units import parse_uint_list
+            cores = parse_uint_list(cfg.cpu_cores_str)
+            if cores:
+                core = cores[self.rank % len(cores)]
+                try:
+                    os.sched_setaffinity(0, {core})
+                except OSError as err:
+                    logger.log_error(f"core binding failed: {err}")
+        elif cfg.numa_zones_str:
+            from ..utils.numa import bind_to_numa_zone
+            from ..toolkits.units import parse_uint_list
+            zones = parse_uint_list(cfg.numa_zones_str)
+            if zones:
+                bind_to_numa_zone(zones[self.rank % len(zones)])
+
+    def _alloc_io_buffer(self) -> None:
+        """Page-aligned I/O buffer via anonymous mmap (replaces the
+        reference's posix_memalign, LocalWorker.cpp:1401) — page alignment
+        satisfies O_DIRECT. Pre-filled with random data so writes aren't
+        trivially compressible (reference: allocIOBuffer :1386)."""
+        size = max(self.cfg.block_size, 1)
+        self._io_buf_mmap = mmap.mmap(-1, size)
+        self._io_buf = memoryview(self._io_buf_mmap)
+        fill = create_rand_algo("fast", seed=self.rank + 1)
+        self._io_buf[:] = fill.fill_buffer(size)
+
+    def _prepare_path_fds(self) -> None:
+        """File/blockdev mode FDs. Shared FDs live in cfg.bench_path_fds
+        (opened once by the WorkerManager); --nofdsharing makes each worker
+        open its own (reference: prepareBenchPathFDsVec, ProgArgs.cpp:1981)."""
+        cfg = self.cfg
+        if cfg.bench_path_fds and not cfg.no_fd_sharing:
+            self._path_fds = cfg.bench_path_fds
+            return
+        flags = os.O_RDWR
+        if cfg.run_create_files:
+            flags |= os.O_CREAT
+        if cfg.use_direct_io:
+            flags |= os.O_DIRECT
+        self._own_path_fds = [os.open(p, flags, MKFILE_MODE)
+                              for p in cfg.paths]
+        self._path_fds = self._own_path_fds
+
+    # ------------------------------------------------------------------
+    # phase loop (reference: LocalWorker::run, LocalWorker.cpp:193-418)
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        self.prepare()
+        # capture the current uuid BEFORE signalling prep-done: the
+        # coordinator may start the first phase the moment the last worker
+        # checks in, and we must notice that uuid change
+        last_uuid = self.shared.bench_uuid
+        self.shared.inc_num_workers_done()  # prep barrier
+        try:
+            while True:
+                phase, last_uuid = self.shared.wait_for_phase_change(last_uuid)
+                if phase == BenchPhase.TERMINATE:
+                    return
+                if phase == BenchPhase.IDLE:
+                    continue
+                self.reset_stats()
+                try:
+                    while True:
+                        self._dispatch_phase(phase)
+                        if not self.cfg.do_infinite_io_loop:
+                            break
+                        self.check_interruption_request(force=True)
+                    self.finish_phase_stats()
+                    self.shared.inc_num_workers_done()
+                except WorkerInterruptedException:
+                    self.finish_phase_stats()
+                    self.shared.inc_num_workers_done()
+                except Exception as err:  # noqa: BLE001
+                    logger.log_error(
+                        f"Worker {self.rank} phase "
+                        f"{phase.name} failed: {type(err).__name__}: {err}")
+                    self.shared.inc_num_workers_done_with_error(err)
+        finally:
+            self.cleanup()
+
+    def _dispatch_phase(self, phase: BenchPhase) -> None:
+        cfg = self.cfg
+        self._num_iops_submitted = 0
+        if phase == BenchPhase.SYNC:
+            self._any_mode_sync()
+        elif phase == BenchPhase.DROPCACHES:
+            self._any_mode_drop_caches()
+        elif cfg.bench_mode == BenchMode.S3:
+            from .s3_worker_mixin import dispatch_s3_phase
+            dispatch_s3_phase(self, phase)
+        elif cfg.bench_mode == BenchMode.NETBENCH:
+            from .netbench import run_netbench_phase
+            run_netbench_phase(self, phase)
+        elif phase in (BenchPhase.CREATEDIRS, BenchPhase.DELETEDIRS,
+                       BenchPhase.STATDIRS):
+            self._dir_mode_iterate_dirs(phase)
+        elif cfg.bench_path_type == BenchPathType.DIR:
+            if cfg.tree_file_path:
+                self._custom_tree_iterate_files(phase)
+            else:
+                self._dir_mode_iterate_files(phase)
+        else:
+            self._file_mode_phase(phase)
+
+    # ------------------------------------------------------------------
+    # dir mode (reference: dirModeIterateDirs :2811 / IterateFiles :3055)
+    # ------------------------------------------------------------------
+
+    def _dir_rel_path(self, dir_idx: int) -> str:
+        """Namespace: "r<rank>/d<idx>", or shared "d<idx>" with --dirsharing
+        (reference: LocalWorker.cpp:3097 + dirsharing)."""
+        if self.cfg.do_dir_sharing:
+            return f"d{dir_idx}"
+        return f"r{self.rank}/d{dir_idx}"
+
+    def _file_rel_path(self, dir_idx: int, file_idx: int) -> str:
+        return f"{self._dir_rel_path(dir_idx)}/r{self.rank}-f{file_idx}"
+
+    def _bench_path_for_dir(self, dir_idx: int) -> str:
+        """Round-robin dirs over bench paths (reference: :3110)."""
+        paths = self.cfg.paths
+        return paths[(self.rank + dir_idx) % len(paths)]
+
+    def _dir_mode_iterate_dirs(self, phase: BenchPhase) -> None:
+        cfg = self.cfg
+        if cfg.do_dir_sharing and self.rank % cfg.num_threads != 0 \
+                and phase != BenchPhase.STATDIRS:
+            # with dirsharing only one local worker creates/deletes the
+            # shared dirs (others would collide)
+            self.got_phase_work = False
+            return
+        for dir_idx in range(cfg.num_dirs):
+            self.check_interruption_request(force=True)
+            base = self._bench_path_for_dir(dir_idx)
+            rel = self._dir_rel_path(dir_idx)
+            path = os.path.join(base, rel)
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.CREATEDIRS:
+                os.makedirs(path, MKDIR_MODE, exist_ok=True)
+            elif phase == BenchPhase.DELETEDIRS:
+                try:
+                    os.rmdir(path)
+                    parent = os.path.dirname(path)
+                    if os.path.basename(parent).startswith("r"):
+                        try:
+                            os.rmdir(parent)  # remove empty rank dir
+                        except OSError:
+                            pass
+                except FileNotFoundError:
+                    if not cfg.ignore_delete_errors:
+                        raise
+            else:  # STATDIRS
+                os.stat(path)
+            lat_usec = (time.perf_counter_ns() - t0) // 1000
+            self.entries_latency_histo.add_latency(lat_usec)
+            self.live_ops.num_entries_done += 1
+
+    def _dir_mode_iterate_files(self, phase: BenchPhase) -> None:
+        """open -> [stat-inline] -> block loop -> close per file; entry
+        latency histogram per file (reference: dirModeIterateFiles
+        :3055-3281, unlinkat/fstatat for del/stat :3237-3249)."""
+        cfg = self.cfg
+        for dir_idx in range(cfg.num_dirs):
+            for file_idx in range(cfg.num_files):
+                self.check_interruption_request(force=True)
+                base = self._bench_path_for_dir(dir_idx)
+                path = os.path.join(base,
+                                    self._file_rel_path(dir_idx, file_idx))
+                t0 = time.perf_counter_ns()
+                if phase == BenchPhase.CREATEFILES:
+                    self._write_one_file(path)
+                elif phase == BenchPhase.READFILES:
+                    self._read_one_file(path)
+                elif phase == BenchPhase.STATFILES:
+                    os.stat(path)
+                elif phase == BenchPhase.DELETEFILES:
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        if not cfg.ignore_delete_errors:
+                            raise
+                lat_usec = (time.perf_counter_ns() - t0) // 1000
+                self.entries_latency_histo.add_latency(lat_usec)
+                self.live_ops.num_entries_done += 1
+
+    def _open_flags_write(self) -> int:
+        cfg = self.cfg
+        flags = os.O_WRONLY | os.O_CREAT
+        if cfg.rwmix_read_pct or cfg.do_read_inline or cfg.do_direct_verify:
+            flags = os.O_RDWR | os.O_CREAT
+        if cfg.use_direct_io:
+            flags |= os.O_DIRECT
+        if cfg.do_truncate:
+            flags |= os.O_TRUNC
+        return flags
+
+    def _write_one_file(self, path: str) -> None:
+        cfg = self.cfg
+        try:
+            fd = os.open(path, self._open_flags_write(), MKFILE_MODE)
+        except FileNotFoundError as err:
+            if not cfg.run_create_dirs:
+                # parity hint (reference: dirModeOpenAndPrepFile :7395)
+                raise WorkerException(
+                    f"File create/open failed. Did you forget to enable "
+                    f"directory creation ('--mkdirs'/-d)? Path: {path}"
+                ) from err
+            raise
+        try:
+            if cfg.do_prealloc_file and cfg.file_size:
+                os.posix_fallocate(fd, 0, cfg.file_size)
+            if cfg.do_truncate_to_size:
+                os.ftruncate(fd, cfg.file_size)
+            if cfg.file_size:
+                gen = self._make_offset_gen_for_file(is_write=True)
+                self._rw_block_sized(fd, gen, is_write=True)
+            self._apply_fadvise(fd)
+        finally:
+            os.close(fd)
+
+    def _read_one_file(self, path: str) -> None:
+        cfg = self.cfg
+        flags = os.O_RDONLY
+        if cfg.use_direct_io:
+            flags |= os.O_DIRECT
+        fd = os.open(path, flags)
+        try:
+            self._apply_fadvise(fd)
+            if cfg.file_size:
+                if cfg.use_mmap:
+                    self._rw_block_sized_mmap(fd, is_write=False)
+                else:
+                    gen = self._make_offset_gen_for_file(is_write=False)
+                    self._rw_block_sized(fd, gen, is_write=False)
+        finally:
+            os.close(fd)
+
+    def _apply_fadvise(self, fd: int) -> None:
+        flags_str = self.cfg.fadvise_flags
+        if not flags_str:
+            return
+        advice_map = {"seq": os.POSIX_FADV_SEQUENTIAL,
+                      "rand": os.POSIX_FADV_RANDOM,
+                      "willneed": os.POSIX_FADV_WILLNEED,
+                      "dontneed": os.POSIX_FADV_DONTNEED,
+                      "noreuse": os.POSIX_FADV_NOREUSE}
+        for name in flags_str.split(","):
+            name = name.strip()
+            if not name:
+                continue
+            if name not in advice_map:
+                raise WorkerException(f"unknown fadvise flag: {name}")
+            os.posix_fadvise(fd, 0, 0, advice_map[name])
+
+    # ------------------------------------------------------------------
+    # offset generator wiring (reference: initPhaseRWOffsetGen :1141-1186)
+    # ------------------------------------------------------------------
+
+    def _make_offset_gen_for_file(self, is_write: bool):
+        cfg = self.cfg
+        size, bs = cfg.file_size, cfg.block_size
+        if cfg.use_random_offsets:
+            amount = max(cfg.random_amount // max(1, cfg.num_dataset_threads),
+                         bs) if cfg.random_amount else size
+            if cfg.no_random_align:
+                return OffsetGenRandom(self._rand_offset_algo, amount, bs,
+                                       range_len=size)
+            if is_write:
+                # full-coverage LCG: every block exactly once (default for
+                # aligned random writes, reference LocalWorker.cpp:1177-1184)
+                return OffsetGenRandomAlignedFullCoverage(
+                    self._rand_offset_algo, amount, bs, range_len=size)
+            return OffsetGenRandomAligned(self._rand_offset_algo, amount, bs,
+                                          range_len=size)
+        if cfg.do_reverse_seq_offsets:
+            return OffsetGenReverseSeq(size, bs)
+        return OffsetGenSequential(size, bs)
+
+    # ------------------------------------------------------------------
+    # hot loop (reference: rwBlockSized, LocalWorker.cpp:1702-1814)
+    # ------------------------------------------------------------------
+
+    def _rw_block_sized(self, fd: int, gen, is_write: bool,
+                        file_offset_base: int = 0,
+                        multi_file: "object | None" = None) -> None:
+        """offset-gen loop -> rate limit -> [rwmix decision] -> [fill buf] ->
+        positional I/O -> [verify] -> [TPU H2D] -> latency + counters.
+
+        When the native C++ ioengine is available and the workload qualifies
+        (no verify/rwmix/TPU/opslog), the whole loop is delegated to it.
+        """
+        cfg = self.cfg
+        from ..utils.native import get_native_engine
+        native = get_native_engine()
+        if (native is not None and multi_file is None and self._tpu is None
+                and not cfg.integrity_check_salt and not cfg.rwmix_read_pct
+                and not cfg.block_variance_pct and self._ops_log is None
+                and not cfg.do_read_inline and not cfg.do_direct_verify
+                and self._rate_limiter_read is None
+                and self._rate_limiter_write is None):
+            if self._run_native_block_loop(native, fd, gen, is_write,
+                                           file_offset_base):
+                return
+        buf = self._io_buf
+        for off, length in gen:
+            do_read_this_op = (not is_write) or self._rwmix_decides_read()
+            limiter = (self._rate_limiter_read if do_read_this_op
+                       else self._rate_limiter_write)
+            if limiter:
+                # limiter sleeps can be ~1s, so check every op here
+                self.check_interruption_request(force=True)
+                limiter.wait(length)
+            else:
+                self.check_interruption_request()
+            if multi_file is not None:
+                fd, real_off = multi_file(off, length)
+            else:
+                real_off = file_offset_base + off
+            if not do_read_this_op:
+                self._pre_write_fill(buf, real_off, length)
+            t0 = time.perf_counter_ns()
+            if do_read_this_op:
+                n = os.preadv(fd, [buf[:length]], real_off)
+            else:
+                n = os.pwritev(fd, [buf[:length]], real_off)
+            lat_usec = (time.perf_counter_ns() - t0) // 1000
+            if n != length:
+                raise WorkerException(
+                    f"short {'read' if do_read_this_op else 'write'} at "
+                    f"offset {real_off}: {n} != {length}")
+            if self._ops_log:
+                self._ops_log.log_op("read" if do_read_this_op else "write",
+                                     "", real_off, length)
+            if do_read_this_op:
+                self._post_read_actions(buf, real_off, length)
+            elif cfg.do_read_inline or cfg.do_direct_verify:
+                self._inline_read_back(fd, buf, real_off, length)
+            ops = (self.live_ops_rwmix_read
+                   if (is_write and do_read_this_op) else self.live_ops)
+            histo = (self.iops_latency_histo_rwmix
+                     if (is_write and do_read_this_op)
+                     else self.iops_latency_histo)
+            histo.add_latency(lat_usec)
+            ops.num_bytes_done += n
+            ops.num_iops_done += 1
+            self._num_iops_submitted += 1
+
+    _NATIVE_CHUNK_BLOCKS = 8192
+
+    def _run_native_block_loop(self, native, fd, gen, is_write,
+                               file_offset_base) -> bool:
+        """Delegate the block loop to the C++ engine in chunks (bounded
+        memory, live-stats progress, interruptibility between chunks);
+        counters and latency buckets sync back per chunk."""
+        offsets: "list[int]" = []
+        lengths: "list[int]" = []
+        for off, length in gen:
+            offsets.append(file_offset_base + off)
+            lengths.append(length)
+            if len(offsets) >= self._NATIVE_CHUNK_BLOCKS:
+                self.check_interruption_request(force=True)
+                native.run_block_loop(
+                    fd=fd, offsets=offsets, lengths=lengths,
+                    is_write=is_write, buf_addr=self._buf_addr(),
+                    iodepth=self.cfg.io_depth, worker=self)
+                offsets, lengths = [], []
+        if offsets:
+            self.check_interruption_request(force=True)
+            native.run_block_loop(
+                fd=fd, offsets=offsets, lengths=lengths, is_write=is_write,
+                buf_addr=self._buf_addr(), iodepth=self.cfg.io_depth,
+                worker=self)
+        return True
+
+    def _buf_addr(self) -> int:
+        import ctypes
+        return ctypes.addressof(
+            ctypes.c_char.from_buffer(self._io_buf_mmap))
+
+    def _rwmix_decides_read(self) -> bool:
+        """Per-op modulo split (reference: (workerRank+numIOPSSubmitted)%100
+        < rwMixReadPercent, LocalWorker.cpp:1741-1742)."""
+        pct = self.cfg.rwmix_read_pct
+        if not pct:
+            return False
+        return (self.rank + self._num_iops_submitted) % 100 < pct
+
+    # -- write-side block content -------------------------------------------
+
+    def _pre_write_fill(self, buf: memoryview, offset: int,
+                        length: int) -> None:
+        cfg = self.cfg
+        if self._tpu is not None:
+            # TPU staging: block content originates in HBM; device->host
+            # transfer lands it in the write buffer (replaces cudaMemcpy
+            # D2H pre-write, reference LocalWorker.cpp:2437-2490). With
+            # --verify the pattern itself is generated on-device so the
+            # read-back check still holds.
+            t0 = time.perf_counter_ns()
+            self._tpu.device_to_host(buf, length,
+                                     verify_salt=cfg.integrity_check_salt,
+                                     file_offset=offset)
+            self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+            self.tpu_transfer_bytes += length
+            return
+        if cfg.integrity_check_salt:
+            self._fill_verify_pattern(buf, offset, length,
+                                      cfg.integrity_check_salt)
+        elif cfg.block_variance_pct:
+            refill = (length * cfg.block_variance_pct) // 100
+            if refill:
+                buf[:refill] = self._block_var_algo.fill_buffer(refill)
+
+    @staticmethod
+    def _fill_verify_pattern(buf: memoryview, offset: int, length: int,
+                             salt: int) -> None:
+        """Each 8-byte-aligned word = (file offset of word + salt)
+        (reference: preWriteIntegrityCheckFillBuf, LocalWorker.cpp:2124)."""
+        n_words = length // 8
+        arr = np.frombuffer(buf[:n_words * 8], dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            arr[:] = (np.arange(n_words, dtype=np.uint64) * np.uint64(8)
+                      + np.uint64(offset) + np.uint64(salt))
+        tail = length - n_words * 8
+        if tail:
+            buf[n_words * 8:length] = bytes(tail)
+
+    def _verify_read_buf(self, buf: memoryview, offset: int,
+                         length: int) -> None:
+        """memcmp + exact mismatch offset report (reference:
+        postReadIntegrityCheckVerifyBuf, LocalWorker.cpp:2170)."""
+        salt = self.cfg.integrity_check_salt
+        n_words = length // 8
+        got = np.frombuffer(buf[:n_words * 8], dtype=np.uint64)
+        with np.errstate(over="ignore"):
+            want = (np.arange(n_words, dtype=np.uint64) * np.uint64(8)
+                    + np.uint64(offset) + np.uint64(salt))
+        bad = np.nonzero(got != want)[0]
+        if bad.size:
+            first = int(bad[0])
+            raise WorkerException(
+                f"data integrity check failed at file offset "
+                f"{offset + first * 8}: expected {int(want[first]):#x}, "
+                f"got {int(got[first]):#x}")
+
+    # -- read-side block actions --------------------------------------------
+
+    def _post_read_actions(self, buf: memoryview, offset: int,
+                           length: int) -> None:
+        cfg = self.cfg
+        if self._tpu is not None:
+            # host->HBM DMA of the read block (replaces cudaMemcpy H2D post-
+            # read / cuFile read, reference LocalWorker.cpp:2633-2749)
+            t0 = time.perf_counter_ns()
+            self._tpu.host_to_device(buf, length,
+                                     verify_salt=cfg.integrity_check_salt
+                                     if cfg.do_tpu_verify else 0,
+                                     file_offset=offset)
+            self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+            self.tpu_transfer_bytes += length
+            if cfg.do_tpu_verify and cfg.integrity_check_salt:
+                return  # verified on-device by the Pallas kernel
+        if cfg.integrity_check_salt:
+            self._verify_read_buf(buf, offset, length)
+
+    def _inline_read_back(self, fd: int, buf: memoryview, offset: int,
+                          length: int) -> None:
+        """--readinline/--verifydirect: read back immediately after write
+        (reference: pwriteAndReadWrapper, LocalWorker.cpp:2566)."""
+        n = os.preadv(fd, [buf[:length]], offset)
+        if n != length:
+            raise WorkerException(f"short inline read-back at {offset}")
+        if self.cfg.integrity_check_salt:
+            self._verify_read_buf(buf, offset, length)
+
+    # ------------------------------------------------------------------
+    # mmap I/O path (reference: mmap wrappers, LocalWorker.cpp:2534+)
+    # ------------------------------------------------------------------
+
+    def _rw_block_sized_mmap(self, fd: int, is_write: bool) -> None:
+        cfg = self.cfg
+        size = cfg.file_size
+        if is_write:
+            os.ftruncate(fd, size)
+        prot = mmap.PROT_WRITE | mmap.PROT_READ if is_write else mmap.PROT_READ
+        mapped = mmap.mmap(fd, size, prot=prot)
+        try:
+            self._apply_madvise(mapped)
+            gen = self._make_offset_gen_for_file(is_write)
+            buf = self._io_buf
+            for off, length in gen:
+                self.check_interruption_request()
+                t0 = time.perf_counter_ns()
+                if is_write:
+                    self._pre_write_fill(buf, off, length)
+                    mapped[off:off + length] = buf[:length]
+                else:
+                    buf[:length] = mapped[off:off + length]
+                lat_usec = (time.perf_counter_ns() - t0) // 1000
+                if not is_write:
+                    self._post_read_actions(buf, off, length)
+                self.iops_latency_histo.add_latency(lat_usec)
+                self.live_ops.num_bytes_done += length
+                self.live_ops.num_iops_done += 1
+        finally:
+            mapped.close()
+
+    def _apply_madvise(self, mapped: mmap.mmap) -> None:
+        flags_str = self.cfg.madvise_flags
+        if not flags_str:
+            return
+        advice_map = {"seq": mmap.MADV_SEQUENTIAL,
+                      "rand": mmap.MADV_RANDOM,
+                      "willneed": mmap.MADV_WILLNEED,
+                      "dontneed": mmap.MADV_DONTNEED}
+        for name in flags_str.split(","):
+            name = name.strip()
+            if name:
+                mapped.madvise(advice_map[name])
+
+    # ------------------------------------------------------------------
+    # file/bdev mode (reference: fileModeIterateFilesSeq :3597,
+    # fileModeIterateFilesRand :3511, fileModeDeleteFiles :3769)
+    # ------------------------------------------------------------------
+
+    def _file_mode_phase(self, phase: BenchPhase) -> None:
+        cfg = self.cfg
+        if phase == BenchPhase.DELETEFILES:
+            # workers round-robin the given files (reference :3769)
+            for i, p in enumerate(cfg.paths):
+                if i % cfg.num_dataset_threads == \
+                        (self.rank % cfg.num_dataset_threads):
+                    try:
+                        os.unlink(p)
+                    except FileNotFoundError:
+                        if not cfg.ignore_delete_errors:
+                            raise
+                    self.live_ops.num_entries_done += 1
+            return
+        if phase == BenchPhase.STATFILES:
+            for p in cfg.paths:
+                os.stat(p)
+                self.live_ops.num_entries_done += 1
+            return
+
+        is_write = (phase == BenchPhase.CREATEFILES)
+        num_files = len(cfg.paths)
+        total_range = cfg.file_size * num_files
+
+        def multi_file(global_off: int, length: int) -> "tuple[int, int]":
+            """Map a global offset over the logical concatenated range to
+            (fd, in-file offset) (reference: calcFileIdxAndOffsetStriped,
+            LocalWorker.cpp:2084)."""
+            file_idx = global_off // cfg.file_size
+            return (self._path_fds[file_idx], global_off % cfg.file_size)
+
+        gen = self._make_file_mode_offset_gen(is_write, total_range)
+        if gen is None:
+            self.got_phase_work = False
+            return
+        if is_write and cfg.do_truncate_to_size:
+            for fd in self._path_fds:
+                os.ftruncate(fd, cfg.file_size)
+        # single file/bdev: global offsets ARE in-file offsets, so skip the
+        # mapping closure and let the native C++ engine take the hot loop
+        self._rw_block_sized(self._path_fds[0], gen, is_write,
+                             multi_file=multi_file if num_files > 1 else None)
+
+    def _make_file_mode_offset_gen(self, is_write: bool, total_range: int):
+        """Per-worker share of the shared file/bdev range: seq mode slices a
+        contiguous range per dataset thread; rand mode divides randamount;
+        --strided interleaves blocks (reference: initPhaseRWOffsetGen +
+        SURVEY.md section 2.4 "Shared-file striping")."""
+        cfg = self.cfg
+        bs = cfg.block_size
+        ndst = max(1, cfg.num_dataset_threads)
+        rank = self.rank % ndst
+        if cfg.use_random_offsets:
+            amount_total = cfg.random_amount or total_range
+            amount = amount_total // ndst
+            if amount < bs:
+                return None
+            if cfg.no_random_align:
+                return OffsetGenRandom(self._rand_offset_algo, amount, bs,
+                                       range_len=total_range)
+            if is_write:
+                return OffsetGenRandomAlignedFullCoverage(
+                    self._rand_offset_algo, amount, bs, range_len=total_range)
+            return OffsetGenRandomAligned(self._rand_offset_algo, amount, bs,
+                                          range_len=total_range)
+        if cfg.do_strided_access:
+            num_blocks = total_range // bs
+            blocks_per_worker = num_blocks // ndst + \
+                (1 if rank < num_blocks % ndst else 0)
+            if not blocks_per_worker:
+                return None
+            return OffsetGenStrided(blocks_per_worker * bs, bs, rank, ndst)
+        # sequential contiguous slice per dataset thread
+        slice_len = total_range // ndst
+        slice_start = rank * slice_len
+        if rank == ndst - 1:
+            slice_len = total_range - slice_start  # last takes remainder
+        if not slice_len:
+            return None
+        if cfg.do_reverse_seq_offsets:
+            return OffsetGenReverseSeq(slice_len, bs, start=slice_start)
+        return OffsetGenSequential(slice_len, bs, start=slice_start)
+
+    # ------------------------------------------------------------------
+    # custom tree mode (reference: dirModeIterateCustomDirs :2960/:3294)
+    # ------------------------------------------------------------------
+
+    def _custom_tree_iterate_files(self, phase: BenchPhase) -> None:
+        from ..toolkits.path_store import PathStore
+        cfg = self.cfg
+        store = PathStore(block_size=cfg.block_size)
+        if phase in (BenchPhase.CREATEDIRS, BenchPhase.DELETEDIRS):
+            store.load_dirs_from_file(cfg.tree_file_path)
+        else:
+            store.load_files_from_file(cfg.tree_file_path,
+                                       round_up_size=cfg.tree_round_up_size)
+        if cfg.use_custom_tree_rand:
+            store.random_shuffle(seed=42)  # same order on all hosts
+        else:
+            store.sort_by_path_len()
+        ndst = max(1, cfg.num_dataset_threads)
+        rank = self.rank % ndst
+        non_shared, shared = store.split_by_share_size(
+            cfg.file_share_size or (cfg.block_size * ndst))
+        my_files = non_shared.get_worker_sublist_non_shared(rank, ndst).elems
+        if cfg.use_custom_tree_round_robin:
+            my_files += shared.get_worker_sublist_shared_round_robin(
+                rank, ndst).elems
+        else:
+            my_files += shared.get_worker_sublist_shared(rank, ndst).elems
+        base = cfg.paths[0]
+        for elem in my_files:
+            self.check_interruption_request(force=True)
+            path = os.path.join(base, elem.path)
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.CREATEFILES:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                fd = os.open(path, self._open_flags_write(), MKFILE_MODE)
+                try:
+                    if elem.range_len:
+                        gen = OffsetGenSequential(elem.range_len,
+                                                 cfg.block_size,
+                                                 start=elem.range_start)
+                        self._rw_block_sized(fd, gen, is_write=True)
+                finally:
+                    os.close(fd)
+            elif phase == BenchPhase.READFILES:
+                flags = os.O_RDONLY | (os.O_DIRECT if cfg.use_direct_io else 0)
+                fd = os.open(path, flags)
+                try:
+                    if elem.range_len:
+                        gen = OffsetGenSequential(elem.range_len,
+                                                 cfg.block_size,
+                                                 start=elem.range_start)
+                        self._rw_block_sized(fd, gen, is_write=False)
+                finally:
+                    os.close(fd)
+            elif phase == BenchPhase.STATFILES:
+                os.stat(path)
+            elif phase == BenchPhase.DELETEFILES:
+                if elem.range_start == 0:  # only one worker deletes shared
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        if not cfg.ignore_delete_errors:
+                            raise
+            lat_usec = (time.perf_counter_ns() - t0) // 1000
+            self.entries_latency_histo.add_latency(lat_usec)
+            self.live_ops.num_entries_done += 1
+
+    # ------------------------------------------------------------------
+    # sync / dropcaches (reference: anyModeSync :8075 / DropCaches :8118)
+    # ------------------------------------------------------------------
+
+    def _any_mode_sync(self) -> None:
+        """Only the first worker syncs; others report no phase work."""
+        if self.rank % max(1, self.cfg.num_threads) != 0:
+            self.got_phase_work = False
+            return
+        os.sync()
+        self.live_ops.num_entries_done += 1
+
+    def _any_mode_drop_caches(self) -> None:
+        if self.rank % max(1, self.cfg.num_threads) != 0:
+            self.got_phase_work = False
+            return
+        try:
+            with open("/proc/sys/vm/drop_caches", "w") as f:
+                f.write("3")
+        except PermissionError as err:
+            raise WorkerException(
+                "dropping caches requires root privileges") from err
+        self.live_ops.num_entries_done += 1

@@ -1,0 +1,155 @@
+"""WorkerManager: owns the thread pool and the phase barrier.
+
+Reference: source/workers/WorkerManager.{h,cpp} — spawns LocalWorkers
+(local/service role) or one RemoteWorker per host (master role)
+(WorkerManager.cpp:159-178), prepareThreads() :143, startNextPhase() :292,
+waitForWorkersDone() :246 (condvar + periodic wakeups + time-limit check
+:110), per-phase work accounting getPhaseNumEntriesAndBytes() :334-489.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..phases import BenchMode, BenchPathType, BenchPhase
+from .local_worker import LocalWorker
+from .shared import WorkerException, WorkersSharedData
+
+WAIT_WAKEUP_SECS = 2.0  # periodic wakeup for time-limit/interrupt checks
+
+
+class WorkerManager:
+    def __init__(self, config, shared: "WorkersSharedData | None" = None):
+        self.cfg = config
+        self.shared = shared or WorkersSharedData(config)
+        self.workers: list = []
+        self.threads: "list[threading.Thread]" = []
+        self._shared_fds: "list[int]" = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def prepare_threads(self) -> None:
+        """Create workers + threads; prep acts as a barrier
+        (reference: prepareThreads + waitForWorkersDone on prep)."""
+        self._open_shared_path_fds()
+        if self.cfg.hosts and not self.cfg.run_as_service:
+            from ..service.remote_worker import RemoteWorker
+            for host_idx, host in enumerate(self.cfg.hosts):
+                worker = RemoteWorker(self.shared, host_idx, host)
+                self.workers.append(worker)
+        else:
+            for rank in range(self.cfg.num_threads):
+                worker = LocalWorker(self.shared,
+                                     self.cfg.rank_offset + rank)
+                self.workers.append(worker)
+        for worker in self.workers:
+            t = threading.Thread(target=worker.thread_start,
+                                 name=f"worker-{worker.rank}", daemon=True)
+            self.threads.append(t)
+            t.start()
+        self._wait_for_prep_done()
+
+    def _open_shared_path_fds(self) -> None:
+        """Open file/bdev bench paths once, shared across workers
+        (reference: prepareBenchPathFDsVec, ProgArgs.cpp:1981)."""
+        cfg = self.cfg
+        if cfg.bench_mode != BenchMode.POSIX \
+                or cfg.bench_path_type == BenchPathType.DIR \
+                or cfg.no_fd_sharing or not cfg.paths or cfg.hosts:
+            return
+        flags = os.O_RDWR
+        if cfg.run_create_files:
+            flags |= os.O_CREAT
+        if cfg.use_direct_io:
+            flags |= os.O_DIRECT
+        self._shared_fds = [os.open(p, flags, 0o644) for p in cfg.paths]
+        cfg.bench_path_fds = self._shared_fds
+
+    def _wait_for_prep_done(self) -> None:
+        shared = self.shared
+        with shared.cond:
+            while (shared.num_workers_done
+                   + shared.num_workers_done_with_error) < len(self.workers):
+                shared.cond.wait(WAIT_WAKEUP_SECS)
+            if shared.num_workers_done_with_error:
+                raise WorkerException(
+                    f"worker preparation failed: {shared.first_error}")
+            shared.num_workers_done = 0
+
+    def start_next_phase(self, phase: BenchPhase) -> str:
+        for worker in self.workers:
+            worker.reset_stats()
+        return self.shared.start_phase(phase)
+
+    def check_phase_time_limit(self, phase_start: float) -> None:
+        """--timelimit enforcement; called from the live-stats poll loop and
+        the done-wait loop (reference: checkPhaseTimeLimit :110)."""
+        limit = self.cfg.time_limit_secs
+        if not limit or self.shared.phase_time_expired:
+            return
+        if (time.monotonic() - phase_start) > limit:
+            self.shared.phase_time_expired = True
+            self.interrupt_and_notify_workers()
+
+    def wait_for_workers_done(self, phase_start: float) -> None:
+        """Block until all workers finished the phase; periodic wakeups
+        check the phase time limit (reference: waitForWorkersDone :246 +
+        checkPhaseTimeLimit :110). Raises on worker error (fail-fast)."""
+        shared = self.shared
+        with shared.cond:
+            while True:
+                total = shared.num_workers_done \
+                    + shared.num_workers_done_with_error
+                if total >= len(self.workers):
+                    break
+                self.check_phase_time_limit(phase_start)
+                shared.cond.wait(WAIT_WAKEUP_SECS)
+            shared.cpu_util_last_done = shared.cpu_util.update()
+            if shared.num_workers_done_with_error:
+                raise WorkerException(str(shared.first_error))
+
+    def all_workers_done(self) -> bool:
+        shared = self.shared
+        return (shared.num_workers_done
+                + shared.num_workers_done_with_error) >= len(self.workers)
+
+    def interrupt_and_notify_workers(self) -> None:
+        for worker in self.workers:
+            worker.interrupt_execution()
+
+    def join_all_threads(self) -> None:
+        self.start_next_phase(BenchPhase.TERMINATE)
+        for t in self.threads:
+            t.join(timeout=30)
+        for fd in self._shared_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._shared_fds = []
+        self.cfg.bench_path_fds = []
+
+    # -- per-phase work accounting (reference: getPhaseNumEntriesAndBytes) --
+
+    def get_phase_num_entries_and_bytes(self, phase: BenchPhase
+                                        ) -> "tuple[int, int]":
+        cfg = self.cfg
+        nthreads = cfg.num_threads * max(1, len(cfg.hosts) or 1)
+        if phase in (BenchPhase.CREATEDIRS, BenchPhase.DELETEDIRS,
+                     BenchPhase.STATDIRS):
+            return (nthreads * cfg.num_dirs, 0)
+        if cfg.bench_path_type == BenchPathType.DIR:
+            entries = nthreads * cfg.num_dirs * cfg.num_files
+            num_bytes = entries * cfg.file_size \
+                if phase in (BenchPhase.CREATEFILES, BenchPhase.READFILES) \
+                else 0
+            return (entries, num_bytes)
+        # file/bdev mode
+        entries = len(cfg.paths)
+        if phase in (BenchPhase.CREATEFILES, BenchPhase.READFILES):
+            if cfg.use_random_offsets:
+                return (entries, cfg.random_amount)
+            return (entries, cfg.file_size * entries)
+        return (entries, 0)

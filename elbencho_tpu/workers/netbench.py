@@ -1,0 +1,10 @@
+"""Netbench phase (placeholder until the raw-TCP benchmark lands;
+reference surface: LocalWorker.cpp:626-819, 7789-8064)."""
+
+from __future__ import annotations
+
+from .shared import WorkerException
+
+
+def run_netbench_phase(worker, phase) -> None:
+    raise WorkerException("netbench mode is not available yet in this build")

@@ -1,0 +1,11 @@
+"""S3/object-storage phase dispatch (placeholder until the S3 front-end
+lands; reference surface: LocalWorker.cpp:3822-7291, 25 bench phases)."""
+
+from __future__ import annotations
+
+from .shared import WorkerException
+
+
+def dispatch_s3_phase(worker, phase) -> None:
+    raise WorkerException(
+        "S3/object storage mode is not available yet in this build")

@@ -1,0 +1,250 @@
+"""End-to-end tests of local benchmark runs through the CLI entry point
+(the reference's test strategy is end-to-end, tools/test-examples.sh;
+SURVEY.md section 4 says to exceed it with unit + integration tests)."""
+
+import json
+import os
+
+import pytest
+
+from elbencho_tpu.cli import main
+
+
+@pytest.fixture(autouse=True)
+def _no_native(monkeypatch):
+    # force pure-Python loop in tests unless a test opts in
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    reset_native_engine_cache()
+
+
+def run_cli(args):
+    return main(args + ["--nolive"])
+
+
+def test_dir_mode_full_cycle(tmp_path, capsys):
+    rc = run_cli(["-w", "-r", "-d", "-D", "-F", "--stat", "-t", "2",
+                  "-n", "2", "-N", "3", "-s", "64K", "-b", "16K",
+                  str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for phase in ("MKDIRS", "WRITE", "STAT", "READ", "RMFILES", "RMDIRS"):
+        assert phase in out
+    # everything deleted again
+    assert not any(tmp_path.iterdir())
+
+
+def test_write_without_mkdirs_gives_hint(tmp_path, capsys):
+    rc = run_cli(["-w", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+                  str(tmp_path)])
+    assert rc == 1  # parity: reference hints at the missing -d flag
+
+
+def test_dir_mode_files_created_with_right_size(tmp_path):
+    rc = run_cli(["-w", "-d", "-t", "2", "-n", "1", "-N", "2", "-s", "10K",
+                  "-b", "4K", str(tmp_path)])
+    assert rc == 0
+    files = sorted(tmp_path.rglob("r*-f*"))
+    assert len(files) == 4  # 2 threads x 1 dir x 2 files
+    assert all(f.stat().st_size == 10240 for f in files)
+    # namespace parity: r<rank>/d<dir>/r<rank>-f<file>
+    rel = files[0].relative_to(tmp_path)
+    parts = rel.parts
+    assert parts[0].startswith("r") and parts[1].startswith("d")
+
+
+def test_file_mode_seq_write_read(tmp_path):
+    target = tmp_path / "bigfile"
+    rc = run_cli(["-w", "-r", "-t", "2", "-s", "1M", "-b", "64K",
+                  str(target)])
+    assert rc == 0
+    assert target.stat().st_size == 1 << 20
+
+
+def test_file_mode_multiple_files_striped(tmp_path):
+    t1, t2 = tmp_path / "f1", tmp_path / "f2"
+    rc = run_cli(["-w", "-t", "2", "-s", "256K", "-b", "64K",
+                  str(t1), str(t2)])
+    assert rc == 0
+    assert t1.stat().st_size == 256 * 1024
+    assert t2.stat().st_size == 256 * 1024
+
+
+def test_verify_data_integrity(tmp_path):
+    """--verify: write with pattern then read+check (the reference's
+    self-verification mechanism, test-examples.sh:228-288)."""
+    rc = run_cli(["-w", "-d", "-r", "-t", "2", "-n", "1", "-N", "2", "-s", "32K",
+                  "-b", "8K", "--verify", "42", str(tmp_path)])
+    assert rc == 0
+
+
+def test_verify_detects_corruption(tmp_path):
+    rc = run_cli(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "16K",
+                  "-b", "16K", "--verify", "42", str(tmp_path)])
+    assert rc == 0
+    victim = next(tmp_path.rglob("r*-f*"))
+    data = bytearray(victim.read_bytes())
+    data[100] ^= 0xFF
+    victim.write_bytes(bytes(data))
+    rc = run_cli(["-r", "-t", "1", "-n", "1", "-N", "1", "-s", "16K",
+                  "-b", "16K", "--verify", "42", str(tmp_path)])
+    assert rc != 0  # corruption must fail the run
+
+
+def test_random_read(tmp_path):
+    target = tmp_path / "file"
+    assert run_cli(["-w", "-t", "1", "-s", "1M", "-b", "4K",
+                    str(target)]) == 0
+    rc = run_cli(["-r", "--rand", "--randamount", "256K", "-t", "2",
+                  "-s", "1M", "-b", "4K", str(target)])
+    assert rc == 0
+
+
+def test_random_write_full_coverage(tmp_path):
+    """Aligned random write uses the full-coverage LCG: file must be fully
+    written (no holes) after the phase."""
+    target = tmp_path / "file"
+    rc = run_cli(["-w", "--rand", "-t", "1", "-s", "256K", "-b", "4K",
+                  str(target)])
+    assert rc == 0
+    data = target.read_bytes()
+    assert len(data) == 256 * 1024
+    # every 4K block non-zero (io buffer is random-filled)
+    for blk in range(0, len(data), 4096):
+        assert any(data[blk:blk + 64])
+
+
+def test_backward_and_strided(tmp_path):
+    target = tmp_path / "file"
+    assert run_cli(["-w", "-t", "1", "-s", "512K", "-b", "64K",
+                    str(target)]) == 0
+    assert run_cli(["-r", "--backward", "-t", "1", "-s", "512K", "-b", "64K",
+                    str(target)]) == 0
+    assert run_cli(["-r", "--strided", "-t", "2", "-s", "512K", "-b", "64K",
+                    str(target)]) == 0
+
+
+def test_rwmix(tmp_path):
+    # pre-create the dataset: rwmix reads target already-written files
+    assert run_cli(["-w", "-d", "-t", "2", "-n", "1", "-N", "2",
+                    "-s", "64K", "-b", "8K", str(tmp_path)]) == 0
+    rc = run_cli(["-w", "--rwmixpct", "50", "-t", "2", "-n", "1", "-N", "2",
+                  "-s", "64K", "-b", "8K", str(tmp_path)])
+    assert rc == 0
+
+
+def test_csv_and_json_output(tmp_path):
+    csv_path = tmp_path / "out.csv"
+    json_path = tmp_path / "out.json"
+    bench_dir = tmp_path / "bench"
+    bench_dir.mkdir()
+    rc = run_cli(["-w", "-d", "-r", "-t", "1", "-n", "1", "-N", "2", "-s", "16K",
+                  "-b", "16K", "--csvfile", str(csv_path),
+                  "--jsonfile", str(json_path), "--label", "mytest",
+                  str(bench_dir)])
+    assert rc == 0
+    lines = csv_path.read_text().strip().splitlines()
+    assert len(lines) == 4  # header + MKDIRS + WRITE + READ
+    header = lines[0].split(",")
+    assert "Phase" in header and "IOPSLast" in header
+    records = [json.loads(ln) for ln in
+               json_path.read_text().strip().splitlines()]
+    assert [r["Phase"] for r in records] == ["MKDIRS", "WRITE", "READ"]
+    assert records[0]["Label"] == "mytest"
+    assert records[1]["EntriesLast"] == 2
+    assert records[2]["BytesLast"] == 2 * 16384
+
+
+def test_resfile(tmp_path):
+    res_path = tmp_path / "results.txt"
+    bench_dir = tmp_path / "bench"
+    bench_dir.mkdir()
+    rc = run_cli(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+                  "-b", "4K", "--resfile", str(res_path), str(bench_dir)])
+    assert rc == 0
+    assert "WRITE" in res_path.read_text()
+
+
+def test_dry_run(tmp_path, capsys):
+    rc = run_cli(["-w", "-r", "-t", "2", "-n", "3", "-N", "4", "-s", "1M",
+                  "--dryrun", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Dry run" in out
+    assert "24 entries" in out  # 2 threads x 3 dirs x 4 files
+
+
+def test_iterations(tmp_path, capsys):
+    rc = run_cli(["-w", "-d", "-F", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+                  "-b", "4K", "-i", "2", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("WRITE") == 2
+
+
+def test_time_limit_interrupts(tmp_path):
+    """--timelimit: a huge workload must stop shortly after the limit."""
+    import time
+    target = tmp_path / "f"
+    t0 = time.monotonic()
+    rc = run_cli(["-w", "-t", "1", "-s", "8G", "-b", "4K",
+                  "--timelimit", "1", "--limitwrite", "64M", str(target)])
+    elapsed = time.monotonic() - t0
+    assert rc == 0
+    assert elapsed < 10
+
+
+def test_mmap_read(tmp_path):
+    target = tmp_path / "file"
+    assert run_cli(["-w", "-t", "1", "-s", "256K", "-b", "64K",
+                    str(target)]) == 0
+    rc = run_cli(["-r", "--mmap", "-t", "1", "-s", "256K", "-b", "64K",
+                  str(target)])
+    assert rc == 0
+
+
+def test_version_and_help(capsys):
+    assert main(["--version"]) == 0
+    assert "elbencho-tpu" in capsys.readouterr().out
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "--tpuperservice" not in out  # tpu flags live in --help-tpu tier
+    assert main(["--help-tpu"]) == 0
+    assert "--tpuids" in capsys.readouterr().out
+
+
+def test_no_paths_shows_help(capsys):
+    assert main([]) == 1
+
+
+def test_opslog(tmp_path):
+    log_path = tmp_path / "ops.jsonl"
+    bench_dir = tmp_path / "bench"
+    bench_dir.mkdir()
+    rc = run_cli(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "8K",
+                  "-b", "4K", "--opslog", str(log_path), str(bench_dir)])
+    assert rc == 0
+    records = [json.loads(ln) for ln in
+               log_path.read_text().strip().splitlines()]
+    writes = [r for r in records if r["op_name"] == "write"]
+    assert len(writes) == 2  # 8K file in 4K blocks
+    assert {r["offset"] for r in writes} == {0, 4096}
+
+
+def test_custom_tree(tmp_path):
+    treefile = tmp_path / "tree.txt"
+    treefile.write_text("d sub1\nd sub2\n"
+                        "f 8192 sub1/a.bin\nf 4096 sub2/b.bin\nf 100 c.txt\n")
+    bench_dir = tmp_path / "bench"
+    bench_dir.mkdir()
+    rc = run_cli(["-w", "-r", "-F", "-t", "2", "-b", "4K",
+                  "--treefile", str(treefile), str(bench_dir)])
+    assert rc == 0
+    assert not (bench_dir / "sub1" / "a.bin").exists()
+
+
+def test_infloop_with_timelimit(tmp_path):
+    rc = run_cli(["-w", "-d", "--infloop", "--timelimit", "1", "-t", "1",
+                  "-n", "1", "-N", "1", "-s", "4K", "-b", "4K",
+                  str(tmp_path)])
+    assert rc == 0
