@@ -1,0 +1,90 @@
+"""Driver benchmark: sequential read from storage into TPU HBM.
+
+This is BASELINE.json config 3 — the north-star TPU data path ("seq read ->
+TPU HBM via --tpuids", the reference's cudaMemcpy/cuFile GPU path re-done on
+PjRt). Two passes over the same file:
+
+  1. baseline: read -> host buffers only (what any storage benchmark does)
+  2. measured: read -> host -> HBM DMA, pipelined to --iodepth
+
+vs_baseline = HBM-ingest MiB/s / host-only read MiB/s, i.e. how much of the
+raw storage bandwidth survives when every block is additionally staged into
+TPU HBM (1.0 = the TPU leg is fully hidden by pipelining). The reference
+publishes no GPU-path numbers (BASELINE.md: published == {}), so the
+self-relative ratio is the honest comparison.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+FILE_SIZE = "256M"
+BLOCK_SIZE = "16M"
+IO_DEPTH = "8"
+
+
+def _run_cli(args, jsonfile):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
+           "--jsonfile", jsonfile] + args
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=600)
+    if res.returncode != 0:
+        raise RuntimeError(f"bench run failed: {res.stderr[-2000:]}")
+    with open(jsonfile) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def main() -> int:
+    tmpdir = tempfile.mkdtemp(prefix="elbencho_tpu_bench_")
+    target = os.path.join(tmpdir, "benchfile")
+    j1 = os.path.join(tmpdir, "w.json")
+    j2 = os.path.join(tmpdir, "host.json")
+    j3 = os.path.join(tmpdir, "hbm.json")
+    warm = os.path.join(tmpdir, "warm.json")
+    try:
+        # create the file (host path)
+        _run_cli(["-w", "-t", "1", "-s", FILE_SIZE, "-b", BLOCK_SIZE,
+                  target], j1)
+        # pass 1: host-only read baseline
+        host = _run_cli(["-r", "-t", "1", "-s", FILE_SIZE, "-b", BLOCK_SIZE,
+                         target], j2)
+        host_mibs = next(r["MiBPerSecLast"] for r in host
+                         if r["Phase"] == "READ")
+        # warmup (jit compile) then pass 2: read -> TPU HBM, pipelined
+        _run_cli(["-r", "-t", "1", "-s", BLOCK_SIZE, "-b", BLOCK_SIZE,
+                  "--tpuids", "0", target], warm)
+        hbm = _run_cli(["-r", "-t", "1", "-s", FILE_SIZE, "-b", BLOCK_SIZE,
+                        "--iodepth", IO_DEPTH, "--tpuids", "0", target], j3)
+        hbm_rec = next(r for r in hbm if r["Phase"] == "READ")
+        hbm_mibs = hbm_rec["TpuHbmMiBPerSec"] or hbm_rec["MiBPerSecLast"]
+        print(json.dumps({
+            "metric": "seq read 16M blocks into TPU HBM (1 chip, iodepth 8)",
+            "value": round(hbm_mibs, 1),
+            "unit": "MiB/s",
+            "vs_baseline": round(hbm_mibs / max(host_mibs, 1e-9), 3),
+        }))
+        return 0
+    finally:
+        for p in (target, j1, j2, j3, warm):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            os.rmdir(tmpdir)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Flagship device-side workload: the HBM ingest pipeline step.
+
+This framework's "model" is its device-side data pipeline (the reference
+has no NN models; its GPU work is buffer staging + curand fill,
+LocalWorker.cpp:1427-1537). The flagship jittable step combines everything
+the TPU data path does to a block resident in HBM:
+
+  1. scramble (PRNG xor-mix; block-variance analogue)
+  2. fingerprint (sum + xor reduction; on-device integrity verify)
+
+It is what ``__graft_entry__.entry()`` exposes for the single-chip compile
+check, and the per-shard body of the pod-wide sharded step in
+parallel/ingest.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def scramble_fingerprint_core(block_u32, key):
+    """Shared per-shard body: scramble + (sum, xor) fingerprints. Used by
+    both the single-chip flagship step and the per-shard function of the
+    pod-wide sharded step (parallel/ingest.py) so they cannot diverge."""
+    bits = jax.random.bits(key, block_u32.shape, dtype=jnp.uint32)
+    scrambled = block_u32 ^ bits
+    total = jnp.sum(scrambled, dtype=jnp.uint32)
+    xor = jax.lax.reduce(scrambled, jnp.uint32(0), jax.lax.bitwise_xor,
+                         tuple(range(scrambled.ndim)))
+    return scrambled, total, xor
+
+
+@jax.jit
+def ingest_block_step(block_u32, key):
+    """(block, key) -> (scrambled block, sum fingerprint, xor fingerprint)."""
+    return scramble_fingerprint_core(block_u32, key)
+
+
+def example_block(num_bytes: int = 1 << 20):
+    """Example args for the flagship step: one 1 MiB block + PRNG key."""
+    import numpy as np
+    block = np.zeros(num_bytes // 4, dtype=np.uint32)
+    key = jax.random.PRNGKey(0)
+    return block, key

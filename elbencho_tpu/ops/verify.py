@@ -44,14 +44,19 @@ def _fingerprint_kernel(x_ref, sum_ref, xor_ref):
                                    list(range(x.ndim)))
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
+_pallas_broken = False
+
+
 def fingerprint_block_pallas(block_u32, num_words: int):
     """(sum mod 2^32, xor) of a uint32 block via a Pallas VMEM kernel;
-    falls back to plain jnp reduction where Pallas is unavailable."""
-    from jax.experimental import pallas as pl
+    falls back to the plain jnp reduction when the block shape doesn't tile
+    to the lane count or Pallas can't lower on this backend (fallback is
+    decided here, outside jit — lowering errors surface at compile time)."""
+    global _pallas_broken
     rows = max(num_words // _LANES, 1)
-    if rows * _LANES != num_words:
+    if _pallas_broken or rows * _LANES != num_words:
         return fingerprint_block_jnp(block_u32)
+    from jax.experimental import pallas as pl
     x2d = block_u32.reshape(rows, _LANES)
     try:
         out_sum, out_xor = pl.pallas_call(
@@ -60,7 +65,13 @@ def fingerprint_block_pallas(block_u32, num_words: int):
                        jax.ShapeDtypeStruct((1, 1), jnp.uint32)),
         )(x2d)
         return out_sum[0, 0], out_xor[0, 0]
-    except Exception:  # pragma: no cover - pallas unavailable on backend
+    except Exception as err:  # pragma: no cover - pallas can't lower here
+        if not _pallas_broken:
+            from ..toolkits import logger
+            logger.log_error(
+                f"Pallas fingerprint kernel unavailable on this backend "
+                f"({type(err).__name__}); using jnp fallback from now on")
+        _pallas_broken = True
         return fingerprint_block_jnp(block_u32)
 
 

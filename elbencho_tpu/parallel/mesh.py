@@ -1,0 +1,41 @@
+"""Device mesh construction for pod-wide ingest.
+
+The reference scales by hosts x threads over HTTP (SURVEY.md section 2.4);
+the TPU-native scaling axis is a ``jax.sharding.Mesh`` over all chips of a
+pod slice: the ("host", "chip") mesh mirrors the reference's
+hosts-by-threads work partitioning, and XLA collectives over ICI replace
+the master's stats aggregation for on-device reductions.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_ingest_mesh(devices: "list | None" = None,
+                     num_hosts: "int | None" = None) -> Mesh:
+    """2D ("host", "chip") mesh over the given devices.
+
+    On a real pod slice the "host" axis matches process boundaries
+    (jax.process_count()); on a flat single-host set (or the virtual CPU
+    mesh) the devices are factored into the most balanced 2D grid so both
+    axes are exercised.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if num_hosts is None:
+        num_hosts = jax.process_count() if jax.process_count() > 1 else None
+    if num_hosts is None:
+        # most balanced factorization h*c == n with h <= c
+        num_hosts = 1
+        for h in range(int(np.sqrt(n)), 0, -1):
+            if n % h == 0:
+                num_hosts = h
+                break
+    chips_per_host = n // num_hosts
+    grid = np.array(devices[:num_hosts * chips_per_host]).reshape(
+        num_hosts, chips_per_host)
+    return Mesh(grid, axis_names=("host", "chip"))

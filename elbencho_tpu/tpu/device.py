@@ -25,6 +25,7 @@ tpu_transfer_usec) and reported by Statistics as "HBM ingest" rows.
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -40,6 +41,17 @@ def _get_jax():
         with _jax_lock:
             if _jax_mod is None:
                 import jax
+                try:
+                    # persistent compile cache: TPU jit compiles are 20-40s,
+                    # benchmark processes are short-lived
+                    jax.config.update(
+                        "jax_compilation_cache_dir",
+                        os.environ.get("ELBENCHO_TPU_JIT_CACHE",
+                                       "/tmp/elbencho_tpu_jit_cache"))
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", 0.5)
+                except Exception:  # pragma: no cover - older jax
+                    pass
                 _jax_mod = jax
     return _jax_mod
 
@@ -53,8 +65,11 @@ class TpuWorkerContext:
     """Per-worker handle to one TPU chip's HBM (CuFileHandleData analogue,
     reference source/CuFileHandleData.h:18-73)."""
 
+    #: device-resident pre-filled source blocks (curand-at-alloc parity)
+    _FILL_POOL_BLOCKS = 4
+
     def __init__(self, chip_id: int, block_size: int, direct: bool = False,
-                 verify_on_device: bool = False):
+                 verify_on_device: bool = False, pipeline_depth: int = 1):
         jax = _get_jax()
         devices = jax.devices()
         if not devices:
@@ -64,30 +79,51 @@ class TpuWorkerContext:
         self.block_size = block_size
         self.direct = direct
         self.verify_on_device = verify_on_device
+        self.pipeline_depth = max(pipeline_depth, 1)
         self._key = jax.random.PRNGKey(chip_id)
-        self._fill_counter = 0
-        # device-resident staging target for reads; rotated per transfer
-        self._last_ingested = None
-        # pre-warm the on-device fill (first jit compile is slow)
         self._num_words = max(block_size // 4, 1)
+        # write-source pool: filled ONCE on first use, like the reference's
+        # curandGenerate at allocGPUIOBuffer time (LocalWorker.cpp:1427);
+        # device_to_host then only pays the D2H DMA, not per-block RNG.
+        # Lazy so read-only workloads never compile the fill kernel.
+        self._fill_pool: list = []
+        self._fill_idx = 0
+        # in-flight H2D transfers (pipelined up to --iodepth; the completion
+        # wait happens when the ring is full or at flush())
+        from collections import deque
+        self._inflight = deque()
+        self._last_ingested = None
 
     # -- read path: host buffer -> HBM --------------------------------------
 
     def host_to_device(self, buf: memoryview, length: int,
                        verify_salt: int = 0, file_offset: int = 0) -> None:
-        """DMA the freshly-read block into HBM and wait for completion
-        (replaces cudaMemcpyAsync H2D + sync, LocalWorker.cpp:2437-2490).
-        With --tpuverify, run the on-device fingerprint check instead of a
-        host-side memcmp."""
+        """DMA the freshly-read block into HBM (replaces cudaMemcpyAsync H2D,
+        LocalWorker.cpp:2437-2490). With pipeline_depth == 1 (default) the
+        call waits for completion so per-block latency stays honest; deeper
+        pipelines overlap up to --iodepth transfers and only wait when the
+        ring is full (documented pipelined mode, SURVEY.md section 7 "TPU
+        transfer overlap"). With --tpuverify, the on-device fingerprint
+        check replaces the host-side memcmp."""
         jax = _get_jax()
         n_words = length // 4
         np_view = np.frombuffer(buf[:n_words * 4], dtype=np.uint32)
         arr = jax.device_put(np_view, self.device)
-        arr.block_until_ready()
+        self._inflight.append(arr)
+        # drain to at most depth-1 in flight: with io_depth rotating host
+        # buffers, the buffer reused next is then guaranteed drained
+        # (depth == 1 -> fully synchronous, per-block latency honest)
+        while len(self._inflight) >= self.pipeline_depth:
+            self._inflight.popleft().block_until_ready()
         self._last_ingested = arr  # keep resident (benchmark sink)
         if verify_salt and self.verify_on_device:
             from ..ops.verify import verify_block_on_device
             verify_block_on_device(arr, file_offset, length, verify_salt)
+
+    def flush(self) -> None:
+        """Drain all pipelined transfers (phase-end completion wait)."""
+        while self._inflight:
+            self._inflight.popleft().block_until_ready()
 
     # -- write path: HBM -> host buffer --------------------------------------
 
@@ -97,17 +133,24 @@ class TpuWorkerContext:
         on-device verify pattern when --verify is active) and is DMA'd to
         the host I/O buffer (replaces curandGenerate + cudaMemcpy D2H,
         LocalWorker.cpp:1427-1537 / :2437)."""
-        jax = _get_jax()
         n_words = max(length // 4, 1)
         if verify_salt:
             from ..ops.fill import verify_pattern_block_u32
             params = _split_u64_params(file_offset, verify_salt)
             arr = verify_pattern_block_u32(params, n_words)
         else:
-            from ..ops.fill import random_block_u32
-            self._fill_counter += 1
-            key = jax.random.fold_in(self._key, self._fill_counter)
-            arr = random_block_u32(key, n_words)
+            # cycle the pre-filled HBM pool (curand-at-alloc parity)
+            if not self._fill_pool:
+                jax = _get_jax()
+                from ..ops.fill import random_block_u32
+                for i in range(self._FILL_POOL_BLOCKS):
+                    key = jax.random.fold_in(self._key, i)
+                    self._fill_pool.append(
+                        random_block_u32(key, self._num_words))
+            self._fill_idx = (self._fill_idx + 1) % len(self._fill_pool)
+            arr = self._fill_pool[self._fill_idx]
+            if n_words != self._num_words:
+                arr = arr[:n_words]
         host = np.asarray(arr)  # D2H transfer
         raw = host.tobytes()
         buf[:len(raw[:length])] = raw[:length]
@@ -115,7 +158,9 @@ class TpuWorkerContext:
             buf[(length // 8) * 8:length] = bytes(length - (length // 8) * 8)
 
     def close(self) -> None:
+        self.flush()
         self._last_ingested = None
+        self._fill_pool = []
 
 
 def _split_u64_params(file_offset: int, salt: int):

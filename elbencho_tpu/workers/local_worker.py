@@ -44,7 +44,10 @@ class LocalWorker(Worker):
     def __init__(self, shared, rank: int):
         super().__init__(shared, rank)
         self.cfg = shared.config
-        self._io_buf_mmap: "mmap.mmap | None" = None
+        # io_depth buffers so async/pipelined paths never overwrite a block
+        # still in flight (reference: allocIOBuffer x iodepth, :1386)
+        self._io_buf_mmaps: "list[mmap.mmap]" = []
+        self._io_bufs: "list[memoryview]" = []
         self._io_buf: "memoryview | None" = None
         self._own_path_fds: "list[int]" = []
         self._path_fds: "list[int]" = []
@@ -72,7 +75,8 @@ class LocalWorker(Worker):
             chip = cfg.tpu_ids[self.rank % len(cfg.tpu_ids)]
             self._tpu = TpuWorkerContext(
                 chip_id=chip, block_size=cfg.block_size,
-                direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify)
+                direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify,
+                pipeline_depth=max(cfg.io_depth, 1))
         if cfg.bench_path_type != BenchPathType.DIR \
                 and cfg.bench_mode == BenchMode.POSIX:
             self._prepare_path_fds()
@@ -97,16 +101,23 @@ class LocalWorker(Worker):
             except OSError:
                 pass
         self._own_path_fds = []
-        if self._io_buf is not None:
-            self._io_buf.release()
-            self._io_buf = None
-        if self._io_buf_mmap is not None:
-            self._io_buf_mmap.close()
-            self._io_buf_mmap = None
+        if self._tpu is not None:
+            self._tpu.close()  # drop device arrays before buffer teardown
+            self._tpu = None
+        self._io_buf = None
+        for mv in self._io_bufs:
+            mv.release()
+        self._io_bufs = []
+        import gc
+        gc.collect()  # drop stray numpy views of the mmaps (jax transfers)
+        for m in self._io_buf_mmaps:
+            try:
+                m.close()
+            except BufferError:
+                pass  # an exported view outlived us; the OS reclaims anyway
+        self._io_buf_mmaps = []
         if self._ops_log is not None:
             self._ops_log.close()
-        if self._tpu is not None:
-            self._tpu.close()
 
     def _apply_core_binding(self) -> None:
         """Round-robin worker->core binding (reference: --cores/--zones via
@@ -129,15 +140,20 @@ class LocalWorker(Worker):
                 bind_to_numa_zone(zones[self.rank % len(zones)])
 
     def _alloc_io_buffer(self) -> None:
-        """Page-aligned I/O buffer via anonymous mmap (replaces the
-        reference's posix_memalign, LocalWorker.cpp:1401) — page alignment
-        satisfies O_DIRECT. Pre-filled with random data so writes aren't
-        trivially compressible (reference: allocIOBuffer :1386)."""
+        """Page-aligned I/O buffers via anonymous mmap, one per iodepth slot
+        (replaces the reference's posix_memalign x iodepth,
+        LocalWorker.cpp:1386-1401) — page alignment satisfies O_DIRECT.
+        Pre-filled with random data so writes aren't trivially
+        compressible."""
         size = max(self.cfg.block_size, 1)
-        self._io_buf_mmap = mmap.mmap(-1, size)
-        self._io_buf = memoryview(self._io_buf_mmap)
         fill = create_rand_algo("fast", seed=self.rank + 1)
-        self._io_buf[:] = fill.fill_buffer(size)
+        for _ in range(max(self.cfg.io_depth, 1)):
+            m = mmap.mmap(-1, size)
+            mv = memoryview(m)
+            mv[:] = fill.fill_buffer(size)
+            self._io_buf_mmaps.append(m)
+            self._io_bufs.append(mv)
+        self._io_buf = self._io_bufs[0]
 
     def _prepare_path_fds(self) -> None:
         """File/blockdev mode FDs. Shared FDs live in cfg.bench_path_fds
@@ -417,8 +433,10 @@ class LocalWorker(Worker):
             if self._run_native_block_loop(native, fd, gen, is_write,
                                            file_offset_base):
                 return
-        buf = self._io_buf
+        num_bufs = len(self._io_bufs)
         for off, length in gen:
+            # rotate buffers so pipelined TPU transfers never race a reuse
+            buf = self._io_bufs[self._num_iops_submitted % num_bufs]
             do_read_this_op = (not is_write) or self._rwmix_decides_read()
             limiter = (self._rate_limiter_read if do_read_this_op
                        else self._rate_limiter_write)
@@ -460,6 +478,10 @@ class LocalWorker(Worker):
             ops.num_bytes_done += n
             ops.num_iops_done += 1
             self._num_iops_submitted += 1
+        if self._tpu is not None:
+            t0 = time.perf_counter_ns()
+            self._tpu.flush()  # drain pipelined transfers before phase end
+            self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
 
     _NATIVE_CHUNK_BLOCKS = 8192
 
@@ -491,7 +513,7 @@ class LocalWorker(Worker):
     def _buf_addr(self) -> int:
         import ctypes
         return ctypes.addressof(
-            ctypes.c_char.from_buffer(self._io_buf_mmap))
+            ctypes.c_char.from_buffer(self._io_buf_mmaps[0]))
 
     def _rwmix_decides_read(self) -> bool:
         """Per-op modulo split (reference: (workerRank+numIOPSSubmitted)%100
@@ -603,9 +625,10 @@ class LocalWorker(Worker):
         try:
             self._apply_madvise(mapped)
             gen = self._make_offset_gen_for_file(is_write)
-            buf = self._io_buf
+            num_bufs = len(self._io_bufs)
             for off, length in gen:
                 self.check_interruption_request()
+                buf = self._io_bufs[self._num_iops_submitted % num_bufs]
                 t0 = time.perf_counter_ns()
                 if is_write:
                     self._pre_write_fill(buf, off, length)
@@ -618,6 +641,12 @@ class LocalWorker(Worker):
                 self.iops_latency_histo.add_latency(lat_usec)
                 self.live_ops.num_bytes_done += length
                 self.live_ops.num_iops_done += 1
+                self._num_iops_submitted += 1
+            if self._tpu is not None:
+                t0 = time.perf_counter_ns()
+                self._tpu.flush()
+                self.tpu_transfer_usec += \
+                    (time.perf_counter_ns() - t0) // 1000
         finally:
             mapped.close()
 

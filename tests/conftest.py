@@ -3,7 +3,9 @@ tests run without TPU hardware (SURVEY.md environment notes)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# this box pins JAX_PLATFORMS=axon (one real TPU chip); tests must run on
+# the virtual 8-device CPU mesh instead
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
