@@ -47,6 +47,18 @@ def _fingerprint_kernel(x_ref, sum_ref, xor_ref):
 _pallas_broken = False
 
 
+@functools.lru_cache(maxsize=64)
+def _pallas_fingerprint_call(rows: int):
+    """One pallas_call per block shape so the hot loop hits jax's dispatch
+    cache instead of rebuilding/retracing the kernel per block."""
+    from jax.experimental import pallas as pl
+    return pl.pallas_call(
+        _fingerprint_kernel,
+        out_shape=(jax.ShapeDtypeStruct((1, 1), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.uint32)),
+    )
+
+
 def fingerprint_block_pallas(block_u32, num_words: int):
     """(sum mod 2^32, xor) of a uint32 block via a Pallas VMEM kernel;
     falls back to the plain jnp reduction when the block shape doesn't tile
@@ -56,14 +68,9 @@ def fingerprint_block_pallas(block_u32, num_words: int):
     rows = max(num_words // _LANES, 1)
     if _pallas_broken or rows * _LANES != num_words:
         return fingerprint_block_jnp(block_u32)
-    from jax.experimental import pallas as pl
     x2d = block_u32.reshape(rows, _LANES)
     try:
-        out_sum, out_xor = pl.pallas_call(
-            _fingerprint_kernel,
-            out_shape=(jax.ShapeDtypeStruct((1, 1), jnp.uint32),
-                       jax.ShapeDtypeStruct((1, 1), jnp.uint32)),
-        )(x2d)
+        out_sum, out_xor = _pallas_fingerprint_call(rows)(x2d)
         return out_sum[0, 0], out_xor[0, 0]
     except Exception as err:  # pragma: no cover - pallas can't lower here
         if not _pallas_broken:

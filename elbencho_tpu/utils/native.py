@@ -43,13 +43,15 @@ class _NativeEngine:
         ]
 
     def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,
-                       buf_addr: int, iodepth: int, worker) -> bool:
+                       buf_addr: int, iodepth: int, worker,
+                       interrupt_flag=None) -> bool:
         n = len(offsets)
         off_arr = (ctypes.c_uint64 * n)(*offsets)
         len_arr = (ctypes.c_uint64 * n)(*lengths)
         lat_arr = (ctypes.c_uint64 * n)()
         bytes_done = ctypes.c_uint64(0)
-        interrupt = ctypes.c_int(0)
+        interrupt = (interrupt_flag if interrupt_flag is not None
+                     else ctypes.c_int(0))  # c_int(0) is falsy: no `or`!
         buf_size = max(lengths)
         ret = self._lib.ioengine_run_block_loop(
             fd, off_arr, len_arr, n, 1 if is_write else 0,
@@ -57,9 +59,17 @@ class _NativeEngine:
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt))
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
+        # completed ops have non-zero timestamps even at 0 usec? no:
+        # latency CAN be 0 usec — count via bytes instead
+        done_ops = 0
+        acc_bytes = 0
         for i in range(n):
+            if acc_bytes >= bytes_done.value:
+                break
             worker.iops_latency_histo.add_latency(lat_arr[i])
-        worker.live_ops.num_iops_done += n
+            acc_bytes += lengths[i]
+            done_ops += 1
+        worker.live_ops.num_iops_done += done_ops
         worker.live_ops.num_bytes_done += bytes_done.value
         worker.create_stonewall_stats_if_triggered()
         return True
@@ -74,14 +84,27 @@ def get_native_engine() -> "_NativeEngine | None":
     with _lock:
         if _engine_checked:
             return _engine
-        if os.environ.get("ELBENCHO_TPU_NO_NATIVE") != "1" \
-                and os.path.exists(_SO_PATH):
-            try:
-                _engine = _NativeEngine(ctypes.CDLL(_SO_PATH))
-            except OSError:
-                _engine = None
+        if os.environ.get("ELBENCHO_TPU_NO_NATIVE") != "1":
+            if not os.path.exists(_SO_PATH):
+                _try_build()
+            if os.path.exists(_SO_PATH):
+                try:
+                    _engine = _NativeEngine(ctypes.CDLL(_SO_PATH))
+                except OSError:
+                    _engine = None
         _engine_checked = True
         return _engine
+
+
+def _try_build() -> None:
+    """One-shot best-effort build of the engine (g++ is in the image)."""
+    import subprocess
+    csrc = os.path.dirname(_SO_PATH)
+    try:
+        subprocess.run(["make", "-C", csrc], capture_output=True,
+                       timeout=120, check=False)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
 
 
 def reset_native_engine_cache() -> None:
