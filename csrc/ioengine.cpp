@@ -205,10 +205,13 @@ int run_aio_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
             break;
         in_flight -= got;
     }
+    // destroy the context BEFORE freeing slot buffers: io_destroy blocks
+    // until outstanding kernel DMA into those buffers has finished, so
+    // freeing first would be a use-after-free on an interrupted chunk
+    sys_io_destroy(ctx);
     for (int i = 0; i < allocated; ++i)
         free(slots[i].buf);
     delete[] slots;
-    sys_io_destroy(ctx);
     *out_bytes = bytes_done;
     return ret;
 }

@@ -7,7 +7,7 @@ import sys
 
 from . import __version__
 from .config.args import (FLAG_DEFS, HELP_CATEGORIES, ConfigError, parse_cli)
-from .phases import BenchPathType
+from .phases import BenchMode, BenchPathType
 from .toolkits import logger
 from .toolkits.units import format_bytes
 
@@ -84,7 +84,17 @@ def main(argv: "list[str] | None" = None) -> int:
         _print_help("essential")
         return 1
     try:
-        cfg.derive()
+        # master mode: paths live on the service hosts, don't probe locally
+        # (services reply with BenchPathInfo; the manager then checks
+        # consistency and re-validates). Probe only for true local runs.
+        cfg.derive(probe_paths=False)
+        if not cfg.hosts:
+            if cfg.hosts_str or cfg.hosts_file_path:
+                raise ConfigError(
+                    "hosts were specified but none are usable "
+                    "(empty hosts file or --numhosts 0?)")
+            if cfg.bench_mode == BenchMode.POSIX and cfg.paths:
+                cfg._find_bench_path_type()
         cfg.check()
     except (ConfigError, OSError) as err:
         print(f"ERROR: {err}", file=sys.stderr)

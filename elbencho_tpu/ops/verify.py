@@ -47,10 +47,10 @@ def _fingerprint_kernel(x_ref, sum_ref, xor_ref):
 _pallas_broken = False
 
 
-@functools.lru_cache(maxsize=64)
-def _pallas_fingerprint_call(rows: int):
-    """One pallas_call per block shape so the hot loop hits jax's dispatch
-    cache instead of rebuilding/retracing the kernel per block."""
+@functools.lru_cache(maxsize=1)
+def _pallas_fingerprint_call():
+    """One shape-polymorphic pallas_call instance so the hot loop hits
+    jax's dispatch cache instead of rebuilding the kernel per block."""
     from jax.experimental import pallas as pl
     return pl.pallas_call(
         _fingerprint_kernel,
@@ -70,7 +70,7 @@ def fingerprint_block_pallas(block_u32, num_words: int):
         return fingerprint_block_jnp(block_u32)
     x2d = block_u32.reshape(rows, _LANES)
     try:
-        out_sum, out_xor = _pallas_fingerprint_call(rows)(x2d)
+        out_sum, out_xor = _pallas_fingerprint_call()(x2d)
         return out_sum[0, 0], out_xor[0, 0]
     except Exception as err:  # pragma: no cover - pallas can't lower here
         if not _pallas_broken:

@@ -1,0 +1,312 @@
+"""Service role: HTTP server exposing the 8 control-plane endpoints.
+
+Reference: source/HTTPServiceSWS.{h,cpp} + HTTPService.{h,cpp} — a
+deliberately **single-threaded** HTTP server (invariant documented at
+HTTPServiceSWS.cpp:130-136: no concurrent mutation of the worker pool),
+with endpoints /info /protocolversion /status /benchresult /preparefile
+/preparephase /startphase /interruptphase (defineServerResources :137),
+daemonization with logfile + instance lock (HTTPService.cpp:32-110),
+duplicate /startphase idempotency via bench-UUID compare (:543-554), and
+strict protocol-version handshake (:280-293).
+
+The control plane rides DCN between TPU-VM hosts; benchmark traffic never
+crosses it (SURVEY.md section 2.3).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import getpass
+import json
+import os
+import sys
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from .. import HTTP_PROTOCOL_VERSION, __version__
+from ..config.args import BenchConfig, ConfigError
+from ..phases import BenchPhase
+from ..stats.statistics import Statistics
+from ..toolkits import logger
+from ..workers.manager import WorkerManager
+from . import protocol as proto
+
+SVC_TMP_DIR = "/var/tmp"
+
+
+class ServiceState:
+    """Mutable service-side state: current config + worker pool + stats.
+    Rebuilt on every /preparephase (reference: :376-498 kills and respawns
+    the pool so stale workers never leak into the next run)."""
+
+    def __init__(self, base_cfg: BenchConfig):
+        self.base_cfg = base_cfg
+        self.cfg: "BenchConfig | None" = None
+        self.manager: "WorkerManager | None" = None
+        self.statistics: "Statistics | None" = None
+        self.phase_start_monotonic = 0.0
+        self.pw_hash = ""
+        if base_cfg.svc_password_file:
+            self.pw_hash = proto.read_pw_file(base_cfg.svc_password_file)
+
+    def teardown_workers(self) -> None:
+        if self.manager is not None:
+            self.manager.interrupt_and_notify_workers()
+            try:
+                self.manager.join_all_threads()
+            except Exception:  # noqa: BLE001 - teardown is best effort
+                pass
+            self.manager = None
+            self.statistics = None
+
+    def prepare_phase(self, cfg_dict: dict) -> dict:
+        """Kill+rebuild the worker pool from the master's config JSON;
+        reply with bench path info + error history."""
+        self.teardown_workers()
+        logger.clear_error_history()
+        version = cfg_dict.get(proto.KEY_PROTOCOL_VERSION)
+        if version != HTTP_PROTOCOL_VERSION:
+            raise ConfigError(
+                f"protocol version mismatch: master={version!r} "
+                f"service={HTTP_PROTOCOL_VERSION!r}")
+        cfg = BenchConfig.from_service_dict(cfg_dict)
+        cfg.run_as_service = True
+        cfg.disable_live_stats = True
+        # service-side overrides: pinned bench paths / TPU ids
+        # (reference: ProgArgs.cpp:1366-1382)
+        if self.base_cfg.paths:
+            cfg.paths = list(self.base_cfg.paths)
+            cfg._find_bench_path_type()
+        if self.base_cfg.tpu_ids_str:
+            cfg.tpu_ids_str = self.base_cfg.tpu_ids_str
+            from ..toolkits.units import parse_uint_list
+            cfg.tpu_ids = parse_uint_list(cfg.tpu_ids_str)
+        if cfg.tree_file_path:
+            cfg.tree_file_path = self._uploaded_file_path(
+                os.path.basename(cfg.tree_file_path))
+        self.cfg = cfg
+        self.manager = WorkerManager(cfg)
+        self.statistics = Statistics(cfg, self.manager)
+        self.manager.prepare_threads()
+        return {
+            proto.KEY_BENCH_PATH_TYPE: int(cfg.bench_path_type),
+            proto.KEY_NUM_BENCH_PATHS: len(cfg.paths),
+            "FileSize": cfg.file_size,
+            "BlockSize": cfg.block_size,
+            "RandomAmount": cfg.random_amount,
+            proto.KEY_ERROR_HISTORY: logger.get_error_history(),
+        }
+
+    def _uploaded_file_path(self, name: str) -> str:
+        d = os.path.join(SVC_TMP_DIR,
+                         f"elbencho_tpu_{getpass.getuser()}"
+                         f"_p{self.base_cfg.service_port}")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, name)
+
+    def start_phase(self, phase_code: int, bench_id: str) -> "tuple[int, str]":
+        """(http_status, message). Duplicate BenchID is idempotent success
+        (reference: :534-578)."""
+        if self.manager is None:
+            return (400, "no /preparephase received yet")
+        shared = self.manager.shared
+        if bench_id and shared.bench_uuid == bench_id:
+            return (200, "phase already running (duplicate start)")
+        if not self.manager.all_workers_done() and \
+                shared.current_phase not in (BenchPhase.IDLE,
+                                             BenchPhase.TERMINATE):
+            return (409, "workers still busy with another phase")
+        phase = BenchPhase(phase_code)
+        self.phase_start_monotonic = time.monotonic()
+        self.manager.start_next_phase(phase)
+        if bench_id:
+            shared.bench_uuid = bench_id  # master's UUID wins (hijack check)
+        return (200, "phase started")
+
+    def status(self) -> dict:
+        if self.statistics is None:
+            return {proto.KEY_PHASE_CODE: int(BenchPhase.IDLE),
+                    proto.KEY_NUM_WORKERS_DONE: 0}
+        if self.manager is not None and self.cfg is not None:
+            self.manager.check_phase_time_limit(self.phase_start_monotonic)
+        return self.statistics.get_live_stats_dict()
+
+    def bench_result(self) -> dict:
+        if self.statistics is None:
+            return {}
+        result = self.statistics.get_bench_result_dict()
+        result[proto.KEY_ERROR_HISTORY] = logger.get_error_history()
+        return result
+
+    def interrupt(self) -> None:
+        if self.manager is not None:
+            self.manager.shared.request_interrupt()
+            self.manager.interrupt_and_notify_workers()
+
+
+def _make_handler(state: ServiceState, server_holder: dict):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            logger.log(logger.LOG_DEBUG, "HTTP " + fmt % args)
+
+        # -- helpers -------------------------------------------------------
+
+        def _reply(self, code: int, body, content_type="application/json"):
+            data = (json.dumps(body) if not isinstance(body, (bytes, str))
+                    else body)
+            if isinstance(data, str):
+                data = data.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _params(self) -> dict:
+            query = urllib.parse.urlparse(self.path).query
+            return {k: v[0] for k, v in
+                    urllib.parse.parse_qs(query).items()}
+
+        def _check_auth(self, params: dict) -> bool:
+            if not state.pw_hash:
+                return True
+            if params.get(proto.KEY_AUTHORIZATION) == state.pw_hash:
+                return True
+            self._reply(401, {"Error": "authorization required"})
+            return False
+
+        # -- GET endpoints ---------------------------------------------------
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            params = self._params()
+            route = urllib.parse.urlparse(self.path).path
+            if not self._check_auth(params):
+                return
+            try:
+                if route == proto.PATH_INFO:
+                    self._reply(200, {
+                        "Service": "elbencho-tpu", "Version": __version__,
+                        proto.KEY_PROTOCOL_VERSION: HTTP_PROTOCOL_VERSION})
+                elif route == proto.PATH_PROTOCOL_VERSION:
+                    self._reply(200, HTTP_PROTOCOL_VERSION,
+                                content_type="text/plain")
+                elif route == proto.PATH_STATUS:
+                    self._reply(200, state.status())
+                elif route == proto.PATH_BENCH_RESULT:
+                    self._reply(200, state.bench_result())
+                elif route == proto.PATH_START_PHASE:
+                    code, msg = state.start_phase(
+                        int(params.get(proto.KEY_PHASE_CODE, 0)),
+                        params.get(proto.KEY_BENCH_ID, ""))
+                    self._reply(code, {"Message": msg})
+                elif route == proto.PATH_INTERRUPT_PHASE:
+                    state.interrupt()
+                    quit_requested = proto.KEY_INTERRUPT_QUIT in params
+                    self._reply(200, {"Message": "interrupted"})
+                    if quit_requested:
+                        state.teardown_workers()
+                        server_holder["shutdown"] = True
+                else:
+                    self._reply(404, {"Error": f"unknown path {route}"})
+            except Exception as err:  # noqa: BLE001 - reply errors over HTTP
+                logger.log_error(f"service request failed: {err}")
+                self._reply(500, {"Error": str(err)})
+
+        # -- POST endpoints --------------------------------------------------
+
+        def do_POST(self):  # noqa: N802
+            params = self._params()
+            route = urllib.parse.urlparse(self.path).path
+            if not self._check_auth(params):
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            try:
+                if route == proto.PATH_PREPARE_PHASE:
+                    reply = state.prepare_phase(json.loads(body))
+                    self._reply(200, reply)
+                elif route == proto.PATH_PREPARE_FILE:
+                    name = os.path.basename(
+                        params.get(proto.KEY_FILE_NAME, "upload"))
+                    dst = state._uploaded_file_path(name)
+                    with open(dst, "wb") as f:
+                        f.write(body)
+                    self._reply(200, {"Message": f"stored {name}"})
+                else:
+                    self._reply(404, {"Error": f"unknown path {route}"})
+            except (ConfigError, ValueError) as err:
+                logger.log_error(f"prepare failed: {err}")
+                self._reply(400, {
+                    "Error": str(err),
+                    proto.KEY_ERROR_HISTORY: logger.get_error_history()})
+            except Exception as err:  # noqa: BLE001
+                logger.log_error(f"service request failed: {err}")
+                self._reply(500, {
+                    "Error": str(err),
+                    proto.KEY_ERROR_HISTORY: logger.get_error_history()})
+
+    return Handler
+
+
+class HTTPService:
+    """Service-role entry (reference: Coordinator::main :42-62 +
+    HTTPService::startServer)."""
+
+    def __init__(self, cfg: BenchConfig):
+        self.cfg = cfg
+
+    def start(self) -> int:
+        cfg = self.cfg
+        logger.enable_error_history(True)
+        if not cfg.run_service_in_foreground:
+            self._daemonize()
+        state = ServiceState(cfg)
+        holder = {"shutdown": False}
+        handler = _make_handler(state, holder)
+        try:
+            server = HTTPServer(("0.0.0.0", cfg.service_port), handler)
+        except OSError as err:
+            print(f"ERROR: cannot bind service port {cfg.service_port}: "
+                  f"{err}", file=sys.stderr)
+            return 1
+        server.timeout = 0.5
+        logger.log(0, f"elbencho-tpu service listening on port "
+                      f"{cfg.service_port}")
+        try:
+            while not holder["shutdown"]:
+                server.handle_request()  # single-threaded by design
+        except KeyboardInterrupt:
+            pass
+        finally:
+            state.teardown_workers()
+            server.server_close()
+        return 0
+
+    def _daemonize(self) -> None:
+        """Double-fork daemonization with logfile + single-instance flock
+        (reference: HTTPService::daemonize, HTTPService.cpp:32-110)."""
+        log_path = os.path.join(
+            SVC_TMP_DIR,
+            f"elbencho_tpu_{getpass.getuser()}_p{self.cfg.service_port}.log")
+        lock_path = log_path + ".lock"
+        lock_fd = os.open(lock_path, os.O_WRONLY | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            print(f"ERROR: another service instance holds {lock_path}",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        if os.fork() > 0:
+            os._exit(0)
+        os.setsid()
+        if os.fork() > 0:
+            os._exit(0)
+        log_fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+        os.dup2(log_fd, 1)
+        os.dup2(log_fd, 2)
+        devnull = os.open(os.devnull, os.O_RDONLY)
+        os.dup2(devnull, 0)

@@ -1,0 +1,50 @@
+"""Master<->service wire protocol constants.
+
+Reference: source/Common.h:229-298 — HTTP paths, GET/JSON parameter keys,
+and the strict exact-match protocol version handshake (HTTP_PROTOCOLVERSION,
+Common.h:91). The wire format here is HTTP/1.1 + JSON (the reference uses
+boost property-tree JSON; same idea, plain json module)."""
+
+from __future__ import annotations
+
+from .. import HTTP_PROTOCOL_VERSION  # noqa: F401 (re-export)
+
+# http service paths (reference: HTTPCLIENTPATH_*, Common.h:229-246)
+PATH_INFO = "/info"
+PATH_PROTOCOL_VERSION = "/protocolversion"
+PATH_STATUS = "/status"
+PATH_BENCH_RESULT = "/benchresult"
+PATH_PREPARE_FILE = "/preparefile"
+PATH_PREPARE_PHASE = "/preparephase"
+PATH_START_PHASE = "/startphase"
+PATH_INTERRUPT_PHASE = "/interruptphase"
+
+# transferred parameter keys (reference: XFER_*, Common.h:251-298)
+KEY_PROTOCOL_VERSION = "ProtocolVersion"
+KEY_BENCH_ID = "BenchID"
+KEY_PHASE_CODE = "PhaseCode"
+KEY_PHASE_NAME = "PhaseName"
+KEY_NUM_WORKERS_DONE = "NumWorkersDone"
+KEY_NUM_WORKERS_DONE_WITH_ERROR = "NumWorkersDoneWithError"
+KEY_NUM_ENTRIES_DONE = "NumEntriesDone"
+KEY_NUM_BYTES_DONE = "NumBytesDone"
+KEY_NUM_IOPS_DONE = "NumIOPSDone"
+KEY_ELAPSED_USEC_LIST = "ElapsedUSecList"
+KEY_ERROR_HISTORY = "ErrorHistory"
+KEY_BENCH_PATH_TYPE = "BenchPathType"
+KEY_NUM_BENCH_PATHS = "NumBenchPaths"
+KEY_FILE_NAME = "FileName"
+KEY_AUTHORIZATION = "PwHash"
+KEY_INTERRUPT_QUIT = "quit"
+
+
+def make_pw_hash(secret: str) -> str:
+    """Shared-secret hash for --svcpwfile (reference: HashTk + ProgArgs
+    :3003; sha256 here — the protocol is ours)."""
+    import hashlib
+    return hashlib.sha256(secret.encode()).hexdigest()
+
+
+def read_pw_file(path: str) -> str:
+    with open(path) as f:
+        return make_pw_hash(f.read().strip())

@@ -1,0 +1,301 @@
+"""RemoteWorker: the master's per-host proxy thread.
+
+Reference: source/workers/RemoteWorker.{h,cpp} — one per --hosts entry;
+uploads prep files (:288-345), POSTs the serialized config
+(preparePhase :354-407), GETs /startphase (:412), polls /status at an
+adaptive cadence accumulating remote live ops into its own counters
+(:447-560), fetches /benchresult and ingests per-thread elapsed vectors +
+mergeable histograms (finishPhase :172-280), sends /interruptphase on
+error/quit. Bench-UUID hijack detection: a /status reply with an unexpected
+BenchID aborts the run (RemoteWorker.cpp:199-202).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import time
+import urllib.parse
+
+from .. import HTTP_PROTOCOL_VERSION
+from ..phases import BenchPhase
+from ..stats.latency_histogram import LatencyHistogram
+from ..toolkits import logger
+from ..workers.base import Worker
+from ..workers.shared import (WorkerInterruptedException,
+                              WorkerRemoteException)
+from . import protocol as proto
+
+DEFAULT_PORT = 1611
+CONNECT_TIMEOUT_SECS = 10
+# adaptive /status cadence: start fast for short phases, back off to the
+# configured --svcupint (reference: 25ms -> 500ms, RemoteWorker.cpp:447+)
+POLL_MIN_SECS = 0.025
+
+
+def split_host_port(host: str, default_port: int = DEFAULT_PORT
+                    ) -> "tuple[str, int]":
+    if ":" in host:
+        name, _, port = host.rpartition(":")
+        return (name, int(port))
+    return (host, default_port)
+
+
+class ServiceClient:
+    """Minimal HTTP/JSON client for one service host."""
+
+    def __init__(self, host: str, default_port: int, pw_hash: str = ""):
+        self.hostname, self.port = split_host_port(host, default_port)
+        self.pw_hash = pw_hash
+
+    def _request(self, method: str, path: str, params: "dict | None" = None,
+                 body: "bytes | None" = None,
+                 timeout: float = CONNECT_TIMEOUT_SECS):
+        params = dict(params or {})
+        if self.pw_hash:
+            params[proto.KEY_AUTHORIZATION] = self.pw_hash
+        if params:
+            path = path + "?" + urllib.parse.urlencode(params)
+        conn = http.client.HTTPConnection(self.hostname, self.port,
+                                          timeout=timeout)
+        try:
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+        finally:
+            conn.close()
+
+    def get_json(self, path: str, params: "dict | None" = None,
+                 timeout: float = CONNECT_TIMEOUT_SECS) -> "tuple[int, dict]":
+        status, data = self._request("GET", path, params, timeout=timeout)
+        try:
+            return status, (json.loads(data) if data else {})
+        except json.JSONDecodeError:
+            return status, {"raw": data.decode(errors="replace")}
+
+    def post_json(self, path: str, obj, params: "dict | None" = None,
+                  timeout: float = 60.0) -> "tuple[int, dict]":
+        body = json.dumps(obj).encode()
+        status, data = self._request("POST", path, params, body=body,
+                                     timeout=timeout)
+        try:
+            return status, (json.loads(data) if data else {})
+        except json.JSONDecodeError:
+            return status, {"raw": data.decode(errors="replace")}
+
+
+class RemoteWorker(Worker):
+    def __init__(self, shared, host_idx: int, host: str):
+        super().__init__(shared, rank=host_idx)
+        self.cfg = shared.config
+        self.host = host
+        self.host_idx = host_idx
+        pw_hash = ""
+        if self.cfg.svc_password_file:
+            pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
+        self.client = ServiceClient(host, self.cfg.service_port, pw_hash)
+        self.num_remote_threads = self.cfg.num_threads
+        self._expected_bench_id = ""
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        self._check_protocol_version()
+        self._prepare_remote_files()
+        self._prepare_phase_remote()
+        last_uuid = self.shared.bench_uuid
+        self.shared.inc_num_workers_done()  # prep barrier
+        while True:
+            phase, last_uuid = self.shared.wait_for_phase_change(last_uuid)
+            if phase == BenchPhase.TERMINATE:
+                self._interrupt_remote(quit_service=False)
+                return
+            if phase == BenchPhase.IDLE:
+                continue
+            try:
+                self._start_remote_phase(phase, last_uuid)
+                self._poll_until_done(phase)
+                self._finish_phase_remote()
+                self.shared.inc_num_workers_done()
+            except WorkerInterruptedException:
+                self._interrupt_remote(quit_service=False)
+                self.shared.inc_num_workers_done()
+            except Exception as err:  # noqa: BLE001
+                logger.log_error(f"Remote worker for {self.host} failed: "
+                                 f"{err}")
+                self._interrupt_remote(quit_service=False)
+                self.shared.inc_num_workers_done_with_error(err)
+
+    # ------------------------------------------------------------------
+
+    def _check_protocol_version(self) -> None:
+        status, data = self.client._request("GET",
+                                            proto.PATH_PROTOCOL_VERSION)
+        remote = data.decode().strip().strip('"')
+        if status != 200 or remote != HTTP_PROTOCOL_VERSION:
+            raise WorkerRemoteException(
+                f"service {self.host} protocol version mismatch: "
+                f"{remote!r} != {HTTP_PROTOCOL_VERSION!r}")
+
+    def _prepare_remote_files(self) -> None:
+        """Upload treefile to the service (reference: :288-345)."""
+        if not self.cfg.tree_file_path:
+            return
+        with open(self.cfg.tree_file_path, "rb") as f:
+            body = f.read()
+        status, data = self.client._request(
+            "POST", proto.PATH_PREPARE_FILE, {
+                proto.KEY_FILE_NAME:
+                    os.path.basename(self.cfg.tree_file_path)}, body)
+        if status != 200:
+            raise WorkerRemoteException(
+                f"file upload to {self.host} failed: {data!r}")
+
+    def _prepare_phase_remote(self) -> None:
+        """POST the full effective config with this host's rank offset
+        (reference: preparePhase :354-407; rank offset = hostIdx * threads,
+        ProgArgs.cpp:3921)."""
+        cfg_dict = self.cfg.to_service_dict(
+            service_rank_offset=self.host_idx * self.cfg.num_threads)
+        status, reply = self.client.post_json(proto.PATH_PREPARE_PHASE,
+                                              cfg_dict, timeout=300.0)
+        for line in reply.get(proto.KEY_ERROR_HISTORY, []):
+            logger.log_error(f"[{self.host}] {line}")
+        if status != 200:
+            raise WorkerRemoteException(
+                f"preparation on {self.host} failed: "
+                f"{reply.get('Error', reply)}")
+        self.bench_path_info = reply
+
+    def _start_remote_phase(self, phase: BenchPhase, bench_id: str) -> None:
+        self._expected_bench_id = bench_id
+        status, reply = self.client.get_json(proto.PATH_START_PHASE, {
+            proto.KEY_PHASE_CODE: int(phase),
+            proto.KEY_BENCH_ID: bench_id})
+        if status != 200:
+            raise WorkerRemoteException(
+                f"phase start on {self.host} failed: "
+                f"{reply.get('Message', reply)}")
+
+    def _poll_until_done(self, phase: BenchPhase) -> None:
+        """Poll /status, mirroring remote live totals into this worker's
+        counters so the master's live stats aggregate naturally
+        (reference: waitForBenchPhaseCompletion :447-560)."""
+        interval = POLL_MIN_SECS
+        max_interval = max(self.cfg.svc_update_interval_ms, 25) / 1000.0
+        while True:
+            self.check_interruption_request(force=True)
+            status, stats = self.client.get_json(proto.PATH_STATUS)
+            if status != 200:
+                raise WorkerRemoteException(
+                    f"status poll on {self.host} failed ({status})")
+            got_id = stats.get(proto.KEY_BENCH_ID, "")
+            if got_id and self._expected_bench_id \
+                    and got_id != self._expected_bench_id:
+                raise WorkerRemoteException(
+                    f"service {self.host} was hijacked by another master "
+                    f"(bench UUID mismatch)")  # reference: :199-202
+            self.live_ops.num_entries_done = \
+                stats.get(proto.KEY_NUM_ENTRIES_DONE, 0)
+            self.live_ops.num_bytes_done = \
+                stats.get(proto.KEY_NUM_BYTES_DONE, 0)
+            self.live_ops.num_iops_done = \
+                stats.get(proto.KEY_NUM_IOPS_DONE, 0)
+            if stats.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
+                raise WorkerRemoteException(
+                    f"worker error on service {self.host}")
+            done = stats.get(proto.KEY_NUM_WORKERS_DONE, 0)
+            if done >= self.num_remote_threads:
+                return
+            time.sleep(interval)
+            interval = min(interval * 2, max_interval)
+
+    def _finish_phase_remote(self) -> None:
+        """GET /benchresult and ingest per-thread elapsed + histograms
+        (reference: finishPhase :172-280)."""
+        status, result = self.client.get_json(proto.PATH_BENCH_RESULT,
+                                              timeout=60.0)
+        if status != 200:
+            raise WorkerRemoteException(
+                f"result fetch from {self.host} failed ({status})")
+        for line in result.get(proto.KEY_ERROR_HISTORY, []):
+            logger.log_error(f"[{self.host}] {line}")
+        if result.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
+            raise WorkerRemoteException(
+                f"service {self.host} reported worker errors")
+        final = result.get("Final", {})
+        stonewall = result.get("StoneWall", {})
+        self.live_ops.num_entries_done = final.get("entries", 0)
+        self.live_ops.num_bytes_done = final.get("bytes", 0)
+        self.live_ops.num_iops_done = final.get("iops", 0)
+        self.stonewall_ops.num_entries_done = stonewall.get("entries", 0)
+        self.stonewall_ops.num_bytes_done = stonewall.get("bytes", 0)
+        self.stonewall_ops.num_iops_done = stonewall.get("iops", 0)
+        final_rw = result.get("FinalRWMixRead", {})
+        stone_rw = result.get("StoneWallRWMixRead", {})
+        self.live_ops_rwmix_read.num_entries_done = final_rw.get("entries", 0)
+        self.live_ops_rwmix_read.num_bytes_done = final_rw.get("bytes", 0)
+        self.live_ops_rwmix_read.num_iops_done = final_rw.get("iops", 0)
+        self.stonewall_ops_rwmix_read.num_bytes_done = \
+            stone_rw.get("bytes", 0)
+        self.stonewall_ops_rwmix_read.num_iops_done = stone_rw.get("iops", 0)
+        self.elapsed_usec_vec = list(
+            result.get(proto.KEY_ELAPSED_USEC_LIST, []))
+        self.stonewall_elapsed_usec = result.get("StoneWallUSec", 0)
+        self.stonewall_taken = True
+        self.phase_finished = True
+        self.iops_latency_histo = LatencyHistogram.from_dict(
+            result.get("IOLatHisto", {}))
+        self.entries_latency_histo = LatencyHistogram.from_dict(
+            result.get("EntLatHisto", {}))
+        self.iops_latency_histo_rwmix = LatencyHistogram.from_dict(
+            result.get("IOLatHistoRWMixRead", {}))
+        self.tpu_transfer_bytes = result.get("TpuHbmBytes", 0)
+        self.tpu_transfer_usec = result.get("TpuHbmUSec", 0)
+        self.got_phase_work = bool(self.elapsed_usec_vec)
+
+    def _interrupt_remote(self, quit_service: bool) -> None:
+        params = {proto.KEY_INTERRUPT_QUIT: "1"} if quit_service else {}
+        try:
+            self.client.get_json(proto.PATH_INTERRUPT_PHASE, params)
+        except OSError:
+            pass  # service may already be gone
+
+
+# ---------------------------------------------------------------------------
+# master-side helpers (reference: Coordinator::waitForServicesReady :165-227)
+# ---------------------------------------------------------------------------
+
+def wait_for_services_ready(hosts: "list[str]", default_port: int,
+                            wait_secs: int) -> None:
+    deadline = time.monotonic() + max(wait_secs, 0)
+    for host in hosts:
+        client = ServiceClient(host, default_port)
+        while True:
+            try:
+                status, _ = client.get_json(proto.PATH_STATUS, timeout=3)
+                if status in (200, 401):
+                    break
+            except OSError:
+                pass
+            if time.monotonic() >= deadline:
+                raise WorkerRemoteException(
+                    f"service {host} not reachable "
+                    f"(--svcwait to extend the wait)")
+            time.sleep(1)
+
+
+def send_interrupt_to_hosts(hosts: "list[str]", default_port: int,
+                            quit: bool = False) -> None:
+    """--interrupt / --quit handling (reference: Coordinator service
+    control paths)."""
+    for host in hosts:
+        client = ServiceClient(host, default_port)
+        params = {proto.KEY_INTERRUPT_QUIT: "1"} if quit else {}
+        try:
+            client.get_json(proto.PATH_INTERRUPT_PHASE, params)
+            logger.log(0, f"sent {'quit' if quit else 'interrupt'} to {host}")
+        except OSError as err:
+            logger.log_error(f"could not reach {host}: {err}")

@@ -59,17 +59,18 @@ class _NativeEngine:
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt))
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
-        # completed ops have non-zero timestamps even at 0 usec? no:
-        # latency CAN be 0 usec — count via bytes instead
-        done_ops = 0
-        acc_bytes = 0
-        for i in range(n):
-            if acc_bytes >= bytes_done.value:
-                break
-            worker.iops_latency_histo.add_latency(lat_arr[i])
-            acc_bytes += lengths[i]
-            done_ops += 1
-        worker.live_ops.num_iops_done += done_ops
+        total_bytes = sum(lengths)
+        if bytes_done.value == total_bytes:
+            for i in range(n):
+                worker.iops_latency_histo.add_latency(lat_arr[i])
+            worker.live_ops.num_iops_done += n
+        else:
+            # interrupted chunk: AIO completes out of order, so per-block
+            # latencies can't be attributed reliably — count bytes/ops only
+            # (the phase is being aborted; its results are partial anyway)
+            avg_len = max(total_bytes // n, 1)
+            worker.live_ops.num_iops_done += \
+                min(n, bytes_done.value // avg_len)
         worker.live_ops.num_bytes_done += bytes_done.value
         worker.create_stonewall_stats_if_triggered()
         return True

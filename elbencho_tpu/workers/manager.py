@@ -50,6 +50,35 @@ class WorkerManager:
             self.threads.append(t)
             t.start()
         self._wait_for_prep_done()
+        if self.cfg.hosts and not self.cfg.run_as_service:
+            self._check_service_bench_path_infos()
+
+    def _check_service_bench_path_infos(self) -> None:
+        """All services must report consistent path info; the master adopts
+        the services' path type and re-validates path-dependent flags
+        (reference: checkServiceBenchPathInfos, WorkerManager.cpp:498 +
+        ProgArgs.cpp:4206)."""
+        from ..config.args import ConfigError
+        from ..phases import BenchPathType
+        from ..service import protocol as proto
+        infos = [getattr(w, "bench_path_info", None) for w in self.workers]
+        infos = [i for i in infos if i]
+        if not infos:
+            return
+        first = infos[0]
+        for info in infos[1:]:
+            if (info.get(proto.KEY_BENCH_PATH_TYPE)
+                    != first.get(proto.KEY_BENCH_PATH_TYPE)) \
+                    or (info.get(proto.KEY_NUM_BENCH_PATHS)
+                        != first.get(proto.KEY_NUM_BENCH_PATHS)):
+                raise WorkerException(
+                    f"services report inconsistent bench path info ({infos})")
+        self.cfg.bench_path_type = BenchPathType(
+            first.get(proto.KEY_BENCH_PATH_TYPE, 0))
+        try:
+            self.cfg.check()  # path-type-dependent validation, now for real
+        except ConfigError as err:
+            raise WorkerException(str(err)) from err
 
     def _open_shared_path_fds(self) -> None:
         """Open file/bdev bench paths once, shared across workers

@@ -1,0 +1,174 @@
+"""Distributed service-mode integration tests: two local service instances
+plus a master — the reference's localhost multi-service pattern
+(tools/test-examples.sh:296-330; SURVEY.md section 4)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PORTS = (17111, 17112)
+
+
+def _wait_ready(port, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"service on port {port} not ready")
+
+
+@pytest.fixture()
+def services():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    try:
+        for port in PORTS:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "elbencho_tpu", "--service",
+                 "--foreground", "--port", str(port)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        for port in PORTS:
+            _wait_ready(port)
+        yield PORTS
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _master(args):
+    from elbencho_tpu.cli import main
+    return main(args + ["--nolive"])
+
+
+def test_distributed_dir_mode_write_read(services, tmp_path, capsys):
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    rc = _master(["-w", "-d", "-r", "-F", "-D", "-t", "2", "-n", "1",
+                  "-N", "2", "-s", "16K", "-b", "16K",
+                  "--hosts", hosts, str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "WRITE" in out and "READ" in out
+
+
+def test_distributed_rank_namespace(services, tmp_path):
+    """Per-host rank offsets: host 0 gets ranks 0..1, host 1 gets 2..3 —
+    so 4 distinct rank dirs appear (reference: per-host rank offset =
+    hostIdx * numThreads, ProgArgs.cpp:3921)."""
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    rc = _master(["-w", "-d", "-t", "2", "-n", "1", "-N", "1",
+                  "-s", "4K", "-b", "4K", "--hosts", hosts, str(tmp_path)])
+    assert rc == 0
+    rank_dirs = sorted(p.name for p in tmp_path.iterdir()
+                       if p.name.startswith("r"))
+    assert rank_dirs == ["r0", "r1", "r2", "r3"]
+
+
+def test_distributed_json_results_aggregate(services, tmp_path):
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    jsonfile = tmp_path / "out.json"
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    rc = _master(["-w", "-d", "-t", "2", "-n", "1", "-N", "3",
+                  "-s", "8K", "-b", "8K", "--hosts", hosts,
+                  "--jsonfile", str(jsonfile), str(bench)])
+    assert rc == 0
+    recs = [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+    write_rec = next(r for r in recs if r["Phase"] == "WRITE")
+    # 2 hosts x 2 threads x 1 dir x 3 files
+    assert write_rec["EntriesLast"] == 12
+    assert write_rec["BytesLast"] == 12 * 8192
+    assert write_rec["NumWorkers"] == 2  # one RemoteWorker per host
+    # elapsed vec carries every remote thread (4 threads total)
+    assert len(write_rec["ElapsedUSecList"]) == 4
+
+
+def test_distributed_numhosts_limit(services, tmp_path):
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    rc = _master(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+                  "-b", "4K", "--hosts", hosts, "--numhosts", "1",
+                  str(tmp_path)])
+    assert rc == 0
+    rank_dirs = sorted(p.name for p in tmp_path.iterdir()
+                       if p.name.startswith("r"))
+    assert rank_dirs == ["r0"]  # only first host participated
+
+
+def test_distributed_worker_error_propagates(services, tmp_path):
+    """READ of nonexistent dataset => remote worker error => master fails
+    fast with rc != 0."""
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    rc = _master(["-r", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+                  "-b", "4K", "--hosts", hosts, str(tmp_path)])
+    assert rc != 0
+
+
+def test_protocol_version_endpoint(services):
+    from elbencho_tpu import HTTP_PROTOCOL_VERSION
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{services[0]}/protocolversion",
+            timeout=5) as r:
+        assert r.read().decode().strip() == HTTP_PROTOCOL_VERSION
+
+
+def test_info_endpoint(services):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{services[0]}/info", timeout=5) as r:
+        info = json.loads(r.read())
+    assert info["Service"] == "elbencho-tpu"
+
+
+def test_duplicate_startphase_idempotent(services, tmp_path):
+    """A duplicated /startphase GET with the same BenchID must be accepted
+    (reference: HTTPServiceSWS.cpp:543-554)."""
+    port = services[0]
+    from elbencho_tpu.config.args import parse_cli
+    cfg, _ = parse_cli(["-w", "-d", "-t", "1", "-n", "1", "-N", "1",
+                        "-s", "4K", "-b", "4K", str(tmp_path)])
+    cfg.derive()
+    body = json.dumps(cfg.to_service_dict()).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/preparephase", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+    from elbencho_tpu.phases import BenchPhase
+    url = (f"http://127.0.0.1:{port}/startphase?"
+           f"PhaseCode={int(BenchPhase.CREATEDIRS)}&BenchID=test-uuid-1")
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+    with urllib.request.urlopen(url, timeout=10) as r:  # duplicate
+        assert r.status == 200
+
+
+def test_quit_services(services):
+    """--quit terminates the service processes."""
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    rc = _master(["--quit", "--hosts", hosts])
+    assert rc == 0
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{services[0]}/status", timeout=1)
+            time.sleep(0.2)
+        except OSError:
+            return  # service is gone
+    raise AssertionError("service still alive after --quit")
