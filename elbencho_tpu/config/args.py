@@ -303,6 +303,8 @@ FLAG_DEFS = [
      "Request signing policy (0=signed v4)"),
     ("s3maxconns", None, "s3_max_connections", "int", 0, "s3",
      "Max parallel S3 connections per worker (0=iodepth)"),
+    ("s3mpusharing", None, "s3_mpu_sharing", "bool", False, "s3",
+     "Multiple workers upload parts of the same (shared-name) objects"),
     ("s3ignoreerrors", None, "s3_ignore_errors", "bool", False, "s3",
      "Continue on S3 request errors (stress mode)"),
 

@@ -1,0 +1,258 @@
+"""In-memory S3 server for CI without real object storage.
+
+The reference has no mock backend (SURVEY.md section 4: testing is
+end-to-end against real resources); the survey's test-strategy implication
+is to exceed that with a fake backend. This implements the XML API subset
+the benchmark uses: bucket create/delete/head, object PUT/GET(+Range)/HEAD/
+DELETE, ListObjectsV2 with continuation tokens, multi-object delete,
+multipart uploads, ACL and tagging. No auth validation (signatures are
+accepted unchecked).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MockS3State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.buckets: "dict[str, dict[str, bytes]]" = {}
+        self.uploads: "dict[str, dict]" = {}  # uploadId -> {bucket,key,parts}
+        self.tags: "dict[tuple[str, str], dict]" = {}
+        self.next_upload_id = 0
+
+
+def _make_handler(state: MockS3State):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        # -- helpers -------------------------------------------------------
+
+        def _split(self):
+            parsed = urllib.parse.urlparse(self.path)
+            parts = parsed.path.lstrip("/").split("/", 1)
+            bucket = urllib.parse.unquote(parts[0]) if parts[0] else ""
+            key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+            query = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(parsed.query,
+                                           keep_blank_values=True).items()}
+            return bucket, key, query
+
+        def _reply(self, code: int, body: bytes = b"",
+                   headers: "dict | None" = None):
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _error(self, code: int, s3code: str, message: str = ""):
+            body = (f"<Error><Code>{s3code}</Code>"
+                    f"<Message>{message}</Message></Error>").encode()
+            self._reply(code, body)
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length) if length else b""
+
+        # -- methods -------------------------------------------------------
+
+        def do_PUT(self):  # noqa: N802
+            bucket, key, query = self._split()
+            body = self._body()
+            with state.lock:
+                if not key:
+                    if "acl" in query:
+                        self._reply(200)
+                        return
+                    state.buckets.setdefault(bucket, {})
+                    self._reply(200)
+                    return
+                if bucket not in state.buckets:
+                    self._error(404, "NoSuchBucket", bucket)
+                    return
+                if "partNumber" in query and "uploadId" in query:
+                    upload = state.uploads.get(query["uploadId"])
+                    if upload is None:
+                        self._error(404, "NoSuchUpload", query["uploadId"])
+                        return
+                    part_num = int(query["partNumber"])
+                    upload["parts"][part_num] = body
+                    self._reply(200, headers={
+                        "ETag": f'"part{part_num}"'})
+                    return
+                if "tagging" in query:
+                    state.tags[(bucket, key)] = body
+                    self._reply(200)
+                    return
+                if "acl" in query:
+                    self._reply(200)
+                    return
+                state.buckets[bucket][key] = body
+                self._reply(200, headers={"ETag": '"mock-etag"'})
+
+        def do_POST(self):  # noqa: N802
+            bucket, key, query = self._split()
+            body = self._body()
+            with state.lock:
+                if "uploads" in query:
+                    state.next_upload_id += 1
+                    upload_id = f"mock-upload-{state.next_upload_id}"
+                    state.uploads[upload_id] = {
+                        "bucket": bucket, "key": key, "parts": {}}
+                    xml_reply = (
+                        "<InitiateMultipartUploadResult>"
+                        f"<Bucket>{bucket}</Bucket><Key>{key}</Key>"
+                        f"<UploadId>{upload_id}</UploadId>"
+                        "</InitiateMultipartUploadResult>").encode()
+                    self._reply(200, xml_reply)
+                    return
+                if "uploadId" in query:
+                    upload = state.uploads.pop(query["uploadId"], None)
+                    if upload is None:
+                        self._error(404, "NoSuchUpload", query["uploadId"])
+                        return
+                    data = b"".join(upload["parts"][num]
+                                    for num in sorted(upload["parts"]))
+                    state.buckets.setdefault(bucket, {})[key] = data
+                    self._reply(200, (
+                        "<CompleteMultipartUploadResult>"
+                        f"<Key>{key}</Key>"
+                        "</CompleteMultipartUploadResult>").encode())
+                    return
+                if "delete" in query:
+                    root = ET.fromstring(body)
+                    deleted = []
+                    for obj in root.iter("Object"):
+                        k = obj.findtext("Key", "")
+                        state.buckets.get(bucket, {}).pop(k, None)
+                        deleted.append(k)
+                    self._reply(200, b"<DeleteResult></DeleteResult>")
+                    return
+                self._error(400, "InvalidRequest")
+
+        def do_GET(self):  # noqa: N802
+            bucket, key, query = self._split()
+            with state.lock:
+                if bucket not in state.buckets:
+                    self._error(404, "NoSuchBucket", bucket)
+                    return
+                if not key or "list-type" in query:
+                    self._list(bucket, query)
+                    return
+                if "acl" in query:
+                    self._reply(200, b"<AccessControlPolicy>"
+                                     b"</AccessControlPolicy>")
+                    return
+                if "tagging" in query:
+                    body = state.tags.get((bucket, key),
+                                          b"<Tagging><TagSet></TagSet>"
+                                          b"</Tagging>")
+                    self._reply(200, body)
+                    return
+                data = state.buckets[bucket].get(key)
+                if data is None:
+                    self._error(404, "NoSuchKey", key)
+                    return
+                range_header = self.headers.get("Range")
+                if range_header:
+                    spec = range_header.split("=", 1)[1]
+                    start_s, _, end_s = spec.partition("-")
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(data) - 1
+                    chunk = data[start:end + 1]
+                    self._reply(206, chunk, headers={
+                        "Content-Range":
+                            f"bytes {start}-{end}/{len(data)}"})
+                    return
+                self._reply(200, data)
+
+        def _list(self, bucket: str, query: dict):
+            prefix = query.get("prefix", "")
+            max_keys = int(query.get("max-keys", "1000"))
+            token = query.get("continuation-token", "")
+            keys = sorted(k for k in state.buckets[bucket]
+                          if k.startswith(prefix))
+            start = int(token) if token else 0
+            page = keys[start:start + max_keys]
+            next_token = str(start + max_keys) \
+                if start + max_keys < len(keys) else ""
+            contents = "".join(
+                f"<Contents><Key>{k}</Key>"
+                f"<Size>{len(state.buckets[bucket][k])}</Size></Contents>"
+                for k in page)
+            more = (f"<NextContinuationToken>{next_token}"
+                    f"</NextContinuationToken>") if next_token else ""
+            xml_reply = (
+                "<ListBucketResult>"
+                f"<Name>{bucket}</Name><KeyCount>{len(page)}</KeyCount>"
+                f"{contents}{more}</ListBucketResult>").encode()
+            self._reply(200, xml_reply)
+
+        def do_HEAD(self):  # noqa: N802
+            bucket, key, _query = self._split()
+            with state.lock:
+                if bucket not in state.buckets:
+                    self._reply(404)
+                    return
+                if not key:
+                    self._reply(200)
+                    return
+                data = state.buckets[bucket].get(key)
+                if data is None:
+                    self._reply(404)
+                    return
+                self._reply(200, headers={"Content-Length-Mock":
+                                          str(len(data))})
+
+        def do_DELETE(self):  # noqa: N802
+            bucket, key, query = self._split()
+            with state.lock:
+                if "uploadId" in query:
+                    state.uploads.pop(query["uploadId"], None)
+                    self._reply(204)
+                    return
+                if not key:
+                    if bucket in state.buckets and state.buckets[bucket]:
+                        self._error(409, "BucketNotEmpty", bucket)
+                        return
+                    state.buckets.pop(bucket, None)
+                    self._reply(204)
+                    return
+                state.buckets.get(bucket, {}).pop(key, None)
+                self._reply(204)
+
+    return Handler
+
+
+class MockS3Server:
+    """Threaded in-process mock S3 endpoint for tests."""
+
+    def __init__(self, port: int = 0):
+        self.state = MockS3State()
+        self.server = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _make_handler(self.state))
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "MockS3Server":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,340 @@
+"""S3/GCS object-storage client toolkit.
+
+Reference: source/toolkits/S3Tk.{h,cpp} (AWS SDK based: global init,
+per-worker client factory with endpoint round-robin by rank :167-316,
+zero-copy memory streams) plus S3CredentialStore. Here the client is
+self-contained stdlib HTTP + AWS Signature V4 (the public, documented
+algorithm) — no SDK dependency, which also keeps GCS's S3-compat XML API
+(interoperability mode) working unchanged.
+
+Operations cover the phases in SURVEY.md section 2.2 "S3 mode": bucket
+create/delete/head, object PUT/GET(+range)/HEAD/DELETE, ListObjectsV2,
+multi-object delete, multipart create/uploadPart/complete/abort, and
+object/bucket ACL + tagging get/put used by the metadata phases.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(f"S3 error {status} {code}: {message}")
+        self.status = status
+        self.code = code
+
+
+class S3Client:
+    """One S3 endpoint connection (per worker; endpoint picked round-robin
+    by worker rank like the reference's client factory)."""
+
+    def __init__(self, endpoint: str, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1",
+                 virtual_hosted: bool = False, timeout: float = 60.0):
+        parsed = urllib.parse.urlparse(
+            endpoint if "//" in endpoint else "http://" + endpoint)
+        self.scheme = parsed.scheme or "http"
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port or (443 if self.scheme == "https" else 80)
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.virtual_hosted = virtual_hosted
+        self.timeout = timeout
+        self._conn: "http.client.HTTPConnection | None" = None
+
+    # -- low-level request --------------------------------------------------
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            cls = (http.client.HTTPSConnection if self.scheme == "https"
+                   else http.client.HTTPConnection)
+            self._conn = cls(self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _sign_v4(self, method: str, path: str, query: "dict[str, str]",
+                 headers: "dict[str, str]", payload_hash: str) -> None:
+        """AWS Signature Version 4 (public algorithm: canonical request ->
+        string-to-sign -> HMAC chain)."""
+        if not self.access_key:
+            return
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date_stamp = now.strftime("%Y%m%d")
+        headers["x-amz-date"] = amz_date
+        headers["x-amz-content-sha256"] = payload_hash
+        canon_query = "&".join(
+            f"{urllib.parse.quote(k, safe='')}"
+            f"={urllib.parse.quote(str(v), safe='')}"
+            for k, v in sorted(query.items()))
+        signed_names = sorted(h.lower() for h in headers)
+        canon_headers = "".join(
+            f"{name}:{str(headers[next(h for h in headers if h.lower() == name)]).strip()}\n"
+            for name in signed_names)
+        signed_headers = ";".join(signed_names)
+        canonical = "\n".join([method, path, canon_query, canon_headers,
+                               signed_headers, payload_hash])
+        scope = f"{date_stamp}/{self.region}/s3/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+
+        def _hmac(key: bytes, msg: str) -> bytes:
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hmac(("AWS4" + self.secret_key).encode(), date_stamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, "s3")
+        k = _hmac(k, "aws4_request")
+        signature = hmac.new(k, string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}")
+
+    def request(self, method: str, bucket: str = "", key: str = "",
+                query: "dict | None" = None, body: bytes = b"",
+                headers: "dict | None" = None,
+                want_body: bool = True) -> "tuple[int, dict, bytes]":
+        query = {k: str(v) for k, v in (query or {}).items()}
+        headers = dict(headers or {})
+        if self.virtual_hosted and bucket:
+            host = f"{bucket}.{self.host}"
+            path = "/" + urllib.parse.quote(key) if key else "/"
+        else:
+            host = self.host
+            path = "/" + bucket + ("/" + urllib.parse.quote(key)
+                                   if key else "")
+            if not bucket:
+                path = "/"
+        headers["Host"] = host if self.port in (80, 443) \
+            else f"{host}:{self.port}"
+        payload_hash = hashlib.sha256(body).hexdigest() if body \
+            else _EMPTY_SHA256
+        self._sign_v4(method, path, query, headers, payload_hash)
+        url = path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        conn = self._connection()
+        try:
+            conn.request(method, url, body=body or None, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read() if want_body or resp.status >= 300 else b""
+            if not want_body and resp.status < 300:
+                resp.read()  # drain for keep-alive
+            return resp.status, dict(resp.getheaders()), data
+        except (http.client.HTTPException, OSError):
+            self.close()  # drop broken keep-alive connection
+            raise
+
+    def _check(self, status: int, data: bytes, ok=(200, 204)) -> None:
+        if status in ok:
+            return
+        code, message = "Unknown", data.decode(errors="replace")[:300]
+        try:
+            root = ET.fromstring(data)
+            code = root.findtext("Code", default=code)
+            message = root.findtext("Message", default=message)
+        except ET.ParseError:
+            pass
+        raise S3Error(status, code, message)
+
+    # -- bucket ops ----------------------------------------------------------
+
+    def create_bucket(self, bucket: str) -> None:
+        status, _, data = self.request("PUT", bucket)
+        if status == 409:  # BucketAlreadyOwnedByYou: treat as success
+            return
+        self._check(status, data, ok=(200,))
+
+    def delete_bucket(self, bucket: str) -> None:
+        status, _, data = self.request("DELETE", bucket)
+        self._check(status, data)
+
+    def head_bucket(self, bucket: str) -> bool:
+        status, _, _ = self.request("HEAD", bucket)
+        return status == 200
+
+    # -- object ops ----------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, body: bytes) -> None:
+        status, _, data = self.request("PUT", bucket, key, body=body)
+        self._check(status, data, ok=(200,))
+
+    def get_object(self, bucket: str, key: str,
+                   range_start: "int | None" = None,
+                   range_len: "int | None" = None) -> bytes:
+        headers = {}
+        if range_start is not None:
+            end = "" if range_len is None else str(range_start + range_len - 1)
+            headers["Range"] = f"bytes={range_start}-{end}"
+        status, _, data = self.request("GET", bucket, key, headers=headers)
+        if status not in (200, 206):
+            self._check(status, data, ok=())
+        return data
+
+    def head_object(self, bucket: str, key: str) -> "dict[str, str]":
+        status, headers, _ = self.request("HEAD", bucket, key)
+        if status != 200:
+            raise S3Error(status, "NotFound", key)
+        return headers
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        status, _, data = self.request("DELETE", bucket, key)
+        self._check(status, data)
+
+    def delete_objects(self, bucket: str, keys: "list[str]") -> None:
+        """Multi-object delete (reference: --s3multidel). With Quiet mode
+        the 200 reply body lists only per-key failures — surface them."""
+        objs = "".join(f"<Object><Key>{k}</Key></Object>" for k in keys)
+        body = (f"<Delete><Quiet>true</Quiet>{objs}</Delete>").encode()
+        status, _, data = self.request("POST", bucket, query={"delete": ""},
+                                       body=body)
+        self._check(status, data, ok=(200,))
+        try:
+            root = ET.fromstring(data)
+        except ET.ParseError:
+            return
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        errors = [(el.findtext(f"{ns}Key", ""), el.findtext(f"{ns}Code", ""))
+                  for el in root.iter(f"{ns}Error")]
+        if errors:
+            key, code = errors[0]
+            raise S3Error(200, code or "MultiDeleteError",
+                          f"{len(errors)} object(s) failed to delete, "
+                          f"first: {key}")
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000,
+                     continuation_token: str = ""
+                     ) -> "tuple[list[str], str]":
+        """ListObjectsV2 page -> (keys, next_continuation_token)."""
+        query = {"list-type": "2", "max-keys": str(max_keys)}
+        if prefix:
+            query["prefix"] = prefix
+        if continuation_token:
+            query["continuation-token"] = continuation_token
+        status, _, data = self.request("GET", bucket, query=query)
+        self._check(status, data, ok=(200,))
+        root = ET.fromstring(data)
+        ns = ""
+        if root.tag.startswith("{"):
+            ns = root.tag[:root.tag.index("}") + 1]
+        keys = [el.findtext(f"{ns}Key") for el in root.findall(
+            f"{ns}Contents")]
+        next_token = root.findtext(f"{ns}NextContinuationToken", default="")
+        return [k for k in keys if k], next_token
+
+    # -- multipart ------------------------------------------------------------
+
+    def create_multipart_upload(self, bucket: str, key: str) -> str:
+        status, _, data = self.request("POST", bucket, key,
+                                       query={"uploads": ""})
+        self._check(status, data, ok=(200,))
+        root = ET.fromstring(data)
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        upload_id = root.findtext(f"{ns}UploadId")
+        if not upload_id:
+            raise S3Error(500, "NoUploadId", "missing UploadId in reply")
+        return upload_id
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, body: bytes) -> str:
+        status, headers, data = self.request(
+            "PUT", bucket, key,
+            query={"partNumber": str(part_number), "uploadId": upload_id},
+            body=body)
+        self._check(status, data, ok=(200,))
+        return headers.get("ETag", headers.get("etag", ""))
+
+    def complete_multipart_upload(self, bucket: str, key: str,
+                                  upload_id: str,
+                                  parts: "list[tuple[int, str]]") -> None:
+        parts_xml = "".join(
+            f"<Part><PartNumber>{num}</PartNumber><ETag>{etag}</ETag></Part>"
+            for num, etag in sorted(parts))
+        body = (f"<CompleteMultipartUpload>{parts_xml}"
+                f"</CompleteMultipartUpload>").encode()
+        status, _, data = self.request("POST", bucket, key,
+                                       query={"uploadId": upload_id},
+                                       body=body)
+        self._check(status, data, ok=(200,))
+
+    def abort_multipart_upload(self, bucket: str, key: str,
+                               upload_id: str) -> None:
+        status, _, data = self.request("DELETE", bucket, key,
+                                       query={"uploadId": upload_id})
+        self._check(status, data)
+
+    # -- metadata ops (ACL / tagging) ----------------------------------------
+
+    def put_object_tagging(self, bucket: str, key: str,
+                           tags: "dict[str, str]") -> None:
+        tagset = "".join(f"<Tag><Key>{k}</Key><Value>{v}</Value></Tag>"
+                         for k, v in tags.items())
+        body = f"<Tagging><TagSet>{tagset}</TagSet></Tagging>".encode()
+        status, _, data = self.request("PUT", bucket, key,
+                                       query={"tagging": ""}, body=body)
+        self._check(status, data, ok=(200,))
+
+    def get_object_tagging(self, bucket: str, key: str) -> "dict[str, str]":
+        status, _, data = self.request("GET", bucket, key,
+                                       query={"tagging": ""})
+        self._check(status, data, ok=(200,))
+        root = ET.fromstring(data)
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        out = {}
+        for tag in root.iter(f"{ns}Tag"):
+            out[tag.findtext(f"{ns}Key", "")] = \
+                tag.findtext(f"{ns}Value", "")
+        return out
+
+    def put_object_acl(self, bucket: str, key: str, acl: str) -> None:
+        status, _, data = self.request(
+            "PUT", bucket, key, query={"acl": ""},
+            headers={"x-amz-acl": acl})
+        self._check(status, data, ok=(200,))
+
+    def get_object_acl(self, bucket: str, key: str) -> bytes:
+        status, _, data = self.request("GET", bucket, key,
+                                       query={"acl": ""})
+        self._check(status, data, ok=(200,))
+        return data
+
+    def put_bucket_acl(self, bucket: str, acl: str) -> None:
+        status, _, data = self.request("PUT", bucket, query={"acl": ""},
+                                       headers={"x-amz-acl": acl})
+        self._check(status, data, ok=(200,))
+
+    def get_bucket_acl(self, bucket: str) -> bytes:
+        status, _, data = self.request("GET", bucket, query={"acl": ""})
+        self._check(status, data, ok=(200,))
+        return data
+
+
+def make_client_for_rank(cfg, rank: int) -> S3Client:
+    """Endpoint round-robin by worker rank (reference: S3Tk.cpp:167-316)."""
+    endpoints = [e.strip() for e in cfg.s3_endpoints_str.split(",")
+                 if e.strip()]
+    if not endpoints:
+        raise ValueError("no S3 endpoints configured (--s3endpoints)")
+    endpoint = endpoints[rank % len(endpoints)]
+    return S3Client(endpoint, access_key=cfg.s3_access_key,
+                    secret_key=cfg.s3_secret_key, region=cfg.s3_region,
+                    virtual_hosted=cfg.s3_virtual_hosted)

@@ -1,0 +1,106 @@
+"""Shared multipart-upload store for cross-worker MPU.
+
+Reference: source/S3UploadStore.{h,cpp} — process-wide mutex-protected map
+<bucket, object> -> {uploadID, completedParts, bytesDone}; emits the
+completion signal when bytesDone reaches the object size; abort support for
+interrupts (S3UploadStore.h:73-105). Used by --s3mpusharing style shared
+uploads where multiple workers upload parts of one object.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _UploadEntry:
+    upload_id: str = ""
+    completed_parts: "list[tuple[int, str]]" = field(default_factory=list)
+    bytes_done: int = 0
+    object_size: int = 0
+    aborted: bool = False
+
+
+class S3UploadStore:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._uploads: "dict[tuple[str, str], _UploadEntry]" = {}
+
+    def get_or_create_upload_id(self, bucket: str, key: str,
+                                object_size: int, create_fn) -> str:
+        """First caller wins the CreateMultipartUpload race and performs it;
+        everyone else WAITS for that id (reference: one creator thread wins,
+        S3UploadStore semantics) — two concurrent creates would split the
+        parts across two uploads."""
+        with self._lock:
+            entry = self._uploads.get((bucket, key))
+            if entry is None:
+                entry = _UploadEntry(object_size=object_size)
+                self._uploads[(bucket, key)] = entry
+                creator = True
+            else:
+                creator = False
+                while not entry.upload_id and not entry.aborted:
+                    self._lock.wait(timeout=60)
+                if entry.upload_id:
+                    return entry.upload_id
+                raise RuntimeError(
+                    f"shared upload for {bucket}/{key} was aborted")
+        try:
+            upload_id = create_fn()
+        except BaseException:
+            with self._lock:
+                entry.aborted = True
+                self._lock.notify_all()
+            raise
+        with self._lock:
+            entry.upload_id = upload_id
+            self._lock.notify_all()
+        return upload_id
+
+    def add_completed_part(self, bucket: str, key: str, part_number: int,
+                           etag: str, num_bytes: int) -> bool:
+        """Record a finished part; returns True when this part completed the
+        object (the caller then sends CompleteMultipartUpload)."""
+        with self._lock:
+            entry = self._uploads[(bucket, key)]
+            entry.completed_parts.append((part_number, etag))
+            entry.bytes_done += num_bytes
+            return (entry.object_size > 0
+                    and entry.bytes_done >= entry.object_size
+                    and not entry.aborted)
+
+    def get_completed_parts(self, bucket: str,
+                            key: str) -> "list[tuple[int, str]]":
+        with self._lock:
+            return sorted(self._uploads[(bucket, key)].completed_parts)
+
+    def mark_aborted(self, bucket: str, key: str) -> str:
+        """Interrupt path: flag + return upload id for AbortMultipartUpload
+        (reference: abort-MPU-on-interrupt, LocalWorker.cpp:6044-6135)."""
+        with self._lock:
+            entry = self._uploads.get((bucket, key))
+            if entry is None:
+                return ""
+            entry.aborted = True
+            self._lock.notify_all()  # wake waiters in get_or_create
+            return entry.upload_id
+
+    def pop_all_unfinished(self) -> "list[tuple[str, str, str]]":
+        """(bucket, key, upload_id) of every upload not yet completed."""
+        with self._lock:
+            out = []
+            for (bucket, key), entry in self._uploads.items():
+                if entry.object_size and \
+                        entry.bytes_done < entry.object_size:
+                    out.append((bucket, key, entry.upload_id))
+            return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._uploads.clear()
+
+
+#: process-wide instance (one per service, like the reference's singleton)
+shared_upload_store = S3UploadStore()

@@ -78,8 +78,9 @@ class LocalWorker(Worker):
         cfg = self.cfg
         self._apply_core_binding()
         if cfg.file_size > 0 or cfg.tree_file_path \
-                or cfg.bench_mode == BenchMode.NETBENCH:
+                or cfg.bench_mode in (BenchMode.NETBENCH, BenchMode.S3):
             self._alloc_io_buffer()
+        self._s3_client = None  # created lazily by workers/s3_worker.py
         if cfg.tpu_ids:
             from ..tpu.device import TpuWorkerContext
             chip = cfg.tpu_ids[self.rank % len(cfg.tpu_ids)]
@@ -132,6 +133,9 @@ class LocalWorker(Worker):
         self._io_buf_mmaps = []
         if self._ops_log is not None:
             self._ops_log.close()
+        if getattr(self, "_s3_client", None) is not None:
+            self._s3_client.close()
+            self._s3_client = None
 
     def _apply_core_binding(self) -> None:
         """Round-robin worker->core binding (reference: --cores/--zones via
@@ -232,7 +236,7 @@ class LocalWorker(Worker):
         elif phase == BenchPhase.DROPCACHES:
             self._any_mode_drop_caches()
         elif cfg.bench_mode == BenchMode.S3:
-            from .s3_worker_mixin import dispatch_s3_phase
+            from .s3_worker import dispatch_s3_phase
             dispatch_s3_phase(self, phase)
         elif cfg.bench_mode == BenchMode.NETBENCH:
             from .netbench import run_netbench_phase
@@ -252,15 +256,27 @@ class LocalWorker(Worker):
     # dir mode (reference: dirModeIterateDirs :2811 / IterateFiles :3055)
     # ------------------------------------------------------------------
 
-    def _dir_rel_path(self, dir_idx: int) -> str:
+    @staticmethod
+    def dir_rel_path_for(rank: int, dir_idx: int, dir_sharing: bool) -> str:
         """Namespace: "r<rank>/d<idx>", or shared "d<idx>" with --dirsharing
         (reference: LocalWorker.cpp:3097 + dirsharing)."""
-        if self.cfg.do_dir_sharing:
+        if dir_sharing:
             return f"d{dir_idx}"
-        return f"r{self.rank}/d{dir_idx}"
+        return f"r{rank}/d{dir_idx}"
+
+    @staticmethod
+    def file_rel_path_for(rank: int, dir_idx: int, file_idx: int,
+                          dir_sharing: bool) -> str:
+        base = LocalWorker.dir_rel_path_for(rank, dir_idx, dir_sharing)
+        return f"{base}/r{rank}-f{file_idx}"
+
+    def _dir_rel_path(self, dir_idx: int) -> str:
+        return self.dir_rel_path_for(self.rank, dir_idx,
+                                     self.cfg.do_dir_sharing)
 
     def _file_rel_path(self, dir_idx: int, file_idx: int) -> str:
-        return f"{self._dir_rel_path(dir_idx)}/r{self.rank}-f{file_idx}"
+        return self.file_rel_path_for(self.rank, dir_idx, file_idx,
+                                      self.cfg.do_dir_sharing)
 
     def _bench_path_for_dir(self, dir_idx: int) -> str:
         """Round-robin dirs over bench paths (reference: :3110)."""

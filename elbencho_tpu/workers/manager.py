@@ -34,6 +34,9 @@ class WorkerManager:
         """Create workers + threads; prep acts as a barrier
         (reference: prepareThreads + waitForWorkersDone on prep)."""
         self._open_shared_path_fds()
+        if self.cfg.bench_mode == BenchMode.S3:
+            from ..toolkits.s3_upload_store import shared_upload_store
+            shared_upload_store.clear()  # no stale MPU state across runs
         if self.cfg.hosts and not self.cfg.run_as_service:
             from ..service.remote_worker import RemoteWorker
             for host_idx, host in enumerate(self.cfg.hosts):

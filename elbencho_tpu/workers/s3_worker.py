@@ -1,0 +1,446 @@
+"""S3/object-storage workload phases for LocalWorker.
+
+Reference: the S3 surface of source/workers/LocalWorker.cpp —
+s3ModeIterateBuckets :3822, s3ModeIterateObjects :3920-4059, upload single
+:4810 / multipart :4905, download :6137, stat :6489, delete :6516, listing
+:6549 (single) / :6641 (parallel) / verify :6797, multi-delete :6850,
+object/bucket ACL :4623-4742/:6985-7107, tagging :4495-4589/:7109-7204.
+
+Object namespace matches dir mode: "<prefix>r<rank>/d<dir>/r<rank>-f<file>"
+so WRITE/READ/STAT/RMFILES phases line up across POSIX and S3 front-ends.
+The TPU HBM staging seam is identical: downloaded blocks go through
+worker._tpu.host_to_device, uploads originate from the same io buffer fill
+path (on-device pool with --tpuids).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..phases import BenchPhase
+from ..toolkits.s3_upload_store import shared_upload_store
+from .shared import WorkerException
+
+MAX_LIST_PAGE = 1000
+
+
+def _client(worker):
+    if getattr(worker, "_s3_client", None) is None:
+        from ..toolkits.s3_tk import make_client_for_rank
+        worker._s3_client = make_client_for_rank(worker.cfg, worker.rank)
+    return worker._s3_client
+
+
+def dispatch_s3_phase(worker, phase: BenchPhase) -> None:
+    cfg = worker.cfg
+    handlers = {
+        BenchPhase.CREATEDIRS: _iterate_buckets,
+        BenchPhase.DELETEDIRS: _iterate_buckets,
+        BenchPhase.STATDIRS: _iterate_buckets,
+        BenchPhase.CREATEFILES: _iterate_objects,
+        BenchPhase.READFILES: _iterate_objects,
+        BenchPhase.STATFILES: _iterate_objects,
+        BenchPhase.DELETEFILES: _iterate_objects,
+        BenchPhase.LISTOBJECTS: _list_objects_single,
+        BenchPhase.LISTOBJPARALLEL: _list_objects_parallel,
+        BenchPhase.MULTIDELOBJ: _multi_delete,
+        BenchPhase.PUTOBJACL: _obj_acl,
+        BenchPhase.GETOBJACL: _obj_acl,
+        BenchPhase.PUTBUCKETACL: _bucket_acl,
+        BenchPhase.GETBUCKETACL: _bucket_acl,
+        BenchPhase.PUT_OBJ_MD: _obj_tagging,
+        BenchPhase.GET_OBJ_MD: _obj_tagging,
+        BenchPhase.DEL_OBJ_MD: _obj_tagging,
+    }
+    handler = handlers.get(phase)
+    if handler is None:
+        raise WorkerException(
+            f"S3 phase {phase.name} is not implemented yet")
+    handler(worker, phase)
+    if worker._tpu is not None:
+        t0 = time.perf_counter_ns()
+        worker._tpu.flush()
+        worker.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+
+
+# ---------------------------------------------------------------------------
+# namespace helpers (same formulas as POSIX dir mode)
+# ---------------------------------------------------------------------------
+
+def _object_key(worker, dir_idx: int, file_idx: int) -> str:
+    cfg = worker.cfg
+    if cfg.s3_mpu_sharing:
+        # shared object namespace: every worker uploads parts of the SAME
+        # objects (reference: --s3mpusharing semantics)
+        return f"{cfg.s3_object_prefix}d{dir_idx}-f{file_idx}"
+    return (f"{cfg.s3_object_prefix}"
+            f"{worker._file_rel_path(dir_idx, file_idx)}")
+
+
+def _bucket_for_dir(worker, dir_idx: int) -> str:
+    return worker._bench_path_for_dir(dir_idx)
+
+
+def _iter_entries(worker):
+    for dir_idx in range(worker.cfg.num_dirs):
+        for file_idx in range(worker.cfg.num_files):
+            yield (_bucket_for_dir(worker, dir_idx),
+                   _object_key(worker, dir_idx, file_idx))
+
+
+# ---------------------------------------------------------------------------
+# buckets (reference: s3ModeIterateBuckets :3822)
+# ---------------------------------------------------------------------------
+
+def _iterate_buckets(worker, phase: BenchPhase) -> None:
+    cfg = worker.cfg
+    client = _client(worker)
+    ndst = max(1, cfg.num_dataset_threads)
+    got_work = False
+    for idx, bucket in enumerate(cfg.paths):
+        if idx % ndst != worker.rank % ndst:
+            continue
+        got_work = True
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        if phase == BenchPhase.CREATEDIRS:
+            client.create_bucket(bucket)
+        elif phase == BenchPhase.DELETEDIRS:
+            client.delete_bucket(bucket)
+        else:  # STATDIRS
+            if not client.head_bucket(bucket):
+                raise WorkerException(f"bucket not found: {bucket}")
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        worker.entries_latency_histo.add_latency(lat_usec)
+        worker.live_ops.num_entries_done += 1
+    worker.got_phase_work = got_work
+
+
+# ---------------------------------------------------------------------------
+# objects (reference: s3ModeIterateObjects :3920-4059)
+# ---------------------------------------------------------------------------
+
+def _iterate_objects(worker, phase: BenchPhase) -> None:
+    cfg = worker.cfg
+    for bucket, key in _iter_entries(worker):
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        if phase == BenchPhase.CREATEFILES:
+            _upload_object(worker, bucket, key)
+        elif phase == BenchPhase.READFILES:
+            _download_object(worker, bucket, key)
+        elif phase == BenchPhase.STATFILES:
+            _client(worker).head_object(bucket, key)
+        elif phase == BenchPhase.DELETEFILES:
+            try:
+                _client(worker).delete_object(bucket, key)
+            except Exception:
+                if not cfg.ignore_delete_errors and not cfg.s3_ignore_errors:
+                    raise
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        worker.entries_latency_histo.add_latency(lat_usec)
+        worker.live_ops.num_entries_done += 1
+
+
+def _upload_object(worker, bucket: str, key: str) -> None:
+    """Single PUT for small objects / --s3single; multipart otherwise
+    (reference: upload single :4810, MPU :4905; shared MPU :5455 via
+    the S3UploadStore when --s3mpusharing)."""
+    cfg = worker.cfg
+    client = _client(worker)
+    size, bs = cfg.file_size, cfg.block_size
+    limiter = worker._rate_limiter_write
+    if cfg.s3_mpu_sharing and size > bs:
+        _upload_object_shared_mpu(worker, bucket, key)
+        return
+    if size <= bs or cfg.s3_no_mpu:
+        if limiter:
+            limiter.wait(size)
+        # assemble the full payload block-by-block: io buffers are only
+        # block_size bytes, and the fill path works per block
+        body = b"".join(
+            _next_upload_block(worker, off, min(bs, size - off))
+            for off in range(0, size, bs)) if size else b""
+        t0 = time.perf_counter_ns()
+        client.put_object(bucket, key, body)
+        worker.iops_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
+        worker.live_ops.num_bytes_done += size
+        worker.live_ops.num_iops_done += 1
+        worker._num_iops_submitted += 1
+        return
+    upload_id = client.create_multipart_upload(bucket, key)
+    parts: "list[tuple[int, str]]" = []
+    try:
+        offset = 0
+        part_number = 1
+        while offset < size:
+            worker.check_interruption_request()
+            length = min(bs, size - offset)
+            if limiter:
+                limiter.wait(length)
+            body = _next_upload_block(worker, offset, length)
+            t0 = time.perf_counter_ns()
+            etag = client.upload_part(bucket, key, upload_id, part_number,
+                                      body)
+            worker.iops_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+            parts.append((part_number, etag))
+            worker.live_ops.num_bytes_done += length
+            worker.live_ops.num_iops_done += 1
+            worker._num_iops_submitted += 1
+            offset += length
+            part_number += 1
+        client.complete_multipart_upload(bucket, key, upload_id, parts)
+    except BaseException:
+        # abort on interrupt/error so no orphaned MPU is left behind
+        # (reference: LocalWorker.cpp:6044-6135)
+        try:
+            client.abort_multipart_upload(bucket, key, upload_id)
+        except Exception:  # noqa: BLE001
+            pass
+        raise
+
+
+def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
+    """Shared multipart upload: workers upload interleaved parts of one
+    object through the process-wide S3UploadStore; whichever worker
+    completes the final byte sends CompleteMultipartUpload (reference:
+    s3ModeUploadObjectMultiPartShared :5455 + S3UploadStore.h:73-105)."""
+    cfg = worker.cfg
+    client = _client(worker)
+    size, bs = cfg.file_size, cfg.block_size
+    ndst = max(1, cfg.num_dataset_threads)
+    rank = worker.rank % ndst
+    num_parts = (size + bs - 1) // bs
+    upload_id = shared_upload_store.get_or_create_upload_id(
+        bucket, key, size,
+        lambda: client.create_multipart_upload(bucket, key))
+    got_final = False
+    try:
+        for part_idx in range(rank, num_parts, ndst):
+            worker.check_interruption_request()
+            offset = part_idx * bs
+            length = min(bs, size - offset)
+            if worker._rate_limiter_write:
+                worker._rate_limiter_write.wait(length)
+            body = _next_upload_block(worker, offset, length)
+            t0 = time.perf_counter_ns()
+            etag = client.upload_part(bucket, key, upload_id,
+                                      part_idx + 1, body)
+            worker.iops_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+            worker.live_ops.num_bytes_done += length
+            worker.live_ops.num_iops_done += 1
+            worker._num_iops_submitted += 1
+            got_final = shared_upload_store.add_completed_part(
+                bucket, key, part_idx + 1, etag, length)
+        if got_final:
+            client.complete_multipart_upload(
+                bucket, key, upload_id,
+                shared_upload_store.get_completed_parts(bucket, key))
+    except BaseException:
+        upload_id = shared_upload_store.mark_aborted(bucket, key)
+        if upload_id:
+            try:
+                client.abort_multipart_upload(bucket, key, upload_id)
+            except Exception:  # noqa: BLE001
+                pass
+        raise
+
+
+def _next_upload_block(worker, offset: int, length: int) -> bytes:
+    """Upload payload from the worker's io buffer, via the same pre-write
+    fill path as POSIX mode (verify pattern / block variance / TPU pool)."""
+    buf = worker._io_bufs[worker._num_iops_submitted % len(worker._io_bufs)]
+    worker._pre_write_fill(buf, offset, length)
+    return bytes(buf[:length])
+
+
+def _download_object(worker, bucket: str, key: str) -> None:
+    """Whole-object GET when blocksize >= filesize, ranged GETs per block
+    otherwise (reference: download :6137)."""
+    cfg = worker.cfg
+    client = _client(worker)
+    size, bs = cfg.file_size, cfg.block_size
+    limiter = worker._rate_limiter_read
+    offset = 0
+    while offset < size:
+        worker.check_interruption_request()
+        length = min(bs, size - offset)
+        if limiter:
+            limiter.wait(length)
+        t0 = time.perf_counter_ns()
+        if size <= bs:
+            data = client.get_object(bucket, key)
+        else:
+            data = client.get_object(bucket, key, range_start=offset,
+                                     range_len=length)
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        if len(data) != length:
+            raise WorkerException(
+                f"short S3 read for {bucket}/{key} at {offset}: "
+                f"{len(data)} != {length}")
+        worker.iops_latency_histo.add_latency(lat_usec)
+        buf = worker._io_bufs[
+            worker._num_iops_submitted % len(worker._io_bufs)]
+        buf[:length] = data
+        worker._post_read_actions(buf, offset, length)
+        worker.live_ops.num_bytes_done += length
+        worker.live_ops.num_iops_done += 1
+        worker._num_iops_submitted += 1
+        offset += length
+
+
+# ---------------------------------------------------------------------------
+# listing (reference: :6549 single / :6641 parallel / verify :6797)
+# ---------------------------------------------------------------------------
+
+def _expected_keys(worker) -> "set[str]":
+    """Every key any rank would have written, built from the same namespace
+    helper the writers use (so --dirsharing etc. can't diverge)."""
+    from .local_worker import LocalWorker
+    cfg = worker.cfg
+    out = set()
+    for rank in range(max(cfg.num_dataset_threads, cfg.num_threads)):
+        for dir_idx in range(cfg.num_dirs):
+            for file_idx in range(cfg.num_files):
+                if cfg.s3_mpu_sharing:
+                    out.add(f"{cfg.s3_object_prefix}d{dir_idx}-f{file_idx}")
+                else:
+                    out.add(cfg.s3_object_prefix
+                            + LocalWorker.file_rel_path_for(
+                                rank, dir_idx, file_idx,
+                                cfg.do_dir_sharing))
+    return out
+
+
+def _list_bucket(worker, bucket: str, prefix: str, limit: int) -> int:
+    client = _client(worker)
+    token = ""
+    total = 0
+    # hoisted: the expected set is O(dataset) to build, not per page
+    expected = _expected_keys(worker) \
+        if worker.cfg.do_list_objects_verify else None
+    while total < limit:
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        keys, token = client.list_objects(
+            bucket, prefix=prefix,
+            max_keys=min(MAX_LIST_PAGE, limit - total),
+            continuation_token=token)
+        worker.iops_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
+        total += len(keys)
+        worker.live_ops.num_entries_done += len(keys)
+        worker.live_ops.num_iops_done += 1
+        if expected is not None:
+            unexpected = [k for k in keys if k not in expected]
+            if unexpected:
+                raise WorkerException(
+                    f"listing verification failed: unexpected keys "
+                    f"{unexpected[:3]}...")
+        if not token:
+            break
+    return total
+
+
+def _list_objects_single(worker, phase: BenchPhase) -> None:
+    """Only the first worker lists (reference: :6549)."""
+    cfg = worker.cfg
+    if worker.rank % max(1, cfg.num_threads) != 0:
+        worker.got_phase_work = False
+        return
+    limit = cfg.run_list_objects_num or (1 << 62)
+    for bucket in cfg.paths:
+        _list_bucket(worker, bucket, cfg.s3_object_prefix, limit)
+
+
+def _list_objects_parallel(worker, phase: BenchPhase) -> None:
+    """Each worker lists its own rank prefix (reference: :6641). With
+    --dirsharing keys are not rank-prefixed, so every worker lists the
+    full shared prefix instead."""
+    cfg = worker.cfg
+    limit = cfg.run_list_objects_num or (1 << 62)
+    if cfg.do_dir_sharing or cfg.s3_mpu_sharing:
+        prefix = cfg.s3_object_prefix
+    else:
+        prefix = f"{cfg.s3_object_prefix}r{worker.rank}/"
+    for bucket in cfg.paths:
+        _list_bucket(worker, bucket, prefix, limit)
+
+
+def _multi_delete(worker, phase: BenchPhase) -> None:
+    """Batched DeleteObjects of this worker's own objects
+    (reference: :6850)."""
+    cfg = worker.cfg
+    client = _client(worker)
+    batch_size = max(1, cfg.run_multi_delete_num)
+    batch: "list[str]" = []
+    by_bucket: "dict[str, list[str]]" = {}
+    for bucket, key in _iter_entries(worker):
+        by_bucket.setdefault(bucket, []).append(key)
+    for bucket, keys in by_bucket.items():
+        for i in range(0, len(keys), batch_size):
+            worker.check_interruption_request(force=True)
+            batch = keys[i:i + batch_size]
+            t0 = time.perf_counter_ns()
+            client.delete_objects(bucket, batch)
+            worker.iops_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+            worker.live_ops.num_entries_done += len(batch)
+            worker.live_ops.num_iops_done += 1
+
+
+# ---------------------------------------------------------------------------
+# ACL / tagging metadata phases
+# ---------------------------------------------------------------------------
+
+def _obj_acl(worker, phase: BenchPhase) -> None:
+    client = _client(worker)
+    for bucket, key in _iter_entries(worker):
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        if phase == BenchPhase.PUTOBJACL:
+            client.put_object_acl(bucket, key, "private")
+        else:
+            client.get_object_acl(bucket, key)
+        worker.entries_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
+        worker.live_ops.num_entries_done += 1
+
+
+def _bucket_acl(worker, phase: BenchPhase) -> None:
+    cfg = worker.cfg
+    client = _client(worker)
+    ndst = max(1, cfg.num_dataset_threads)
+    got_work = False
+    for idx, bucket in enumerate(cfg.paths):
+        if idx % ndst != worker.rank % ndst:
+            continue
+        got_work = True
+        t0 = time.perf_counter_ns()
+        if phase == BenchPhase.PUTBUCKETACL:
+            client.put_bucket_acl(bucket, "private")
+        else:
+            client.get_bucket_acl(bucket)
+        worker.entries_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
+        worker.live_ops.num_entries_done += 1
+    worker.got_phase_work = got_work
+
+
+def _obj_tagging(worker, phase: BenchPhase) -> None:
+    client = _client(worker)
+    for bucket, key in _iter_entries(worker):
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        if phase == BenchPhase.PUT_OBJ_MD:
+            client.put_object_tagging(bucket, key, {"elbencho": "tpu"})
+        elif phase == BenchPhase.GET_OBJ_MD:
+            client.get_object_tagging(bucket, key)
+        else:  # DEL_OBJ_MD: overwrite with empty set
+            client.put_object_tagging(bucket, key, {})
+        worker.entries_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
+        worker.live_ops.num_entries_done += 1

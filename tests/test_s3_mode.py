@@ -1,0 +1,201 @@
+"""S3/object-storage front-end tests against the in-memory mock server."""
+
+import json
+
+import pytest
+
+from elbencho_tpu.cli import main
+from elbencho_tpu.testing.mock_s3 import MockS3Server
+from elbencho_tpu.toolkits.s3_tk import S3Client, S3Error
+
+
+@pytest.fixture(scope="module")
+def mock_s3():
+    server = MockS3Server().start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(mock_s3):
+    c = S3Client(mock_s3.endpoint, access_key="test", secret_key="secret")
+    yield c
+    c.close()
+
+
+def run_cli(mock_s3, args):
+    return main(args + ["--nolive", "--s3endpoints", mock_s3.endpoint,
+                        "--s3key", "k", "--s3secret", "s"])
+
+
+# -- client-level tests -------------------------------------------------------
+
+def test_bucket_lifecycle(client):
+    client.create_bucket("b1")
+    assert client.head_bucket("b1")
+    client.delete_bucket("b1")
+    assert not client.head_bucket("b1")
+
+
+def test_object_put_get_roundtrip(client):
+    client.create_bucket("b2")
+    client.put_object("b2", "hello.txt", b"payload123")
+    assert client.get_object("b2", "hello.txt") == b"payload123"
+    assert client.get_object("b2", "hello.txt", range_start=3,
+                             range_len=4) == b"load"
+    client.delete_object("b2", "hello.txt")
+    with pytest.raises(S3Error):
+        client.get_object("b2", "hello.txt")
+
+
+def test_multipart_roundtrip(client):
+    client.create_bucket("b3")
+    upload_id = client.create_multipart_upload("b3", "big.bin")
+    parts = []
+    for num, chunk in enumerate([b"a" * 100, b"b" * 100, b"c" * 50], 1):
+        etag = client.upload_part("b3", "big.bin", upload_id, num, chunk)
+        parts.append((num, etag))
+    client.complete_multipart_upload("b3", "big.bin", upload_id, parts)
+    data = client.get_object("b3", "big.bin")
+    assert data == b"a" * 100 + b"b" * 100 + b"c" * 50
+
+
+def test_multipart_abort(client):
+    client.create_bucket("b4")
+    upload_id = client.create_multipart_upload("b4", "gone.bin")
+    client.upload_part("b4", "gone.bin", upload_id, 1, b"x" * 10)
+    client.abort_multipart_upload("b4", "gone.bin", upload_id)
+    with pytest.raises(S3Error):
+        client.get_object("b4", "gone.bin")
+
+
+def test_listing_with_pagination(client):
+    client.create_bucket("b5")
+    for i in range(25):
+        client.put_object("b5", f"obj{i:03d}", b"x")
+    keys, token = client.list_objects("b5", max_keys=10)
+    assert len(keys) == 10 and token
+    keys2, token2 = client.list_objects("b5", continuation_token=token,
+                                        max_keys=10)
+    assert len(keys2) == 10 and token2
+    keys3, token3 = client.list_objects("b5", continuation_token=token2,
+                                        max_keys=10)
+    assert len(keys3) == 5 and not token3
+
+
+def test_multi_delete(client):
+    client.create_bucket("b6")
+    for i in range(5):
+        client.put_object("b6", f"del{i}", b"x")
+    client.delete_objects("b6", [f"del{i}" for i in range(5)])
+    keys, _ = client.list_objects("b6")
+    assert keys == []
+
+
+def test_tagging(client):
+    client.create_bucket("b7")
+    client.put_object("b7", "t.txt", b"x")
+    client.put_object_tagging("b7", "t.txt", {"env": "test"})
+    assert client.get_object_tagging("b7", "t.txt") == {"env": "test"}
+
+
+# -- benchmark-level tests ----------------------------------------------------
+
+def test_s3_full_cycle_single_part(mock_s3, capsys):
+    rc = run_cli(mock_s3, ["-w", "-d", "-r", "--stat", "-F", "-D",
+                           "-t", "2", "-n", "1", "-N", "3", "-s", "8K",
+                           "-b", "8K", "s3://cycle1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for phase in ("MKBUCKETS", "WRITE", "HEADOBJ", "READ", "RMOBJECTS",
+                  "RMBUCKETS"):
+        assert phase in out, f"missing phase {phase}"
+
+
+def test_s3_multipart_upload_download(mock_s3):
+    rc = run_cli(mock_s3, ["-w", "-d", "-r", "-t", "1", "-n", "1", "-N", "1",
+                           "-s", "64K", "-b", "16K", "s3://cycle2"])
+    assert rc == 0  # 64K object in 4 x 16K parts, then ranged GETs
+
+
+def test_s3_object_bytes_accounted(mock_s3, tmp_path):
+    jsonfile = tmp_path / "out.json"
+    rc = main(["-w", "-d", "-r", "-t", "2", "-n", "1", "-N", "2",
+               "-s", "32K", "-b", "8K", "s3://acct", "--nolive",
+               "--s3endpoints", mock_s3.endpoint,
+               "--jsonfile", str(jsonfile)])
+    assert rc == 0
+    recs = [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+    write_rec = next(r for r in recs if r["Phase"] == "WRITE")
+    assert write_rec["EntriesLast"] == 4      # 2 threads x 2 objects
+    assert write_rec["BytesLast"] == 4 * 32768
+    read_rec = next(r for r in recs if r["Phase"] == "READ")
+    assert read_rec["BytesLast"] == 4 * 32768
+
+
+def test_s3_listing_phase(mock_s3, capsys):
+    assert run_cli(mock_s3, ["-w", "-d", "-t", "1", "-n", "1", "-N", "5",
+                             "-s", "1K", "-b", "1K", "s3://lst"]) == 0
+    rc = run_cli(mock_s3, ["--s3listobj", "100", "-t", "1", "-n", "1",
+                           "-N", "5", "-s", "1K", "-b", "1K", "s3://lst"])
+    assert rc == 0
+    assert "LISTOBJ" in capsys.readouterr().out
+
+
+def test_s3_multidel_phase(mock_s3):
+    assert run_cli(mock_s3, ["-w", "-d", "-t", "1", "-n", "1", "-N", "6",
+                             "-s", "1K", "-b", "1K", "s3://mdel"]) == 0
+    rc = run_cli(mock_s3, ["--s3multidel", "2", "-t", "1", "-n", "1",
+                           "-N", "6", "-s", "1K", "-b", "1K", "s3://mdel"])
+    assert rc == 0
+
+
+def test_s3_verify_integrity(mock_s3):
+    rc = run_cli(mock_s3, ["-w", "-d", "-r", "--verify", "7", "-t", "1",
+                           "-n", "1", "-N", "2", "-s", "16K", "-b", "4K",
+                           "s3://vrfy"])
+    assert rc == 0
+
+
+def test_s3_single_put_large_object_not_truncated(mock_s3):
+    """--s3single with file_size > block_size must upload the full object
+    (assembled block-by-block) and read it back."""
+    rc = run_cli(mock_s3, ["-w", "-d", "-r", "--s3single", "-t", "1",
+                           "-n", "1", "-N", "1", "-s", "64K", "-b", "16K",
+                           "s3://single-big"])
+    assert rc == 0
+    c = S3Client(mock_s3.endpoint)
+    data = c.get_object("single-big", "r0/d0/r0-f0")
+    assert len(data) == 64 * 1024
+    c.close()
+
+
+def test_s3_shared_mpu(mock_s3):
+    """--s3mpusharing: 2 workers upload interleaved parts of the same
+    objects; the completer stitches them together."""
+    rc = run_cli(mock_s3, ["-w", "-d", "--s3mpusharing", "-t", "2",
+                           "-n", "1", "-N", "2", "-s", "64K", "-b", "8K",
+                           "s3://sharedmpu"])
+    assert rc == 0
+    c = S3Client(mock_s3.endpoint)
+    for f in range(2):
+        data = c.get_object("sharedmpu", f"d0-f{f}")
+        assert len(data) == 64 * 1024
+    c.close()
+
+
+def test_s3_listverify_with_dirsharing(mock_s3):
+    """Listing verification must accept keys written under --dirsharing."""
+    assert run_cli(mock_s3, ["-w", "-d", "--dirsharing", "-t", "2",
+                             "-n", "1", "-N", "2", "-s", "1K", "-b", "1K",
+                             "s3://dshare"]) == 0
+    rc = run_cli(mock_s3, ["--s3listobj", "100", "--s3listverify",
+                           "--dirsharing", "-t", "2", "-n", "1", "-N", "2",
+                           "-s", "1K", "-b", "1K", "s3://dshare"])
+    assert rc == 0
+
+
+def test_s3_read_missing_object_fails(mock_s3):
+    rc = run_cli(mock_s3, ["-r", "-t", "1", "-n", "1", "-N", "1",
+                           "-s", "4K", "-b", "4K", "s3://nonexistent-b"])
+    assert rc != 0
