@@ -64,6 +64,28 @@ def _print_dry_run(cfg) -> None:
               f"{format_bytes(num_bytes)}B")
 
 
+def _run_tree_scan(cfg) -> int:
+    """--treescan DIR --treefile OUT: build a treefile from a real tree
+    (reference: --treescan + tools/elbencho-scan-path)."""
+    import os
+    from .toolkits.file_tk import scan_tree, write_treefile
+    if not cfg.tree_file_path:
+        print("ERROR: --treescan requires --treefile OUT for the result",
+              file=sys.stderr)
+        return 1
+    if not os.path.isdir(cfg.tree_scan_path):
+        print(f"ERROR: --treescan path is not a directory: "
+              f"{cfg.tree_scan_path}", file=sys.stderr)
+        return 1
+    dirs, files, needs_b64 = scan_tree(cfg.tree_scan_path)
+    write_treefile(cfg.tree_file_path, dirs, files, use_base64=needs_b64)
+    total = sum(e.total_len for e in files.elems)
+    print(f"Scanned {cfg.tree_scan_path}: {dirs.num_paths} dirs, "
+          f"{files.num_paths} files, {format_bytes(total)}B total -> "
+          f"{cfg.tree_file_path}")
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     try:
         cfg, ns = parse_cli(argv)
@@ -80,7 +102,7 @@ def main(argv: "list[str] | None" = None) -> int:
             return 0
     if not cfg.paths and not (cfg.run_as_service or cfg.quit_services
                               or cfg.interrupt_services
-                              or cfg.run_netbench):
+                              or cfg.run_netbench or cfg.tree_scan_path):
         _print_help("essential")
         return 1
     try:
@@ -100,6 +122,8 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"ERROR: {err}", file=sys.stderr)
         return 1
     logger.set_log_level(cfg.log_level)
+    if cfg.tree_scan_path:
+        return _run_tree_scan(cfg)
     if cfg.do_dry_run:
         _print_dry_run(cfg)
         return 0

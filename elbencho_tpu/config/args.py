@@ -239,6 +239,12 @@ FLAG_DEFS = [
      "Number of hosts acting as netbench servers"),
     ("respsize", None, "netbench_response_size", "size", 1, "dist",
      "Netbench server response size in bytes"),
+    # internal (master -> service): netbench topology facts the services
+    # cannot derive themselves (the hosts list is stripped from the wire)
+    ("netbenchsrvlist", None, "netbench_servers_str", "str", "", "dist",
+     "[internal] netbench server endpoints host:port, set by the master"),
+    ("netbenchtotalhosts", None, "netbench_total_hosts", "int", 0, "dist",
+     "[internal] total number of hosts in the run, set by the master"),
     ("recvbuf", None, "sock_recv_buf_size", "size", 0, "dist",
      "Socket receive buffer size"),
     ("sendbuf", None, "sock_send_buf_size", "size", 0, "dist",
@@ -276,6 +282,10 @@ FLAG_DEFS = [
      "Round file sizes in tree file up to multiple of this"),
     ("sharesize", None, "file_share_size", "size", 0, "multi",
      "Custom tree: files >= this size are shared between workers"),
+    ("treescan", None, "tree_scan_path", "str", "", "multi",
+     "Scan this directory tree and write a treefile (with --treefile OUT)"),
+    ("statinline", None, "do_stat_inline", "bool", False, "misc",
+     "Stat each file inline during write/read phases"),
 
     # S3/object storage (front-end parity; stdlib SigV4 client)
     ("s3endpoints", None, "s3_endpoints_str", "str", "", "s3",
@@ -495,6 +505,17 @@ class BenchConfig(BenchConfigBase):
                 "blockdev)")
         if self.tpu_ids_str and self.bench_mode == BenchMode.NETBENCH:
             raise ConfigError("--tpuids not supported in netbench mode")
+        if self.run_netbench:
+            if not self.hosts and not self.netbench_total_hosts:
+                raise ConfigError(
+                    "netbench requires distributed mode: --hosts with at "
+                    "least 2 hosts (first --netbenchservers act as servers)")
+            if self.num_netbench_servers < 1:
+                raise ConfigError("--netbenchservers must be >= 1")
+            if self.hosts and len(self.hosts) <= self.num_netbench_servers:
+                raise ConfigError(
+                    "netbench needs more --hosts than --netbenchservers "
+                    "(servers don't generate load)")
 
     # -- phase selection getters (used by Coordinator ordering table) --------
 
@@ -543,6 +564,16 @@ class BenchConfig(BenchConfigBase):
         d["hosts_file_path"] = ""
         d["run_as_service"] = False
         d["num_dataset_threads_override"] = self.num_dataset_threads
+        if self.run_netbench and self.hosts:
+            # netbench topology: server data port = service port + 1000
+            # (reference: LocalWorker.cpp:646 servers listen on svc+1000)
+            servers = []
+            for host in self.hosts[:self.num_netbench_servers]:
+                name, _, port = host.partition(":")
+                data_port = (int(port) if port else self.service_port) + 1000
+                servers.append(f"{name}:{data_port}")
+            d["netbench_servers_str"] = ",".join(servers)
+            d["netbench_total_hosts"] = len(self.hosts)
         return d
 
     @classmethod

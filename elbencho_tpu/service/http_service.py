@@ -73,6 +73,9 @@ class ServiceState:
         cfg = BenchConfig.from_service_dict(cfg_dict)
         cfg.run_as_service = True
         cfg.disable_live_stats = True
+        # keep OUR listen port, not the master's --port: netbench derives
+        # its data port (svc port + 1000) from it
+        cfg.service_port = self.base_cfg.service_port
         # service-side overrides: pinned bench paths / TPU ids
         # (reference: ProgArgs.cpp:1366-1382)
         if self.base_cfg.paths:

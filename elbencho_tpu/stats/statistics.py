@@ -65,6 +65,7 @@ class Statistics:
         self._live_csv_fh = None
         self._live_json_fh = None
         self._live_started = 0.0
+        self._fullscreen_active = False
 
     # ------------------------------------------------------------------
     # live statistics (reference: printLiveStats, Statistics.cpp:1337)
@@ -116,6 +117,12 @@ class Statistics:
                 continue
             unit, div = ("MB", 1000 ** 2) if cfg.use_base10_units \
                 else ("MiB", 1 << 20)
+            fullscreen = (is_tty and not cfg.use_single_line_live_stats
+                          and not cfg.single_line_live_stats_no_erase)
+            if fullscreen:
+                self._render_fullscreen(phase, elapsed, bps / div,
+                                        ops_per_s, unit, div, done)
+                continue
             line = (f"{phase_name(phase, cfg.bench_mode == BenchMode.S3)}: "
                     f"{elapsed}s; {bps / div:,.0f} {unit}/s; "
                     f"{ops_per_s:,.0f} IOPS; {entries} entries; "
@@ -127,8 +134,44 @@ class Statistics:
                 print("\r\x1b[2K" + line, end="", flush=True)
             else:
                 print(line, flush=True)
-        if use_line and is_tty and not cfg.single_line_live_stats_no_erase:
-            print("\r\x1b[2K", end="", flush=True)
+        if use_line and is_tty:
+            if self._fullscreen_active:
+                print("\x1b[2J\x1b[H", end="", flush=True)
+                self._fullscreen_active = False
+            elif not cfg.single_line_live_stats_no_erase:
+                print("\r\x1b[2K", end="", flush=True)
+
+    def _render_fullscreen(self, phase, elapsed, rate, ops_per_s, unit,
+                           div, done) -> None:
+        """Fullscreen per-worker live table (ANSI, dependency-free analogue
+        of the reference's ftxui screen, Statistics.cpp:716-1249)."""
+        cfg = self.cfg
+        shared = self.manager.shared
+        lines = []
+        s3 = cfg.bench_mode == BenchMode.S3
+        lines.append(
+            f"Phase: {phase_name(phase, s3)}   Elapsed: {elapsed}s   "
+            f"Done: {done}/{len(self.manager.workers)}")
+        lines.append(f"Total: {rate:,.0f} {unit}/s  {ops_per_s:,.0f} IOPS"
+                     + (f"  CPU: {shared.cpu_util.update():.0f}%"
+                        if cfg.show_cpu_util else ""))
+        lines.append("")
+        lines.append(f"{'Rank':>6} {'Entries':>10} {unit:>10} {'IOPS':>12} "
+                     f"{'State':>8}")
+        for w in self.manager.workers[:40]:  # cap rows to screen height
+            state = "done" if w.phase_finished else "run"
+            lines.append(
+                f"{w.rank:>6} {w.live_ops.num_entries_done:>10} "
+                f"{w.live_ops.num_bytes_done / div:>10,.0f} "
+                f"{w.live_ops.num_iops_done:>12,} {state:>8}")
+        if len(self.manager.workers) > 40:
+            lines.append(f"... {len(self.manager.workers) - 40} more "
+                         f"workers not shown")
+        frame = "\x1b[H" + "\x1b[2K" + "\n\x1b[2K".join(lines) + "\x1b[J"
+        if not self._fullscreen_active:
+            print("\x1b[2J", end="")
+            self._fullscreen_active = True
+        print(frame, end="", flush=True)
 
     def _write_live_files(self, phase, entries, num_bytes, iops,
                           elapsed) -> None:

@@ -1,0 +1,106 @@
+"""File toolkit: locking, sparse detection, tree scanning.
+
+Reference: source/toolkits/FileTk.{h,cpp} (586 LoC) — flock range/full
+templates (FileTk.h:50+, --flock), sparse/compressed file detection,
+bottom-up mkdirat, and the directory-tree scan behind --treescan /
+elbencho-scan-path.
+"""
+
+from __future__ import annotations
+
+import base64
+import fcntl
+import os
+
+from .path_store import (TREEFILE_BASE64_HEADER, PathStore)
+
+
+class FileRangeLock:
+    """POSIX advisory byte-range lock around one I/O op (reference:
+    FileTk flock templates; --flock range|full)."""
+
+    def __init__(self, fd: int, mode: str, offset: int, length: int,
+                 is_write: bool):
+        self.fd = fd
+        self.is_write = is_write
+        if mode == "full":
+            self.offset, self.length = 0, 0  # 0 length = whole file
+        else:
+            self.offset, self.length = offset, length
+
+    def __enter__(self):
+        fcntl.lockf(self.fd, fcntl.LOCK_EX if self.is_write
+                    else fcntl.LOCK_SH, self.length, self.offset, 0)
+        return self
+
+    def __exit__(self, *exc):
+        fcntl.lockf(self.fd, fcntl.LOCK_UN, self.length, self.offset, 0)
+        return False
+
+
+def file_is_sparse_or_compressed(path: str) -> bool:
+    """st_blocks*512 < st_size => holes or FS compression
+    (reference: FileTk sparse detection)."""
+    st = os.stat(path)
+    return (st.st_blocks * 512) < st.st_size
+
+
+def scan_tree(root: str) -> "tuple[PathStore, PathStore, bool]":
+    """Walk a real directory tree into (dirs_store, files_store,
+    needs_base64). Used by --treescan / elbencho-tpu-scan-path
+    (reference: FileTk dir-tree scan + tools/elbencho-scan-path)."""
+    dirs = PathStore()
+    files = PathStore()
+    needs_b64 = False
+    root = root.rstrip("/")
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        if rel_dir != ".":
+            dirs.load_dirs_from_text(f"d {rel_dir}")
+            if "\n" in rel_dir:
+                needs_b64 = True
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            try:
+                size = os.stat(full).st_size
+            except OSError:
+                continue
+            if "\n" in rel:
+                needs_b64 = True
+            files.load_files_from_text(f"f {size} {rel}")
+    return dirs, files, needs_b64
+
+
+def write_treefile(out_path: str, dirs: PathStore, files: PathStore,
+                   use_base64: bool = False) -> None:
+    with open(out_path, "w", encoding="utf-8",
+              errors="surrogateescape") as f:
+        if use_base64:
+            f.write(TREEFILE_BASE64_HEADER + "\n")
+
+            def enc(s: str) -> str:
+                return base64.b64encode(
+                    s.encode("utf-8", errors="surrogateescape")).decode()
+        else:
+            def enc(s: str) -> str:
+                return s
+        for elem in dirs.elems:
+            f.write(f"d {enc(elem.path)}\n")
+        for elem in files.elems:
+            f.write(f"f {elem.total_len} {enc(elem.path)}\n")
+
+
+def makedirs_bottom_up(path: str, mode: int = 0o755) -> None:
+    """Reference: FileTk bottom-up mkdirat — try the leaf first, walk up
+    only on ENOENT (cheaper for mostly-existing deep trees)."""
+    try:
+        os.mkdir(path, mode)
+        return
+    except FileExistsError:
+        return
+    except FileNotFoundError:
+        parent = os.path.dirname(path)
+        if parent and parent != path:
+            makedirs_bottom_up(parent, mode)
+            os.makedirs(path, mode, exist_ok=True)

@@ -1,0 +1,134 @@
+"""TCP socket toolkit for the netbench data plane.
+
+Reference: source/toolkits/net/BasicSocket.{h,cpp} (791 LoC) + Socket base —
+connect/bind/listen/accept, timed recv (recvT/recvExactT), poll-based
+waiting, SO_RCVBUF/SNDBUF sizing, SO_BINDTODEVICE, TCP_NODELAY, keepalive
+(BasicSocket.h:17-110).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+
+class SocketError(OSError):
+    pass
+
+
+class BasicSocket:
+    """Thin wrapper with the reference's semantics: explicit timeouts,
+    exact-length receive, optional device binding and buffer sizing."""
+
+    def __init__(self, sock: "socket.socket | None" = None):
+        self.sock = sock or socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+
+    # -- setup ---------------------------------------------------------------
+
+    def set_no_delay(self, enabled: bool = True) -> None:
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                             1 if enabled else 0)
+
+    def set_keepalive(self, enabled: bool = True) -> None:
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE,
+                             1 if enabled else 0)
+
+    def set_buffer_sizes(self, recv_size: int = 0, send_size: int = 0) -> None:
+        if recv_size:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                 recv_size)
+        if send_size:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                 send_size)
+
+    def bind_to_device(self, netdev: str) -> None:
+        """--netdevs client binding (reference: SO_BINDTODEVICE,
+        LocalWorker.cpp:762-766)."""
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_BINDTODEVICE,
+                             netdev.encode() + b"\0")
+
+    # -- server --------------------------------------------------------------
+
+    def listen(self, host: str, port: int, backlog: int = 128) -> None:
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(backlog)
+
+    def accept(self, timeout: "float | None" = None) -> "BasicSocket":
+        self.sock.settimeout(timeout)
+        conn, _addr = self.sock.accept()
+        wrapped = BasicSocket(conn)
+        wrapped.set_no_delay()
+        return wrapped
+
+    # -- client --------------------------------------------------------------
+
+    def connect_with_retry(self, host: str, port: int,
+                           retry_secs: float = 20.0,
+                           interrupt_check=None, setup_fn=None) -> None:
+        """Connect, retrying until the server side is up (reference:
+        netbench client connect retry 20s, LocalWorker.cpp:784-818).
+        ``setup_fn(sock)`` re-applies socket options (buffer sizes, device
+        binding) to each fresh socket created for a retry."""
+        deadline = time.monotonic() + retry_secs
+        while True:
+            if interrupt_check:
+                interrupt_check()
+            try:
+                self.sock.settimeout(3.0)
+                self.sock.connect((host, port))
+                self.set_no_delay()
+                return
+            except OSError as err:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                if time.monotonic() >= deadline:
+                    raise SocketError(
+                        f"connect to {host}:{port} failed: {err}") from err
+                self.sock = socket.socket(socket.AF_INET,
+                                          socket.SOCK_STREAM)
+                if setup_fn:
+                    setup_fn(self)
+                time.sleep(0.5)
+
+    # -- I/O -----------------------------------------------------------------
+
+    def send_all(self, data: "bytes | memoryview",
+                 timeout: "float | None" = None) -> None:
+        self.sock.settimeout(timeout)
+        self.sock.sendall(data)
+
+    def recv_exact(self, num_bytes: int, timeout: "float | None" = None,
+                   interrupt_check=None) -> bytes:
+        """Receive exactly num_bytes or raise SocketError after ``timeout``
+        seconds of overall inactivity (reference: recvExactT). Short recv
+        slices let interrupt checks run on idle connections."""
+        chunks = []
+        remaining = num_bytes
+        deadline = time.monotonic() + (timeout or 5.0)
+        self.sock.settimeout(1.0)
+        while remaining:
+            try:
+                chunk = self.sock.recv(min(remaining, 1 << 20))
+            except socket.timeout:
+                if interrupt_check:
+                    interrupt_check()
+                if time.monotonic() >= deadline:
+                    raise SocketError(
+                        f"recv timed out after {timeout}s "
+                        f"({num_bytes - remaining}/{num_bytes} bytes)")
+                continue
+            if not chunk:
+                raise SocketError("connection closed by peer")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+            deadline = time.monotonic() + (timeout or 5.0)  # progress resets
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

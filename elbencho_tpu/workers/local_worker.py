@@ -27,6 +27,7 @@ import numpy as np
 
 from ..phases import BenchMode, BenchPathType, BenchPhase
 from ..toolkits import logger
+from ..toolkits.file_tk import FileRangeLock
 from ..toolkits.offset_gen import (OffsetGenRandom, OffsetGenRandomAligned,
                                    OffsetGenRandomAlignedFullCoverage,
                                    OffsetGenReverseSeq, OffsetGenSequential,
@@ -95,6 +96,9 @@ class LocalWorker(Worker):
             from ..toolkits.ops_logger import OpsLogger
             self._ops_log = OpsLogger(cfg.ops_log_path, self.rank,
                                       use_lock=cfg.ops_log_lock)
+        if cfg.bench_mode == BenchMode.NETBENCH:
+            from .netbench import prepare_netbench
+            prepare_netbench(self)  # cross-host connect/accept barrier
         self._rand_offset_algo = create_rand_algo(
             cfg.rand_offset_algo, seed=None)
         if cfg.block_variance_pct:
@@ -136,6 +140,9 @@ class LocalWorker(Worker):
         if getattr(self, "_s3_client", None) is not None:
             self._s3_client.close()
             self._s3_client = None
+        if getattr(self, "_netbench_conns", None):
+            from .netbench import cleanup_netbench
+            cleanup_netbench(self)
 
     def _apply_core_binding(self) -> None:
         """Round-robin worker->core binding (reference: --cores/--zones via
@@ -369,6 +376,8 @@ class LocalWorker(Worker):
                 ) from err
             raise
         try:
+            if cfg.do_stat_inline:
+                os.fstat(fd)
             if cfg.do_prealloc_file and cfg.file_size:
                 os.posix_fallocate(fd, 0, cfg.file_size)
             if cfg.do_truncate_to_size:
@@ -388,6 +397,8 @@ class LocalWorker(Worker):
         fd = os.open(path, flags)
         try:
             self._apply_fadvise(fd)
+            if cfg.do_stat_inline:
+                os.fstat(fd)  # --statinline (reference: stat-inline :3140)
             if cfg.file_size:
                 if cfg.use_mmap:
                     self._rw_block_sized_mmap(fd, is_write=False)
@@ -483,7 +494,14 @@ class LocalWorker(Worker):
             if not do_read_this_op:
                 self._pre_write_fill(buf, real_off, length)
             t0 = time.perf_counter_ns()
-            if do_read_this_op:
+            if cfg.use_file_locks:
+                with FileRangeLock(fd, cfg.use_file_locks, real_off, length,
+                                   is_write=not do_read_this_op):
+                    if do_read_this_op:
+                        n = os.preadv(fd, [buf[:length]], real_off)
+                    else:
+                        n = os.pwritev(fd, [buf[:length]], real_off)
+            elif do_read_this_op:
                 n = os.preadv(fd, [buf[:length]], real_off)
             else:
                 n = os.pwritev(fd, [buf[:length]], real_off)

@@ -1,10 +1,244 @@
-"""Netbench phase (placeholder until the raw-TCP benchmark lands;
-reference surface: LocalWorker.cpp:626-819, 7789-8064)."""
+"""Netbench: raw-TCP request/response network benchmark.
+
+Reference: the netbench mode of source/workers/LocalWorker.cpp — init
+:626-819 (first N hosts are servers listening on service port + 1000; the
+first worker of a server accepts ALL connections and distributes them to
+its sibling workers :646-728; each remaining host is a client whose threads
+open one connection each, round-robin across servers, optional --netdevs
+SO_BINDTODEVICE :762-766, 20s connect retry :784-818), transfer loop
+:7789-8064 (server polls its connection share and answers each received
+block of --block bytes with --respsize bytes; client sends blocks and
+awaits responses), cleanup :825-881.
+
+Connections are established during worker preparation (a cross-host
+barrier: clients retry while servers come up), so the measured NETBENCH
+phase contains only transfer traffic.
+"""
 
 from __future__ import annotations
 
+import selectors
+import socket as socket_mod
+import time
+
+from ..phases import BenchPhase
+from ..toolkits import logger
+from ..toolkits.sockets import BasicSocket, SocketError
 from .shared import WorkerException
 
+ACCEPT_TIMEOUT_SECS = 30.0
+NETBENCH_PORT_OFFSET = 1000
 
-def run_netbench_phase(worker, phase) -> None:
-    raise WorkerException("netbench mode is not available yet in this build")
+
+def _topology(cfg):
+    """(host_idx, num_hosts, num_servers, server_endpoints)."""
+    if cfg.netbench_total_hosts:
+        num_hosts = cfg.netbench_total_hosts
+    elif cfg.hosts:
+        num_hosts = len(cfg.hosts)
+    else:
+        raise WorkerException(
+            "netbench requires distributed mode (--hosts with at least "
+            "2 hosts; first --netbenchservers hosts act as servers)")
+    num_servers = max(1, cfg.num_netbench_servers)
+    if num_servers >= num_hosts:
+        raise WorkerException(
+            "netbench needs more hosts than --netbenchservers "
+            "(servers don't generate load)")
+    host_idx = cfg.rank_offset // max(1, cfg.num_threads)
+    servers = [s for s in cfg.netbench_servers_str.split(",") if s]
+    return host_idx, num_hosts, num_servers, servers
+
+
+def prepare_netbench(worker) -> None:
+    """Connection establishment during worker prep (reference: :626-819)."""
+    cfg = worker.cfg
+    host_idx, num_hosts, num_servers, servers = _topology(cfg)
+    local_rank = worker.rank % max(1, cfg.num_threads)
+    shared = worker.shared
+    if host_idx < num_servers:
+        _prepare_server(worker, shared, host_idx, num_hosts, num_servers,
+                        local_rank)
+    else:
+        _prepare_client(worker, host_idx, num_servers, servers, local_rank)
+
+
+def _expected_server_conns(host_idx: int, num_hosts: int, num_servers: int,
+                           num_threads: int) -> int:
+    total_client_threads = (num_hosts - num_servers) * num_threads
+    return sum(1 for c in range(total_client_threads)
+               if c % num_servers == host_idx)
+
+
+def _prepare_server(worker, shared, host_idx, num_hosts, num_servers,
+                    local_rank) -> None:
+    cfg = worker.cfg
+    with shared.cond:
+        if not hasattr(shared, "netbench_conns"):
+            shared.netbench_conns = None  # set by the accepting worker
+    if local_rank == 0:
+        # first worker of the server accepts ALL connections (:646-728)
+        expected = _expected_server_conns(host_idx, num_hosts, num_servers,
+                                          cfg.num_threads)
+        listener = BasicSocket()
+        listener.set_buffer_sizes(cfg.sock_recv_buf_size,
+                                  cfg.sock_send_buf_size)
+        listener.listen("0.0.0.0", cfg.service_port + NETBENCH_PORT_OFFSET)
+        conns = []
+        logger.log(1, f"netbench server: awaiting {expected} connections")
+        for _ in range(expected):
+            conns.append(listener.accept(timeout=ACCEPT_TIMEOUT_SECS))
+        listener.close()
+        with shared.cond:
+            shared.netbench_conns = conns
+            shared.cond.notify_all()
+    else:
+        with shared.cond:
+            deadline = time.monotonic() + ACCEPT_TIMEOUT_SECS + 10
+            while shared.netbench_conns is None:
+                if time.monotonic() > deadline:
+                    raise WorkerException(
+                        "netbench: timed out waiting for connections")
+                shared.cond.wait(1.0)
+    # round-robin distribution of accepted conns to this server's workers
+    with shared.cond:
+        conns = shared.netbench_conns
+    worker._netbench_conns = [c for i, c in enumerate(conns)
+                              if i % cfg.num_threads == local_rank]
+    worker._netbench_role = "server"
+
+
+def _prepare_client(worker, host_idx, num_servers, servers,
+                    local_rank) -> None:
+    cfg = worker.cfg
+    if not servers:
+        raise WorkerException(
+            "netbench: no server endpoints received from master")
+    conn_global_idx = ((host_idx - num_servers) * cfg.num_threads
+                       + local_rank)
+    server = servers[conn_global_idx % num_servers]
+    name, _, port = server.partition(":")
+    sock = BasicSocket()
+    netdevs = [d for d in cfg.netdevs_str.split(",") if d]
+
+    def setup(s: BasicSocket) -> None:
+        s.set_buffer_sizes(cfg.sock_recv_buf_size, cfg.sock_send_buf_size)
+        if netdevs:
+            s.bind_to_device(netdevs[local_rank % len(netdevs)])
+
+    setup(sock)
+    sock.connect_with_retry(
+        name, int(port), retry_secs=20.0,
+        interrupt_check=lambda: worker.check_interruption_request(
+            force=True),
+        setup_fn=setup)
+    worker._netbench_conns = [sock]
+    worker._netbench_role = "client"
+
+
+def cleanup_netbench(worker) -> None:
+    for conn in getattr(worker, "_netbench_conns", []):
+        conn.close()
+    worker._netbench_conns = []
+
+
+# ---------------------------------------------------------------------------
+# transfer phase (reference: :7789-8064)
+# ---------------------------------------------------------------------------
+
+def run_netbench_phase(worker, phase: BenchPhase) -> None:
+    role = getattr(worker, "_netbench_role", None)
+    if role is None:
+        prepare_netbench(worker)
+        role = worker._netbench_role
+    if role == "server":
+        _run_server(worker)
+    else:
+        _run_client(worker)
+
+
+def _run_client(worker) -> None:
+    """Send --size bytes in --block requests; each answered with
+    --respsize bytes. Latency = request+response round trip."""
+    cfg = worker.cfg
+    sock = worker._netbench_conns[0]
+    bs = cfg.block_size
+    # whole blocks only: the server replies per full --block received, so a
+    # trailing partial block would deadlock awaiting a response
+    total = max(bs, (cfg.file_size // bs) * bs)
+    payload = bytes(worker._io_buf[:bs])
+    sent = 0
+    while sent < total:
+        worker.check_interruption_request()
+        length = min(bs, total - sent)
+        if worker._rate_limiter_write:
+            worker._rate_limiter_write.wait(length)
+        t0 = time.perf_counter_ns()
+        sock.send_all(memoryview(payload)[:length], timeout=30.0)
+        resp = sock.recv_exact(
+            cfg.netbench_response_size, timeout=5.0,
+            interrupt_check=lambda: worker.check_interruption_request(
+                force=True))
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        worker.iops_latency_histo.add_latency(lat_usec)
+        worker.live_ops.num_bytes_done += length + len(resp)
+        worker.live_ops.num_iops_done += 1
+        sent += length
+    # clean shutdown signals EOF to the server's poll loop; ignore a peer
+    # that already closed — the measured transfer is complete either way
+    try:
+        sock.sock.shutdown(socket_mod.SHUT_WR)
+        sock.recv_exact(1, timeout=5.0)  # drain until server closes
+    except (SocketError, OSError):
+        pass
+
+
+def _run_server(worker) -> None:
+    """Poll this worker's connection share; reply --respsize per received
+    --block bytes; finish when every connection reached EOF."""
+    cfg = worker.cfg
+    conns = worker._netbench_conns
+    if not conns:
+        worker.got_phase_work = False
+        return
+    bs = cfg.block_size
+    response = bytes(cfg.netbench_response_size)
+    sel = selectors.DefaultSelector()
+    states = {}
+    for conn in conns:
+        conn.sock.setblocking(False)
+        sel.register(conn.sock, selectors.EVENT_READ, conn)
+        states[conn] = 0  # bytes received toward the current block
+    open_conns = set(conns)
+    try:
+        while open_conns:
+            worker.check_interruption_request(force=True)
+            for key, _events in sel.select(timeout=1.0):
+                conn = key.data
+                try:
+                    chunk = conn.sock.recv(1 << 20)
+                except BlockingIOError:
+                    continue
+                except OSError:
+                    chunk = b""
+                if not chunk:
+                    sel.unregister(conn.sock)
+                    open_conns.discard(conn)
+                    continue
+                worker.live_ops.num_bytes_done += len(chunk)
+                states[conn] += len(chunk)
+                while states[conn] >= bs:
+                    states[conn] -= bs
+                    t0 = time.perf_counter_ns()
+                    conn.sock.setblocking(True)
+                    conn.send_all(response, timeout=30.0)
+                    conn.sock.setblocking(False)
+                    worker.iops_latency_histo.add_latency(
+                        (time.perf_counter_ns() - t0) // 1000)
+                    worker.live_ops.num_bytes_done += len(response)
+                    worker.live_ops.num_iops_done += 1
+    finally:
+        sel.close()
+        for conn in conns:
+            conn.close()
+        worker._netbench_conns = []

@@ -1,0 +1,70 @@
+"""Netbench (raw-TCP) integration test: one server service + one client
+service on localhost (reference: netbench mode, LocalWorker.cpp:626-8064)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PORTS = (17311, 17312)
+
+
+@pytest.fixture()
+def services():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "elbencho_tpu", "--service", "--foreground",
+         "--port", str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for port in PORTS]
+    deadline = time.monotonic() + 20
+    try:
+        for port in PORTS:
+            while True:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status", timeout=2)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"service {port} not up")
+                    time.sleep(0.2)
+        yield PORTS
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_netbench_two_hosts(services, tmp_path):
+    from elbencho_tpu.cli import main
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    jsonfile = tmp_path / "out.json"
+    rc = main(["--netbench", "-t", "2", "-s", "2M", "-b", "64K",
+               "--respsize", "4K", "--hosts", hosts,
+               "--jsonfile", str(jsonfile), "--nolive"])
+    assert rc == 0
+    recs = [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+    nb = next(r for r in recs if r["Phase"] == "NETBENCH")
+    # client side: 2 threads x 2M sent (+responses); server mirrors it.
+    # bytes counted on both sides: >= 2 x 2M
+    assert nb["BytesLast"] >= 2 * (2 << 20)
+    assert nb["IOPSLast"] > 0
+
+
+def test_netbench_requires_hosts():
+    from elbencho_tpu.cli import main
+    rc = main(["--netbench", "-t", "1", "--nolive"])
+    assert rc == 1  # clear config error, not a crash

@@ -1,0 +1,115 @@
+"""Tests for --treescan, the tools suite, flock, statinline, netbench
+config, and fullscreen-stats plumbing."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from elbencho_tpu.cli import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _no_native(monkeypatch):
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    reset_native_engine_cache()
+
+
+def _tool(name, args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(TOOLS, name)] + args,
+        capture_output=True, text=True, env=env, timeout=60)
+
+
+def test_treescan_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.bin").write_bytes(b"x" * 1000)
+    (src / "sub" / "b.bin").write_bytes(b"y" * 2500)
+    treefile = tmp_path / "tree.txt"
+    rc = main(["--treescan", str(src), "--treefile", str(treefile),
+               "--nolive"])
+    assert rc == 0
+    content = treefile.read_text()
+    assert "d sub" in content
+    assert "f 1000 a.bin" in content
+    assert "f 2500 sub/b.bin" in content
+    # and the treefile drives a benchmark
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    rc = main(["-w", "-r", "-F", "-t", "2", "-b", "1K", "--treefile",
+               str(treefile), "--nolive", str(bench)])
+    assert rc == 0
+
+
+def test_scan_path_tool(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "f.dat").write_bytes(b"z" * 123)
+    out = tmp_path / "out.tree"
+    res = _tool("elbencho-tpu-scan-path", [str(src), str(out)])
+    assert res.returncode == 0, res.stderr
+    assert "f 123 f.dat" in out.read_text()
+
+
+def test_summarize_json_tool(tmp_path):
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jsonfile = tmp_path / "res.json"
+    assert main(["-w", "-d", "-r", "-t", "1", "-n", "1", "-N", "2",
+                 "-s", "8K", "-b", "8K", "--jsonfile", str(jsonfile),
+                 "--label", "L1", "--nolive", str(bench)]) == 0
+    res = _tool("elbencho-tpu-summarize-json",
+                [str(jsonfile), "--group", "bench_label"])
+    assert res.returncode == 0, res.stderr
+    assert "WRITE" in res.stdout and "READ" in res.stdout
+    assert "L1" in res.stdout
+    res_csv = _tool("elbencho-tpu-summarize-json", [str(jsonfile), "--csv"])
+    assert res_csv.returncode == 0
+    assert res_csv.stdout.splitlines()[0].startswith("Phase,")
+
+
+def test_chart_tool(tmp_path):
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    csvfile = tmp_path / "res.csv"
+    assert main(["-w", "-d", "-r", "-t", "1", "-n", "1", "-N", "2",
+                 "-s", "8K", "-b", "8K", "--csvfile", str(csvfile),
+                 "--nolive", str(bench)]) == 0
+    res = _tool("elbencho-tpu-chart", [str(csvfile)])
+    assert res.returncode == 0, res.stderr
+    assert "#" in res.stdout  # bars rendered
+
+
+def test_flock_modes(tmp_path):
+    target = tmp_path / "f"
+    for mode in ("range", "full"):
+        rc = main(["-w", "-r", "-t", "2", "-s", "128K", "-b", "32K",
+                   "--flock", mode, "--nolive", str(target)])
+        assert rc == 0
+
+
+def test_statinline(tmp_path):
+    rc = main(["-w", "-d", "-r", "--statinline", "-t", "1", "-n", "1",
+               "-N", "2", "-s", "8K", "-b", "8K", "--nolive",
+               str(tmp_path)])
+    assert rc == 0
+
+
+def test_netbench_requires_hosts_config_error(capsys):
+    rc = main(["--netbench", "--nolive"])
+    assert rc == 1
+    assert "netbench requires distributed" in capsys.readouterr().err
+
+
+def test_treescan_requires_treefile(tmp_path, capsys):
+    rc = main(["--treescan", str(tmp_path), "--nolive"])
+    assert rc == 1
