@@ -102,7 +102,8 @@ def main(argv: "list[str] | None" = None) -> int:
             return 0
     if not cfg.paths and not (cfg.run_as_service or cfg.quit_services
                               or cfg.interrupt_services
-                              or cfg.run_netbench or cfg.tree_scan_path):
+                              or cfg.run_netbench or cfg.tree_scan_path
+                              or cfg.run_tpu_bench):
         _print_help("essential")
         return 1
     try:

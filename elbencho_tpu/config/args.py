@@ -264,6 +264,12 @@ FLAG_DEFS = [
      "Run integrity verification on-device (Pallas kernel) instead of host"),
     ("tpuhbmpct", None, "tpu_hbm_limit_pct", "int", 90, "tpu",
      "Max percentage of per-chip HBM to use for staging buffers"),
+    ("tpubench", None, "run_tpu_bench", "bool", False, "tpu",
+     "Run TPU transfer benchmark (no storage; the netbench analogue over "
+     "the device fabric: host<->HBM DMA and ICI collectives)"),
+    ("tpubenchpat", None, "tpu_bench_pattern", "str", "h2d", "tpu",
+     "TPU bench pattern: h2d|d2h|both|ici (ici = ring ppermute over all "
+     "chips, measuring inter-chip bandwidth)"),
 
     # NUMA/core binding
     ("zones", None, "numa_zones_str", "str", "", "multi",
@@ -315,6 +321,16 @@ FLAG_DEFS = [
      "Max parallel S3 connections per worker (0=iodepth)"),
     ("s3mpusharing", None, "s3_mpu_sharing", "bool", False, "s3",
      "Multiple workers upload parts of the same (shared-name) objects"),
+    ("s3mpucomplphase", None, "run_s3_mpu_complete_phase", "bool", False,
+     "s3", "Complete shared multipart uploads in a separate MPUCOMPL "
+     "phase instead of inline"),
+    ("s3credfile", None, "s3_cred_file_path", "str", "", "s3",
+     "File with one 'accesskey:secret' credential pair per line "
+     "(round-robin across workers)"),
+    ("s3credlist", None, "s3_cred_list", "str", "", "s3",
+     "Comma-separated 'accesskey:secret' pairs (round-robin)"),
+    ("s3retries", None, "s3_num_retries", "int", 3, "s3",
+     "Transient-error retries per S3 request (5xx / connection errors)"),
     ("s3ignoreerrors", None, "s3_ignore_errors", "bool", False, "s3",
      "Continue on S3 request errors (stress mode)"),
 
@@ -463,6 +479,11 @@ class BenchConfig(BenchConfigBase):
                 self.random_amount = self.file_size
         if self.run_as_service:
             self.disable_live_stats = True
+        if self.run_tpu_bench:
+            if not self.tpu_ids:
+                self.tpu_ids = [0]  # default to the first chip
+            if not self.file_size:
+                self.file_size = 256 << 20  # sensible default amount
         if self.num_rwmix_read_threads and not self.run_create_files:
             raise ConfigError("--rwmixthr requires the write phase (-w)")
 
@@ -505,6 +526,10 @@ class BenchConfig(BenchConfigBase):
                 "blockdev)")
         if self.tpu_ids_str and self.bench_mode == BenchMode.NETBENCH:
             raise ConfigError("--tpuids not supported in netbench mode")
+        if self.run_s3_mpu_complete_phase and not self.s3_mpu_sharing:
+            raise ConfigError(
+                "--s3mpucomplphase requires --s3mpusharing (only shared "
+                "uploads defer completion to the MPUCOMPL phase)")
         if self.run_netbench:
             if not self.hosts and not self.netbench_total_hosts:
                 raise ConfigError(
@@ -529,6 +554,8 @@ class BenchConfig(BenchConfigBase):
             p.append(BenchPhase.STATDIRS)
         if self.run_create_files:
             p.append(BenchPhase.CREATEFILES)
+        if self.run_s3_mpu_complete_phase:
+            p.append(BenchPhase.S3MPUCOMPLETE)
         if self.run_stat_files:
             p.append(BenchPhase.STATFILES)
         if self.run_list_objects_num and not self.run_list_objects_parallel:
@@ -545,6 +572,8 @@ class BenchConfig(BenchConfigBase):
             p.append(BenchPhase.DELETEDIRS)
         if self.run_netbench:
             p.append(BenchPhase.NETBENCH)
+        if self.run_tpu_bench:
+            p.append(BenchPhase.TPUBENCH)
         return p
 
     # -- service protocol round-trip ----------------------------------------

@@ -38,6 +38,7 @@ class BenchPhase(enum.IntEnum):
     DEL_BUCKET_MD = 23
     S3MPUCOMPLETE = 24
     NETBENCH = 25
+    TPUBENCH = 26  # TPU-native: host<->HBM / ICI transfer benchmark
 
 
 # human-readable phase names (reference: PHASENAME_*, Common.h:43-74)
@@ -68,6 +69,7 @@ PHASE_NAMES = {
     BenchPhase.DEL_BUCKET_MD: "DELBUCKETMD",
     BenchPhase.S3MPUCOMPLETE: "MPUCOMPL",
     BenchPhase.NETBENCH: "NETBENCH",
+    BenchPhase.TPUBENCH: "TPUBENCH",
 }
 
 # bucket-flavored names used in S3 mode (reference: MKBUCKETS/RMBUCKETS/...)

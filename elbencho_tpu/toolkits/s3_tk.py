@@ -38,7 +38,8 @@ class S3Client:
 
     def __init__(self, endpoint: str, access_key: str = "",
                  secret_key: str = "", region: str = "us-east-1",
-                 virtual_hosted: bool = False, timeout: float = 60.0):
+                 virtual_hosted: bool = False, timeout: float = 60.0,
+                 num_retries: int = 0, interrupt_check=None):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "http://" + endpoint)
         self.scheme = parsed.scheme or "http"
@@ -49,6 +50,8 @@ class S3Client:
         self.region = region
         self.virtual_hosted = virtual_hosted
         self.timeout = timeout
+        self.num_retries = num_retries
+        self.interrupt_check = interrupt_check
         self._conn: "http.client.HTTPConnection | None" = None
 
     # -- low-level request --------------------------------------------------
@@ -105,10 +108,41 @@ class S3Client:
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
             f"SignedHeaders={signed_headers}, Signature={signature}")
 
+    _RETRY_STATUSES = (500, 502, 503, 429)
+
     def request(self, method: str, bucket: str = "", key: str = "",
                 query: "dict | None" = None, body: bytes = b"",
                 headers: "dict | None" = None,
                 want_body: bool = True) -> "tuple[int, dict, bytes]":
+        """One S3 request with transient-error retries at the request level
+        (reference: S3InterruptibleRetryStrategy — retry whole requests on
+        connection errors / retryable statuses, checking for interruption
+        between attempts; accounting stays per successful request)."""
+        import time as _time
+        last_err = None
+        for attempt in range(self.num_retries + 1):
+            if self.interrupt_check:
+                self.interrupt_check()
+            try:
+                status, resp_headers, data = self._request_once(
+                    method, bucket, key, query, body, headers, want_body)
+            except (OSError, http.client.HTTPException) as err:
+                # covers dropped connections too (IncompleteRead etc.)
+                last_err = err
+                if attempt < self.num_retries:
+                    _time.sleep(0.2 * (attempt + 1))
+                continue
+            if status in self._RETRY_STATUSES and attempt < self.num_retries:
+                _time.sleep(0.2 * (attempt + 1))
+                continue
+            return status, resp_headers, data
+        raise last_err if last_err is not None else S3Error(
+            503, "RetryExhausted", "request retries exhausted")
+
+    def _request_once(self, method: str, bucket: str = "", key: str = "",
+                      query: "dict | None" = None, body: bytes = b"",
+                      headers: "dict | None" = None,
+                      want_body: bool = True) -> "tuple[int, dict, bytes]":
         query = {k: str(v) for k, v in (query or {}).items()}
         headers = dict(headers or {})
         if self.virtual_hosted and bucket:
@@ -328,13 +362,59 @@ class S3Client:
         return data
 
 
-def make_client_for_rank(cfg, rank: int) -> S3Client:
-    """Endpoint round-robin by worker rank (reference: S3Tk.cpp:167-316)."""
+class S3CredentialStore:
+    """Multi-credential round-robin (reference: S3CredentialStore, 234 LoC
+    — spreads workers over credential pairs for per-user rate limits).
+    Parsed once per (file, list) source and shared by all workers."""
+
+    _cache: "dict[tuple, S3CredentialStore]" = {}
+
+    def __init__(self, cred_file: str, cred_list: str,
+                 fallback: "tuple[str, str]"):
+        self.pairs: "list[tuple[str, str]]" = []
+        if cred_file:
+            with open(cred_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        key, _, secret = line.partition(":")
+                        self.pairs.append((key, secret))
+        for item in (cred_list or "").split(","):
+            item = item.strip()
+            if item:
+                key, _, secret = item.partition(":")
+                self.pairs.append((key, secret))
+        if not self.pairs:
+            self.pairs = [fallback]
+
+    @classmethod
+    def for_config(cls, cfg) -> "S3CredentialStore":
+        cache_key = (cfg.s3_cred_file_path, cfg.s3_cred_list,
+                     cfg.s3_access_key, cfg.s3_secret_key)
+        store = cls._cache.get(cache_key)
+        if store is None:
+            store = cls(cfg.s3_cred_file_path, cfg.s3_cred_list,
+                        (cfg.s3_access_key, cfg.s3_secret_key))
+            cls._cache[cache_key] = store
+        return store
+
+    def for_rank(self, rank: int) -> "tuple[str, str]":
+        return self.pairs[rank % len(self.pairs)]
+
+
+def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
+    """Endpoint + credential round-robin by worker rank
+    (reference: S3Tk.cpp:167-316 + S3CredentialStore)."""
     endpoints = [e.strip() for e in cfg.s3_endpoints_str.split(",")
                  if e.strip()]
     if not endpoints:
         raise ValueError("no S3 endpoints configured (--s3endpoints)")
     endpoint = endpoints[rank % len(endpoints)]
-    return S3Client(endpoint, access_key=cfg.s3_access_key,
-                    secret_key=cfg.s3_secret_key, region=cfg.s3_region,
-                    virtual_hosted=cfg.s3_virtual_hosted)
+    access_key, secret_key = S3CredentialStore.for_config(cfg).for_rank(rank)
+    return S3Client(endpoint, access_key=access_key,
+                    secret_key=secret_key, region=cfg.s3_region,
+                    virtual_hosted=cfg.s3_virtual_hosted,
+                    num_retries=cfg.s3_num_retries,
+                    interrupt_check=interrupt_check)
+
+

@@ -87,6 +87,20 @@ class S3UploadStore:
             self._lock.notify_all()  # wake waiters in get_or_create
             return entry.upload_id
 
+    def pop_all_complete(self
+                         ) -> "list[tuple[str, str, str, list]]":
+        """(bucket, key, upload_id, sorted_parts) of every byte-complete
+        upload; used by the separate MPUCOMPL phase."""
+        with self._lock:
+            out = []
+            for (bucket, key), entry in list(self._uploads.items()):
+                if entry.object_size and not entry.aborted \
+                        and entry.bytes_done >= entry.object_size:
+                    out.append((bucket, key, entry.upload_id,
+                                sorted(entry.completed_parts)))
+                    del self._uploads[(bucket, key)]
+            return out
+
     def pop_all_unfinished(self) -> "list[tuple[str, str, str]]":
         """(bucket, key, upload_id) of every upload not yet completed."""
         with self._lock:

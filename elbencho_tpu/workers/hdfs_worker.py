@@ -1,0 +1,165 @@
+"""HDFS workload phases via pyarrow's libhdfs binding.
+
+Reference: the HDFS mode of source/workers/LocalWorker.cpp
+(hdfsDirModeIterateDirs :7488, hdfsDirModeIterateFiles :7617, wrappers
+:2751-2787, init :592-624) using libhdfs (JNI), gated behind HDFS_SUPPORT
+(Makefile:142-146). Here the binding is pyarrow.fs.HadoopFileSystem —
+gated at runtime with a clear error when libhdfs/JVM are absent, like the
+reference's build flag.
+
+The filesystem is injectable (``set_filesystem_factory``) so tests can run
+every HDFS code path against pyarrow's LocalFileSystem without a Hadoop
+cluster.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import time
+
+from ..phases import BenchPhase
+from .shared import WorkerException
+
+_fs_factory = None  # test hook
+
+
+def set_filesystem_factory(factory) -> None:
+    global _fs_factory
+    _fs_factory = factory
+
+
+def _make_fs(worker):
+    if _fs_factory is not None:
+        return _fs_factory(worker.cfg)
+    try:
+        from pyarrow import fs as pafs
+    except ImportError as err:  # pragma: no cover
+        raise WorkerException(
+            "HDFS support requires pyarrow (not installed)") from err
+    # paths look like host[:port]/base/dir after the hdfs:// prefix strip
+    first = worker.cfg.paths[0]
+    authority, _, _base = first.partition("/")
+    host, _, port = authority.partition(":")
+    try:
+        return pafs.HadoopFileSystem(host or "default",
+                                     int(port) if port else 8020)
+    except Exception as err:
+        raise WorkerException(
+            f"cannot connect to HDFS (libhdfs/JVM required): {err}") from err
+
+
+def _base_path(worker) -> str:
+    first = worker.cfg.paths[0]
+    if _fs_factory is not None:
+        return first
+    _authority, _, base = first.partition("/")
+    return "/" + base if base else "/"
+
+
+def dispatch_hdfs_phase(worker, phase: BenchPhase) -> None:
+    if getattr(worker, "_hdfs", None) is None:
+        worker._hdfs = _make_fs(worker)
+    fs = worker._hdfs
+    base = _base_path(worker)
+    cfg = worker.cfg
+    if phase in (BenchPhase.CREATEDIRS, BenchPhase.DELETEDIRS,
+                 BenchPhase.STATDIRS):
+        for dir_idx in range(cfg.num_dirs):
+            worker.check_interruption_request(force=True)
+            path = posixpath.join(base, worker._dir_rel_path(dir_idx))
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.CREATEDIRS:
+                fs.create_dir(path, recursive=True)
+            elif phase == BenchPhase.DELETEDIRS:
+                fs.delete_dir(path)
+                # remove the per-rank parent only when it is now empty:
+                # pyarrow delete_dir is RECURSIVE (unlike POSIX rmdir), so
+                # deleting a non-empty parent would wipe sibling d-dirs
+                parent = posixpath.dirname(path)
+                if not cfg.do_dir_sharing \
+                        and posixpath.basename(parent).startswith("r") \
+                        and dir_idx == cfg.num_dirs - 1:
+                    try:
+                        from pyarrow import fs as pafs
+                        leftover = fs.get_file_info(
+                            pafs.FileSelector(parent, recursive=False))
+                        if not leftover:
+                            fs.delete_dir(parent)
+                    except OSError:
+                        pass
+            else:
+                fs.get_file_info(path)
+            worker.entries_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+            worker.live_ops.num_entries_done += 1
+        return
+    for dir_idx in range(cfg.num_dirs):
+        for file_idx in range(cfg.num_files):
+            worker.check_interruption_request(force=True)
+            path = posixpath.join(base,
+                                  worker._file_rel_path(dir_idx, file_idx))
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.CREATEFILES:
+                _write_file(worker, fs, path)
+            elif phase == BenchPhase.READFILES:
+                _read_file(worker, fs, path)
+            elif phase == BenchPhase.STATFILES:
+                info = fs.get_file_info(path)
+                import pyarrow.fs as pafs
+                if info.type == pafs.FileType.NotFound:
+                    raise WorkerException(f"stat failed: {path}")
+            elif phase == BenchPhase.DELETEFILES:
+                try:
+                    fs.delete_file(path)
+                except (OSError, FileNotFoundError):
+                    if not cfg.ignore_delete_errors:
+                        raise
+            worker.entries_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+            worker.live_ops.num_entries_done += 1
+
+
+def _write_file(worker, fs, path: str) -> None:
+    cfg = worker.cfg
+    size, bs = cfg.file_size, cfg.block_size
+    num_bufs = len(worker._io_bufs)
+    with fs.open_output_stream(path) as out:
+        offset = 0
+        while offset < size:
+            worker.check_interruption_request()
+            length = min(bs, size - offset)
+            buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+            worker._pre_write_fill(buf, offset, length)
+            t0 = time.perf_counter_ns()
+            out.write(bytes(buf[:length]))
+            worker.iops_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+            worker.live_ops.num_bytes_done += length
+            worker.live_ops.num_iops_done += 1
+            worker._num_iops_submitted += 1
+            offset += length
+
+
+def _read_file(worker, fs, path: str) -> None:
+    cfg = worker.cfg
+    size, bs = cfg.file_size, cfg.block_size
+    num_bufs = len(worker._io_bufs)
+    with fs.open_input_file(path) as inp:
+        offset = 0
+        while offset < size:
+            worker.check_interruption_request()
+            length = min(bs, size - offset)
+            t0 = time.perf_counter_ns()
+            data = inp.read_at(length, offset)
+            lat = (time.perf_counter_ns() - t0) // 1000
+            if len(data) != length:
+                raise WorkerException(
+                    f"short HDFS read at {offset} of {path}")
+            buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+            buf[:length] = data
+            worker._post_read_actions(buf, offset, length)
+            worker.iops_latency_histo.add_latency(lat)
+            worker.live_ops.num_bytes_done += length
+            worker.live_ops.num_iops_done += 1
+            worker._num_iops_submitted += 1
+            offset += length

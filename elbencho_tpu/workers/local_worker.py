@@ -89,6 +89,11 @@ class LocalWorker(Worker):
                 chip_id=chip, block_size=cfg.block_size,
                 direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify,
                 pipeline_depth=max(cfg.io_depth, 1))
+            needs_fill = (cfg.run_create_files
+                          or (cfg.run_tpu_bench
+                              and cfg.tpu_bench_pattern in ("d2h", "both")))
+            if needs_fill and not cfg.integrity_check_salt:
+                self._tpu.warmup_fill()  # jit outside the timed phase
         if cfg.bench_path_type != BenchPathType.DIR \
                 and cfg.bench_mode == BenchMode.POSIX:
             self._prepare_path_fds()
@@ -238,13 +243,51 @@ class LocalWorker(Worker):
     def _dispatch_phase(self, phase: BenchPhase) -> None:
         cfg = self.cfg
         self._num_iops_submitted = 0
+        # --rwmixthr: the first N local ranks of a WRITE phase run the READ
+        # workload instead, accounted as rwmix-read (reference: rwmix-threads
+        # reader conversion, LocalWorker.cpp:1054-1062)
+        if (phase == BenchPhase.CREATEFILES
+                and cfg.num_rwmix_read_threads
+                and (self.rank % max(1, cfg.num_threads))
+                < cfg.num_rwmix_read_threads):
+            self._run_as_rwmix_reader()
+            return
+        self._dispatch_phase_inner(phase)
+
+    def _run_as_rwmix_reader(self) -> None:
+        """Swap accounting to the rwmix-read counters, run the read
+        workload, swap back."""
+        def swap():
+            self.live_ops, self.live_ops_rwmix_read = \
+                self.live_ops_rwmix_read, self.live_ops
+            self.iops_latency_histo, self.iops_latency_histo_rwmix = \
+                self.iops_latency_histo_rwmix, self.iops_latency_histo
+            self.entries_latency_histo, self.entries_latency_histo_rwmix = \
+                self.entries_latency_histo_rwmix, self.entries_latency_histo
+
+        swap()
+        self._rwmix_thread_reader = True
+        try:
+            self._dispatch_phase_inner(BenchPhase.READFILES)
+        finally:
+            self._rwmix_thread_reader = False
+            swap()
+
+    def _dispatch_phase_inner(self, phase: BenchPhase) -> None:
+        cfg = self.cfg
         if phase == BenchPhase.SYNC:
             self._any_mode_sync()
         elif phase == BenchPhase.DROPCACHES:
             self._any_mode_drop_caches()
+        elif phase == BenchPhase.TPUBENCH:
+            from .tpubench import run_tpubench_phase
+            run_tpubench_phase(self, phase)
         elif cfg.bench_mode == BenchMode.S3:
             from .s3_worker import dispatch_s3_phase
             dispatch_s3_phase(self, phase)
+        elif cfg.bench_mode == BenchMode.HDFS:
+            from .hdfs_worker import dispatch_hdfs_phase
+            dispatch_hdfs_phase(self, phase)
         elif cfg.bench_mode == BenchMode.NETBENCH:
             from .netbench import run_netbench_phase
             run_netbench_phase(self, phase)
@@ -475,16 +518,28 @@ class LocalWorker(Worker):
                                            file_offset_base):
                 return
         num_bufs = len(self._io_bufs)
+        is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
+        # the byte-ratio balancer only applies to the mixed WRITE phase
+        # (writers + converted readers); a later pure READ phase must not
+        # be throttled against zero writer bytes
+        balancer = (self.shared.rwmix_balancer
+                    if (is_write or is_rwmix_reader) else None)
         for off, length in gen:
             # rotate buffers so pipelined TPU transfers never race a reuse
             buf = self._io_bufs[self._num_iops_submitted % num_bufs]
             do_read_this_op = (not is_write) or self._rwmix_decides_read()
             limiter = (self._rate_limiter_read if do_read_this_op
                        else self._rate_limiter_write)
-            if limiter:
-                # limiter sleeps can be ~1s, so check every op here
+            if limiter or balancer:
+                # limiter/balancer sleeps can be long; check every op here
                 self.check_interruption_request(force=True)
-                limiter.wait(length)
+                if balancer:
+                    if do_read_this_op or is_rwmix_reader:
+                        balancer.wait_read(length)
+                    else:
+                        balancer.wait_write(length)
+                if limiter:
+                    limiter.wait(length)
             else:
                 self.check_interruption_request()
             if multi_file is not None:

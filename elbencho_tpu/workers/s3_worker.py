@@ -27,7 +27,10 @@ MAX_LIST_PAGE = 1000
 def _client(worker):
     if getattr(worker, "_s3_client", None) is None:
         from ..toolkits.s3_tk import make_client_for_rank
-        worker._s3_client = make_client_for_rank(worker.cfg, worker.rank)
+        worker._s3_client = make_client_for_rank(
+            worker.cfg, worker.rank,
+            interrupt_check=lambda: worker.check_interruption_request(
+                force=True))
     return worker._s3_client
 
 
@@ -51,6 +54,7 @@ def dispatch_s3_phase(worker, phase: BenchPhase) -> None:
         BenchPhase.PUT_OBJ_MD: _obj_tagging,
         BenchPhase.GET_OBJ_MD: _obj_tagging,
         BenchPhase.DEL_OBJ_MD: _obj_tagging,
+        BenchPhase.S3MPUCOMPLETE: _mpu_complete_phase,
     }
     handler = handlers.get(phase)
     if handler is None:
@@ -120,17 +124,38 @@ def _iterate_buckets(worker, phase: BenchPhase) -> None:
 # objects (reference: s3ModeIterateObjects :3920-4059)
 # ---------------------------------------------------------------------------
 
+def _ignoring_errors_call(worker, fn) -> bool:
+    """--s3ignoreerrors stress mode: keep going on request failures
+    (retries happen inside S3Client.request)."""
+    try:
+        fn()
+        return True
+    except Exception:
+        if worker.cfg.s3_ignore_errors:
+            return False
+        raise
+
+
 def _iterate_objects(worker, phase: BenchPhase) -> None:
     cfg = worker.cfg
+    if phase == BenchPhase.READFILES and cfg.s3_rand_obj_select:
+        _download_random_objects(worker)
+        return
     for bucket, key in _iter_entries(worker):
         worker.check_interruption_request(force=True)
         t0 = time.perf_counter_ns()
         if phase == BenchPhase.CREATEFILES:
-            _upload_object(worker, bucket, key)
+            _ignoring_errors_call(worker,
+                                  lambda: _upload_object(worker, bucket,
+                                                         key))
         elif phase == BenchPhase.READFILES:
-            _download_object(worker, bucket, key)
+            _ignoring_errors_call(worker,
+                                  lambda: _download_object(worker, bucket,
+                                                           key))
         elif phase == BenchPhase.STATFILES:
-            _client(worker).head_object(bucket, key)
+            _ignoring_errors_call(worker,
+                                  lambda: _client(worker).head_object(
+                                      bucket, key))
         elif phase == BenchPhase.DELETEFILES:
             try:
                 _client(worker).delete_object(bucket, key)
@@ -140,6 +165,55 @@ def _iterate_objects(worker, phase: BenchPhase) -> None:
         lat_usec = (time.perf_counter_ns() - t0) // 1000
         worker.entries_latency_histo.add_latency(lat_usec)
         worker.live_ops.num_entries_done += 1
+
+
+def _download_random_objects(worker) -> None:
+    """--s3randobj: random aligned offsets of random objects until this
+    worker's share of --randamount is read (reference: s3 rand :4069)."""
+    cfg = worker.cfg
+    client = _client(worker)
+    size, bs = cfg.file_size, cfg.block_size
+    ndst = max(1, cfg.num_dataset_threads)
+    amount = (cfg.random_amount or size * cfg.num_dirs * cfg.num_files) \
+        // ndst
+    rand = worker._rand_offset_algo
+    blocks_per_obj = max(1, size // bs)
+    num_bufs = len(worker._io_bufs)
+    done = 0
+    from .local_worker import LocalWorker
+    while done < amount:
+        worker.check_interruption_request()
+        rank_r = rand.next64() % ndst
+        dir_r = rand.next64() % cfg.num_dirs
+        file_r = rand.next64() % cfg.num_files
+        if cfg.s3_mpu_sharing:
+            key = f"{cfg.s3_object_prefix}d{dir_r}-f{file_r}"
+        else:
+            key = cfg.s3_object_prefix + LocalWorker.file_rel_path_for(
+                rank_r, dir_r, file_r, cfg.do_dir_sharing)
+        bucket = cfg.paths[(rank_r + dir_r) % len(cfg.paths)]
+        offset = (rand.next64() % blocks_per_obj) * bs
+        length = min(bs, size - offset, amount - done)
+        if length <= 0:
+            break
+        if worker._rate_limiter_read:
+            worker._rate_limiter_read.wait(length)
+        t0 = time.perf_counter_ns()
+        data = client.get_object(bucket, key, range_start=offset,
+                                 range_len=length)
+        lat = (time.perf_counter_ns() - t0) // 1000
+        if len(data) != length:
+            raise WorkerException(
+                f"short random S3 read for {bucket}/{key} at {offset}")
+        buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+        buf[:length] = data
+        worker._post_read_actions(buf, offset, length)
+        worker.iops_latency_histo.add_latency(lat)
+        worker.live_ops.num_bytes_done += length
+        worker.live_ops.num_iops_done += 1
+        worker._num_iops_submitted += 1
+        done += length
+    worker.live_ops.num_entries_done += 1
 
 
 def _upload_object(worker, bucket: str, key: str) -> None:
@@ -235,7 +309,9 @@ def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
             worker._num_iops_submitted += 1
             got_final = shared_upload_store.add_completed_part(
                 bucket, key, part_idx + 1, etag, length)
-        if got_final:
+        if got_final and not cfg.run_s3_mpu_complete_phase:
+            # inline completion; with --s3mpucomplphase the separate
+            # MPUCOMPL phase sends the completions instead
             client.complete_multipart_upload(
                 bucket, key, upload_id,
                 shared_upload_store.get_completed_parts(bucket, key))
@@ -390,6 +466,25 @@ def _multi_delete(worker, phase: BenchPhase) -> None:
                 (time.perf_counter_ns() - t0) // 1000)
             worker.live_ops.num_entries_done += len(batch)
             worker.live_ops.num_iops_done += 1
+
+
+def _mpu_complete_phase(worker, phase: BenchPhase) -> None:
+    """MPUCOMPL: complete all shared multipart uploads recorded by the
+    preceding WRITE phase (reference: separate MPUCOMPLETE phase for
+    --s3mpusharing, Coordinator phase table + MPU complete :5936)."""
+    cfg = worker.cfg
+    if worker.rank % max(1, cfg.num_threads) != 0:
+        worker.got_phase_work = False
+        return
+    client = _client(worker)
+    completed = shared_upload_store.pop_all_complete()
+    for bucket, key, upload_id, parts in completed:
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        client.complete_multipart_upload(bucket, key, upload_id, parts)
+        worker.entries_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
+        worker.live_ops.num_entries_done += 1
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,13 @@ class WorkersSharedData:
         self.cpu_util_stonewall: float = 0.0
         self.cpu_util_last_done: float = 0.0
         self.first_error: "Exception | None" = None
+        # --rwmixthrpct byte-ratio balancer, shared by all workers
+        # (reference: RateLimiterRWMixThreads static atomics)
+        self.rwmix_balancer = None
+        if getattr(config, "rwmix_thr_read_pct", 0):
+            from ..toolkits.rate_limiter import RateLimiterRWMixThreads
+            self.rwmix_balancer = RateLimiterRWMixThreads(
+                config.rwmix_thr_read_pct)
 
     # -- phase control (coordinator side) -----------------------------------
 
@@ -63,6 +70,8 @@ class WorkersSharedData:
             self.phase_start_monotonic = time.monotonic()
             self.phase_start_wall = time.time()
             self.cpu_util.update()  # baseline for phase CPU util
+            if self.rwmix_balancer is not None:
+                self.rwmix_balancer.reset()
             self.cond.notify_all()
             return self.bench_uuid
 

@@ -1,0 +1,112 @@
+"""TPUBENCH: transfer benchmark over the device fabric (no storage).
+
+The TPU-native analogue of the reference's raw-TCP netbench (SURVEY.md
+section 2.3: "netbench analogue can target ICI"): instead of client/server
+sockets, workers hammer the data paths a TPU ingest pipeline actually uses:
+
+  h2d   host buffer -> HBM DMA            (cudaMemcpy H2D analogue)
+  d2h   HBM -> host buffer DMA            (cudaMemcpy D2H analogue)
+  both  h2d followed by d2h per block     (request/response analogue)
+  ici   ring ppermute of a sharded array over every chip of the mesh —
+        each step moves the full shard over the inter-chip interconnect
+        (the XLA-collective replacement for NCCL-style p2p benchmarks)
+
+Workers transfer --size bytes total in --block chunks; per-op latency goes
+to the IOPS histogram; bytes count into both live ops and the per-chip HBM
+ingest accounting. Runs on one chip (h2d/d2h/both; ici degenerates to a
+self-permute) and scales to a full pod slice.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..phases import BenchPhase
+from .shared import WorkerException
+
+
+def run_tpubench_phase(worker, phase: BenchPhase) -> None:
+    cfg = worker.cfg
+    pattern = cfg.tpu_bench_pattern
+    if worker._tpu is None:
+        raise WorkerException(
+            "--tpubench requires --tpuids (chips to benchmark)")
+    if pattern == "ici":
+        _run_ici(worker)
+        return
+    if pattern not in ("h2d", "d2h", "both"):
+        raise WorkerException(
+            f"unknown --tpubenchpat {pattern!r} (h2d|d2h|both|ici)")
+    ctx = worker._tpu
+    bs = cfg.block_size
+    total = max(cfg.file_size, bs)
+    done = 0
+    num_bufs = len(worker._io_bufs)
+    while done < total:
+        worker.check_interruption_request()
+        length = min(bs, total - done)
+        buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+        t0 = time.perf_counter_ns()
+        if pattern in ("h2d", "both"):
+            ctx.host_to_device(buf, length)
+        if pattern in ("d2h", "both"):
+            ctx.device_to_host(buf, length)
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        moved = length * (2 if pattern == "both" else 1)
+        worker.iops_latency_histo.add_latency(lat_usec)
+        worker.live_ops.num_bytes_done += moved
+        worker.live_ops.num_iops_done += 1
+        worker.tpu_transfer_bytes += moved
+        worker.tpu_transfer_usec += lat_usec
+        worker._num_iops_submitted += 1
+        done += length
+    t0 = time.perf_counter_ns()
+    ctx.flush()
+    worker.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+
+
+def _run_ici(worker) -> None:
+    """Ring ppermute over all available chips; only the first local worker
+    drives the mesh (one SPMD program per host, like the reference's
+    rank-0-only sync phase)."""
+    cfg = worker.cfg
+    if worker.rank % max(1, cfg.num_threads) != 0:
+        worker.got_phase_work = False
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = Mesh(np.array(devices), axis_names=("chip",))
+    bs_words = max(cfg.block_size // 4, 128)
+    total = max(cfg.file_size, cfg.block_size)
+    # sharded array: one block per chip
+    arr = jax.device_put(
+        np.zeros((n_dev, bs_words), dtype=np.uint32),
+        NamedSharding(mesh, P("chip", None)))
+
+    def _shift(x):
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        return jax.lax.ppermute(x, axis_name="chip", perm=perm)
+
+    step = jax.jit(shard_map(_shift, mesh=mesh, in_specs=P("chip", None),
+                             out_specs=P("chip", None)))
+    step(arr)[0].block_until_ready()  # warm the compile outside timing
+    bytes_per_step = n_dev * bs_words * 4
+    done = 0
+    while done < total:
+        worker.check_interruption_request(force=True)
+        t0 = time.perf_counter_ns()
+        arr = step(arr)
+        jax.block_until_ready(arr)
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        worker.iops_latency_histo.add_latency(lat_usec)
+        worker.live_ops.num_bytes_done += bytes_per_step
+        worker.live_ops.num_iops_done += 1
+        worker.tpu_transfer_bytes += bytes_per_step
+        worker.tpu_transfer_usec += lat_usec
+        done += bytes_per_step

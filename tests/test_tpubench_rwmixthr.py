@@ -1,0 +1,73 @@
+"""Tests for the TPU transfer benchmark phase and --rwmixthr readers."""
+
+import json
+
+from elbencho_tpu.cli import main
+
+
+def test_tpubench_h2d(tmp_path):
+    jsonfile = tmp_path / "out.json"
+    rc = main(["--tpubench", "-s", "1M", "-b", "256K", "--nolive",
+               "--jsonfile", str(jsonfile)])
+    assert rc == 0
+    rec = json.loads(jsonfile.read_text().splitlines()[0])
+    assert rec["Phase"] == "TPUBENCH"
+    assert rec["BytesLast"] == 1 << 20
+    assert rec["TpuHbmBytes"] == 1 << 20
+
+
+def test_tpubench_both_pattern(tmp_path):
+    rc = main(["--tpubench", "--tpubenchpat", "both", "-s", "512K",
+               "-b", "128K", "--nolive"])
+    assert rc == 0
+
+
+def test_tpubench_ici_pattern(tmp_path):
+    """ici pattern: ring ppermute over the 8 virtual CPU devices."""
+    jsonfile = tmp_path / "out.json"
+    rc = main(["--tpubench", "--tpubenchpat", "ici", "-s", "512K",
+               "-b", "64K", "-t", "2", "--nolive",
+               "--jsonfile", str(jsonfile)])
+    assert rc == 0
+    rec = json.loads(jsonfile.read_text().splitlines()[0])
+    assert rec["BytesLast"] >= 512 * 1024
+    # only the first worker drives the mesh; the other reports no work
+    assert rec["NumWorkers"] == 1
+
+
+def test_tpubench_bad_pattern():
+    rc = main(["--tpubench", "--tpubenchpat", "bogus", "-s", "64K",
+               "--nolive"])
+    assert rc != 0
+
+
+def test_rwmixthr_readers(tmp_path, monkeypatch):
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    reset_native_engine_cache()
+    # pre-create dataset (readers need existing files)
+    assert main(["-w", "-d", "-t", "2", "-n", "1", "-N", "2", "-s", "64K",
+                 "-b", "16K", "--nolive", str(tmp_path)]) == 0
+    jsonfile = tmp_path / "out.json"
+    rc = main(["-w", "--rwmixthr", "1", "-t", "2", "-n", "1", "-N", "2",
+               "-s", "64K", "-b", "16K", "--nolive",
+               "--jsonfile", str(jsonfile), str(tmp_path)])
+    assert rc == 0
+    rec = next(json.loads(ln) for ln in jsonfile.read_text().splitlines()
+               if json.loads(ln)["Phase"] == "WRITE")
+    # rank 0 read, rank 1 wrote: both sides accounted
+    assert rec["RWMixReadIOPSLast"] > 0
+    assert rec["IOPSLast"] > 0
+    assert rec["BytesLast"] == 2 * 65536  # writer side: 2 files x 64K
+
+
+def test_rwmixthr_with_balancer(tmp_path, monkeypatch):
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    reset_native_engine_cache()
+    assert main(["-w", "-d", "-t", "2", "-n", "1", "-N", "2", "-s", "64K",
+                 "-b", "16K", "--nolive", str(tmp_path)]) == 0
+    rc = main(["-w", "--rwmixthr", "1", "--rwmixthrpct", "50", "-t", "2",
+               "-n", "1", "-N", "2", "-s", "64K", "-b", "16K", "--nolive",
+               str(tmp_path)])
+    assert rc == 0
