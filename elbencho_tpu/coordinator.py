@@ -31,6 +31,8 @@ class Coordinator:
     # ------------------------------------------------------------------
 
     def main(self) -> int:
+        from .toolkits.signals import register_fault_handlers
+        register_fault_handlers()  # reference: SignalTk fault trace
         cfg = self.cfg
         if cfg.run_as_service:
             from .service.http_service import HTTPService

@@ -55,7 +55,40 @@ class OpsLogger:
         self._write(self._record(op_name, entry_name, offset, length,
                                  is_finished=True, is_error=is_error))
 
+    def logged_op(self, op_name: str, entry_name: str = "",
+                  offset: int = 0, length: int = 0) -> "_LoggedOp":
+        """Context manager writing the pre record on entry and the post
+        record on exit — with is_error=True when the body raises, or when
+        the body sets ``ctx.error = True`` (for swallowed failures)."""
+        return _LoggedOp(self, op_name, entry_name, offset, length)
+
     def close(self) -> None:
         if self._fd >= 0:
             os.close(self._fd)
             self._fd = -1
+
+
+class _LoggedOp:
+    __slots__ = ("_logger", "_args", "error")
+
+    def __init__(self, logger: "OpsLogger | None", op_name: str,
+                 entry_name: str, offset: int, length: int):
+        self._logger = logger
+        self._args = (op_name, entry_name, offset, length)
+        self.error = False
+
+    def __enter__(self) -> "_LoggedOp":
+        if self._logger is not None:
+            self._logger.log_op_pre(*self._args)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._logger is not None:
+            self._logger.log_op(*self._args,
+                                is_error=self.error or exc_type is not None)
+        return False
+
+
+#: shared no-op instance for workers running without --opslog
+def null_logged_op(*_args, **_kwargs) -> _LoggedOp:
+    return _LoggedOp(None, "", "", 0, 0)

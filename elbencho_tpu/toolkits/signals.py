@@ -1,0 +1,36 @@
+"""Fault-signal diagnostics.
+
+Reference: source/toolkits/SignalTk.{h,cpp} — fault handlers print a
+backtrace to the console and write /tmp/elbencho_fault_trace.txt
+(SignalTk.cpp:25-60); SIGINT blocking for worker threads is handled by the
+coordinator's handler instead (Python delivers signals to the main thread
+only, so per-thread blocking is unnecessary).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import getpass
+
+FAULT_TRACE_PATH_TEMPLATE = "/tmp/elbencho_tpu_{user}_fault_trace.txt"
+
+_trace_file = None
+
+
+def register_fault_handlers() -> str:
+    """Enable faulthandler for SIGSEGV/SIGFPE/SIGABRT/SIGBUS: tracebacks of
+    all threads go to a per-user trace file (faulthandler supports a single
+    sink; the path is logged at startup so a crashed console run points
+    somewhere). Returns the trace file path."""
+    global _trace_file
+    path = FAULT_TRACE_PATH_TEMPLATE.format(user=getpass.getuser())
+    if _trace_file is None:
+        try:
+            _trace_file = open(path, "w")
+            faulthandler.enable(file=_trace_file, all_threads=True)
+            from . import logger
+            logger.log(logger.LOG_VERBOSE,
+                       f"fault trace file: {path}")
+        except OSError:
+            faulthandler.enable()  # stderr only
+    return path

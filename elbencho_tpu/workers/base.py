@@ -79,6 +79,16 @@ class Worker:
         self.tpu_transfer_bytes = 0   # HBM ingest accounting (TPU data path)
         self.tpu_transfer_usec = 0
 
+    def oplog(self, op_name: str, entry_name: str = "", offset: int = 0,
+              length: int = 0):
+        """Per-op trace context (pre+post records incl. error flag);
+        no-op without --opslog (reference: OPLOG macros, OpsLogger.h:19-36)."""
+        from ..toolkits.ops_logger import null_logged_op
+        ops_log = getattr(self, "_ops_log", None)
+        if ops_log is None:
+            return null_logged_op()
+        return ops_log.logged_op(op_name, entry_name, offset, length)
+
     # -- stats management ---------------------------------------------------
 
     def reset_stats(self) -> None:

@@ -346,24 +346,26 @@ class LocalWorker(Worker):
             base = self._bench_path_for_dir(dir_idx)
             rel = self._dir_rel_path(dir_idx)
             path = os.path.join(base, rel)
-            t0 = time.perf_counter_ns()
-            if phase == BenchPhase.CREATEDIRS:
-                os.makedirs(path, MKDIR_MODE, exist_ok=True)
-            elif phase == BenchPhase.DELETEDIRS:
-                try:
-                    os.rmdir(path)
-                    parent = os.path.dirname(path)
-                    if os.path.basename(parent).startswith("r"):
-                        try:
-                            os.rmdir(parent)  # remove empty rank dir
-                        except OSError:
-                            pass
-                except FileNotFoundError:
-                    if not cfg.ignore_delete_errors:
-                        raise
-            else:  # STATDIRS
-                os.stat(path)
-            lat_usec = (time.perf_counter_ns() - t0) // 1000
+            with self.oplog(phase.name.lower(), path) as op_rec:
+                t0 = time.perf_counter_ns()
+                if phase == BenchPhase.CREATEDIRS:
+                    os.makedirs(path, MKDIR_MODE, exist_ok=True)
+                elif phase == BenchPhase.DELETEDIRS:
+                    try:
+                        os.rmdir(path)
+                        parent = os.path.dirname(path)
+                        if os.path.basename(parent).startswith("r"):
+                            try:
+                                os.rmdir(parent)  # remove empty rank dir
+                            except OSError:
+                                pass
+                    except FileNotFoundError:
+                        if not cfg.ignore_delete_errors:
+                            raise
+                        op_rec.error = True
+                else:  # STATDIRS
+                    os.stat(path)
+                lat_usec = (time.perf_counter_ns() - t0) // 1000
             self.entries_latency_histo.add_latency(lat_usec)
             self.live_ops.num_entries_done += 1
 
@@ -378,20 +380,22 @@ class LocalWorker(Worker):
                 base = self._bench_path_for_dir(dir_idx)
                 path = os.path.join(base,
                                     self._file_rel_path(dir_idx, file_idx))
-                t0 = time.perf_counter_ns()
-                if phase == BenchPhase.CREATEFILES:
-                    self._write_one_file(path)
-                elif phase == BenchPhase.READFILES:
-                    self._read_one_file(path)
-                elif phase == BenchPhase.STATFILES:
-                    os.stat(path)
-                elif phase == BenchPhase.DELETEFILES:
-                    try:
-                        os.unlink(path)
-                    except FileNotFoundError:
-                        if not cfg.ignore_delete_errors:
-                            raise
-                lat_usec = (time.perf_counter_ns() - t0) // 1000
+                with self.oplog(phase.name.lower(), path) as op_rec:
+                    t0 = time.perf_counter_ns()
+                    if phase == BenchPhase.CREATEFILES:
+                        self._write_one_file(path)
+                    elif phase == BenchPhase.READFILES:
+                        self._read_one_file(path)
+                    elif phase == BenchPhase.STATFILES:
+                        os.stat(path)
+                    elif phase == BenchPhase.DELETEFILES:
+                        try:
+                            os.unlink(path)
+                        except FileNotFoundError:
+                            if not cfg.ignore_delete_errors:
+                                raise
+                            op_rec.error = True
+                    lat_usec = (time.perf_counter_ns() - t0) // 1000
                 self.entries_latency_histo.add_latency(lat_usec)
                 self.live_ops.num_entries_done += 1
 

@@ -106,15 +106,16 @@ def _iterate_buckets(worker, phase: BenchPhase) -> None:
             continue
         got_work = True
         worker.check_interruption_request(force=True)
-        t0 = time.perf_counter_ns()
-        if phase == BenchPhase.CREATEDIRS:
-            client.create_bucket(bucket)
-        elif phase == BenchPhase.DELETEDIRS:
-            client.delete_bucket(bucket)
-        else:  # STATDIRS
-            if not client.head_bucket(bucket):
-                raise WorkerException(f"bucket not found: {bucket}")
-        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        with worker.oplog(phase.name.lower(), bucket):
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.CREATEDIRS:
+                client.create_bucket(bucket)
+            elif phase == BenchPhase.DELETEDIRS:
+                client.delete_bucket(bucket)
+            else:  # STATDIRS
+                if not client.head_bucket(bucket):
+                    raise WorkerException(f"bucket not found: {bucket}")
+            lat_usec = (time.perf_counter_ns() - t0) // 1000
         worker.entries_latency_histo.add_latency(lat_usec)
         worker.live_ops.num_entries_done += 1
     worker.got_phase_work = got_work
@@ -143,26 +144,26 @@ def _iterate_objects(worker, phase: BenchPhase) -> None:
         return
     for bucket, key in _iter_entries(worker):
         worker.check_interruption_request(force=True)
-        t0 = time.perf_counter_ns()
-        if phase == BenchPhase.CREATEFILES:
-            _ignoring_errors_call(worker,
-                                  lambda: _upload_object(worker, bucket,
-                                                         key))
-        elif phase == BenchPhase.READFILES:
-            _ignoring_errors_call(worker,
-                                  lambda: _download_object(worker, bucket,
-                                                           key))
-        elif phase == BenchPhase.STATFILES:
-            _ignoring_errors_call(worker,
-                                  lambda: _client(worker).head_object(
-                                      bucket, key))
-        elif phase == BenchPhase.DELETEFILES:
-            try:
-                _client(worker).delete_object(bucket, key)
-            except Exception:
-                if not cfg.ignore_delete_errors and not cfg.s3_ignore_errors:
-                    raise
-        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        with worker.oplog(phase.name.lower(), f"{bucket}/{key}") as op_rec:
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.CREATEFILES:
+                op_rec.error = not _ignoring_errors_call(
+                    worker, lambda: _upload_object(worker, bucket, key))
+            elif phase == BenchPhase.READFILES:
+                op_rec.error = not _ignoring_errors_call(
+                    worker, lambda: _download_object(worker, bucket, key))
+            elif phase == BenchPhase.STATFILES:
+                op_rec.error = not _ignoring_errors_call(
+                    worker, lambda: _client(worker).head_object(bucket, key))
+            elif phase == BenchPhase.DELETEFILES:
+                try:
+                    _client(worker).delete_object(bucket, key)
+                except Exception:
+                    if not cfg.ignore_delete_errors \
+                            and not cfg.s3_ignore_errors:
+                        raise
+                    op_rec.error = True
+            lat_usec = (time.perf_counter_ns() - t0) // 1000
         worker.entries_latency_histo.add_latency(lat_usec)
         worker.live_ops.num_entries_done += 1
 
