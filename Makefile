@@ -1,0 +1,32 @@
+# elbencho-tpu top-level targets (reference: hand-written Makefile driving
+# the C++ build; here the Python package needs no build and the native
+# engine lives in csrc/)
+
+.PHONY: all native native-tsan test test-fast bench docs clean
+
+all: native
+
+native:
+	$(MAKE) -C csrc
+
+# ThreadSanitizer build of the native engine (SURVEY.md section 5.2: the
+# reference has no sanitizer targets; we add one since the engine is new)
+native-tsan:
+	$(MAKE) -C csrc CXXFLAGS="-O1 -g -fsanitize=thread -fPIC -std=c++17"
+
+test: native
+	python -m pytest tests/ -q
+
+test-fast: native
+	python -m pytest tests/ -q -x --ignore=tests/test_service_mode.py \
+		--ignore=tests/test_netbench.py
+
+bench: native
+	python bench.py
+
+docs:
+	python tools/generate-usage-docs
+
+clean:
+	$(MAKE) -C csrc clean
+	rm -rf build dist/*.egg-info

@@ -117,6 +117,8 @@ FLAG_DEFS = [
      "Do not treat deletion of non-existing files as error"),
     ("no0usecerr", None, "ignore_0usec_errors", "bool", False, "misc",
      "Do not warn about operations completing in 0 microseconds"),
+    ("nopathexp", None, "no_path_expansion", "bool", False, "misc",
+     "Disable {N..M} numeric range expansion in bench paths"),
 
     # integrity / variance
     ("verify", None, "integrity_check_salt", "int", 0, "misc",
@@ -331,6 +333,36 @@ FLAG_DEFS = [
      "Comma-separated 'accesskey:secret' pairs (round-robin)"),
     ("s3retries", None, "s3_num_retries", "int", 3, "s3",
      "Transient-error retries per S3 request (5xx / connection errors)"),
+    ("s3aclput", None, "run_s3_acl_put", "bool", False, "s3",
+     "Run object ACL put phase"),
+    ("s3aclget", None, "run_s3_acl_get", "bool", False, "s3",
+     "Run object ACL get phase"),
+    ("s3baclput", None, "run_s3_bucket_acl_put", "bool", False, "s3",
+     "Run bucket ACL put phase"),
+    ("s3baclget", None, "run_s3_bucket_acl_get", "bool", False, "s3",
+     "Run bucket ACL get phase"),
+    ("s3otag", None, "run_s3_object_tagging", "bool", False, "s3",
+     "Run object tagging put/get/del phases"),
+    ("s3otagverify", None, "do_s3_object_tagging_verify", "bool", False,
+     "s3", "Verify object tags read back correctly"),
+    ("s3btag", None, "run_s3_bucket_tagging", "bool", False, "s3",
+     "Run bucket tagging put/get/del phases"),
+    ("s3btagverify", None, "do_s3_bucket_tagging_verify", "bool", False,
+     "s3", "Verify bucket tags read back correctly"),
+    ("s3bversion", None, "run_s3_bucket_versioning", "bool", False, "s3",
+     "Run bucket versioning put/get phases"),
+    ("s3bversionverify", None, "do_s3_bucket_versioning_verify", "bool",
+     False, "s3", "Verify bucket versioning status reads back correctly"),
+    ("s3olockcfg", None, "run_s3_object_lock_cfg", "bool", False, "s3",
+     "Run bucket object-lock configuration put/get phases"),
+    ("s3olockcfgverify", None, "do_s3_object_lock_cfg_verify", "bool",
+     False, "s3", "Verify object-lock configuration reads back correctly"),
+    ("s3sse", None, "s3_sse", "bool", False, "s3",
+     "Server-side encryption (SSE-S3 AES256 header) for uploads"),
+    ("s3sseckey", None, "s3_sse_customer_key", "str", "", "s3",
+     "SSE-C customer key (base64) for uploads/downloads"),
+    ("s3ssekmskey", None, "s3_sse_kms_key_id", "str", "", "s3",
+     "SSE-KMS key id for uploads"),
     ("s3ignoreerrors", None, "s3_ignore_errors", "bool", False, "s3",
      "Continue on S3 request errors (stress mode)"),
 
@@ -418,9 +450,30 @@ class BenchConfig(BenchConfigBase):
             hosts = hosts[:self.num_hosts_limit]
         self.hosts = hosts
 
+    @staticmethod
+    def _expand_path_braces(paths: "list[str]") -> "list[str]":
+        """"{N..M}" numeric range expansion in bench paths (reference:
+        ProgArgs path expansion; disable with --nopathexp)."""
+        import re
+        out: "list[str]" = []
+        pattern = re.compile(r"\{(\d+)\.\.(\d+)\}")
+        for p in paths:
+            m = pattern.search(p)
+            if not m:
+                out.append(p)
+                continue
+            lo, hi = int(m.group(1)), int(m.group(2))
+            step = 1 if hi >= lo else -1
+            for i in range(lo, hi + step, step):
+                out.extend(BenchConfig._expand_path_braces(
+                    [p[:m.start()] + str(i) + p[m.end():]]))
+        return out
+
     def _init_bench_mode(self) -> None:
         """Bench mode from flags/path prefixes (reference: initBenchMode,
         ProgArgs.cpp:1112 — s3:// and hdfs:// prefixes, --netbench flag)."""
+        if not self.no_path_expansion:
+            self.paths = self._expand_path_braces(self.paths)
         if self.run_netbench:
             self.bench_mode = BenchMode.NETBENCH
             return
@@ -545,29 +598,56 @@ class BenchConfig(BenchConfigBase):
     # -- phase selection getters (used by Coordinator ordering table) --------
 
     def enabled_phases(self) -> "list[BenchPhase]":
-        """Ordered phase list (reference: Coordinator.cpp:311-334 —
-        creates before deletes; listing after write/read setup)."""
+        """Ordered phase list (reference: the 21-entry ordering table in
+        Coordinator.cpp:311-334 — creates before deletes, bucket metadata
+        around bucket lifecycle, object metadata around object lifecycle)."""
         p = []
+        bucket_md = (self.run_s3_bucket_tagging
+                     or self.run_s3_bucket_versioning
+                     or self.run_s3_object_lock_cfg)
         if self.run_create_dirs:
             p.append(BenchPhase.CREATEDIRS)
+        if self.run_s3_bucket_acl_put:
+            p.append(BenchPhase.PUTBUCKETACL)
+        # PUT/DEL metadata phases mutate the dataset, so they are gated on
+        # the create/delete phases (reference: ProgArgs.h:659-667); GET
+        # phases run whenever the metadata flag is set
+        if bucket_md and self.run_create_dirs:
+            p.append(BenchPhase.PUT_BUCKET_MD)
         if self.run_stat_dirs:
             p.append(BenchPhase.STATDIRS)
+        if bucket_md:
+            p.append(BenchPhase.GET_BUCKET_MD)
         if self.run_create_files:
             p.append(BenchPhase.CREATEFILES)
         if self.run_s3_mpu_complete_phase:
             p.append(BenchPhase.S3MPUCOMPLETE)
+        if self.run_s3_acl_put:
+            p.append(BenchPhase.PUTOBJACL)
+        if self.run_s3_object_tagging and self.run_create_files:
+            p.append(BenchPhase.PUT_OBJ_MD)
         if self.run_stat_files:
             p.append(BenchPhase.STATFILES)
+        if self.run_s3_object_tagging:
+            p.append(BenchPhase.GET_OBJ_MD)
+        if self.run_s3_acl_get:
+            p.append(BenchPhase.GETOBJACL)
         if self.run_list_objects_num and not self.run_list_objects_parallel:
             p.append(BenchPhase.LISTOBJECTS)
         if self.run_list_objects_parallel:
             p.append(BenchPhase.LISTOBJPARALLEL)
         if self.run_read_files:
             p.append(BenchPhase.READFILES)
+        if self.run_s3_object_tagging and self.run_delete_files:
+            p.append(BenchPhase.DEL_OBJ_MD)
         if self.run_multi_delete_num:
             p.append(BenchPhase.MULTIDELOBJ)
         if self.run_delete_files:
             p.append(BenchPhase.DELETEFILES)
+        if bucket_md and self.run_delete_dirs:
+            p.append(BenchPhase.DEL_BUCKET_MD)
+        if self.run_s3_bucket_acl_get:
+            p.append(BenchPhase.GETBUCKETACL)
         if self.run_delete_dirs:
             p.append(BenchPhase.DELETEDIRS)
         if self.run_netbench:

@@ -98,7 +98,9 @@ def phase_entry_type(phase: BenchPhase, s3_mode: bool = False) -> str:
     """"dirs"/"files"/"buckets"/"objects" for the given phase
     (reference: TranslatorTk::benchPhaseToPhaseEntryType)."""
     dir_phases = {BenchPhase.CREATEDIRS, BenchPhase.DELETEDIRS,
-                  BenchPhase.STATDIRS}
+                  BenchPhase.STATDIRS, BenchPhase.PUTBUCKETACL,
+                  BenchPhase.GETBUCKETACL, BenchPhase.PUT_BUCKET_MD,
+                  BenchPhase.GET_BUCKET_MD, BenchPhase.DEL_BUCKET_MD}
     if phase in dir_phases:
         return "buckets" if s3_mode else "dirs"
     return "objects" if s3_mode else "files"

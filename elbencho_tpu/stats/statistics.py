@@ -265,10 +265,10 @@ class Statistics:
     # -- rendering ----------------------------------------------------------
 
     def print_phase_results_table_header(self) -> None:
-        line = (f"{'OPERATION':<10}{'RESULT TYPE':<20}"
+        line = (f"{'OPERATION':<12}{'RESULT TYPE':<20}"
                 f"{'FIRST DONE':>14}{'LAST DONE':>14}")
         print(line)
-        print(f"{'=' * 9:<10}{'=' * 18:<20}{'=' * 12:>14}{'=' * 12:>14}")
+        print(f"{'=' * 11:<12}{'=' * 18:<20}{'=' * 12:>14}{'=' * 12:>14}")
         self._print_to_res_file(line)
 
     def print_phase_results(self, phase: BenchPhase) -> PhaseResults:
@@ -281,7 +281,7 @@ class Statistics:
         return res
 
     def _row(self, op: str, rtype: str, first, last) -> str:
-        return f"{op:<10}{rtype + ' :':<20}{first:>14}{last:>14}"
+        return f"{op:<12}{rtype + ' :':<20}{first:>14}{last:>14}"
 
     def _render_result_rows(self, res: PhaseResults) -> None:
         cfg = self.cfg
@@ -339,26 +339,41 @@ class Statistics:
                                   f"{res.cpu_last_done:.0f}"))
         if cfg.show_latency and res.iops_histo.num_values:
             h = res.iops_histo
-            rows.append(f"{'':10}{'IO latency us :':<20}"
+            rows.append(f"{'':12}{'IO latency us :':<20}"
                         f"min={h.min_micro} avg={h.avg_micro:.0f} "
                         f"max={h.max_micro}")
         if cfg.show_latency and res.entries_histo.num_values:
             h = res.entries_histo
-            rows.append(f"{'':10}{'Ent latency us :':<20}"
+            rows.append(f"{'':12}{'Ent latency us :':<20}"
                         f"min={h.min_micro} avg={h.avg_micro:.0f} "
                         f"max={h.max_micro}")
         if cfg.show_latency_percentiles and res.iops_histo.num_values:
             nines = res.iops_histo.percentiles_nines(
                 cfg.num_latency_percentile_9s)
             txt = " ".join(f"{k}={v:.0f}" for k, v in nines.items())
-            rows.append(f"{'':10}{'IO lat pcts :':<20}{txt}")
+            rows.append(f"{'':12}{'IO lat pcts :':<20}{txt}")
         if cfg.show_latency_histogram and res.iops_histo.num_values:
-            rows.append(f"{'':10}IO lat histogram : "
+            rows.append(f"{'':12}IO lat histogram : "
                         f"{res.iops_histo.histogram_str()}")
         if cfg.show_all_elapsed:
             txt = ", ".join(_fmt_elapsed_usec(u)
                             for u in sorted(res.elapsed_usec_vec))
-            rows.append(f"{'':10}Worker elapsed   : {txt}")
+            rows.append(f"{'':12}Worker elapsed   : {txt}")
+        if cfg.show_svc_elapsed and cfg.hosts:
+            # per-service last-done elapsed (--svcelapsed)
+            parts = []
+            for w in self.manager.workers:
+                if getattr(w, "host", None) and w.elapsed_usec_vec:
+                    parts.append(f"{w.host}="
+                                 f"{_fmt_elapsed_usec(max(w.elapsed_usec_vec))}")
+            if parts:
+                rows.append(f"{'':12}Service elapsed  : {', '.join(parts)}")
+        if not cfg.ignore_0usec_errors and res.iops_histo.num_values \
+                and res.iops_histo.min_micro == 0:
+            rows.append(
+                f"{'':12}WARNING: operations completed in 0 microseconds; "
+                f"results may be bogus (caching?). --no0usecerr silences "
+                f"this.")
         for row in rows:
             print(row)
             self._print_to_res_file(row)

@@ -23,6 +23,7 @@ class MockS3State:
         self.buckets: "dict[str, dict[str, bytes]]" = {}
         self.uploads: "dict[str, dict]" = {}  # uploadId -> {bucket,key,parts}
         self.tags: "dict[tuple[str, str], dict]" = {}
+        self.bucket_meta: "dict[tuple[str, str], bytes]" = {}
         self.next_upload_id = 0
 
 
@@ -74,6 +75,11 @@ def _make_handler(state: MockS3State):
                     if "acl" in query:
                         self._reply(200)
                         return
+                    for meta in ("tagging", "versioning", "object-lock"):
+                        if meta in query:
+                            state.bucket_meta[(bucket, meta)] = body
+                            self._reply(200)
+                            return
                     state.buckets.setdefault(bucket, {})
                     self._reply(200)
                     return
@@ -146,7 +152,27 @@ def _make_handler(state: MockS3State):
                 if bucket not in state.buckets:
                     self._error(404, "NoSuchBucket", bucket)
                     return
-                if not key or "list-type" in query:
+                if not key:
+                    if "acl" in query:
+                        self._reply(200, b"<AccessControlPolicy>"
+                                         b"</AccessControlPolicy>")
+                        return
+                    for meta, default in (
+                            ("tagging",
+                             b"<Tagging><TagSet></TagSet></Tagging>"),
+                            ("versioning",
+                             b"<VersioningConfiguration>"
+                             b"</VersioningConfiguration>"),
+                            ("object-lock",
+                             b"<ObjectLockConfiguration>"
+                             b"</ObjectLockConfiguration>")):
+                        if meta in query:
+                            self._reply(200, state.bucket_meta.get(
+                                (bucket, meta), default))
+                            return
+                    self._list(bucket, query)
+                    return
+                if "list-type" in query:
                     self._list(bucket, query)
                     return
                 if "acl" in query:
@@ -222,10 +248,18 @@ def _make_handler(state: MockS3State):
                     self._reply(204)
                     return
                 if not key:
+                    if "tagging" in query:
+                        state.bucket_meta.pop((bucket, "tagging"), None)
+                        self._reply(204)
+                        return
                     if bucket in state.buckets and state.buckets[bucket]:
                         self._error(409, "BucketNotEmpty", bucket)
                         return
                     state.buckets.pop(bucket, None)
+                    self._reply(204)
+                    return
+                if "tagging" in query:
+                    state.tags.pop((bucket, key), None)
                     self._reply(204)
                     return
                 state.buckets.get(bucket, {}).pop(key, None)

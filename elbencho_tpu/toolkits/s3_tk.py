@@ -25,6 +25,25 @@ import xml.etree.ElementTree as ET
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 
+def _xml_ns(root) -> str:
+    """Namespace prefix of an XML root element ('' if unqualified)."""
+    return root.tag[:root.tag.index("}") + 1] if root.tag.startswith("{") \
+        else ""
+
+
+def _build_tagging_xml(tags: "dict[str, str]") -> bytes:
+    tagset = "".join(f"<Tag><Key>{k}</Key><Value>{v}</Value></Tag>"
+                     for k, v in tags.items())
+    return f"<Tagging><TagSet>{tagset}</TagSet></Tagging>".encode()
+
+
+def _parse_tagging_xml(data: bytes) -> "dict[str, str]":
+    root = ET.fromstring(data)
+    ns = _xml_ns(root)
+    return {tag.findtext(f"{ns}Key", ""): tag.findtext(f"{ns}Value", "")
+            for tag in root.iter(f"{ns}Tag")}
+
+
 class S3Error(Exception):
     def __init__(self, status: int, code: str, message: str):
         super().__init__(f"S3 error {status} {code}: {message}")
@@ -204,14 +223,17 @@ class S3Client:
 
     # -- object ops ----------------------------------------------------------
 
-    def put_object(self, bucket: str, key: str, body: bytes) -> None:
-        status, _, data = self.request("PUT", bucket, key, body=body)
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   extra_headers: "dict | None" = None) -> None:
+        status, _, data = self.request("PUT", bucket, key, body=body,
+                                       headers=extra_headers)
         self._check(status, data, ok=(200,))
 
     def get_object(self, bucket: str, key: str,
                    range_start: "int | None" = None,
-                   range_len: "int | None" = None) -> bytes:
-        headers = {}
+                   range_len: "int | None" = None,
+                   extra_headers: "dict | None" = None) -> bytes:
+        headers = dict(extra_headers or {})
         if range_start is not None:
             end = "" if range_len is None else str(range_start + range_len - 1)
             headers["Range"] = f"bytes={range_start}-{end}"
@@ -220,8 +242,10 @@ class S3Client:
             self._check(status, data, ok=())
         return data
 
-    def head_object(self, bucket: str, key: str) -> "dict[str, str]":
-        status, headers, _ = self.request("HEAD", bucket, key)
+    def head_object(self, bucket: str, key: str,
+                    extra_headers: "dict | None" = None) -> "dict[str, str]":
+        status, headers, _ = self.request("HEAD", bucket, key,
+                                          headers=extra_headers)
         if status != 200:
             raise S3Error(status, "NotFound", key)
         return headers
@@ -242,8 +266,7 @@ class S3Client:
             root = ET.fromstring(data)
         except ET.ParseError:
             return
-        ns = root.tag[:root.tag.index("}") + 1] if \
-            root.tag.startswith("{") else ""
+        ns = _xml_ns(root)
         errors = [(el.findtext(f"{ns}Key", ""), el.findtext(f"{ns}Code", ""))
                   for el in root.iter(f"{ns}Error")]
         if errors:
@@ -265,9 +288,7 @@ class S3Client:
         status, _, data = self.request("GET", bucket, query=query)
         self._check(status, data, ok=(200,))
         root = ET.fromstring(data)
-        ns = ""
-        if root.tag.startswith("{"):
-            ns = root.tag[:root.tag.index("}") + 1]
+        ns = _xml_ns(root)
         keys = [el.findtext(f"{ns}Key") for el in root.findall(
             f"{ns}Contents")]
         next_token = root.findtext(f"{ns}NextContinuationToken", default="")
@@ -275,24 +296,26 @@ class S3Client:
 
     # -- multipart ------------------------------------------------------------
 
-    def create_multipart_upload(self, bucket: str, key: str) -> str:
+    def create_multipart_upload(self, bucket: str, key: str,
+                                extra_headers: "dict | None" = None) -> str:
         status, _, data = self.request("POST", bucket, key,
-                                       query={"uploads": ""})
+                                       query={"uploads": ""},
+                                       headers=extra_headers)
         self._check(status, data, ok=(200,))
         root = ET.fromstring(data)
-        ns = root.tag[:root.tag.index("}") + 1] if \
-            root.tag.startswith("{") else ""
+        ns = _xml_ns(root)
         upload_id = root.findtext(f"{ns}UploadId")
         if not upload_id:
             raise S3Error(500, "NoUploadId", "missing UploadId in reply")
         return upload_id
 
     def upload_part(self, bucket: str, key: str, upload_id: str,
-                    part_number: int, body: bytes) -> str:
+                    part_number: int, body: bytes,
+                    extra_headers: "dict | None" = None) -> str:
         status, headers, data = self.request(
             "PUT", bucket, key,
             query={"partNumber": str(part_number), "uploadId": upload_id},
-            body=body)
+            body=body, headers=extra_headers)
         self._check(status, data, ok=(200,))
         return headers.get("ETag", headers.get("etag", ""))
 
@@ -319,25 +342,16 @@ class S3Client:
 
     def put_object_tagging(self, bucket: str, key: str,
                            tags: "dict[str, str]") -> None:
-        tagset = "".join(f"<Tag><Key>{k}</Key><Value>{v}</Value></Tag>"
-                         for k, v in tags.items())
-        body = f"<Tagging><TagSet>{tagset}</TagSet></Tagging>".encode()
         status, _, data = self.request("PUT", bucket, key,
-                                       query={"tagging": ""}, body=body)
+                                       query={"tagging": ""},
+                                       body=_build_tagging_xml(tags))
         self._check(status, data, ok=(200,))
 
     def get_object_tagging(self, bucket: str, key: str) -> "dict[str, str]":
         status, _, data = self.request("GET", bucket, key,
                                        query={"tagging": ""})
         self._check(status, data, ok=(200,))
-        root = ET.fromstring(data)
-        ns = root.tag[:root.tag.index("}") + 1] if \
-            root.tag.startswith("{") else ""
-        out = {}
-        for tag in root.iter(f"{ns}Tag"):
-            out[tag.findtext(f"{ns}Key", "")] = \
-                tag.findtext(f"{ns}Value", "")
-        return out
+        return _parse_tagging_xml(data)
 
     def put_object_acl(self, bucket: str, key: str, acl: str) -> None:
         status, _, data = self.request(
@@ -350,6 +364,68 @@ class S3Client:
                                        query={"acl": ""})
         self._check(status, data, ok=(200,))
         return data
+
+    def delete_object_tagging(self, bucket: str, key: str) -> None:
+        status, _, data = self.request("DELETE", bucket, key,
+                                       query={"tagging": ""})
+        self._check(status, data)
+
+    def put_bucket_tagging(self, bucket: str,
+                           tags: "dict[str, str]") -> None:
+        status, _, data = self.request("PUT", bucket,
+                                       query={"tagging": ""},
+                                       body=_build_tagging_xml(tags))
+        self._check(status, data, ok=(200, 204))
+
+    def get_bucket_tagging(self, bucket: str) -> "dict[str, str]":
+        status, _, data = self.request("GET", bucket,
+                                       query={"tagging": ""})
+        self._check(status, data, ok=(200,))
+        return _parse_tagging_xml(data)
+
+    def delete_bucket_tagging(self, bucket: str) -> None:
+        status, _, data = self.request("DELETE", bucket,
+                                       query={"tagging": ""})
+        self._check(status, data)
+
+    def put_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+        state = "Enabled" if enabled else "Suspended"
+        body = (f"<VersioningConfiguration><Status>{state}</Status>"
+                f"</VersioningConfiguration>").encode()
+        status, _, data = self.request("PUT", bucket,
+                                       query={"versioning": ""}, body=body)
+        self._check(status, data, ok=(200,))
+
+    def get_bucket_versioning(self, bucket: str) -> str:
+        status, _, data = self.request("GET", bucket,
+                                       query={"versioning": ""})
+        self._check(status, data, ok=(200,))
+        root = ET.fromstring(data)
+        ns = _xml_ns(root)
+        return root.findtext(f"{ns}Status", default="")
+
+    def put_object_lock_configuration(self, bucket: str,
+                                      mode: str = "GOVERNANCE",
+                                      days: int = 1) -> None:
+        """Empty mode clears the default-retention rule (cleanup path)."""
+        rule = (f"<Rule><DefaultRetention><Mode>{mode}</Mode>"
+                f"<Days>{days}</Days></DefaultRetention></Rule>"
+                if mode else "")
+        body = (f"<ObjectLockConfiguration>"
+                f"<ObjectLockEnabled>Enabled</ObjectLockEnabled>{rule}"
+                f"</ObjectLockConfiguration>").encode()
+        status, _, data = self.request("PUT", bucket,
+                                       query={"object-lock": ""}, body=body)
+        self._check(status, data, ok=(200,))
+
+    def get_object_lock_configuration(self, bucket: str) -> str:
+        status, _, data = self.request("GET", bucket,
+                                       query={"object-lock": ""})
+        self._check(status, data, ok=(200,))
+        root = ET.fromstring(data)
+        ns = _xml_ns(root)
+        rule = root.find(f"{ns}Rule/{ns}DefaultRetention/{ns}Mode")
+        return rule.text if rule is not None else ""
 
     def put_bucket_acl(self, bucket: str, acl: str) -> None:
         status, _, data = self.request("PUT", bucket, query={"acl": ""},

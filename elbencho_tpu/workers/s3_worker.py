@@ -54,6 +54,9 @@ def dispatch_s3_phase(worker, phase: BenchPhase) -> None:
         BenchPhase.PUT_OBJ_MD: _obj_tagging,
         BenchPhase.GET_OBJ_MD: _obj_tagging,
         BenchPhase.DEL_OBJ_MD: _obj_tagging,
+        BenchPhase.PUT_BUCKET_MD: _bucket_metadata,
+        BenchPhase.GET_BUCKET_MD: _bucket_metadata,
+        BenchPhase.DEL_BUCKET_MD: _bucket_metadata,
         BenchPhase.S3MPUCOMPLETE: _mpu_complete_phase,
     }
     handler = handlers.get(phase)
@@ -154,7 +157,9 @@ def _iterate_objects(worker, phase: BenchPhase) -> None:
                     worker, lambda: _download_object(worker, bucket, key))
             elif phase == BenchPhase.STATFILES:
                 op_rec.error = not _ignoring_errors_call(
-                    worker, lambda: _client(worker).head_object(bucket, key))
+                    worker, lambda: _client(worker).head_object(
+                        bucket, key,
+                        extra_headers=_sse_c_headers(cfg) or None))
             elif phase == BenchPhase.DELETEFILES:
                 try:
                     _client(worker).delete_object(bucket, key)
@@ -201,7 +206,8 @@ def _download_random_objects(worker) -> None:
             worker._rate_limiter_read.wait(length)
         t0 = time.perf_counter_ns()
         data = client.get_object(bucket, key, range_start=offset,
-                                 range_len=length)
+                                 range_len=length,
+                                 extra_headers=_sse_c_headers(cfg) or None)
         lat = (time.perf_counter_ns() - t0) // 1000
         if len(data) != length:
             raise WorkerException(
@@ -237,14 +243,16 @@ def _upload_object(worker, bucket: str, key: str) -> None:
             _next_upload_block(worker, off, min(bs, size - off))
             for off in range(0, size, bs)) if size else b""
         t0 = time.perf_counter_ns()
-        client.put_object(bucket, key, body)
+        client.put_object(bucket, key, body,
+                          extra_headers=_sse_headers(cfg))
         worker.iops_latency_histo.add_latency(
             (time.perf_counter_ns() - t0) // 1000)
         worker.live_ops.num_bytes_done += size
         worker.live_ops.num_iops_done += 1
         worker._num_iops_submitted += 1
         return
-    upload_id = client.create_multipart_upload(bucket, key)
+    upload_id = client.create_multipart_upload(
+        bucket, key, extra_headers=_sse_headers(cfg))
     parts: "list[tuple[int, str]]" = []
     try:
         offset = 0
@@ -257,7 +265,9 @@ def _upload_object(worker, bucket: str, key: str) -> None:
             body = _next_upload_block(worker, offset, length)
             t0 = time.perf_counter_ns()
             etag = client.upload_part(bucket, key, upload_id, part_number,
-                                      body)
+                                      body,
+                                      extra_headers=_sse_c_headers(cfg)
+                                      or None)
             worker.iops_latency_histo.add_latency(
                 (time.perf_counter_ns() - t0) // 1000)
             parts.append((part_number, etag))
@@ -290,7 +300,8 @@ def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
     num_parts = (size + bs - 1) // bs
     upload_id = shared_upload_store.get_or_create_upload_id(
         bucket, key, size,
-        lambda: client.create_multipart_upload(bucket, key))
+        lambda: client.create_multipart_upload(
+            bucket, key, extra_headers=_sse_headers(cfg)))
     got_final = False
     try:
         for part_idx in range(rank, num_parts, ndst):
@@ -302,7 +313,9 @@ def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
             body = _next_upload_block(worker, offset, length)
             t0 = time.perf_counter_ns()
             etag = client.upload_part(bucket, key, upload_id,
-                                      part_idx + 1, body)
+                                      part_idx + 1, body,
+                                      extra_headers=_sse_c_headers(cfg)
+                                      or None)
             worker.iops_latency_histo.add_latency(
                 (time.perf_counter_ns() - t0) // 1000)
             worker.live_ops.num_bytes_done += length
@@ -348,11 +361,12 @@ def _download_object(worker, bucket: str, key: str) -> None:
         if limiter:
             limiter.wait(length)
         t0 = time.perf_counter_ns()
+        sse_c = _sse_c_headers(cfg) or None
         if size <= bs:
-            data = client.get_object(bucket, key)
+            data = client.get_object(bucket, key, extra_headers=sse_c)
         else:
             data = client.get_object(bucket, key, range_start=offset,
-                                     range_len=length)
+                                     range_len=length, extra_headers=sse_c)
         lat_usec = (time.perf_counter_ns() - t0) // 1000
         if len(data) != length:
             raise WorkerException(
@@ -526,17 +540,114 @@ def _bucket_acl(worker, phase: BenchPhase) -> None:
     worker.got_phase_work = got_work
 
 
+_BENCH_TAGS = {"elbencho-tpu": "bench"}
+
+
+def _sse_c_headers(cfg) -> "dict":
+    """SSE-C customer-key headers — required on BOTH upload and every
+    retrieval of an SSE-C object (GET/HEAD)."""
+    if not cfg.s3_sse_customer_key:
+        return {}
+    import base64
+    import hashlib
+    raw = base64.b64decode(cfg.s3_sse_customer_key)
+    return {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key": cfg.s3_sse_customer_key,
+        "x-amz-server-side-encryption-customer-key-MD5":
+            base64.b64encode(hashlib.md5(raw).digest()).decode(),
+    }
+
+
+def _sse_headers(cfg) -> "dict | None":
+    """Full server-side encryption headers for single PUT / multipart
+    *initiate* (--s3sse / --s3sseckey / --s3ssekmskey). SSE-S3/KMS go on
+    the initiate request only; parts and downloads need only SSE-C."""
+    h = {}
+    if cfg.s3_sse_kms_key_id:
+        h["x-amz-server-side-encryption"] = "aws:kms"
+        h["x-amz-server-side-encryption-aws-kms-key-id"] = \
+            cfg.s3_sse_kms_key_id
+    elif cfg.s3_sse:
+        h["x-amz-server-side-encryption"] = "AES256"
+    h.update(_sse_c_headers(cfg))
+    return h or None
+
+
 def _obj_tagging(worker, phase: BenchPhase) -> None:
+    """Object tagging put/get/del phases (--s3otag; verify with
+    --s3otagverify) — reference: :7109-7204."""
     client = _client(worker)
+    cfg = worker.cfg
     for bucket, key in _iter_entries(worker):
         worker.check_interruption_request(force=True)
-        t0 = time.perf_counter_ns()
-        if phase == BenchPhase.PUT_OBJ_MD:
-            client.put_object_tagging(bucket, key, {"elbencho": "tpu"})
-        elif phase == BenchPhase.GET_OBJ_MD:
-            client.get_object_tagging(bucket, key)
-        else:  # DEL_OBJ_MD: overwrite with empty set
-            client.put_object_tagging(bucket, key, {})
-        worker.entries_latency_histo.add_latency(
-            (time.perf_counter_ns() - t0) // 1000)
+        with worker.oplog(phase.name.lower(), f"{bucket}/{key}"):
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.PUT_OBJ_MD:
+                client.put_object_tagging(bucket, key, _BENCH_TAGS)
+            elif phase == BenchPhase.GET_OBJ_MD:
+                tags = client.get_object_tagging(bucket, key)
+                if cfg.do_s3_object_tagging_verify and tags != _BENCH_TAGS:
+                    raise WorkerException(
+                        f"object tag verification failed for {key}: {tags}")
+            else:  # DEL_OBJ_MD
+                client.delete_object_tagging(bucket, key)
+            worker.entries_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
         worker.live_ops.num_entries_done += 1
+
+
+def _bucket_metadata(worker, phase: BenchPhase) -> None:
+    """Bucket-level metadata phases: tagging, versioning, object-lock
+    config, each optional + verifiable (reference: bucket MD phases +
+    --s3btag/--s3bversion/--s3olockcfg and their verify flags)."""
+    cfg = worker.cfg
+    client = _client(worker)
+    ndst = max(1, cfg.num_dataset_threads)
+    got_work = False
+    for idx, bucket in enumerate(cfg.paths):
+        if idx % ndst != worker.rank % ndst:
+            continue
+        got_work = True
+        worker.check_interruption_request(force=True)
+        with worker.oplog(phase.name.lower(), bucket):
+            t0 = time.perf_counter_ns()
+            if phase == BenchPhase.PUT_BUCKET_MD:
+                if cfg.run_s3_bucket_tagging:
+                    client.put_bucket_tagging(bucket, _BENCH_TAGS)
+                if cfg.run_s3_bucket_versioning:
+                    client.put_bucket_versioning(bucket, enabled=True)
+                if cfg.run_s3_object_lock_cfg:
+                    client.put_object_lock_configuration(bucket)
+            elif phase == BenchPhase.GET_BUCKET_MD:
+                if cfg.run_s3_bucket_tagging:
+                    tags = client.get_bucket_tagging(bucket)
+                    if cfg.do_s3_bucket_tagging_verify \
+                            and tags != _BENCH_TAGS:
+                        raise WorkerException(
+                            f"bucket tag verification failed: {tags}")
+                if cfg.run_s3_bucket_versioning:
+                    status = client.get_bucket_versioning(bucket)
+                    if cfg.do_s3_bucket_versioning_verify \
+                            and status != "Enabled":
+                        raise WorkerException(
+                            f"bucket versioning verification failed: "
+                            f"{status!r}")
+                if cfg.run_s3_object_lock_cfg:
+                    mode = client.get_object_lock_configuration(bucket)
+                    if cfg.do_s3_object_lock_cfg_verify and not mode:
+                        raise WorkerException(
+                            "object-lock configuration verification failed")
+            else:  # DEL_BUCKET_MD (reference: LocalWorker.cpp:3883-3892
+                  # suspends versioning / clears lock cfg on cleanup)
+                if cfg.run_s3_bucket_tagging:
+                    client.delete_bucket_tagging(bucket)
+                if cfg.run_s3_bucket_versioning:
+                    client.put_bucket_versioning(bucket, enabled=False)
+                if cfg.run_s3_object_lock_cfg:
+                    client.put_object_lock_configuration(bucket, mode="",
+                                                         days=0)
+            worker.entries_latency_histo.add_latency(
+                (time.perf_counter_ns() - t0) // 1000)
+        worker.live_ops.num_entries_done += 1
+    worker.got_phase_work = got_work

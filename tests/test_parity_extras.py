@@ -1,0 +1,111 @@
+"""Parity extras: path brace expansion, S3 metadata phases (ACL, tagging,
+versioning, object-lock), SSE headers, host rotation, svcelapsed."""
+
+import pytest
+
+from elbencho_tpu.cli import main
+from elbencho_tpu.config.args import BenchConfig
+from elbencho_tpu.phases import BenchPhase
+from elbencho_tpu.testing.mock_s3 import MockS3Server
+
+
+@pytest.fixture(scope="module")
+def mock_s3():
+    server = MockS3Server().start()
+    yield server
+    server.stop()
+
+
+def run_cli(mock_s3, args):
+    return main(args + ["--nolive", "--s3endpoints", mock_s3.endpoint])
+
+
+def test_path_brace_expansion(tmp_path):
+    for i in range(1, 4):
+        (tmp_path / f"dir{i}").mkdir()
+    cfg = BenchConfig(paths=[f"{tmp_path}/dir{{1..3}}"])
+    cfg.derive()
+    assert cfg.paths == [f"{tmp_path}/dir{i}" for i in (1, 2, 3)]
+    # --nopathexp disables it
+    cfg2 = BenchConfig(paths=["/x/{1..3}"], no_path_expansion=True)
+    cfg2.derive(probe_paths=False)
+    assert cfg2.paths == ["/x/{1..3}"]
+
+
+def test_phase_ordering_with_s3_metadata():
+    cfg = BenchConfig(run_create_dirs=True, run_create_files=True,
+                      run_read_files=True, run_delete_files=True,
+                      run_delete_dirs=True, run_s3_acl_put=True,
+                      run_s3_acl_get=True, run_s3_bucket_acl_put=True,
+                      run_s3_bucket_acl_get=True,
+                      run_s3_object_tagging=True,
+                      run_s3_bucket_tagging=True)
+    # read-only runs must not schedule the mutating metadata phases
+    ro = BenchConfig(run_read_files=True, run_s3_object_tagging=True,
+                     run_s3_bucket_tagging=True)
+    ro_phases = ro.enabled_phases()
+    assert BenchPhase.PUT_OBJ_MD not in ro_phases
+    assert BenchPhase.DEL_OBJ_MD not in ro_phases
+    assert BenchPhase.PUT_BUCKET_MD not in ro_phases
+    assert BenchPhase.GET_OBJ_MD in ro_phases  # get-only timing is fine
+    phases = cfg.enabled_phases()
+    order = {p: i for i, p in enumerate(phases)}
+    # creates before metadata before deletes (reference ordering table)
+    assert order[BenchPhase.CREATEDIRS] < order[BenchPhase.PUTBUCKETACL]
+    assert order[BenchPhase.PUT_BUCKET_MD] < order[BenchPhase.CREATEFILES]
+    assert order[BenchPhase.CREATEFILES] < order[BenchPhase.PUT_OBJ_MD]
+    assert order[BenchPhase.PUT_OBJ_MD] < order[BenchPhase.GET_OBJ_MD]
+    assert order[BenchPhase.READFILES] < order[BenchPhase.DEL_OBJ_MD]
+    assert order[BenchPhase.DEL_OBJ_MD] < order[BenchPhase.DELETEFILES]
+    assert order[BenchPhase.DEL_BUCKET_MD] < order[BenchPhase.DELETEDIRS]
+
+
+def test_s3_object_acl_and_tagging_phases(mock_s3, capsys):
+    rc = run_cli(mock_s3, ["-w", "-d", "-F", "--s3aclput", "--s3aclget",
+                           "--s3otag", "--s3otagverify", "-t", "1",
+                           "-n", "1", "-N", "2", "-s", "4K", "-b", "4K",
+                           "s3://md1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for phase in ("PUTOBJACL", "GETOBJACL", "PUTOBJMD", "GETOBJMD",
+                  "DELOBJMD"):
+        assert phase in out, f"missing {phase}"
+
+
+def test_s3_bucket_metadata_phases(mock_s3, capsys):
+    rc = run_cli(mock_s3, ["-w", "-d", "-F", "-D", "--s3btag",
+                           "--s3btagverify",
+                           "--s3bversion", "--s3bversionverify",
+                           "--s3olockcfg", "--s3olockcfgverify",
+                           "--s3baclput", "--s3baclget", "-t", "1",
+                           "-n", "1", "-N", "1", "-s", "4K", "-b", "4K",
+                           "s3://md2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for phase in ("PUTBUCKETMD", "GETBUCKETMD", "DELBUCKETMD", "PUTBACL",
+                  "GETBACL"):
+        assert phase in out, f"missing {phase}"
+
+
+def test_s3_sse_headers_accepted(mock_s3):
+    rc = run_cli(mock_s3, ["-w", "-d", "--s3sse", "-t", "1", "-n", "1",
+                           "-N", "1", "-s", "32K", "-b", "8K", "s3://sse"])
+    assert rc == 0
+
+
+def test_0usec_warning(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    reset_native_engine_cache()
+    target = tmp_path / "f"
+    # tiny blocks on tmpfs easily complete in 0us
+    rc = main(["-w", "-r", "-t", "1", "-s", "64K", "-b", "512", "--nolive",
+               str(target)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # with --no0usecerr the warning is silenced
+    rc = main(["-w", "-r", "-t", "1", "-s", "64K", "-b", "512",
+               "--no0usecerr", "--nolive", str(target)])
+    assert rc == 0
+    out2 = capsys.readouterr().out
+    assert "WARNING" not in out2
