@@ -1,0 +1,29 @@
+# elbencho-tpu container image (reference: Dockerfile + build_helpers/docker).
+# CPU-only by default; for the TPU data path install the jax TPU wheel in a
+# derived image or mount a site-dir that provides the PJRT plugin.
+#
+#   docker build -t elbencho-tpu .
+#   docker run --rm -v /mnt/bench:/mnt/bench elbencho-tpu \
+#       -w -r -t 4 -s 1G -b 1M /mnt/bench/testfile
+#
+# Service mode (one per storage client host):
+#   docker run --rm --network host elbencho-tpu --service --foreground
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir numpy
+
+WORKDIR /opt/elbencho-tpu
+COPY elbencho_tpu ./elbencho_tpu
+COPY csrc ./csrc
+COPY tools ./tools
+COPY dist/elbencho-tpu.bash-completion /etc/bash_completion.d/elbencho-tpu
+
+RUN make -C csrc
+
+ENV PYTHONPATH=/opt/elbencho-tpu
+ENTRYPOINT ["python", "-m", "elbencho_tpu"]
+CMD ["--help"]

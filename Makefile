@@ -2,7 +2,7 @@
 # the C++ build; here the Python package needs no build and the native
 # engine lives in csrc/)
 
-.PHONY: all native native-tsan test test-fast bench docs clean
+.PHONY: all native native-tsan test test-fast bench docs clean deb rpm docker
 
 all: native
 
@@ -26,6 +26,21 @@ bench: native
 
 docs:
 	python tools/generate-usage-docs
+
+# packaging (reference: make deb / make rpm / Docker images)
+deb: native
+	bash packaging/make-deb.sh
+
+rpm: native
+	@command -v rpmbuild >/dev/null || \
+		{ echo "rpmbuild not installed"; exit 1; }
+	rpmbuild -bb --define "_sourcedir $(CURDIR)" \
+		--define "pkg_version $$(sed -n 's/^version = \"\(.*\)\"/\1/p' \
+		pyproject.toml)" packaging/elbencho-tpu.spec
+
+docker:
+	@command -v docker >/dev/null || { echo "docker not installed"; exit 1; }
+	docker build -t elbencho-tpu .
 
 clean:
 	$(MAKE) -C csrc clean

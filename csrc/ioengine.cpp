@@ -13,11 +13,17 @@
 //     iodepth, then harvest completions (bounded-wait so interrupts are
 //     noticed) and refill. Each ring slot gets its own 4 KiB-aligned
 //     buffer, O_DIRECT-safe.
+//   - engine=uring: io_uring (io_uring_setup/io_uring_enter raw syscalls,
+//     no liburing dependency), same seed/refill semantics at any iodepth —
+//     the idiomatic modern async path (SURVEY.md section 7 step 4).
 //
 // ABI (all out-params caller-allocated):
 //   ioengine_run_block_loop(fd, offsets, lengths, n, is_write, buf,
 //                           buf_size, iodepth, out_lat_usec, out_bytes,
 //                           interrupt_flag) -> 0 or -errno
+//   ioengine_run_block_loop2(... , engine) — engine: 0=auto (sync if
+//     iodepth<=1 else aio), 1=sync, 2=aio, 3=io_uring
+//   ioengine_uring_supported() -> 1 if the kernel accepts io_uring_setup
 // Build: make -C csrc  (g++ -O2 -shared -fPIC)
 
 #include <cerrno>
@@ -27,6 +33,8 @@
 #include <ctime>
 
 #include <linux/aio_abi.h>
+#include <linux/io_uring.h>
+#include <sys/mman.h>
 #include <sys/syscall.h>
 #include <unistd.h>
 
@@ -216,20 +224,288 @@ int run_aio_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
     return ret;
 }
 
+// ---------------------------------------------------------------------------
+// io_uring path (raw syscalls; no liburing)
+
+inline int sys_io_uring_setup(unsigned entries, io_uring_params* p) {
+    return static_cast<int>(syscall(__NR_io_uring_setup, entries, p));
+}
+inline int sys_io_uring_enter(int ring_fd, unsigned to_submit,
+                              unsigned min_complete, unsigned flags,
+                              const void* arg, size_t argsz) {
+    return static_cast<int>(syscall(__NR_io_uring_enter, ring_fd, to_submit,
+                                    min_complete, flags, arg, argsz));
+}
+
+#ifndef IORING_ENTER_EXT_ARG
+#define IORING_ENTER_EXT_ARG (1U << 3)
+#endif
+#ifndef IORING_FEAT_EXT_ARG
+#define IORING_FEAT_EXT_ARG (1U << 8)
+#endif
+
+// defined locally in case the image's linux/io_uring.h predates 5.11
+struct UringGetEventsArg {
+    uint64_t sigmask;
+    uint32_t sigmask_sz;
+    uint32_t pad;
+    uint64_t ts;
+};
+
+struct UringSlot {
+    char* buf;
+    uint64_t submit_usec;
+    uint64_t block_idx;
+};
+
+// mmap'd ring state; unmap-all on destruction
+struct UringRings {
+    int ring_fd = -1;
+    void* sq_ptr = nullptr;
+    void* cq_ptr = nullptr;
+    io_uring_sqe* sqes = nullptr;
+    size_t sq_sz = 0, cq_sz = 0, sqes_sz = 0;
+    // ring pointers (into sq_ptr/cq_ptr)
+    unsigned* sq_tail = nullptr;
+    unsigned* sq_mask = nullptr;
+    unsigned* sq_array = nullptr;
+    unsigned* cq_head = nullptr;
+    unsigned* cq_tail = nullptr;
+    unsigned* cq_mask = nullptr;
+    io_uring_cqe* cqes = nullptr;
+
+    ~UringRings() {
+        if (sqes)
+            munmap(sqes, sqes_sz);
+        if (cq_ptr && cq_ptr != sq_ptr)
+            munmap(cq_ptr, cq_sz);
+        if (sq_ptr)
+            munmap(sq_ptr, sq_sz);
+        if (ring_fd >= 0)
+            close(ring_fd);
+    }
+
+    int init(unsigned entries) {
+        io_uring_params p;
+        memset(&p, 0, sizeof(p));
+        ring_fd = sys_io_uring_setup(entries, &p);
+        if (ring_fd < 0)
+            return -errno;
+        // the bounded-wait loops need EXT_ARG timeouts (5.11+); without
+        // them a blocking GETEVENTS could never notice interrupts
+        if (!(p.features & IORING_FEAT_EXT_ARG))
+            return -ENOSYS;
+        sq_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+        cq_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+        const bool single_mmap = p.features & IORING_FEAT_SINGLE_MMAP;
+        if (single_mmap)
+            sq_sz = cq_sz = (sq_sz > cq_sz ? sq_sz : cq_sz);
+        sq_ptr = mmap(nullptr, sq_sz, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_SQ_RING);
+        if (sq_ptr == MAP_FAILED) {
+            sq_ptr = nullptr;
+            return -errno;
+        }
+        if (single_mmap) {
+            cq_ptr = sq_ptr;
+        } else {
+            cq_ptr = mmap(nullptr, cq_sz, PROT_READ | PROT_WRITE,
+                          MAP_SHARED | MAP_POPULATE, ring_fd,
+                          IORING_OFF_CQ_RING);
+            if (cq_ptr == MAP_FAILED) {
+                cq_ptr = nullptr;
+                return -errno;
+            }
+        }
+        sqes_sz = p.sq_entries * sizeof(io_uring_sqe);
+        void* sq_mem = mmap(nullptr, sqes_sz, PROT_READ | PROT_WRITE,
+                            MAP_SHARED | MAP_POPULATE, ring_fd,
+                            IORING_OFF_SQES);
+        if (sq_mem == MAP_FAILED)
+            return -errno;
+        sqes = static_cast<io_uring_sqe*>(sq_mem);
+        char* sq = static_cast<char*>(sq_ptr);
+        char* cq = static_cast<char*>(cq_ptr);
+        sq_tail = reinterpret_cast<unsigned*>(sq + p.sq_off.tail);
+        sq_mask = reinterpret_cast<unsigned*>(sq + p.sq_off.ring_mask);
+        sq_array = reinterpret_cast<unsigned*>(sq + p.sq_off.array);
+        cq_head = reinterpret_cast<unsigned*>(cq + p.cq_off.head);
+        cq_tail = reinterpret_cast<unsigned*>(cq + p.cq_off.tail);
+        cq_mask = reinterpret_cast<unsigned*>(cq + p.cq_off.ring_mask);
+        cqes = reinterpret_cast<io_uring_cqe*>(cq + p.cq_off.cqes);
+        return 0;
+    }
+};
+
+int run_uring_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
+                   uint64_t n, int is_write, const char* src_buf,
+                   uint64_t buf_size, int iodepth, uint64_t* out_lat_usec,
+                   uint64_t* out_bytes, volatile int* interrupt_flag) {
+    if (iodepth < 1)
+        iodepth = 1;
+    UringRings ring;
+    int ret = ring.init(static_cast<unsigned>(iodepth));
+    if (ret != 0)
+        return ret;
+
+    UringSlot* slots = new UringSlot[iodepth];
+    for (int i = 0; i < iodepth; ++i)
+        slots[i].buf = nullptr;
+    int allocated = 0;
+    for (; allocated < iodepth; ++allocated) {
+        void* p = nullptr;
+        if (posix_memalign(&p, kAlign, buf_size) != 0) {
+            ret = -ENOMEM;
+            break;
+        }
+        slots[allocated].buf = static_cast<char*>(p);
+        if (is_write)
+            memcpy(slots[allocated].buf, src_buf, buf_size);
+    }
+
+    uint64_t next_submit = 0;
+    uint64_t completed = 0;
+    uint64_t bytes_done = 0;
+    int queued = 0;     // SQEs written to the ring but not yet submitted
+    int in_flight = 0;  // ops the kernel owns (submitted, not yet reaped) —
+                        // ONLY these can DMA into slot buffers
+
+    // queue one block on a free slot; sq tail advance is published with a
+    // release store (kernel reads it with acquire semantics)
+    auto queue_one = [&](UringSlot& s) {
+        const unsigned tail = *ring.sq_tail;
+        const unsigned idx = tail & *ring.sq_mask;
+        io_uring_sqe* sqe = &ring.sqes[idx];
+        memset(sqe, 0, sizeof(*sqe));
+        sqe->opcode = is_write ? IORING_OP_WRITE : IORING_OP_READ;
+        sqe->fd = fd;
+        sqe->addr = reinterpret_cast<uint64_t>(s.buf);
+        sqe->len = static_cast<uint32_t>(lengths[next_submit]);
+        sqe->off = offsets[next_submit];
+        sqe->user_data = reinterpret_cast<uint64_t>(&s);
+        ring.sq_array[idx] = idx;
+        s.submit_usec = now_usec();
+        s.block_idx = next_submit;
+        __atomic_store_n(ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
+        ++next_submit;
+        ++queued;
+    };
+
+    if (ret == 0) {
+        // seed the ring up to iodepth
+        while (queued < iodepth && next_submit < n)
+            queue_one(slots[queued]);
+
+        while (ret == 0 && completed < n) {
+            if (interrupt_flag && *interrupt_flag)
+                break;
+            // submit anything queued and wait (bounded, for interrupts)
+            timespec ts = {1, 0};
+            UringGetEventsArg arg;
+            memset(&arg, 0, sizeof(arg));
+            arg.ts = reinterpret_cast<uint64_t>(&ts);
+            int res = sys_io_uring_enter(
+                ring.ring_fd, static_cast<unsigned>(queued), 1,
+                IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
+                sizeof(arg));
+            if (res < 0 && errno != ETIME) {
+                if (errno == EINTR)
+                    continue;
+                ret = -errno;
+                break;
+            }
+            if (res > 0) {  // enter returns the number of SQEs consumed
+                in_flight += res;
+                queued -= res;
+            }
+            // reap completions; refill freed slots
+            unsigned head = *ring.cq_head;
+            const unsigned tail =
+                __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
+            const uint64_t t_now = now_usec();
+            while (head != tail && ret == 0) {
+                const io_uring_cqe& cqe = ring.cqes[head & *ring.cq_mask];
+                UringSlot* s = reinterpret_cast<UringSlot*>(cqe.user_data);
+                ++head;
+                --in_flight;  // every reaped cqe leaves the ring, error or not
+                if (cqe.res < 0) {
+                    ret = cqe.res;
+                } else if (static_cast<uint64_t>(cqe.res)
+                           != lengths[s->block_idx]) {
+                    ret = -EIO;
+                } else {
+                    out_lat_usec[s->block_idx] = t_now - s->submit_usec;
+                    bytes_done += static_cast<uint64_t>(cqe.res);
+                    ++completed;
+                    if (next_submit < n)
+                        queue_one(*s);  // refill the freed slot
+                }
+            }
+            __atomic_store_n(ring.cq_head, head, __ATOMIC_RELEASE);
+        }
+    }
+
+    // drain in-flight ops before buffers are freed (interrupt/error path):
+    // the kernel may still be DMA-ing into slot buffers, so we must wait
+    // for every outstanding completion however long it takes — freeing
+    // early would be a use-after-free. Only an unrecoverable enter error
+    // aborts the drain, and then the slot buffers are deliberately leaked.
+    bool drain_failed = false;
+    while (in_flight > 0) {
+        unsigned head = *ring.cq_head;
+        const unsigned tail = __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
+        if (head == tail) {
+            timespec ts = {1, 0};
+            UringGetEventsArg arg;
+            memset(&arg, 0, sizeof(arg));
+            arg.ts = reinterpret_cast<uint64_t>(&ts);
+            if (sys_io_uring_enter(
+                    ring.ring_fd, 0, 1,
+                    IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG,
+                    &arg, sizeof(arg)) < 0
+                    && errno != ETIME && errno != EINTR) {
+                drain_failed = true;
+                break;
+            }
+            continue;
+        }
+        while (head != tail) {
+            ++head;
+            --in_flight;
+        }
+        __atomic_store_n(ring.cq_head, head, __ATOMIC_RELEASE);
+    }
+    if (!drain_failed)
+        for (int i = 0; i < allocated; ++i)
+            free(slots[i].buf);
+    delete[] slots;
+    *out_bytes = bytes_done;
+    return ret;
+}
+
 }  // namespace
 
 extern "C" {
 
-int ioengine_run_block_loop(int fd, const uint64_t* offsets,
-                            const uint64_t* lengths, uint64_t n,
-                            int is_write, void* buf, uint64_t buf_size,
-                            int iodepth, uint64_t* out_lat_usec,
-                            uint64_t* out_bytes, int* interrupt_flag) {
+// engine selector values for ioengine_run_block_loop2
+enum { ENGINE_AUTO = 0, ENGINE_SYNC = 1, ENGINE_AIO = 2, ENGINE_URING = 3 };
+
+int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
+                             const uint64_t* lengths, uint64_t n,
+                             int is_write, void* buf, uint64_t buf_size,
+                             int iodepth, uint64_t* out_lat_usec,
+                             uint64_t* out_bytes, int* interrupt_flag,
+                             int engine) {
     if (n == 0) {
         *out_bytes = 0;
         return 0;
     }
-    if (iodepth <= 1)
+    if (engine == ENGINE_URING)
+        return run_uring_loop(fd, offsets, lengths, n, is_write,
+                              static_cast<const char*>(buf), buf_size,
+                              iodepth, out_lat_usec, out_bytes,
+                              interrupt_flag);
+    if (engine == ENGINE_SYNC || (engine == ENGINE_AUTO && iodepth <= 1))
         return run_sync_loop(fd, offsets, lengths, n, is_write,
                              static_cast<char*>(buf), out_lat_usec,
                              out_bytes, interrupt_flag);
@@ -238,7 +514,32 @@ int ioengine_run_block_loop(int fd, const uint64_t* offsets,
                         out_lat_usec, out_bytes, interrupt_flag);
 }
 
+int ioengine_run_block_loop(int fd, const uint64_t* offsets,
+                            const uint64_t* lengths, uint64_t n,
+                            int is_write, void* buf, uint64_t buf_size,
+                            int iodepth, uint64_t* out_lat_usec,
+                            uint64_t* out_bytes, int* interrupt_flag) {
+    return ioengine_run_block_loop2(fd, offsets, lengths, n, is_write, buf,
+                                    buf_size, iodepth, out_lat_usec,
+                                    out_bytes, interrupt_flag, ENGINE_AUTO);
+}
+
+// 1 if this kernel accepts io_uring_setup (it may be compiled out or
+// disabled via the io_uring_disabled sysctl) AND provides EXT_ARG timed
+// waits (5.11+), which the engine's interruptible wait loops require
+int ioengine_uring_supported() {
+    io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    int fd = sys_io_uring_setup(1, &p);
+    if (fd < 0)
+        return 0;
+    close(fd);
+    return (p.features & IORING_FEAT_EXT_ARG) ? 1 : 0;
+}
+
 // engine self-description for diagnostics / tests
-const char* ioengine_version() { return "elbencho-tpu ioengine 1 (sync+aio)"; }
+const char* ioengine_version() {
+    return "elbencho-tpu ioengine 2 (sync+aio+uring)";
+}
 
 }  // extern "C"

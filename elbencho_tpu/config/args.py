@@ -71,6 +71,9 @@ FLAG_DEFS = [
      "Number of bytes per read/write op"),
     ("iodepth", None, "io_depth", "int", 1, "large",
      "Async I/O depth (queued ops per thread; 1 = sync I/O)"),
+    ("ioengine", None, "io_engine", "str", "auto", "large",
+     "Native block-loop engine: auto|sync|aio|uring (auto = sync when "
+     "iodepth is 1, kernel AIO otherwise)"),
 
     # access pattern
     ("rand", None, "use_random_offsets", "bool", False, "large",
@@ -115,6 +118,9 @@ FLAG_DEFS = [
      "Show dirs/s in write phase results"),
     ("nodelerr", None, "ignore_delete_errors", "bool", False, "misc",
      "Do not treat deletion of non-existing files as error"),
+    ("hdfs", None, "use_hdfs", "bool", False, "misc",
+     "Use HDFS for file/dir benchmark paths (alternative to hdfs:// "
+     "path prefix)"),
     ("no0usecerr", None, "ignore_0usec_errors", "bool", False, "misc",
      "Do not warn about operations completing in 0 microseconds"),
     ("nopathexp", None, "no_path_expansion", "bool", False, "misc",
@@ -229,6 +235,8 @@ FLAG_DEFS = [
      "File with shared secret for service authorization"),
     ("svcelapsed", None, "show_svc_elapsed", "bool", False, "dist",
      "Show per-service elapsed times in results"),
+    ("svcping", None, "show_svc_ping", "bool", False, "dist",
+     "Show per-service control-plane round-trip latency in live stats"),
     ("rotatehosts", None, "rotate_hosts_num", "int", 0, "dist",
      "Rotate hosts list by this many positions between phases"),
     ("datasetthreads", None, "num_dataset_threads_override", "int", 0, "dist",
@@ -237,6 +245,15 @@ FLAG_DEFS = [
      "Synchronized start time (HH:MM[:SS] UTC or unix timestamp)"),
     ("netdevs", None, "netdevs_str", "str", "", "dist",
      "Comma-separated network devices for netbench client binding"),
+    ("servers", None, "servers_str", "str", "", "dist",
+     "Comma-separated service hosts acting as netbench servers "
+     "(host[:port]); combined with --clients this replaces --hosts"),
+    ("clients", None, "clients_str", "str", "", "dist",
+     "Comma-separated service hosts acting as netbench clients"),
+    ("serversfile", None, "servers_file_path", "str", "", "dist",
+     "File with line-separated netbench server hosts"),
+    ("clientsfile", None, "clients_file_path", "str", "", "dist",
+     "File with line-separated netbench client hosts"),
     ("netbenchservers", None, "num_netbench_servers", "int", 1, "dist",
      "Number of hosts acting as netbench servers"),
     ("respsize", None, "netbench_response_size", "size", 1, "dist",
@@ -300,6 +317,8 @@ FLAG_DEFS = [
      "Comma-separated S3 endpoint URLs"),
     ("s3key", None, "s3_access_key", "str", "", "s3", "S3 access key"),
     ("s3secret", None, "s3_secret_key", "str", "", "s3", "S3 secret key"),
+    ("s3sessiontoken", None, "s3_session_token", "str", "", "s3",
+     "S3 session token for temporary credentials (x-amz-security-token)"),
     ("s3region", None, "s3_region", "str", "us-east-1", "s3", "S3 region"),
     ("s3objprefix", None, "s3_object_prefix", "str", "", "s3",
      "Prefix for object names in bucket"),
@@ -438,16 +457,46 @@ class BenchConfig(BenchConfigBase):
         self.derived_done = True
         return self
 
-    def _parse_hosts(self) -> None:
+    @staticmethod
+    def _read_hosts(hosts_str: str, file_path: str) -> "list[str]":
         hosts: "list[str]" = []
-        if self.hosts_file_path:
-            with open(self.hosts_file_path) as f:
+        if file_path:
+            with open(file_path) as f:
                 hosts += [ln.strip() for ln in f
                           if ln.strip() and not ln.startswith("#")]
-        if self.hosts_str:
-            hosts += [h.strip() for h in self.hosts_str.split(",") if h.strip()]
+        if hosts_str:
+            hosts += [h.strip() for h in hosts_str.split(",") if h.strip()]
+        return hosts
+
+    def _parse_hosts(self) -> None:
+        hosts = self._read_hosts(self.hosts_str, self.hosts_file_path)
+        # netbench topology via explicit --servers/--clients lists
+        # (reference: parseHosts, ProgArgs.cpp:2343-2460 — servers first,
+        # clients last, numNetBenchServers = len(servers))
+        servers = self._read_hosts(self.servers_str, self.servers_file_path)
+        clients = self._read_hosts(self.clients_str, self.clients_file_path)
+        if servers or clients:
+            if not self.run_netbench:
+                raise ConfigError(
+                    "--servers/--clients are netbench-mode flags "
+                    "(use --hosts otherwise)")
+            if hosts:
+                raise ConfigError(
+                    "--hosts and --servers/--clients are mutually exclusive")
+            if not servers or not clients:
+                raise ConfigError(
+                    "netbench needs both --servers and --clients")
+            if self.num_hosts_limit >= 0:
+                raise ConfigError(
+                    "--numhosts cannot be combined with --servers/"
+                    "--clients (it would truncate the merged list and "
+                    "silently drop clients)")
+            hosts = servers + clients
+            self.num_netbench_servers = len(servers)
         if 0 <= self.num_hosts_limit < len(hosts):
             hosts = hosts[:self.num_hosts_limit]
+        if len(set(hosts)) != len(hosts):
+            raise ConfigError("list of hosts contains duplicates")
         self.hosts = hosts
 
     @staticmethod
@@ -462,11 +511,17 @@ class BenchConfig(BenchConfigBase):
             if not m:
                 out.append(p)
                 continue
-            lo, hi = int(m.group(1)), int(m.group(2))
+            lo_str, hi_str = m.group(1), m.group(2)
+            lo, hi = int(lo_str), int(hi_str)
+            # bash-style zero-padding: {01..03} -> 01 02 03; bash pads to
+            # the widest endpoint when either has a leading zero
+            width = max(len(lo_str), len(hi_str)) \
+                if lo_str.startswith("0") or hi_str.startswith("0") else 0
             step = 1 if hi >= lo else -1
             for i in range(lo, hi + step, step):
+                num = str(i).zfill(width)
                 out.extend(BenchConfig._expand_path_braces(
-                    [p[:m.start()] + str(i) + p[m.end():]]))
+                    [p[:m.start()] + num + p[m.end():]]))
         return out
 
     def _init_bench_mode(self) -> None:
@@ -483,9 +538,10 @@ class BenchConfig(BenchConfigBase):
             self.paths = [p[len("s3://"):] if p.startswith("s3://") else p
                           for p in self.paths]
             return
-        if any(p.startswith("hdfs://") for p in self.paths):
+        if self.use_hdfs or any(p.startswith("hdfs://") for p in self.paths):
             self.bench_mode = BenchMode.HDFS
-            self.paths = [p[len("hdfs://"):] for p in self.paths]
+            self.paths = [p[len("hdfs://"):] if p.startswith("hdfs://")
+                          else p for p in self.paths]
             return
         self.paths = [p[len("file://"):] if p.startswith("file://") else p
                       for p in self.paths]
@@ -556,6 +612,14 @@ class BenchConfig(BenchConfigBase):
                 raise ConfigError(
                     "direct I/O requires file size and block size to be "
                     "multiples of 512 bytes (use --nodiocheck to override)")
+        if self.io_engine not in ("auto", "sync", "aio", "uring"):
+            raise ConfigError("--ioengine must be auto|sync|aio|uring")
+        if self.io_engine == "sync" and self.io_depth > 1:
+            raise ConfigError("--ioengine sync requires --iodepth 1")
+        if self.io_engine != "auto" and self.bench_mode != BenchMode.POSIX:
+            raise ConfigError(
+                "--ioengine selects the native POSIX block-loop engine; "
+                "it does not apply to S3/HDFS/netbench modes")
         if self.rwmix_read_pct and not (0 <= self.rwmix_read_pct <= 100):
             raise ConfigError("--rwmixpct must be in 0..100")
         if self.num_rwmix_read_threads >= max(1, self.num_threads):
@@ -673,6 +737,13 @@ class BenchConfig(BenchConfigBase):
         d["hosts_file_path"] = ""
         d["run_as_service"] = False
         d["num_dataset_threads_override"] = self.num_dataset_threads
+        if self.assign_tpu_per_service and self.tpu_ids:
+            # --tpuperservice: round-robin chips across service instances —
+            # each service gets ONE chip from the list instead of all
+            # workers sharing it (reference: --gpuperservice, ProgArgs.h:378)
+            host_idx = service_rank_offset // max(self.num_threads, 1)
+            d["tpu_ids_str"] = str(
+                self.tpu_ids[host_idx % len(self.tpu_ids)])
         if self.run_netbench and self.hosts:
             # netbench topology: server data port = service port + 1000
             # (reference: LocalWorker.cpp:646 servers listen on svc+1000)
@@ -723,6 +794,14 @@ HELP_CATEGORIES = {
     "help-all": None,  # all categories
 }
 
+# reference long-flag spellings accepted as aliases, so command lines
+# written for the reference keep working (alias -> our canonical flag)
+REF_FLAG_ALIASES = {
+    "dropcache": "dropcaches",       # reference: ARG_DROPCACHESPHASE_LONG
+    "nodetach": "foreground",        # reference: ARG_NODETACH_LONG
+    "numservers": "netbenchservers",  # reference: ARG_NUMSERVERS_LONG
+}
+
 
 def build_arg_parser():
     import argparse
@@ -741,6 +820,8 @@ def build_arg_parser():
                         help="Show version and build info")
     for flag, short, dest, kind, default, _cat, help_txt in FLAG_DEFS:
         names = [f"--{flag}"] + ([f"-{short}"] if short else [])
+        names += [f"--{alias}" for alias, target in REF_FLAG_ALIASES.items()
+                  if target == flag]
         if kind == "bool":
             parser.add_argument(*names, dest=dest, action="store_true",
                                 default=default, help=help_txt)

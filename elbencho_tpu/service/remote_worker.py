@@ -92,6 +92,7 @@ class RemoteWorker(Worker):
         self.cfg = shared.config
         self.host = host
         self.host_idx = host_idx
+        self.last_ping_usec = 0  # --svcping: last /status RTT
         pw_hash = ""
         if self.cfg.svc_password_file:
             pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
@@ -187,7 +188,11 @@ class RemoteWorker(Worker):
         max_interval = max(self.cfg.svc_update_interval_ms, 25) / 1000.0
         while True:
             self.check_interruption_request(force=True)
+            t0 = time.monotonic()
             status, stats = self.client.get_json(proto.PATH_STATUS)
+            # --svcping: the /status round-trip IS the service ping
+            # (reference fullscreen shows per-service latency, --svcping)
+            self.last_ping_usec = int((time.monotonic() - t0) * 1e6)
             if status != 200:
                 raise WorkerRemoteException(
                     f"status poll on {self.host} failed ({status})")

@@ -138,40 +138,135 @@ class Statistics:
             if self._fullscreen_active:
                 print("\x1b[2J\x1b[H", end="", flush=True)
                 self._fullscreen_active = False
+                self._exit_fullscreen_keys()
             elif not cfg.single_line_live_stats_no_erase:
                 print("\r\x1b[2K", end="", flush=True)
+
+    #: worker rows per fullscreen frame (scrollable window)
+    _FS_ROWS = 40
 
     def _render_fullscreen(self, phase, elapsed, rate, ops_per_s, unit,
                            div, done) -> None:
         """Fullscreen per-worker live table (ANSI, dependency-free analogue
-        of the reference's ftxui screen, Statistics.cpp:716-1249)."""
+        of the reference's ftxui screen, Statistics.cpp:716-1249). Arrow /
+        PgUp / PgDn / Home keys scroll the worker rows."""
         cfg = self.cfg
         shared = self.manager.shared
+        workers = self.manager.workers
+        self._poll_fullscreen_keys(len(workers))
+        scroll = getattr(self, "_fs_scroll", 0)
         lines = []
         s3 = cfg.bench_mode == BenchMode.S3
         lines.append(
             f"Phase: {phase_name(phase, s3)}   Elapsed: {elapsed}s   "
-            f"Done: {done}/{len(self.manager.workers)}")
+            f"Done: {done}/{len(workers)}")
         lines.append(f"Total: {rate:,.0f} {unit}/s  {ops_per_s:,.0f} IOPS"
                      + (f"  CPU: {shared.cpu_util.update():.0f}%"
                         if cfg.show_cpu_util else ""))
+        if cfg.show_svc_ping and cfg.hosts:
+            # --svcping: control-plane /status RTT per service
+            pings = [f"{w.host}={w.last_ping_usec / 1000:.1f}ms"
+                     for w in workers if hasattr(w, "last_ping_usec")]
+            if pings:
+                lines.append("Service ping: " + "  ".join(pings))
         lines.append("")
         lines.append(f"{'Rank':>6} {'Entries':>10} {unit:>10} {'IOPS':>12} "
                      f"{'State':>8}")
-        for w in self.manager.workers[:40]:  # cap rows to screen height
+        window = workers[scroll:scroll + self._FS_ROWS]
+        for w in window:
             state = "done" if w.phase_finished else "run"
             lines.append(
                 f"{w.rank:>6} {w.live_ops.num_entries_done:>10} "
                 f"{w.live_ops.num_bytes_done / div:>10,.0f} "
                 f"{w.live_ops.num_iops_done:>12,} {state:>8}")
-        if len(self.manager.workers) > 40:
-            lines.append(f"... {len(self.manager.workers) - 40} more "
-                         f"workers not shown")
+        hidden = len(workers) - len(window)
+        if hidden > 0:
+            lines.append(f"... showing {scroll}..{scroll + len(window) - 1} "
+                         f"of {len(workers)} workers (arrow keys / PgUp / "
+                         f"PgDn scroll)")
         frame = "\x1b[H" + "\x1b[2K" + "\n\x1b[2K".join(lines) + "\x1b[J"
         if not self._fullscreen_active:
             print("\x1b[2J", end="")
             self._fullscreen_active = True
+            self._enter_fullscreen_keys()
         print(frame, end="", flush=True)
+
+    # -- fullscreen keyboard navigation (reference: ftxui arrow-key rows) ----
+
+    def _enter_fullscreen_keys(self) -> None:
+        """Put stdin into cbreak so arrow keys arrive without Enter; restored
+        by close()/_exit_fullscreen_keys."""
+        self._fs_scroll = 0
+        self._fs_old_termios = None
+        try:
+            import termios
+            import tty
+            if sys.stdin.isatty():
+                fd = sys.stdin.fileno()
+                self._fs_old_termios = (fd, termios.tcgetattr(fd))
+                tty.setcbreak(fd)
+        except (ImportError, OSError):
+            pass
+
+    def _exit_fullscreen_keys(self) -> None:
+        old = getattr(self, "_fs_old_termios", None)
+        if old is not None:
+            try:
+                import termios
+                termios.tcsetattr(old[0], termios.TCSADRAIN, old[1])
+            except (ImportError, OSError):
+                pass
+            self._fs_old_termios = None
+
+    def _poll_fullscreen_keys(self, num_workers: int) -> None:
+        """Non-blocking read of pending key escape sequences; updates the
+        scroll offset window over the per-worker rows."""
+        if getattr(self, "_fs_old_termios", None) is None:
+            return
+        import select
+        scroll = getattr(self, "_fs_scroll", 0)
+        max_scroll = max(num_workers - self._FS_ROWS, 0)
+        buf = b""
+        try:
+            while select.select([sys.stdin], [], [], 0)[0]:
+                chunk = os.read(sys.stdin.fileno(), 64)
+                if not chunk:
+                    break
+                buf += chunk
+        except OSError:
+            pass
+        # parse sequence-by-sequence: auto-repeat delivers several escape
+        # sequences per read, so the buffer must be consumed incrementally
+        i = 0
+        while i < len(buf):
+            seq, step = self._match_key_seq(buf[i:])
+            i += step
+            if seq in ("up", "k"):
+                scroll -= 1
+            elif seq in ("down", "j"):
+                scroll += 1
+            elif seq in ("pgup", "\x02"):
+                scroll -= self._FS_ROWS
+            elif seq in ("pgdn", "\x06"):
+                scroll += self._FS_ROWS
+            elif seq in ("home", "g"):
+                scroll = 0
+            elif seq in ("end", "G"):
+                scroll = max_scroll
+        self._fs_scroll = min(max(scroll, 0), max_scroll)
+
+    _ESC_SEQS = {b"\x1b[A": "up", b"\x1b[B": "down", b"\x1b[5~": "pgup",
+                 b"\x1b[6~": "pgdn", b"\x1b[H": "home", b"\x1b[F": "end"}
+
+    @classmethod
+    def _match_key_seq(cls, buf: bytes) -> "tuple[str, int]":
+        """Match one key at the front of buf -> (name, bytes_consumed)."""
+        if buf[:1] == b"\x1b":
+            for seq, name in cls._ESC_SEQS.items():
+                if buf.startswith(seq):
+                    return name, len(seq)
+            return "", 1  # unknown escape: skip the ESC byte
+        return chr(buf[0]), 1
 
     def _write_live_files(self, phase, entries, num_bytes, iops,
                           elapsed) -> None:
@@ -368,12 +463,15 @@ class Statistics:
                                  f"{_fmt_elapsed_usec(max(w.elapsed_usec_vec))}")
             if parts:
                 rows.append(f"{'':12}Service elapsed  : {', '.join(parts)}")
-        if not cfg.ignore_0usec_errors and res.iops_histo.num_values \
-                and res.iops_histo.min_micro == 0:
+        if not cfg.ignore_0usec_errors and res.num_workers \
+                and res.first_done_usec == 0:
+            # reference semantics (Statistics.cpp:2186): warn when the
+            # fastest worker finished in 0 microseconds — the whole phase
+            # was too short to measure
             rows.append(
-                f"{'':12}WARNING: operations completed in 0 microseconds; "
-                f"results may be bogus (caching?). --no0usecerr silences "
-                f"this.")
+                f"{'':12}WARNING: phase completed in 0 microseconds; "
+                f"results may be bogus (too little work?). --no0usecerr "
+                f"silences this.")
         for row in rows:
             print(row)
             self._print_to_res_file(row)
@@ -542,6 +640,7 @@ class Statistics:
         }
 
     def close(self) -> None:
+        self._exit_fullscreen_keys()
         for fh in (self._live_csv_fh, self._live_json_fh):
             if fh is not None and fh is not sys.stdout:
                 fh.close()

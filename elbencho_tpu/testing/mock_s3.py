@@ -153,6 +153,17 @@ def _make_handler(state: MockS3State):
                     self._error(404, "NoSuchBucket", bucket)
                     return
                 if not key:
+                    if "uploads" in query:
+                        ups = "".join(
+                            f"<Upload><Key>{u['key']}</Key>"
+                            f"<UploadId>{uid}</UploadId></Upload>"
+                            for uid, u in sorted(state.uploads.items())
+                            if u["bucket"] == bucket)
+                        self._reply(200, (
+                            "<ListMultipartUploadsResult>"
+                            "<IsTruncated>false</IsTruncated>"
+                            f"{ups}</ListMultipartUploadsResult>").encode())
+                        return
                     if "acl" in query:
                         self._reply(200, b"<AccessControlPolicy>"
                                          b"</AccessControlPolicy>")

@@ -58,7 +58,8 @@ class S3Client:
     def __init__(self, endpoint: str, access_key: str = "",
                  secret_key: str = "", region: str = "us-east-1",
                  virtual_hosted: bool = False, timeout: float = 60.0,
-                 num_retries: int = 0, interrupt_check=None):
+                 num_retries: int = 0, interrupt_check=None,
+                 session_token: str = ""):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "http://" + endpoint)
         self.scheme = parsed.scheme or "http"
@@ -66,6 +67,7 @@ class S3Client:
         self.port = parsed.port or (443 if self.scheme == "https" else 80)
         self.access_key = access_key
         self.secret_key = secret_key
+        self.session_token = session_token
         self.region = region
         self.virtual_hosted = virtual_hosted
         self.timeout = timeout
@@ -98,6 +100,9 @@ class S3Client:
         date_stamp = now.strftime("%Y%m%d")
         headers["x-amz-date"] = amz_date
         headers["x-amz-content-sha256"] = payload_hash
+        if self.session_token:
+            # temporary credentials: token is part of the signed headers
+            headers["x-amz-security-token"] = self.session_token
         canon_query = "&".join(
             f"{urllib.parse.quote(k, safe='')}"
             f"={urllib.parse.quote(str(v), safe='')}"
@@ -338,6 +343,32 @@ class S3Client:
                                        query={"uploadId": upload_id})
         self._check(status, data)
 
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               key_marker: str = "",
+                               upload_id_marker: str = ""
+                               ) -> "tuple[list[tuple[str, str]], str, str]":
+        """ListMultipartUploads page -> ([(key, upload_id)...],
+        next_key_marker, next_upload_id_marker); empty markers = done."""
+        query = {"uploads": ""}
+        if prefix:
+            query["prefix"] = prefix
+        if key_marker:
+            query["key-marker"] = key_marker
+        if upload_id_marker:
+            query["upload-id-marker"] = upload_id_marker
+        status, _, data = self.request("GET", bucket, query=query)
+        self._check(status, data, ok=(200,))
+        root = ET.fromstring(data)
+        ns = _xml_ns(root)
+        uploads = [(el.findtext(f"{ns}Key", default=""),
+                    el.findtext(f"{ns}UploadId", default=""))
+                   for el in root.findall(f"{ns}Upload")]
+        truncated = root.findtext(f"{ns}IsTruncated", default="false")
+        if truncated.lower() == "true":
+            return (uploads, root.findtext(f"{ns}NextKeyMarker", default=""),
+                    root.findtext(f"{ns}NextUploadIdMarker", default=""))
+        return uploads, "", ""
+
     # -- metadata ops (ACL / tagging) ----------------------------------------
 
     def put_object_tagging(self, bucket: str, key: str,
@@ -491,6 +522,7 @@ def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
                     secret_key=secret_key, region=cfg.s3_region,
                     virtual_hosted=cfg.s3_virtual_hosted,
                     num_retries=cfg.s3_num_retries,
-                    interrupt_check=interrupt_check)
+                    interrupt_check=interrupt_check,
+                    session_token=cfg.s3_session_token)
 
 

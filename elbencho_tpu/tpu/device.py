@@ -69,7 +69,8 @@ class TpuWorkerContext:
     _FILL_POOL_BLOCKS = 4
 
     def __init__(self, chip_id: int, block_size: int, direct: bool = False,
-                 verify_on_device: bool = False, pipeline_depth: int = 1):
+                 verify_on_device: bool = False, pipeline_depth: int = 1,
+                 hbm_limit_pct: int = 90):
         jax = _get_jax()
         devices = jax.devices()
         if not devices:
@@ -80,6 +81,22 @@ class TpuWorkerContext:
         self.direct = direct
         self.verify_on_device = verify_on_device
         self.pipeline_depth = max(pipeline_depth, 1)
+        # --tpuhbmpct budget enforcement: resident HBM is the fill pool +
+        # the in-flight transfer ring + the last-ingested sink block. The
+        # pool shrinks and the pipeline depth is clamped to fit the budget;
+        # below the 3-block floor (1 pool/in-flight + 1 sink + 1 headroom)
+        # the block size is rejected outright.
+        self.hbm_budget_bytes = hbm_bytes_limit(self.device, hbm_limit_pct)
+        budget_blocks = self.hbm_budget_bytes // max(block_size, 1)
+        if budget_blocks < 3:
+            raise RuntimeError(
+                f"block size {block_size} exceeds the HBM staging budget "
+                f"of chip {chip_id} ({self.hbm_budget_bytes} bytes at "
+                f"--tpuhbmpct {hbm_limit_pct} fits fewer than 3 blocks)")
+        self._pool_blocks = min(self._FILL_POOL_BLOCKS,
+                                max(budget_blocks - 2, 1))
+        max_depth = max(budget_blocks - self._pool_blocks - 1, 1)
+        self.pipeline_depth = min(self.pipeline_depth, max_depth)
         self._key = jax.random.PRNGKey(chip_id)
         self._num_words = max(block_size // 4, 1)
         # write-source pool: filled ONCE on first use, like the reference's
@@ -129,7 +146,7 @@ class TpuWorkerContext:
         if not self._fill_pool:
             jax = _get_jax()
             from ..ops.fill import random_block_u32
-            for i in range(self._FILL_POOL_BLOCKS):
+            for i in range(self._pool_blocks):
                 key = jax.random.fold_in(self._key, i)
                 self._fill_pool.append(
                     random_block_u32(key, self._num_words))

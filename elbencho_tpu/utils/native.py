@@ -18,8 +18,15 @@ _lock = threading.Lock()
 _engine = None
 _engine_checked = False
 
-_SO_PATH = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "csrc", "libioengine.so")
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo checkout layout (csrc/ beside the package) — buildable via make
+_SO_PATH = os.path.join(os.path.dirname(_PKG_DIR), "csrc", "libioengine.so")
+# installed layout (deb/rpm/wheel ship the prebuilt .so inside the package)
+_SO_PATH_INSTALLED = os.path.join(_PKG_DIR, "_native", "libioengine.so")
+
+
+# engine selector values (must match csrc/ioengine.cpp)
+ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 
 
 class _NativeEngine:
@@ -27,8 +34,8 @@ class _NativeEngine:
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
-        lib.ioengine_run_block_loop.restype = ctypes.c_int
-        lib.ioengine_run_block_loop.argtypes = [
+        lib.ioengine_run_block_loop2.restype = ctypes.c_int
+        lib.ioengine_run_block_loop2.argtypes = [
             ctypes.c_int,                     # fd
             ctypes.POINTER(ctypes.c_uint64),  # offsets
             ctypes.POINTER(ctypes.c_uint64),  # lengths
@@ -40,11 +47,17 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: latencies (usec/block)
             ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
+            ctypes.c_int,                     # engine (ENGINE_CODES)
         ]
+        lib.ioengine_uring_supported.restype = ctypes.c_int
+        lib.ioengine_uring_supported.argtypes = []
+
+    def uring_supported(self) -> bool:
+        return bool(self._lib.ioengine_uring_supported())
 
     def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,
                        buf_addr: int, iodepth: int, worker,
-                       interrupt_flag=None) -> bool:
+                       interrupt_flag=None, engine: str = "auto") -> bool:
         n = len(offsets)
         off_arr = (ctypes.c_uint64 * n)(*offsets)
         len_arr = (ctypes.c_uint64 * n)(*lengths)
@@ -53,10 +66,11 @@ class _NativeEngine:
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))  # c_int(0) is falsy: no `or`!
         buf_size = max(lengths)
-        ret = self._lib.ioengine_run_block_loop(
+        ret = self._lib.ioengine_run_block_loop2(
             fd, off_arr, len_arr, n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), buf_size, iodepth,
-            lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt))
+            lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt),
+            ENGINE_CODES[engine])
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
         total_bytes = sum(lengths)
@@ -86,13 +100,16 @@ def get_native_engine() -> "_NativeEngine | None":
         if _engine_checked:
             return _engine
         if os.environ.get("ELBENCHO_TPU_NO_NATIVE") != "1":
-            if not os.path.exists(_SO_PATH):
+            if not os.path.exists(_SO_PATH) \
+                    and not os.path.exists(_SO_PATH_INSTALLED):
                 _try_build()
-            if os.path.exists(_SO_PATH):
-                try:
-                    _engine = _NativeEngine(ctypes.CDLL(_SO_PATH))
-                except OSError:
-                    _engine = None
+            for so in (_SO_PATH, _SO_PATH_INSTALLED):
+                if os.path.exists(so):
+                    try:
+                        _engine = _NativeEngine(ctypes.CDLL(so))
+                        break
+                    except (OSError, AttributeError):
+                        _engine = None
         _engine_checked = True
         return _engine
 

@@ -88,7 +88,8 @@ class LocalWorker(Worker):
             self._tpu = TpuWorkerContext(
                 chip_id=chip, block_size=cfg.block_size,
                 direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify,
-                pipeline_depth=max(cfg.io_depth, 1))
+                pipeline_depth=max(cfg.io_depth, 1),
+                hbm_limit_pct=cfg.tpu_hbm_limit_pct)
             needs_fill = (cfg.run_create_files
                           or (cfg.run_tpu_bench
                               and cfg.tpu_bench_pattern in ("d2h", "both")))
@@ -115,7 +116,17 @@ class LocalWorker(Worker):
         # load (and first time: build) the native engine here, OUTSIDE the
         # timed phase, so `make` never charges to a measured result
         from ..utils.native import get_native_engine
-        get_native_engine()
+        native = get_native_engine()
+        if cfg.io_engine != "auto":
+            # explicitly requested engines must never silently fall back
+            if native is None:
+                raise WorkerException(
+                    f"--ioengine {cfg.io_engine} requires the native "
+                    f"ioengine (csrc/libioengine.so failed to build/load)")
+            if cfg.io_engine == "uring" and not native.uring_supported():
+                raise WorkerException(
+                    "--ioengine uring: this kernel does not support "
+                    "io_uring (compiled out or disabled via sysctl)")
         self._prepared = True
 
     def cleanup(self) -> None:
@@ -521,6 +532,12 @@ class LocalWorker(Worker):
             if self._run_native_block_loop(native, fd, gen, is_write,
                                            file_offset_base):
                 return
+        if cfg.io_engine != "auto":
+            raise WorkerException(
+                f"--ioengine {cfg.io_engine} only supports the plain native "
+                f"block loop — incompatible with --verify/--verifydirect/"
+                f"--readinline/--rwmixpct/--blockvarpct/--opslog/rate "
+                f"limits/--tpuids/multi-file striping")
         num_bufs = len(self._io_bufs)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
         # the byte-ratio balancer only applies to the mixed WRITE phase
@@ -612,7 +629,8 @@ class LocalWorker(Worker):
             native.run_block_loop(
                 fd=fd, offsets=offsets, lengths=lengths, is_write=is_write,
                 buf_addr=self._buf_addr(), iodepth=self.cfg.io_depth,
-                worker=self, interrupt_flag=self._native_interrupt)
+                worker=self, interrupt_flag=self._native_interrupt,
+                engine=self.cfg.io_engine)
 
         for off, length in gen:
             offsets.append(file_offset_base + off)

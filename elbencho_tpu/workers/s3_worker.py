@@ -543,20 +543,29 @@ def _bucket_acl(worker, phase: BenchPhase) -> None:
 _BENCH_TAGS = {"elbencho-tpu": "bench"}
 
 
+_sse_c_cache: "dict[str, dict]" = {}
+
+
 def _sse_c_headers(cfg) -> "dict":
     """SSE-C customer-key headers — required on BOTH upload and every
-    retrieval of an SSE-C object (GET/HEAD)."""
-    if not cfg.s3_sse_customer_key:
+    retrieval of an SSE-C object (GET/HEAD). Computed once per key (the
+    MD5/base64 round-trip must not tax the measured hot path)."""
+    key = cfg.s3_sse_customer_key
+    if not key:
         return {}
-    import base64
-    import hashlib
-    raw = base64.b64decode(cfg.s3_sse_customer_key)
-    return {
-        "x-amz-server-side-encryption-customer-algorithm": "AES256",
-        "x-amz-server-side-encryption-customer-key": cfg.s3_sse_customer_key,
-        "x-amz-server-side-encryption-customer-key-MD5":
-            base64.b64encode(hashlib.md5(raw).digest()).decode(),
-    }
+    cached = _sse_c_cache.get(key)
+    if cached is None:
+        import base64
+        import hashlib
+        raw = base64.b64decode(key)
+        cached = {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key": key,
+            "x-amz-server-side-encryption-customer-key-MD5":
+                base64.b64encode(hashlib.md5(raw).digest()).decode(),
+        }
+        _sse_c_cache[key] = cached
+    return cached
 
 
 def _sse_headers(cfg) -> "dict | None":

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Build a .deb of elbencho-tpu with dpkg-deb (no debhelper dependency).
+#
+# Reference packaging: packaging/ deb templates + `make deb`. Layout:
+#   /usr/lib/python3/dist-packages/elbencho_tpu/   (incl. libioengine.so)
+#   /usr/bin/elbencho-tpu + tools
+#   /usr/share/bash-completion/completions/elbencho-tpu
+#
+# Usage: packaging/make-deb.sh [outdir]   (default: ./packaging/out)
+
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${1:-$REPO/packaging/out}"
+VERSION="$(sed -n 's/^version = "\(.*\)"/\1/p' "$REPO/pyproject.toml")"
+ARCH="$(dpkg --print-architecture 2>/dev/null || echo amd64)"
+STAGE="$(mktemp -d)"
+trap 'rm -rf "$STAGE"' EXIT
+
+PKGROOT="$STAGE/elbencho-tpu_${VERSION}_${ARCH}"
+PYDEST="$PKGROOT/usr/lib/python3/dist-packages"
+mkdir -p "$PKGROOT/DEBIAN" "$PYDEST" "$PKGROOT/usr/bin" \
+    "$PKGROOT/usr/share/bash-completion/completions" \
+    "$PKGROOT/usr/share/doc/elbencho-tpu"
+
+# native engine: build fresh so the .so matches this source tree
+make -C "$REPO/csrc" >/dev/null
+
+cp -a "$REPO/elbencho_tpu" "$PYDEST/"
+find "$PYDEST" -name __pycache__ -type d -exec rm -rf {} +
+# ship the native engine inside the package dir; utils/native.py probes
+# this location after the csrc/ checkout location
+mkdir -p "$PYDEST/elbencho_tpu/_native"
+cp "$REPO/csrc/libioengine.so" "$PYDEST/elbencho_tpu/_native/"
+
+cat > "$PKGROOT/usr/bin/elbencho-tpu" <<'LAUNCHER'
+#!/usr/bin/env python3
+import sys
+from elbencho_tpu.cli import main
+sys.exit(main())
+LAUNCHER
+chmod 755 "$PKGROOT/usr/bin/elbencho-tpu"
+
+for tool in elbencho-tpu-chart elbencho-tpu-summarize-json \
+        elbencho-tpu-scan-path elbencho-tpu-sweep elbencho-tpu-cleanup-mpu; do
+    # the tools' repo-relative sys.path bootstrap resolves to /usr when
+    # installed — harmless, dist-packages provides the real package
+    cp "$REPO/tools/$tool" "$PKGROOT/usr/bin/$tool"
+    chmod 755 "$PKGROOT/usr/bin/$tool"
+done
+
+cp "$REPO/dist/elbencho-tpu.bash-completion" \
+    "$PKGROOT/usr/share/bash-completion/completions/elbencho-tpu"
+cp "$REPO/README.md" "$PKGROOT/usr/share/doc/elbencho-tpu/"
+
+INSTALLED_SIZE=$(du -sk "$PKGROOT/usr" | cut -f1)
+cat > "$PKGROOT/DEBIAN/control" <<EOF
+Package: elbencho-tpu
+Version: $VERSION
+Section: utils
+Priority: optional
+Architecture: $ARCH
+Depends: python3 (>= 3.10), python3-numpy
+Recommends: python3-jax
+Installed-Size: $INSTALLED_SIZE
+Maintainer: elbencho-tpu developers
+Description: TPU-native distributed storage benchmark
+ Benchmark for files, block devices, S3/object storage and networks with
+ a TPU HBM data path (host->HBM DMA staging), distributed service mode
+ across TPU-VM hosts, live statistics and latency histograms.
+EOF
+
+mkdir -p "$OUT"
+dpkg-deb --build --root-owner-group "$PKGROOT" \
+    "$OUT/elbencho-tpu_${VERSION}_${ARCH}.deb"
+echo "built: $OUT/elbencho-tpu_${VERSION}_${ARCH}.deb"

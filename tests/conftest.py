@@ -2,6 +2,7 @@
 tests run without TPU hardware (SURVEY.md environment notes)."""
 
 import os
+import sys
 
 # this box pins JAX_PLATFORMS=axon (one real TPU chip); tests must run on
 # the virtual 8-device CPU mesh instead
@@ -10,3 +11,18 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon TPU plugin (loaded via PYTHONPATH=/root/.axon_site) blocks jax
+# initialization when its tunnel is unreachable — even with platform=cpu.
+# Tests are CPU-only by design, so strip it from this process and from the
+# environment that subprocess-based tests inherit.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and ".axon_site" not in p)
+
+# the plugin's sitecustomize imports jax at interpreter startup, so jax's
+# config captured JAX_PLATFORMS=axon before this file ran — the env-var
+# override above is too late for THIS process. Force the config directly.
+if "jax" in sys.modules:
+    sys.modules["jax"].config.update("jax_platforms", "cpu")

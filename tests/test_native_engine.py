@@ -23,18 +23,22 @@ def engine():
     return lib
 
 
+ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
+
+
 def _run(lib, fd, offsets, lengths, is_write, buf, iodepth=1,
-         interrupt=None):
+         interrupt=None, engine="auto"):
     n = len(offsets)
     off_arr = (ctypes.c_uint64 * n)(*offsets)
     len_arr = (ctypes.c_uint64 * n)(*lengths)
     lat_arr = (ctypes.c_uint64 * n)()
     bytes_done = ctypes.c_uint64(0)
     flag = interrupt or ctypes.c_int(0)
-    ret = lib.ioengine_run_block_loop(
+    ret = lib.ioengine_run_block_loop2(
         fd, off_arr, len_arr, ctypes.c_uint64(n), 1 if is_write else 0,
         buf, ctypes.c_uint64(max(lengths)), iodepth, lat_arr,
-        ctypes.byref(bytes_done), ctypes.byref(flag))
+        ctypes.byref(bytes_done), ctypes.byref(flag),
+        ENGINE_CODES[engine])
     return ret, bytes_done.value, list(lat_arr)
 
 
@@ -82,6 +86,82 @@ def test_aio_write_then_read(engine, tmp_path):
         assert ret == 0 and nbytes == 64 * 4096
     finally:
         os.close(fd)
+
+
+def _uring_supported(lib) -> bool:
+    lib.ioengine_uring_supported.restype = ctypes.c_int
+    return bool(lib.ioengine_uring_supported())
+
+
+def test_uring_write_then_read(engine, tmp_path):
+    if not _uring_supported(engine):
+        pytest.skip("kernel lacks io_uring")
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        buf = ctypes.create_string_buffer(b"\xcd" * 4096, 4096)
+        offsets = [i * 4096 for i in range(64)]
+        lengths = [4096] * 64
+        ret, nbytes, lats = _run(engine, fd, offsets, lengths, True, buf,
+                                 iodepth=8, engine="uring")
+        assert ret == 0
+        assert nbytes == 64 * 4096
+        assert os.path.getsize(path) == 64 * 4096
+        assert all(b == 0xCD for b in open(path, "rb").read(4096))
+        assert all(lat < 60_000_000 for lat in lats)  # sane latencies
+        ret, nbytes, _ = _run(engine, fd, offsets, lengths, False, buf,
+                              iodepth=8, engine="uring")
+        assert ret == 0 and nbytes == 64 * 4096
+        # iodepth 1 must work too (ring of one)
+        ret, nbytes, _ = _run(engine, fd, offsets[:4], lengths[:4], False,
+                              buf, iodepth=1, engine="uring")
+        assert ret == 0 and nbytes == 4 * 4096
+    finally:
+        os.close(fd)
+
+
+def test_uring_interrupt_and_bad_fd(engine, tmp_path):
+    if not _uring_supported(engine):
+        pytest.skip("kernel lacks io_uring")
+    buf = ctypes.create_string_buffer(4096)
+    ret, _, _ = _run(engine, 9999, [0], [4096], False, buf, iodepth=4,
+                     engine="uring")
+    assert ret < 0  # -EBADF via cqe.res
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        flag = ctypes.c_int(1)
+        ret, nbytes, _ = _run(engine, fd, [i * 4096 for i in range(1000)],
+                              [4096] * 1000, True, buf, iodepth=4,
+                              interrupt=flag, engine="uring")
+        assert ret == 0
+        assert nbytes == 0
+    finally:
+        os.close(fd)
+
+
+def test_cli_ioengine_flag(tmp_path, monkeypatch):
+    """--ioengine uring end-to-end through the CLI; --ioengine sync with
+    iodepth > 1 is rejected at config time."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils.native import (get_native_engine,
+                                           reset_native_engine_cache)
+    reset_native_engine_cache()
+    native = get_native_engine()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    from elbencho_tpu.cli import main
+    target = tmp_path / "f"
+    if native.uring_supported():
+        rc = main(["-w", "-r", "-t", "1", "-s", "1M", "-b", "64K",
+                   "--iodepth", "4", "--ioengine", "uring", "--nolive",
+                   str(target)])
+        assert rc == 0
+        assert target.stat().st_size == 1 << 20
+    rc = main(["-w", "-t", "1", "-s", "1M", "-b", "64K", "--iodepth", "4",
+               "--ioengine", "sync", "--nolive", str(tmp_path / "g")])
+    assert rc != 0
+    reset_native_engine_cache()
 
 
 def test_error_on_bad_fd(engine):

@@ -32,6 +32,72 @@ def test_path_brace_expansion(tmp_path):
     assert cfg2.paths == ["/x/{1..3}"]
 
 
+def test_path_brace_expansion_zero_padding():
+    """bash pads to the widest endpoint when either has a leading zero."""
+    expand = BenchConfig._expand_path_braces
+    assert expand(["f{01..3}"]) == ["f01", "f02", "f03"]
+    assert expand(["f{1..010}"]) == [f"f{i:03d}" for i in range(1, 11)]
+    assert expand(["f{8..011}"]) == ["f008", "f009", "f010", "f011"]
+    # no leading zero anywhere: no padding
+    assert expand(["f{9..11}"]) == ["f9", "f10", "f11"]
+
+
+def test_reference_flag_aliases():
+    """Reference long-flag spellings keep working: --dropcache,
+    --nodetach, --numservers, --hdfs."""
+    from elbencho_tpu.config.args import parse_cli
+    cfg, _ = parse_cli(["--dropcache", "--nodetach", "/tmp/x"])
+    assert cfg.run_drop_caches_phase
+    assert cfg.run_service_in_foreground
+    cfg2, _ = parse_cli(["--netbench", "--numservers", "2",
+                         "--hosts", "a,b,c", "/tmp/x"])
+    assert cfg2.num_netbench_servers == 2
+    cfg3, _ = parse_cli(["--hdfs", "-w", "-s", "4K", "bench"])
+    cfg3.derive(probe_paths=False)
+    from elbencho_tpu.phases import BenchMode
+    assert cfg3.bench_mode == BenchMode.HDFS
+
+
+def test_netbench_servers_clients_lists(tmp_path):
+    """--servers/--clients (and file variants) define the netbench host
+    topology: hosts = servers + clients, numservers = len(servers)
+    (reference: parseHosts, ProgArgs.cpp:2343-2460)."""
+    from elbencho_tpu.config.args import ConfigError, parse_cli
+    cfg, _ = parse_cli(["--netbench", "--servers", "s1:17001,s2",
+                        "--clients", "c1,c2,c3"])
+    cfg.derive(probe_paths=False)
+    assert cfg.hosts == ["s1:17001", "s2", "c1", "c2", "c3"]
+    assert cfg.num_netbench_servers == 2
+    # file variants merge with the comma lists
+    sf = tmp_path / "servers.txt"
+    sf.write_text("# comment\ns1\n")
+    cfg2, _ = parse_cli(["--netbench", "--serversfile", str(sf),
+                         "--clients", "c1"])
+    cfg2.derive(probe_paths=False)
+    assert cfg2.hosts == ["s1", "c1"]
+    assert cfg2.num_netbench_servers == 1
+    # mutually exclusive with --hosts; both halves required
+    with pytest.raises(ConfigError):
+        parse_cli(["--netbench", "--servers", "s1", "--clients", "c1",
+                   "--hosts", "x"])[0].derive(probe_paths=False)
+    with pytest.raises(ConfigError):
+        parse_cli(["--netbench", "--servers", "s1"])[0].derive(
+            probe_paths=False)
+    with pytest.raises(ConfigError):
+        parse_cli(["--hosts", "a,a"])[0].derive(probe_paths=False)
+
+
+def test_s3_session_token_signed(mock_s3):
+    """--s3sessiontoken adds x-amz-security-token to signed requests."""
+    from elbencho_tpu.toolkits.s3_tk import S3Client
+    client = S3Client(mock_s3.endpoint, access_key="k", secret_key="s",
+                      session_token="tok123")
+    headers: dict = {}
+    client._sign_v4("GET", "/b", {}, headers, "UNSIGNED")
+    assert headers["x-amz-security-token"] == "tok123"
+    assert "x-amz-security-token" in headers["Authorization"]
+
+
 def test_phase_ordering_with_s3_metadata():
     cfg = BenchConfig(run_create_dirs=True, run_create_files=True,
                       run_read_files=True, run_delete_files=True,
@@ -93,19 +159,27 @@ def test_s3_sse_headers_accepted(mock_s3):
     assert rc == 0
 
 
-def test_0usec_warning(tmp_path, capsys, monkeypatch):
-    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
-    from elbencho_tpu.utils.native import reset_native_engine_cache
-    reset_native_engine_cache()
-    target = tmp_path / "f"
-    # tiny blocks on tmpfs easily complete in 0us
-    rc = main(["-w", "-r", "-t", "1", "-s", "64K", "-b", "512", "--nolive",
-               str(target)])
-    assert rc == 0
-    out = capsys.readouterr().out
-    # with --no0usecerr the warning is silenced
-    rc = main(["-w", "-r", "-t", "1", "-s", "64K", "-b", "512",
-               "--no0usecerr", "--nolive", str(target)])
-    assert rc == 0
-    out2 = capsys.readouterr().out
-    assert "WARNING" not in out2
+def test_0usec_warning(capsys):
+    """Warning appears exactly when the fastest worker's elapsed is 0us
+    (reference semantics) and --no0usecerr silences it."""
+    from elbencho_tpu.stats.statistics import Statistics
+    from elbencho_tpu.workers.manager import WorkerManager
+    from elbencho_tpu.workers.local_worker import LocalWorker
+
+    def render(extra_args):
+        cfg = BenchConfig(run_create_files=True, paths=["/tmp"],
+                          **extra_args)
+        cfg.derive(probe_paths=False)
+        manager = WorkerManager(cfg)
+        worker = LocalWorker(manager.shared, 0)
+        worker.stonewall_taken = True
+        worker.stonewall_elapsed_usec = 0
+        worker.elapsed_usec_vec = [0]
+        worker.live_ops.num_entries_done = 1
+        manager.workers = [worker]
+        stats = Statistics(cfg, manager)
+        stats.print_phase_results(BenchPhase.CREATEFILES)
+        return capsys.readouterr().out
+
+    assert "WARNING" in render({})
+    assert "WARNING" not in render({"ignore_0usec_errors": True})

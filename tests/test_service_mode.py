@@ -158,6 +158,42 @@ def test_duplicate_startphase_idempotent(services, tmp_path):
         assert r.status == 200
 
 
+def test_rotate_hosts(services, tmp_path):
+    """--rotatehosts 1: host order shifts between phases, re-ranking the
+    services (reference: Coordinator::rotateHosts :384-408 — needs a fresh
+    prep phase). Verified at the Coordinator level against live services:
+    after _rotate_hosts the rank-0 slot must belong to the OTHER host and
+    the rebuilt manager's remote workers must be re-prepared."""
+    from elbencho_tpu.config.args import BenchConfig
+    from elbencho_tpu.coordinator import Coordinator
+
+    host_list = [f"127.0.0.1:{p}" for p in services]
+    cfg = BenchConfig(run_create_files=True, num_threads=1, num_dirs=1,
+                      num_files=1, file_size=8192, block_size=8192,
+                      rotate_hosts_num=1, hosts_str=",".join(host_list),
+                      paths=[str(tmp_path)])
+    cfg.derive(probe_paths=False)
+    coord = Coordinator(cfg)
+    coord.manager.prepare_threads()
+    before = [(w.host, w.host_idx) for w in coord.manager.workers]
+    old_manager = coord.manager
+    try:
+        coord._rotate_hosts()
+        after = [(w.host, w.host_idx) for w in coord.manager.workers]
+    finally:
+        coord.manager.join_all_threads()
+    assert before == list(zip(host_list, range(2)))
+    # the second host now holds rank slot 0 (and thus rank offset 0)
+    assert after == [(host_list[1], 0), (host_list[0], 1)]
+    assert coord.manager is not old_manager  # fresh prep phase happened
+
+    # end-to-end: write then read with rotation still succeeds
+    rc = _master(["-w", "-d", "-r", "--rotatehosts", "1", "-t", "1",
+                  "-n", "1", "-N", "2", "-s", "8K", "-b", "8K",
+                  "--hosts", ",".join(host_list), str(tmp_path)])
+    assert rc == 0
+
+
 def test_quit_services(services):
     """--quit terminates the service processes."""
     hosts = ",".join(f"127.0.0.1:{p}" for p in services)

@@ -104,6 +104,37 @@ def test_statinline(tmp_path):
     assert rc == 0
 
 
+def test_cleanup_mpu_tool(tmp_path):
+    """elbencho-tpu-cleanup-mpu lists and aborts leftover multipart
+    uploads (reference: tools/s3-cleanup-mpu.py)."""
+    from elbencho_tpu.testing.mock_s3 import MockS3Server
+    from elbencho_tpu.toolkits.s3_tk import S3Client
+    server = MockS3Server().start()
+    try:
+        client = S3Client(server.endpoint)
+        client.create_bucket("leftovers")
+        up1 = client.create_multipart_upload("leftovers", "obj1")
+        up2 = client.create_multipart_upload("leftovers", "obj2")
+        uploads, _, _ = client.list_multipart_uploads("leftovers")
+        assert sorted(k for k, _ in uploads) == ["obj1", "obj2"]
+        assert {u for _, u in uploads} == {up1, up2}
+        # dry run aborts nothing
+        res = _tool("elbencho-tpu-cleanup-mpu",
+                    ["--endpoint", server.endpoint, "--bucket", "leftovers",
+                     "--dry-run"])
+        assert res.returncode == 0, res.stderr
+        assert "would abort" in res.stdout
+        assert len(client.list_multipart_uploads("leftovers")[0]) == 2
+        # real run aborts both
+        res = _tool("elbencho-tpu-cleanup-mpu",
+                    ["--endpoint", server.endpoint, "--bucket", "leftovers"])
+        assert res.returncode == 0, res.stderr
+        assert "2 upload(s) aborted" in res.stdout
+        assert client.list_multipart_uploads("leftovers")[0] == []
+    finally:
+        server.stop()
+
+
 def test_netbench_requires_hosts_config_error(capsys):
     rc = main(["--netbench", "--nolive"])
     assert rc == 1

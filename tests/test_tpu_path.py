@@ -73,6 +73,45 @@ def test_host_to_device_pipelined_and_flush():
         pass  # CPU backend device_put is zero-copy and may alias the mmap
 
 
+def test_hbm_budget_clamps_pipeline_depth():
+    """--tpuhbmpct: the in-flight ring is clamped so fill pool + ring +
+    sink always fit the chip's staging budget; an over-budget block size
+    is rejected outright."""
+    from elbencho_tpu.tpu.device import hbm_bytes_limit
+
+    ctx = TpuWorkerContext(chip_id=0, block_size=4096, pipeline_depth=4)
+    budget = hbm_bytes_limit(ctx.device, 90)
+    assert ctx.hbm_budget_bytes == budget
+    assert ctx.pipeline_depth == 4  # tiny blocks: no clamping
+
+    # block size chosen so only ~2 blocks fit beyond pool+sink
+    big = budget // 7
+    ctx2 = TpuWorkerContext(chip_id=0, block_size=big, pipeline_depth=64)
+    assert ctx2.pipeline_depth == max(budget // big - 4 - 1, 1)
+
+    with pytest.raises(RuntimeError, match="HBM staging budget"):
+        TpuWorkerContext(chip_id=0, block_size=budget + 1)
+
+
+def test_tpu_per_service_round_robin():
+    """--tpuperservice: each service instance gets one chip, round-robin
+    (reference: --gpuperservice, ProgArgs.h:378)."""
+    from elbencho_tpu.config.args import BenchConfig
+
+    cfg = BenchConfig(run_read_files=True, num_threads=2, file_size=4096,
+                      block_size=4096, tpu_ids_str="0,1,2",
+                      assign_tpu_per_service=True, paths=["/tmp/x"])
+    cfg.derive(probe_paths=False)
+    chips = [BenchConfig.from_service_dict(
+        cfg.to_service_dict(service_rank_offset=i * cfg.num_threads)
+    ).tpu_ids for i in range(4)]
+    assert chips == [[0], [1], [2], [0]]
+    # without the flag every service sees the full list
+    cfg.assign_tpu_per_service = False
+    d = cfg.to_service_dict(service_rank_offset=2)
+    assert BenchConfig.from_service_dict(d).tpu_ids == [0, 1, 2]
+
+
 def test_device_fill_pool_cycles():
     ctx = TpuWorkerContext(chip_id=0, block_size=4096)
     buf1 = memoryview(bytearray(4096))
