@@ -356,6 +356,46 @@ FLAG_DEFS = [
      "Run object ACL put phase"),
     ("s3aclget", None, "run_s3_acl_get", "bool", False, "s3",
      "Run object ACL get phase"),
+    ("s3aclgrantee", None, "s3_acl_grantee", "str", "", "s3",
+     "ACL grantee; canned values (private, public-read, public-read-write, "
+     "authenticated-read) ignore grantee type/permissions"),
+    ("s3aclgtype", None, "s3_acl_grantee_type", "str", "", "s3",
+     "ACL grantee type: id|email|uri|group"),
+    ("s3aclgrants", None, "s3_acl_grants", "str", "", "s3",
+     "Comma-separated ACL grantee permissions: "
+     "none|full|read|write|racp|wacp"),
+    ("s3aclputinl", None, "do_s3_acl_put_inline", "bool", False, "s3",
+     "Set object ACL inline in upload requests (grantee as "
+     "'id=...'/'emailAddress=...'/'uri=...')"),
+    ("s3aclverify", None, "do_s3_acl_verify", "bool", False, "s3",
+     "Verify object/bucket ACLs against given grantee+permissions in the "
+     "ACL get phases"),
+    ("s3checksumalgo", None, "s3_checksum_algo", "str", "", "s3",
+     "Upload checksum algorithm: crc32|crc32c|sha1|sha256 "
+     "(x-amz-sdk-checksum-algorithm + per-request checksum header)"),
+    ("s3nompucompl", None, "s3_no_mpu_completion", "bool", False, "s3",
+     "Don't send CompleteMultipartUpload after uploading all parts "
+     "(cleanup later via elbencho-tpu-cleanup-mpu)"),
+    ("s3nompcheck", None, "s3_ignore_part_num_check", "bool", False, "s3",
+     "Don't check for multipart uploads exceeding 10,000 parts"),
+    ("s3multiignore404", None, "s3_ignore_mpu_completion_404", "bool",
+     False, "s3", "Ignore 404 responses to CompleteMultipartUpload "
+     "(upload already completed by a retried request)"),
+    ("s3fastget", None, "s3_fast_get", "bool", False, "s3",
+     "Discard downloaded object data unbuffered (incompatible with "
+     "--verify and --tpuids staging)"),
+    ("s3fastput", None, "s3_fast_put", "bool", False, "s3",
+     "Reduce upload CPU overhead (implies unsigned payloads)"),
+    ("s3nocompress", None, "s3_no_compression", "bool", False, "s3",
+     "Disable S3 request compression (accepted for reference parity; "
+     "this client never compresses)"),
+    ("s3mpusizevar", None, "s3_mpu_size_variance", "size", 0, "s3",
+     "Max bytes to randomly subtract from each MPU part (last part grows "
+     "to keep the object size)"),
+    ("s3log", None, "s3_log_level", "int", 0, "s3",
+     "S3 request log level (0=off; >0 logs each request to the log file)"),
+    ("s3logprefix", None, "s3_log_prefix", "str", "s3_", "s3",
+     "Path/filename prefix for the S3 request log (DATE.log appended)"),
     ("s3baclput", None, "run_s3_bucket_acl_put", "bool", False, "s3",
      "Run bucket ACL put phase"),
     ("s3baclget", None, "run_s3_bucket_acl_get", "bool", False, "s3",
@@ -647,6 +687,42 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--s3mpucomplphase requires --s3mpusharing (only shared "
                 "uploads defer completion to the MPUCOMPL phase)")
+        if self.s3_checksum_algo and self.s3_checksum_algo.lower() not in (
+                "crc32", "crc32c", "sha1", "sha256"):
+            raise ConfigError(
+                "--s3checksumalgo must be crc32|crc32c|sha1|sha256")
+        if self.s3_checksum_algo and self.s3_mpu_sharing:
+            raise ConfigError(
+                "--s3checksumalgo is not supported with --s3mpusharing "
+                "(shared completions don't track per-part checksums)")
+        if self.s3_acl_grantee_type and self.s3_acl_grantee_type not in (
+                "id", "email", "uri", "group"):
+            raise ConfigError("--s3aclgtype must be id|email|uri|group")
+        if self.s3_fast_get and (self.integrity_check_salt
+                                 or self.tpu_ids_str):
+            raise ConfigError(
+                "--s3fastget discards downloaded data — incompatible with "
+                "--verify and --tpuids staging")
+        if self.bench_mode == BenchMode.S3 and self.run_create_files \
+                and self.file_size and self.block_size \
+                and not self.s3_ignore_part_num_check \
+                and not self.s3_no_mpu \
+                and self.file_size > self.block_size \
+                and (self.file_size + self.block_size - 1) \
+                // self.block_size > 10000:
+            raise ConfigError(
+                "object size / block size exceeds 10,000 multipart parts "
+                "(the S3 protocol limit; --s3nompcheck to override)")
+        if self.s3_acl_grantee and (
+                self.run_s3_acl_put or self.run_s3_bucket_acl_put
+                or self.do_s3_acl_put_inline):
+            from ..toolkits.s3_tk import build_acl_headers
+            try:  # surface grant mistakes at config time, not mid-phase
+                build_acl_headers(self.s3_acl_grantee,
+                                  self.s3_acl_grantee_type,
+                                  self.s3_acl_grants)
+            except ValueError as err:
+                raise ConfigError(str(err)) from err
         if self.run_netbench:
             if not self.hosts and not self.netbench_total_hosts:
                 raise ConfigError(
@@ -800,6 +876,8 @@ REF_FLAG_ALIASES = {
     "dropcache": "dropcaches",       # reference: ARG_DROPCACHESPHASE_LONG
     "nodetach": "foreground",        # reference: ARG_NODETACH_LONG
     "numservers": "netbenchservers",  # reference: ARG_NUMSERVERS_LONG
+    "s3statdirs": "statdirs",        # "bucket attributes query phase"
+    "s3chksumalgo": "s3checksumalgo",  # reference hidden compat alias
 }
 
 

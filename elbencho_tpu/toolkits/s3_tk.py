@@ -51,6 +51,106 @@ class S3Error(Exception):
         self.code = code
 
 
+# -- ACL grants (reference: --s3aclgrantee/--s3aclgtype/--s3aclgrants) ------
+
+_CANNED_ACLS = ("private", "public-read", "public-read-write",
+                "authenticated-read")
+_ACL_GRANT_HEADERS = {
+    "read": "x-amz-grant-read",
+    "write": "x-amz-grant-write",
+    "racp": "x-amz-grant-read-acp",
+    "wacp": "x-amz-grant-write-acp",
+    "full": "x-amz-grant-full-control",
+}
+_ACL_GRANTEE_TYPE_KEYS = {"id": "id", "email": "emailAddress",
+                          "uri": "uri", "group": "uri"}
+
+
+def build_acl_headers(grantee: str, gtype: str, grants: str) -> "dict":
+    """ACL request headers: canned x-amz-acl for special grantee values,
+    x-amz-grant-* otherwise (reference: ProgArgs.h:286-297 value names)."""
+    if not grantee:
+        return {"x-amz-acl": "private"}
+    if grantee in _CANNED_ACLS:
+        return {"x-amz-acl": grantee}
+    if "=" in grantee:  # inline form "id=..."/"emailAddress=..."/"uri=..."
+        type_key, _, name = grantee.partition("=")
+        value = f'{type_key}="{name}"'
+    else:
+        if gtype not in _ACL_GRANTEE_TYPE_KEYS:
+            raise ValueError(
+                "ACL grantee needs --s3aclgtype id|email|uri|group")
+        value = f'{_ACL_GRANTEE_TYPE_KEYS[gtype]}="{grantee}"'
+    headers = {}
+    for perm in grants.split(","):
+        perm = perm.strip().lower()
+        if not perm or perm == "none":
+            continue
+        if perm not in _ACL_GRANT_HEADERS:
+            raise ValueError(f"unknown ACL permission: {perm!r}")
+        headers[_ACL_GRANT_HEADERS[perm]] = value
+    if not headers:
+        raise ValueError("ACL grantee given but no permissions "
+                         "(--s3aclgrants)")
+    return headers
+
+
+# -- upload checksums (reference: --s3checksumalgo, x-amz-checksum-*) -------
+
+_CRC32C_POLY = 0x82F63B78
+_crc32c_table: "list[int]" = []
+_native_crc32c = None
+
+
+def _crc32c(data: bytes) -> int:
+    """Castagnoli CRC32: native library when available (google-crc32c /
+    crc32c), else a table-driven pure-python fallback (slow for multi-MiB
+    blocks — fine for correctness, documented in --help)."""
+    global _native_crc32c
+    if _native_crc32c is None:
+        try:
+            import google_crc32c
+            _native_crc32c = lambda b: int.from_bytes(  # noqa: E731
+                google_crc32c.Checksum(b).digest(), "big")
+        except ImportError:
+            try:
+                import crc32c as _c32c_mod
+                _native_crc32c = _c32c_mod.crc32c
+            except ImportError:
+                _native_crc32c = False
+    if _native_crc32c:
+        return _native_crc32c(data)
+    if not _crc32c_table:
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ _CRC32C_POLY if crc & 1 else crc >> 1
+            _crc32c_table.append(crc)
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc = _crc32c_table[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def build_checksum_headers(algo: str, body: bytes) -> "dict":
+    """x-amz-sdk-checksum-algorithm + x-amz-checksum-<algo> (base64)."""
+    import base64
+    import zlib
+    algo = algo.lower()
+    if algo == "crc32":
+        digest = zlib.crc32(body).to_bytes(4, "big")
+    elif algo == "crc32c":
+        digest = _crc32c(body).to_bytes(4, "big")
+    elif algo == "sha1":
+        digest = hashlib.sha1(body).digest()
+    elif algo == "sha256":
+        digest = hashlib.sha256(body).digest()
+    else:
+        raise ValueError(f"unknown checksum algorithm: {algo!r}")
+    return {"x-amz-sdk-checksum-algorithm": algo.upper(),
+            f"x-amz-checksum-{algo}": base64.b64encode(digest).decode()}
+
+
 class S3Client:
     """One S3 endpoint connection (per worker; endpoint picked round-robin
     by worker rank like the reference's client factory)."""
@@ -59,7 +159,8 @@ class S3Client:
                  secret_key: str = "", region: str = "us-east-1",
                  virtual_hosted: bool = False, timeout: float = 60.0,
                  num_retries: int = 0, interrupt_check=None,
-                 session_token: str = ""):
+                 session_token: str = "", log_level: int = 0,
+                 log_prefix: str = "s3_", unsigned_payload: bool = False):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "http://" + endpoint)
         self.scheme = parsed.scheme or "http"
@@ -73,7 +174,28 @@ class S3Client:
         self.timeout = timeout
         self.num_retries = num_retries
         self.interrupt_check = interrupt_check
+        self.log_level = log_level
+        self.log_prefix = log_prefix
+        # --s3fastput / --s3sign 2: skip the per-request SHA256 of the
+        # payload (the dominant client-side CPU cost of uploads)
+        self.unsigned_payload = unsigned_payload
+        self._log_fh = None
         self._conn: "http.client.HTTPConnection | None" = None
+
+    def _log_request(self, method: str, bucket: str, key: str,
+                     status: int, num_bytes: int) -> None:
+        """--s3log: per-request trace file <prefix>DATE.log (reference:
+        --s3log/--s3logprefix SDK logging)."""
+        if not self.log_level:
+            return
+        if self._log_fh is None:
+            date = datetime.date.today().isoformat()
+            self._log_fh = open(f"{self.log_prefix}{date}.log", "a")
+        now = datetime.datetime.now().isoformat(timespec="milliseconds")
+        self._log_fh.write(
+            f"{now} {method} {self.host}:{self.port} /{bucket}/{key} "
+            f"-> {status} ({num_bytes}B)\n")
+        self._log_fh.flush()
 
     # -- low-level request --------------------------------------------------
 
@@ -88,6 +210,9 @@ class S3Client:
         if self._conn is not None:
             self._conn.close()
             self._conn = None
+        if self._log_fh is not None:
+            self._log_fh.close()
+            self._log_fh = None
 
     def _sign_v4(self, method: str, path: str, query: "dict[str, str]",
                  headers: "dict[str, str]", payload_hash: str) -> None:
@@ -156,6 +281,8 @@ class S3Client:
                 if attempt < self.num_retries:
                     _time.sleep(0.2 * (attempt + 1))
                 continue
+            self._log_request(method, bucket, key, status,
+                              len(body) if body else len(data))
             if status in self._RETRY_STATUSES and attempt < self.num_retries:
                 _time.sleep(0.2 * (attempt + 1))
                 continue
@@ -180,8 +307,11 @@ class S3Client:
                 path = "/"
         headers["Host"] = host if self.port in (80, 443) \
             else f"{host}:{self.port}"
-        payload_hash = hashlib.sha256(body).hexdigest() if body \
-            else _EMPTY_SHA256
+        if self.unsigned_payload and body:
+            payload_hash = "UNSIGNED-PAYLOAD"
+        else:
+            payload_hash = hashlib.sha256(body).hexdigest() if body \
+                else _EMPTY_SHA256
         self._sign_v4(method, path, query, headers, payload_hash)
         url = path
         if query:
@@ -246,6 +376,74 @@ class S3Client:
         if status not in (200, 206):
             self._check(status, data, ok=())
         return data
+
+    def get_object_discard(self, bucket: str, key: str,
+                           range_start: "int | None" = None,
+                           range_len: "int | None" = None,
+                           extra_headers: "dict | None" = None) -> int:
+        """--s3fastget: stream the body in chunks and drop it, returning
+        only the byte count (reference: useS3FastRead sends downloads to
+        /dev/null instead of a memory buffer). Same transient-error retry
+        and interrupt semantics as request()."""
+        import time as _time
+        last_err = None
+        for attempt in range(self.num_retries + 1):
+            if self.interrupt_check:
+                self.interrupt_check()
+            try:
+                status, total = self._get_discard_once(
+                    bucket, key, range_start, range_len, extra_headers)
+            except (OSError, http.client.HTTPException) as err:
+                last_err = err
+                if attempt < self.num_retries:
+                    _time.sleep(0.2 * (attempt + 1))
+                continue
+            if status in self._RETRY_STATUSES and attempt < self.num_retries:
+                _time.sleep(0.2 * (attempt + 1))
+                continue
+            return total
+        raise last_err if last_err is not None else S3Error(
+            503, "RetryExhausted", "request retries exhausted")
+
+    def _get_discard_once(self, bucket, key, range_start, range_len,
+                          extra_headers) -> "tuple[int, int]":
+        headers = dict(extra_headers or {})
+        if range_start is not None:
+            end = "" if range_len is None else str(range_start + range_len - 1)
+            headers["Range"] = f"bytes={range_start}-{end}"
+        if self.virtual_hosted and bucket:
+            host = f"{bucket}.{self.host}"
+            path = "/" + urllib.parse.quote(key) if key else "/"
+        else:
+            host = self.host
+            path = f"/{bucket}/" + urllib.parse.quote(key)
+        headers["Host"] = host if self.port in (80, 443) \
+            else f"{host}:{self.port}"
+        self._sign_v4("GET", path, {}, headers, _EMPTY_SHA256)
+        conn = self._connection()
+        try:
+            conn.request("GET", path, headers=headers)
+            resp = conn.getresponse()
+            if resp.status in self._RETRY_STATUSES:
+                resp.read()  # drain for keep-alive
+                return resp.status, 0
+            if resp.status not in (200, 206):
+                self._check(resp.status, resp.read(), ok=())
+            total = 0
+            chunks = 0
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                total += len(chunk)
+                chunks += 1
+                if self.interrupt_check and chunks % 16 == 0:
+                    self.interrupt_check()  # long streams stay abortable
+            self._log_request("GET", bucket, key, resp.status, total)
+            return resp.status, total
+        except (http.client.HTTPException, OSError):
+            self.close()
+            raise
 
     def head_object(self, bucket: str, key: str,
                     extra_headers: "dict | None" = None) -> "dict[str, str]":
@@ -324,12 +522,23 @@ class S3Client:
         self._check(status, data, ok=(200,))
         return headers.get("ETag", headers.get("etag", ""))
 
+    #: --s3checksumalgo algo -> CompleteMultipartUpload per-part element
+    CHECKSUM_XML_TAGS = {"crc32": "ChecksumCRC32", "crc32c": "ChecksumCRC32C",
+                         "sha1": "ChecksumSHA1", "sha256": "ChecksumSHA256"}
+
     def complete_multipart_upload(self, bucket: str, key: str,
-                                  upload_id: str,
-                                  parts: "list[tuple[int, str]]") -> None:
+                                  upload_id: str, parts,
+                                  checksum_algo: str = "") -> None:
+        """parts: (part_number, etag) tuples, or (part_number, etag,
+        checksum_b64) when the parts were uploaded with x-amz-checksum-*
+        headers — S3 then REQUIRES the per-part checksum in the completion
+        XML."""
+        tag = self.CHECKSUM_XML_TAGS.get(checksum_algo.lower(), "")
         parts_xml = "".join(
-            f"<Part><PartNumber>{num}</PartNumber><ETag>{etag}</ETag></Part>"
-            for num, etag in sorted(parts))
+            f"<Part><PartNumber>{p[0]}</PartNumber><ETag>{p[1]}</ETag>"
+            + (f"<{tag}>{p[2]}</{tag}>" if tag and len(p) > 2 else "")
+            + "</Part>"
+            for p in sorted(parts))
         body = (f"<CompleteMultipartUpload>{parts_xml}"
                 f"</CompleteMultipartUpload>").encode()
         status, _, data = self.request("POST", bucket, key,
@@ -384,10 +593,11 @@ class S3Client:
         self._check(status, data, ok=(200,))
         return _parse_tagging_xml(data)
 
-    def put_object_acl(self, bucket: str, key: str, acl: str) -> None:
+    def put_object_acl(self, bucket: str, key: str, acl: str = "",
+                       acl_headers: "dict | None" = None) -> None:
         status, _, data = self.request(
             "PUT", bucket, key, query={"acl": ""},
-            headers={"x-amz-acl": acl})
+            headers=acl_headers if acl_headers else {"x-amz-acl": acl})
         self._check(status, data, ok=(200,))
 
     def get_object_acl(self, bucket: str, key: str) -> bytes:
@@ -458,9 +668,11 @@ class S3Client:
         rule = root.find(f"{ns}Rule/{ns}DefaultRetention/{ns}Mode")
         return rule.text if rule is not None else ""
 
-    def put_bucket_acl(self, bucket: str, acl: str) -> None:
-        status, _, data = self.request("PUT", bucket, query={"acl": ""},
-                                       headers={"x-amz-acl": acl})
+    def put_bucket_acl(self, bucket: str, acl: str = "",
+                       acl_headers: "dict | None" = None) -> None:
+        status, _, data = self.request(
+            "PUT", bucket, query={"acl": ""},
+            headers=acl_headers if acl_headers else {"x-amz-acl": acl})
         self._check(status, data, ok=(200,))
 
     def get_bucket_acl(self, bucket: str) -> bytes:
@@ -523,6 +735,10 @@ def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
                     virtual_hosted=cfg.s3_virtual_hosted,
                     num_retries=cfg.s3_num_retries,
                     interrupt_check=interrupt_check,
-                    session_token=cfg.s3_session_token)
+                    session_token=cfg.s3_session_token,
+                    log_level=cfg.s3_log_level,
+                    log_prefix=cfg.s3_log_prefix,
+                    unsigned_payload=(cfg.s3_fast_put
+                                      or cfg.s3_sign_policy == 2))
 
 

@@ -234,6 +234,7 @@ def _upload_object(worker, bucket: str, key: str) -> None:
     if cfg.s3_mpu_sharing and size > bs:
         _upload_object_shared_mpu(worker, bucket, key)
         return
+    algo = cfg.s3_checksum_algo.lower()
     if size <= bs or cfg.s3_no_mpu:
         if limiter:
             limiter.wait(size)
@@ -242,9 +243,11 @@ def _upload_object(worker, bucket: str, key: str) -> None:
         body = b"".join(
             _next_upload_block(worker, off, min(bs, size - off))
             for off in range(0, size, bs)) if size else b""
+        # checksum before t0: client-side hashing must not count as
+        # request latency
+        headers = _body_headers(cfg, body, _upload_init_headers(cfg))
         t0 = time.perf_counter_ns()
-        client.put_object(bucket, key, body,
-                          extra_headers=_sse_headers(cfg))
+        client.put_object(bucket, key, body, extra_headers=headers)
         worker.iops_latency_histo.add_latency(
             (time.perf_counter_ns() - t0) // 1000)
         worker.live_ops.num_bytes_done += size
@@ -252,31 +255,54 @@ def _upload_object(worker, bucket: str, key: str) -> None:
         worker._num_iops_submitted += 1
         return
     upload_id = client.create_multipart_upload(
-        bucket, key, extra_headers=_sse_headers(cfg))
-    parts: "list[tuple[int, str]]" = []
+        bucket, key, extra_headers=_mpu_init_headers(cfg))
+    parts: "list[tuple]" = []
     try:
         offset = 0
         part_number = 1
+        num_parts = (size + bs - 1) // bs
         while offset < size:
             worker.check_interruption_request()
-            length = min(bs, size - offset)
+            if part_number < num_parts:
+                length = min(bs, size - offset)
+                if cfg.s3_mpu_size_variance:
+                    # --s3mpusizevar: random shrink per non-final part;
+                    # the LAST part absorbs the difference (reference:
+                    # s3MpuSizeVariance, part count stays size/blocksize)
+                    shrink = worker._rand_offset_algo.next64() \
+                        % (min(cfg.s3_mpu_size_variance, length - 1) + 1)
+                    length -= shrink
+            else:
+                length = size - offset  # final part absorbs all shrinkage
             if limiter:
                 limiter.wait(length)
-            body = _next_upload_block(worker, offset, length)
+            if length <= bs:
+                body = _next_upload_block(worker, offset, length)
+            else:  # enlarged final part spans multiple fill blocks
+                body = b"".join(
+                    _next_upload_block(worker, offset + sub,
+                                       min(bs, length - sub))
+                    for sub in range(0, length, bs))
+            headers = _body_headers(cfg, body, _sse_c_headers(cfg) or None)
             t0 = time.perf_counter_ns()
             etag = client.upload_part(bucket, key, upload_id, part_number,
-                                      body,
-                                      extra_headers=_sse_c_headers(cfg)
-                                      or None)
+                                      body, extra_headers=headers)
             worker.iops_latency_histo.add_latency(
                 (time.perf_counter_ns() - t0) // 1000)
-            parts.append((part_number, etag))
+            if algo:  # completion XML must carry each part's checksum
+                parts.append((part_number, etag,
+                              headers[f"x-amz-checksum-{algo}"]))
+            else:
+                parts.append((part_number, etag))
             worker.live_ops.num_bytes_done += length
             worker.live_ops.num_iops_done += 1
             worker._num_iops_submitted += 1
             offset += length
             part_number += 1
-        client.complete_multipart_upload(bucket, key, upload_id, parts)
+        if cfg.s3_no_mpu_completion:
+            return  # --s3nompucompl: leave the upload incomplete on purpose
+        _complete_mpu_ignoring_404(worker, client, bucket, key, upload_id,
+                                   parts)
     except BaseException:
         # abort on interrupt/error so no orphaned MPU is left behind
         # (reference: LocalWorker.cpp:6044-6135)
@@ -285,6 +311,21 @@ def _upload_object(worker, bucket: str, key: str) -> None:
         except Exception:  # noqa: BLE001
             pass
         raise
+
+
+def _complete_mpu_ignoring_404(worker, client, bucket, key, upload_id,
+                               parts) -> None:
+    """CompleteMultipartUpload; --s3multiignore404 tolerates a 404 from a
+    completion that already succeeded via a retried request."""
+    from ..toolkits.s3_tk import S3Error
+    try:
+        client.complete_multipart_upload(
+            bucket, key, upload_id, parts,
+            checksum_algo=worker.cfg.s3_checksum_algo)
+    except S3Error as err:
+        if not (err.status == 404
+                and worker.cfg.s3_ignore_mpu_completion_404):
+            raise
 
 
 def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
@@ -323,11 +364,12 @@ def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
             worker._num_iops_submitted += 1
             got_final = shared_upload_store.add_completed_part(
                 bucket, key, part_idx + 1, etag, length)
-        if got_final and not cfg.run_s3_mpu_complete_phase:
+        if got_final and not cfg.run_s3_mpu_complete_phase \
+                and not cfg.s3_no_mpu_completion:
             # inline completion; with --s3mpucomplphase the separate
             # MPUCOMPL phase sends the completions instead
-            client.complete_multipart_upload(
-                bucket, key, upload_id,
+            _complete_mpu_ignoring_404(
+                worker, client, bucket, key, upload_id,
                 shared_upload_store.get_completed_parts(bucket, key))
     except BaseException:
         upload_id = shared_upload_store.mark_aborted(bucket, key)
@@ -362,21 +404,28 @@ def _download_object(worker, bucket: str, key: str) -> None:
             limiter.wait(length)
         t0 = time.perf_counter_ns()
         sse_c = _sse_c_headers(cfg) or None
-        if size <= bs:
-            data = client.get_object(bucket, key, extra_headers=sse_c)
+        rng = (None, None) if size <= bs else (offset, length)
+        if cfg.s3_fast_get:
+            # --s3fastget: stream-and-discard, no buffer post-processing
+            got = client.get_object_discard(bucket, key,
+                                            range_start=rng[0],
+                                            range_len=rng[1],
+                                            extra_headers=sse_c)
         else:
-            data = client.get_object(bucket, key, range_start=offset,
-                                     range_len=length, extra_headers=sse_c)
+            data = client.get_object(bucket, key, range_start=rng[0],
+                                     range_len=rng[1], extra_headers=sse_c)
+            got = len(data)
         lat_usec = (time.perf_counter_ns() - t0) // 1000
-        if len(data) != length:
+        if got != length:
             raise WorkerException(
                 f"short S3 read for {bucket}/{key} at {offset}: "
-                f"{len(data)} != {length}")
+                f"{got} != {length}")
         worker.iops_latency_histo.add_latency(lat_usec)
-        buf = worker._io_bufs[
-            worker._num_iops_submitted % len(worker._io_bufs)]
-        buf[:length] = data
-        worker._post_read_actions(buf, offset, length)
+        if not cfg.s3_fast_get:
+            buf = worker._io_bufs[
+                worker._num_iops_submitted % len(worker._io_bufs)]
+            buf[:length] = data
+            worker._post_read_actions(buf, offset, length)
         worker.live_ops.num_bytes_done += length
         worker.live_ops.num_iops_done += 1
         worker._num_iops_submitted += 1
@@ -506,15 +555,53 @@ def _mpu_complete_phase(worker, phase: BenchPhase) -> None:
 # ACL / tagging metadata phases
 # ---------------------------------------------------------------------------
 
+def _acl_headers(cfg) -> "dict":
+    """Grant headers from --s3aclgrantee/--s3aclgtype/--s3aclgrants."""
+    from ..toolkits.s3_tk import build_acl_headers
+    try:
+        return build_acl_headers(cfg.s3_acl_grantee,
+                                 cfg.s3_acl_grantee_type, cfg.s3_acl_grants)
+    except ValueError as err:
+        raise WorkerException(str(err)) from err
+
+
+#: canned ACL -> grantee group URI that must appear in the ACL document
+_CANNED_ACL_MARKERS = {
+    "public-read": b"groups/global/AllUsers",
+    "public-read-write": b"groups/global/AllUsers",
+    "authenticated-read": b"groups/global/AuthenticatedUsers",
+}
+
+
+def _verify_acl(cfg, acl_xml: bytes, what: str) -> None:
+    """--s3aclverify: the configured grantee (or the canned ACL's group
+    URI) must appear in the returned ACL document (reference:
+    doS3AclVerify in the get-ACL phases)."""
+    if not cfg.do_s3_acl_verify or not cfg.s3_acl_grantee:
+        return
+    grantee = cfg.s3_acl_grantee
+    if grantee == "private":
+        return  # owner-only ACL: nothing beyond the owner grant to check
+    marker = _CANNED_ACL_MARKERS.get(grantee) \
+        or (grantee.partition("=")[2] or grantee).encode()
+    if marker not in acl_xml:
+        raise WorkerException(
+            f"ACL verification failed: {marker!r} not in {what} ACL reply")
+
+
 def _obj_acl(worker, phase: BenchPhase) -> None:
+    cfg = worker.cfg
     client = _client(worker)
+    put = phase == BenchPhase.PUTOBJACL
+    acl_headers = _acl_headers(cfg) if put else None  # constant: hoisted
     for bucket, key in _iter_entries(worker):
         worker.check_interruption_request(force=True)
         t0 = time.perf_counter_ns()
-        if phase == BenchPhase.PUTOBJACL:
-            client.put_object_acl(bucket, key, "private")
+        if put:
+            client.put_object_acl(bucket, key, acl_headers=acl_headers)
         else:
-            client.get_object_acl(bucket, key)
+            acl_xml = client.get_object_acl(bucket, key)
+            _verify_acl(cfg, acl_xml, f"object {key}")
         worker.entries_latency_histo.add_latency(
             (time.perf_counter_ns() - t0) // 1000)
         worker.live_ops.num_entries_done += 1
@@ -523,6 +610,8 @@ def _obj_acl(worker, phase: BenchPhase) -> None:
 def _bucket_acl(worker, phase: BenchPhase) -> None:
     cfg = worker.cfg
     client = _client(worker)
+    put = phase == BenchPhase.PUTBUCKETACL
+    acl_headers = _acl_headers(cfg) if put else None  # constant: hoisted
     ndst = max(1, cfg.num_dataset_threads)
     got_work = False
     for idx, bucket in enumerate(cfg.paths):
@@ -530,10 +619,11 @@ def _bucket_acl(worker, phase: BenchPhase) -> None:
             continue
         got_work = True
         t0 = time.perf_counter_ns()
-        if phase == BenchPhase.PUTBUCKETACL:
-            client.put_bucket_acl(bucket, "private")
+        if put:
+            client.put_bucket_acl(bucket, acl_headers=acl_headers)
         else:
-            client.get_bucket_acl(bucket)
+            acl_xml = client.get_bucket_acl(bucket)
+            _verify_acl(cfg, acl_xml, f"bucket {bucket}")
         worker.entries_latency_histo.add_latency(
             (time.perf_counter_ns() - t0) // 1000)
         worker.live_ops.num_entries_done += 1
@@ -581,6 +671,40 @@ def _sse_headers(cfg) -> "dict | None":
         h["x-amz-server-side-encryption"] = "AES256"
     h.update(_sse_c_headers(cfg))
     return h or None
+
+
+def _upload_init_headers(cfg) -> "dict | None":
+    """Headers for single PUT: SSE + inline ACL grants (--s3aclputinl) +
+    checksum algorithm announcement (SDK-style header; the actual
+    x-amz-checksum-<algo> value comes from _body_headers)."""
+    h = dict(_sse_headers(cfg) or {})
+    if cfg.do_s3_acl_put_inline and cfg.s3_acl_grantee:
+        h.update(_acl_headers(cfg))
+    if cfg.s3_checksum_algo:
+        h["x-amz-sdk-checksum-algorithm"] = cfg.s3_checksum_algo.upper()
+    return h or None
+
+
+def _mpu_init_headers(cfg) -> "dict | None":
+    """CreateMultipartUpload headers: like single PUT, but the checksum
+    algorithm is announced via x-amz-checksum-algorithm (the header that
+    CreateMultipartUpload actually accepts)."""
+    h = dict(_sse_headers(cfg) or {})
+    if cfg.do_s3_acl_put_inline and cfg.s3_acl_grantee:
+        h.update(_acl_headers(cfg))
+    if cfg.s3_checksum_algo:
+        h["x-amz-checksum-algorithm"] = cfg.s3_checksum_algo.upper()
+    return h or None
+
+
+def _body_headers(cfg, body: bytes, base: "dict | None") -> "dict | None":
+    """Per-payload headers: base + x-amz-checksum-<algo> of this body."""
+    if not cfg.s3_checksum_algo:
+        return base
+    from ..toolkits.s3_tk import build_checksum_headers
+    h = dict(base or {})
+    h.update(build_checksum_headers(cfg.s3_checksum_algo, body))
+    return h
 
 
 def _obj_tagging(worker, phase: BenchPhase) -> None:

@@ -199,3 +199,157 @@ def test_s3_read_missing_object_fails(mock_s3):
     rc = run_cli(mock_s3, ["-r", "-t", "1", "-n", "1", "-N", "1",
                            "-s", "4K", "-b", "4K", "s3://nonexistent-b"])
     assert rc != 0
+
+
+# -- S3 long-tail flags (ACL grants, checksums, fastget, MPU options) --------
+
+def test_acl_grant_headers():
+    from elbencho_tpu.toolkits.s3_tk import build_acl_headers
+    assert build_acl_headers("", "", "") == {"x-amz-acl": "private"}
+    assert build_acl_headers("public-read", "id", "full") == \
+        {"x-amz-acl": "public-read"}
+    h = build_acl_headers("123", "id", "read,wacp")
+    assert h == {"x-amz-grant-read": 'id="123"',
+                 "x-amz-grant-write-acp": 'id="123"'}
+    h2 = build_acl_headers("a@b.org", "email", "full")
+    assert h2 == {"x-amz-grant-full-control": 'emailAddress="a@b.org"'}
+    # inline "type=value" form (reference: --s3aclputinl)
+    h3 = build_acl_headers("uri=http://acs/global", "", "read")
+    assert h3 == {"x-amz-grant-read": 'uri="http://acs/global"'}
+    with pytest.raises(ValueError):
+        build_acl_headers("123", "", "read")  # missing grantee type
+    with pytest.raises(ValueError):
+        build_acl_headers("123", "id", "none")  # no effective permission
+
+
+def test_checksum_headers():
+    import base64
+    import hashlib
+    import zlib
+    from elbencho_tpu.toolkits.s3_tk import build_checksum_headers
+    body = b"0123456789" * 100
+    h = build_checksum_headers("crc32", body)
+    assert h["x-amz-sdk-checksum-algorithm"] == "CRC32"
+    assert base64.b64decode(h["x-amz-checksum-crc32"]) == \
+        zlib.crc32(body).to_bytes(4, "big")
+    h = build_checksum_headers("sha256", body)
+    assert base64.b64decode(h["x-amz-checksum-sha256"]) == \
+        hashlib.sha256(body).digest()
+    # crc32c known-answer test (RFC 3720 / iSCSI vector)
+    h = build_checksum_headers("crc32c", b"123456789")
+    assert base64.b64decode(h["x-amz-checksum-crc32c"]) == \
+        (0xE3069283).to_bytes(4, "big")
+
+
+def test_s3_acl_grants_e2e(mock_s3):
+    """ACL put with explicit grants + verified get phase."""
+    assert run_cli(mock_s3, ["-w", "-d", "-t", "1", "-n", "1", "-N", "1",
+                             "-s", "1K", "-b", "1K", "s3://aclb"]) == 0
+    rc = run_cli(mock_s3, ["--s3aclput", "--s3aclget", "--s3baclput",
+                           "--s3baclget", "--s3aclgrantee", "public-read",
+                           "-t", "1", "-n", "1", "-N", "1", "-s", "1K",
+                           "-b", "1K", "s3://aclb"])
+    assert rc == 0
+
+
+def test_s3_checksum_and_fastget_e2e(mock_s3):
+    assert run_cli(mock_s3, ["-w", "-d", "--s3checksumalgo", "crc32",
+                             "-t", "1", "-n", "1", "-N", "2", "-s", "32K",
+                             "-b", "8K", "s3://ckb"]) == 0
+    # fastget discards data but still measures the full byte count
+    assert run_cli(mock_s3, ["-r", "--s3fastget", "-t", "1", "-n", "1",
+                             "-N", "2", "-s", "32K", "-b", "8K",
+                             "s3://ckb"]) == 0
+    # incompatible with --verify
+    assert run_cli(mock_s3, ["-r", "--s3fastget", "--verify", "7", "-t",
+                             "1", "-n", "1", "-N", "1", "-s", "8K", "-b",
+                             "8K", "s3://ckb"]) != 0
+
+
+def test_s3_nompucompl_leaves_upload_incomplete(mock_s3):
+    rc = run_cli(mock_s3, ["-w", "-d", "--s3nompucompl", "-t", "1", "-n",
+                           "1", "-N", "1", "-s", "32K", "-b", "8K",
+                           "s3://nocompl"])
+    assert rc == 0
+    c = S3Client(mock_s3.endpoint)
+    uploads, _, _ = c.list_multipart_uploads("nocompl")
+    assert len(uploads) == 1  # upload left incomplete on purpose
+    with pytest.raises(S3Error):
+        c.get_object("nocompl", uploads[0][0])  # object never materialized
+    c.close()
+
+
+def test_s3_mpu_size_variance(mock_s3):
+    """--s3mpusizevar: parts shrink randomly but the object still ends up
+    byte-complete (last part absorbs the difference)."""
+    rc = run_cli(mock_s3, ["-w", "-d", "--s3mpusizevar", "4K", "-t", "1",
+                           "-n", "1", "-N", "1", "-s", "64K", "-b", "16K",
+                           "s3://varb"])
+    assert rc == 0
+    c = S3Client(mock_s3.endpoint)
+    keys, _ = c.list_objects("varb")
+    assert len(keys) == 1
+    assert len(c.get_object("varb", keys[0])) == 64 * 1024
+    c.close()
+
+
+def test_s3_part_limit_check():
+    from elbencho_tpu.config.args import BenchConfig, ConfigError
+    cfg = BenchConfig(run_create_files=True, file_size=20000 * 4096,
+                      block_size=4096, s3_endpoints_str="http://x",
+                      paths=["b"])
+    with pytest.raises(ConfigError, match="10,000"):
+        cfg.derive(probe_paths=False).check()
+    cfg2 = BenchConfig(run_create_files=True, file_size=20000 * 4096,
+                       block_size=4096, s3_endpoints_str="http://x",
+                       s3_ignore_part_num_check=True, paths=["b"])
+    cfg2.derive(probe_paths=False).check()  # --s3nompcheck overrides
+
+
+def test_s3_request_log(mock_s3, tmp_path):
+    prefix = str(tmp_path / "s3trace_")
+    assert run_cli(mock_s3, ["-w", "-d", "--s3log", "1", "--s3logprefix",
+                             prefix, "-t", "1", "-n", "1", "-N", "1",
+                             "-s", "1K", "-b", "1K", "s3://logb"]) == 0
+    logs = list(tmp_path.glob("s3trace_*.log"))
+    assert logs, "request log file missing"
+    text = logs[0].read_text()
+    assert "PUT" in text and "/logb/" in text
+
+
+def test_mpu_completion_xml_carries_checksums(mock_s3):
+    """With --s3checksumalgo, multi-part uploads must run the MPU path and
+    the CompleteMultipartUpload XML must carry per-part checksum elements
+    (real S3 rejects completions without them)."""
+    import threading
+    captured = []
+    orig = S3Client.request
+
+    def spy(self, method, bucket="", key="", **kw):
+        if method == "POST" and "uploadId" in (kw.get("query") or {}):
+            captured.append(kw.get("body", b""))
+        return orig(self, method, bucket, key, **kw)
+
+    S3Client.request = spy
+    try:
+        rc = run_cli(mock_s3, ["-w", "-d", "--s3checksumalgo", "crc32",
+                               "-t", "1", "-n", "1", "-N", "1", "-s",
+                               "32K", "-b", "8K", "s3://ckmpu"])
+    finally:
+        S3Client.request = orig
+    assert rc == 0
+    assert captured, "no CompleteMultipartUpload request seen"
+    xml_body = captured[0].decode()
+    assert xml_body.count("<ChecksumCRC32>") == 4  # one per 8K part
+    # config-time rejection of grant mistakes and unsupported combos
+    from elbencho_tpu.config.args import BenchConfig, ConfigError
+    with pytest.raises(ConfigError, match="permissions"):
+        BenchConfig(run_s3_acl_put=True, s3_acl_grantee="123",
+                    s3_acl_grantee_type="id",
+                    s3_endpoints_str="http://x", paths=["b"]).derive(
+                        probe_paths=False).check()
+    with pytest.raises(ConfigError, match="s3mpusharing"):
+        BenchConfig(run_create_files=True, s3_checksum_algo="crc32",
+                    s3_mpu_sharing=True, s3_endpoints_str="http://x",
+                    file_size=1, block_size=1, paths=["b"]).derive(
+                        probe_paths=False).check()
