@@ -628,6 +628,8 @@ class BenchConfig(BenchConfigBase):
                 self.random_amount = self.file_size
         if self.run_as_service:
             self.disable_live_stats = True
+        self._apply_default_result_files()
+        self._apply_s3_env_credentials()
         if self.run_tpu_bench:
             if not self.tpu_ids:
                 self.tpu_ids = [0]  # default to the first chip
@@ -635,6 +637,69 @@ class BenchConfig(BenchConfigBase):
                 self.file_size = 256 << 20  # sensible default amount
         if self.num_rwmix_read_threads and not self.run_create_files:
             raise ConfigError("--rwmixthr requires the write phase (-w)")
+
+    @staticmethod
+    def _default_results_base() -> str:
+        """Base dir for default result files (separate hook for tests)."""
+        return "/var/tmp"
+
+    def _apply_default_result_files(self) -> None:
+        """Non-service runs default result files into
+        /var/tmp/elbencho-tpu_results_<user>/ with date-stamped names
+        (reference: RESFILE_DIR_USER_DEFAULT, ProgArgs.cpp:71,1174-1187).
+        Disable with ELBENCHO_TPU_NO_DEFAULT_RESFILES=1 (CI/sandboxes)."""
+        if self.run_as_service or getattr(self, "_service_side", False) \
+                or os.environ.get("ELBENCHO_TPU_NO_DEFAULT_RESFILES") == "1":
+            return
+        if self.res_file_path and self.csv_file_path \
+                and self.json_file_path:
+            return
+        import datetime
+        import getpass
+        try:
+            user = getpass.getuser()
+        except (KeyError, OSError):
+            user = f"uid{os.getuid()}"
+        res_dir = os.path.join(self._default_results_base(),
+                               f"elbencho-tpu_results_{user}")
+        try:
+            os.makedirs(res_dir, mode=0o700, exist_ok=True)
+            # /var/tmp is world-writable and the dir name predictable: only
+            # trust a real directory owned by us (no attacker symlink/dir)
+            st = os.lstat(res_dir)
+            if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
+                return
+        except OSError:
+            return  # read-only /var/tmp: keep explicit-only result files
+        date = datetime.date.today().strftime("%Y%m%d")
+        if not self.res_file_path:
+            self.res_file_path = \
+                f"{res_dir}/elbencho-tpu_results_{date}.txt"
+        if not self.csv_file_path:
+            self.csv_file_path = \
+                f"{res_dir}/elbencho-tpu_results_{date}.csv"
+        if not self.json_file_path:
+            self.json_file_path = \
+                f"{res_dir}/elbencho-tpu_results_{date}.json"
+
+    def _apply_s3_env_credentials(self) -> None:
+        """S3 credentials/endpoint from the standard environment variables
+        when flags are empty (reference: S3_ENV_* handling,
+        ProgArgs.cpp:1207-1230; non-service runs only — a service must use
+        exactly what the master shipped, not its own local environment)."""
+        if self.run_as_service or getattr(self, "_service_side", False) \
+                or self.bench_mode != BenchMode.S3:
+            return
+        if not self.s3_access_key:
+            self.s3_access_key = os.environ.get("AWS_ACCESS_KEY_ID", "")
+        if not self.s3_secret_key:
+            self.s3_secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        if not self.s3_session_token:
+            self.s3_session_token = os.environ.get("AWS_SESSION_TOKEN", "")
+        if not self.s3_endpoints_str:
+            self.s3_endpoints_str = os.environ.get(
+                "AWS_ENDPOINT_URL_S3", os.environ.get(
+                    "AWS_ENDPOINT_URL", ""))
 
     # -- validation (reference: checkArgs/checkPathDependentArgs) -----------
 
@@ -812,6 +877,9 @@ class BenchConfig(BenchConfigBase):
         d["hosts_str"] = ""
         d["hosts_file_path"] = ""
         d["run_as_service"] = False
+        # result files are written by the master only (the reference never
+        # serializes resFilePath* to services)
+        d["res_file_path"] = d["csv_file_path"] = d["json_file_path"] = ""
         d["num_dataset_threads_override"] = self.num_dataset_threads
         if self.assign_tpu_per_service and self.tpu_ids:
             # --tpuperservice: round-robin chips across service instances —
@@ -840,6 +908,7 @@ class BenchConfig(BenchConfigBase):
         d.pop("ProtocolVersion", None)
         cfg = cls(**{k: v for k, v in d.items()
                      if k in {f.name for f in dataclasses.fields(cls)}})
+        cfg._service_side = True  # no default result files on services
         cfg.derive()
         cfg.check()
         return cfg
@@ -870,6 +939,15 @@ HELP_CATEGORIES = {
     "help-all": None,  # all categories
 }
 
+# reference CUDA/GPU flags -> the TPU-native replacement to suggest; using
+# one produces a directed error instead of "unrecognized argument"
+CUDA_FLAG_HINTS = {
+    "gpuids": "--tpuids", "gpuperservice": "--tpuperservice",
+    "cufile": "--tpudirect", "gds": "--tpudirect",
+    "gdsbufreg": "--tpudirect", "cuhostbufreg": "--tpuids",
+    "cufiledriveropen": "--tpudirect",
+}
+
 # reference long-flag spellings accepted as aliases, so command lines
 # written for the reference keep working (alias -> our canonical flag)
 REF_FLAG_ALIASES = {
@@ -889,6 +967,17 @@ def build_arg_parser():
                     "(files, block devices, object storage; HBM data path)")
     parser.add_argument("paths", nargs="*", help="Benchmark paths "
                         "(dirs, files, block devices, or s3:// buckets)")
+    # reference compat: paths can also be passed as "--path P" options
+    # (ARG_BENCHPATHS_LONG is the positional-args name there); separate
+    # dest because the empty positional list would clobber appended values
+    parser.add_argument("--path", dest="path_opts", action="append",
+                        default=[], metavar="V", help=argparse.SUPPRESS)
+    for cuda_flag in CUDA_FLAG_HINTS:
+        # nargs="?" so both "--gpuids 0,1" and bare "--cufile" parse; any
+        # use is rejected in parse_cli with the TPU-equivalent hint
+        parser.add_argument(f"--{cuda_flag}", dest=f"cuda_{cuda_flag}",
+                            nargs="?", const=True, default=None,
+                            help=argparse.SUPPRESS)
     for hf in HELP_CATEGORIES:
         names = [f"--{hf}"] + (["-h"] if hf == "help" else [])
         parser.add_argument(*names, action="store_true",
@@ -943,6 +1032,12 @@ def parse_cli(argv: "list[str] | None" = None) -> "tuple[BenchConfig, object]":
     ns = parser.parse_args(argv)
     if ns.config_file_path:
         _apply_config_file(ns.config_file_path, ns, parser)
+    ns.paths = list(ns.paths) + list(ns.path_opts)  # merge --path options
+    for cuda_flag, hint in CUDA_FLAG_HINTS.items():
+        if getattr(ns, f"cuda_{cuda_flag}") is not None:
+            raise ConfigError(
+                f"--{cuda_flag} is a CUDA/GPU flag of the reference; this "
+                f"framework drives TPUs — use {hint} instead")
     field_names = {f.name for f in dataclasses.fields(BenchConfig)}
     kwargs = {k: v for k, v in vars(ns).items() if k in field_names}
     cfg = BenchConfig(**kwargs)

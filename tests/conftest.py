@@ -4,6 +4,10 @@ tests run without TPU hardware (SURVEY.md environment notes)."""
 import os
 import sys
 
+# tests must not write default result files into /var/tmp (reference
+# parity behavior of non-service runs)
+os.environ["ELBENCHO_TPU_NO_DEFAULT_RESFILES"] = "1"
+
 # this box pins JAX_PLATFORMS=axon (one real TPU chip); tests must run on
 # the virtual 8-device CPU mesh instead
 os.environ["JAX_PLATFORMS"] = "cpu"

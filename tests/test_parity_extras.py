@@ -56,6 +56,9 @@ def test_reference_flag_aliases():
     cfg3.derive(probe_paths=False)
     from elbencho_tpu.phases import BenchMode
     assert cfg3.bench_mode == BenchMode.HDFS
+    # --path option form (reference: ARG_BENCHPATHS_LONG positional name)
+    cfg4, _ = parse_cli(["--path", "/x", "--path", "/y", "-w"])
+    assert cfg4.paths == ["/x", "/y"]
 
 
 def test_netbench_servers_clients_lists(tmp_path):
@@ -183,3 +186,78 @@ def test_0usec_warning(capsys):
 
     assert "WARNING" in render({})
     assert "WARNING" not in render({"ignore_0usec_errors": True})
+
+
+def test_cuda_flags_give_tpu_hint():
+    from elbencho_tpu.config.args import ConfigError, parse_cli
+    with pytest.raises(ConfigError, match="--tpuids"):
+        parse_cli(["--gpuids", "0,1", "-w", "/tmp/x"])
+    with pytest.raises(ConfigError, match="--tpudirect"):
+        parse_cli(["--cufile", "-w", "/tmp/x"])
+
+
+def test_default_result_files(monkeypatch, tmp_path):
+    """Non-service runs default TXT/CSV/JSON result files into the
+    per-user results dir (reference: RESFILE_DIR_USER_DEFAULT,
+    ProgArgs.cpp:1174-1187); services and explicit paths don't."""
+    from elbencho_tpu.config.args import BenchConfig
+    monkeypatch.delenv("ELBENCHO_TPU_NO_DEFAULT_RESFILES", raising=False)
+    monkeypatch.setattr(BenchConfig, "_default_results_base",
+                        staticmethod(lambda: str(tmp_path)))
+    cfg = BenchConfig(run_create_files=True, file_size=4096,
+                      block_size=4096, paths=["/tmp/x"])
+    cfg.derive(probe_paths=False)
+    assert f"{tmp_path}/elbencho-tpu_results_" in cfg.res_file_path
+    assert cfg.csv_file_path.endswith(".csv")
+    assert cfg.json_file_path.endswith(".json")
+    # explicit paths win
+    cfg2 = BenchConfig(run_create_files=True, file_size=4096,
+                       block_size=4096, paths=["/tmp/x"],
+                       res_file_path="/tmp/my.txt")
+    cfg2.derive(probe_paths=False)
+    assert cfg2.res_file_path == "/tmp/my.txt"
+    assert str(tmp_path) in cfg2.csv_file_path  # others still defaulted
+    # services never default result files
+    svc = BenchConfig(run_as_service=True)
+    svc.derive(probe_paths=False)
+    assert svc.res_file_path == ""
+    # a symlinked (attacker-plantable) results dir is rejected
+    base2 = tmp_path / "b2"
+    base2.mkdir()
+    monkeypatch.setattr(BenchConfig, "_default_results_base",
+                        staticmethod(lambda: str(base2)))
+    (base2 / f"elbencho-tpu_results_{_current_user()}").symlink_to(
+        base2 / "elsewhere")
+    cfg3 = BenchConfig(run_create_files=True, file_size=4096,
+                       block_size=4096, paths=["/tmp/x"])
+    cfg3.derive(probe_paths=False)
+    assert cfg3.res_file_path == ""  # symlinked target dir: refused
+
+
+def _current_user():
+    import getpass
+    try:
+        return getpass.getuser()
+    except (KeyError, OSError):
+        import os
+        return f"uid{os.getuid()}"
+
+
+def test_s3_env_credentials(monkeypatch):
+    from elbencho_tpu.config.args import BenchConfig
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "envkey")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "envsecret")
+    monkeypatch.setenv("AWS_SESSION_TOKEN", "envtok")
+    monkeypatch.setenv("AWS_ENDPOINT_URL_S3", "http://env-ep:9000")
+    cfg = BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                      paths=["s3://b"])
+    cfg.derive(probe_paths=False)
+    assert cfg.s3_access_key == "envkey"
+    assert cfg.s3_secret_key == "envsecret"
+    assert cfg.s3_session_token == "envtok"
+    assert cfg.s3_endpoints_str == "http://env-ep:9000"
+    # explicit flags win over env
+    cfg2 = BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                       s3_access_key="flagkey", paths=["s3://b"])
+    cfg2.derive(probe_paths=False)
+    assert cfg2.s3_access_key == "flagkey"
