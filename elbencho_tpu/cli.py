@@ -123,6 +123,13 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"ERROR: {err}", file=sys.stderr)
         return 1
     logger.set_log_level(cfg.log_level)
+    if cfg.csv_file_path:
+        from .stats.statistics import Statistics
+        try:  # fail before any phase runs, like the reference
+            Statistics.check_csv_file_compatibility(cfg)
+        except (ValueError, OSError) as err:
+            print(f"ERROR: {err}", file=sys.stderr)
+            return 1
     if cfg.tree_scan_path:
         return _run_tree_scan(cfg)
     if cfg.do_dry_run:

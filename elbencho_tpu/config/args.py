@@ -936,6 +936,8 @@ HELP_CATEGORIES = {
     "help-dist": "dist",
     "help-s3": "s3",
     "help-tpu": "tpu",
+    "help-bdev": "large",  # reference tier name; block devices use the
+                           # large-file/random-I/O flag set here
     "help-all": None,  # all categories
 }
 

@@ -527,17 +527,54 @@ class Statistics:
             res.final_rwmix["bytes"] / last_s / (1 << 20), 2)
         return rec
 
+    #: fixed result columns of the CSV schema (docs/result-columns.md);
+    #: TpuPerChip is JSON-only (nested)
+    CSV_RESULT_COLUMNS = (
+        "ISODate", "Label", "Phase", "EntryType", "NumWorkers",
+        "ElapsedUSecFirst", "ElapsedUSecLast", "EntriesFirst", "EntriesLast",
+        "EntriesPerSecFirst", "EntriesPerSecLast", "IOPSFirst", "IOPSLast",
+        "BytesFirst", "BytesLast", "MiBPerSecFirst", "MiBPerSecLast",
+        "CPUUtilStoneWall", "CPUUtil", "IOLatUSecMin", "IOLatUSecAvg",
+        "IOLatUSecMax", "IOLatUSecP99", "EntLatUSecMin", "EntLatUSecAvg",
+        "EntLatUSecMax", "TpuHbmBytes", "TpuHbmMiBPerSec",
+        "RWMixReadIOPSLast", "RWMixReadMiBPerSecLast")
+
+    @classmethod
+    def check_csv_file_compatibility(cls, cfg) -> None:
+        """Appending to an existing CSV requires a matching column count
+        (reference: checkCSVFileCompatibility, ProgArgs.cpp:4303 — catches
+        files written by a different version/config before any phase
+        runs). Raises ValueError on mismatch."""
+        path = cfg.csv_file_path
+        if not path or not os.path.exists(path) \
+                or os.path.getsize(path) == 0:
+            return
+        with open(path) as f:
+            first_line = f.readline().rstrip("\n")
+        found = first_line.count(",")
+        labels = 0 if cfg.no_csv_labels else len(cfg.config_labels())
+        expected = len(cls.CSV_RESULT_COLUMNS) + labels - 1
+        if found != expected:
+            raise ValueError(
+                f"CSV output file exists and the column compatibility "
+                f"check failed (was it written by a different version or "
+                f"with different label settings?). Found commas: {found}; "
+                f"expected: {expected}; file: {path}")
+
     def _write_csv(self, res: PhaseResults) -> None:
         rec = self._result_record(res)
         rec.pop("TpuPerChip")
+        assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
         path = self.cfg.csv_file_path
         new_file = not os.path.exists(path) or os.path.getsize(path) == 0
         with open(path, "a") as f:
             if new_file:
                 f.write(",".join(list(rec) + list(labels)) + "\n")
-            vals = [str(v) for v in rec.values()] + \
-                [str(v).replace(",", ";") for v in labels.values()]
+            # comma-escape EVERY value (Label is user-supplied) so the
+            # fixed column count the compatibility check relies on holds
+            vals = [str(v).replace(",", ";")
+                    for v in list(rec.values()) + list(labels.values())]
             f.write(",".join(vals) + "\n")
 
     def _write_json(self, res: PhaseResults) -> None:

@@ -248,3 +248,26 @@ def test_infloop_with_timelimit(tmp_path):
                   "-n", "1", "-N", "1", "-s", "4K", "-b", "4K",
                   str(tmp_path)])
     assert rc == 0
+
+
+def test_csv_compat_check(tmp_path):
+    """Appending to a CSV with a different column count fails before any
+    phase runs (reference: checkCSVFileCompatibility, ProgArgs.cpp:4303)."""
+    from elbencho_tpu.cli import main
+    target = tmp_path / "f"
+    csv = tmp_path / "out.csv"
+    args = ["-w", "-t", "1", "-s", "4K", "-b", "4K", "--nolive",
+            "--csvfile", str(csv), str(target)]
+    assert main(args) == 0
+    assert main(args) == 0  # same schema: append works
+    assert len(csv.read_text().splitlines()) == 3  # header + 2 rows
+    bad = tmp_path / "bad.csv"
+    bad.write_text("a,b,c\n1,2,3\n")
+    rc = main(["-w", "-t", "1", "-s", "4K", "-b", "4K", "--nolive",
+               "--csvfile", str(bad), str(target)])
+    assert rc == 1
+    assert bad.read_text() == "a,b,c\n1,2,3\n"  # untouched
+    # --nocsvlabels changes the schema -> also rejected against labeled file
+    rc2 = main(["-w", "-t", "1", "-s", "4K", "-b", "4K", "--nolive",
+                "--nocsvlabels", "--csvfile", str(csv), str(target)])
+    assert rc2 == 1
