@@ -46,7 +46,32 @@ def _run_cli(args, jsonfile):
         return [json.loads(ln) for ln in f if ln.strip()]
 
 
+def _probe_tpu(timeout_secs: int = 180) -> None:
+    """Fail fast (with a clear message) when the TPU backend is
+    unreachable — jax.devices() otherwise blocks forever on a dead
+    tunnel and the whole bench run times out without explanation."""
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); print(d[0].platform)"],
+        capture_output=True, text=True, timeout=timeout_secs)
+    if probe.returncode != 0:
+        raise RuntimeError(
+            f"TPU probe failed: {probe.stderr[-500:]}")
+    platform = probe.stdout.strip().lower()
+    if platform not in ("tpu", "axon"):  # axon = tunneled TPU plugin
+        raise RuntimeError(
+            f"default jax backend is {platform!r}, not a TPU — refusing "
+            f"to publish HBM-ingest numbers measured on a CPU fallback")
+    print(f"# TPU probe ok: platform={platform}", file=sys.stderr)
+
+
 def main() -> int:
+    try:
+        _probe_tpu()
+    except (RuntimeError, subprocess.TimeoutExpired) as err:
+        print(f"ERROR: TPU device unreachable, cannot run the HBM ingest "
+              f"benchmark: {err}", file=sys.stderr)
+        return 1
     tmpdir = tempfile.mkdtemp(prefix="elbencho_tpu_bench_")
     target = os.path.join(tmpdir, "benchfile")
     j1 = os.path.join(tmpdir, "w.json")

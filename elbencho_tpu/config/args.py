@@ -289,6 +289,10 @@ FLAG_DEFS = [
     ("tpubenchpat", None, "tpu_bench_pattern", "str", "h2d", "tpu",
      "TPU bench pattern: h2d|d2h|both|ici (ici = ring ppermute over all "
      "chips, measuring inter-chip bandwidth)"),
+    ("podhosts", None, "use_pod_hosts", "bool", False, "tpu",
+     "Derive --hosts from this TPU pod slice's worker VMs "
+     "(TPU_WORKER_HOSTNAMES env or GCE metadata; each worker must run "
+     "--service)"),
 
     # NUMA/core binding
     ("zones", None, "numa_zones_str", "str", "", "multi",
@@ -510,6 +514,15 @@ class BenchConfig(BenchConfigBase):
 
     def _parse_hosts(self) -> None:
         hosts = self._read_hosts(self.hosts_str, self.hosts_file_path)
+        if self.use_pod_hosts:
+            if hosts:
+                raise ConfigError(
+                    "--podhosts and --hosts are mutually exclusive")
+            from ..tpu.pod import enumerate_pod_hosts
+            try:
+                hosts = enumerate_pod_hosts()
+            except RuntimeError as err:
+                raise ConfigError(str(err)) from err
         # netbench topology via explicit --servers/--clients lists
         # (reference: parseHosts, ProgArgs.cpp:2343-2460 — servers first,
         # clients last, numNetBenchServers = len(servers))

@@ -1,0 +1,65 @@
+"""TPU-VM pod-slice worker enumeration: sugar for --hosts.
+
+The reference takes an explicit host list for its distributed service mode
+(--hosts, ProgArgs.cpp parseHosts). On a TPU pod slice the set of worker
+VMs is already known to the platform, so --podhosts derives the list
+instead (SURVEY.md section 7 step 5):
+
+  1. TPU_WORKER_HOSTNAMES env var (set by the TPU runtime on TPU VMs) —
+     comma-separated hostnames.
+  2. GCE metadata server attribute ``worker-network-endpoints`` — the
+     canonical per-slice list of "index:ip:port"-style entries.
+
+Either source yields one entry per worker VM; each is expected to run
+``elbencho-tpu --service``.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.error
+import urllib.request
+
+#: override for tests / non-GCE environments
+METADATA_URL_ENV = "ELBENCHO_TPU_METADATA_URL"
+_DEFAULT_METADATA_URL = ("http://metadata.google.internal/computeMetadata"
+                         "/v1/instance/attributes/worker-network-endpoints")
+
+
+def enumerate_pod_hosts(timeout: float = 5.0) -> "list[str]":
+    """Worker hostnames/IPs of this pod slice, in worker-index order."""
+    env_hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if env_hosts:
+        hosts = [h.strip() for h in env_hosts.split(",") if h.strip()]
+        if not hosts:
+            raise RuntimeError(
+                "--podhosts: TPU_WORKER_HOSTNAMES is set but empty")
+        return hosts
+    url = os.environ.get(METADATA_URL_ENV, _DEFAULT_METADATA_URL)
+    req = urllib.request.Request(url,
+                                 headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = resp.read().decode()
+    except (urllib.error.URLError, OSError) as err:
+        raise RuntimeError(
+            f"--podhosts: cannot enumerate pod workers (no "
+            f"TPU_WORKER_HOSTNAMES env and metadata query failed: {err})"
+        ) from err
+    return parse_worker_network_endpoints(body)
+
+
+def parse_worker_network_endpoints(body: str) -> "list[str]":
+    """Parse the worker-network-endpoints attribute: comma-separated
+    entries whose last ':'-field is the worker IP (the documented format
+    is "<index>:<unused>:<ip>")."""
+    hosts = []
+    for entry in body.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        hosts.append(entry.rsplit(":", 1)[-1] if ":" in entry else entry)
+    if not hosts:
+        raise RuntimeError(
+            "--podhosts: metadata worker-network-endpoints is empty")
+    return hosts

@@ -178,3 +178,60 @@ def test_e2e_tpu_verify_on_device(tmp_path):
     rc = main(["-r", "-t", "1", "-s", "64K", "-b", "16K", "--verify", "7",
                "--nolive", str(target)])
     assert rc == 0
+
+
+def test_podhosts_enumeration(monkeypatch):
+    """--podhosts: worker list from TPU_WORKER_HOSTNAMES env or the GCE
+    metadata worker-network-endpoints attribute (SURVEY.md section 7
+    step 5 sugar for --hosts)."""
+    import http.server
+    import threading
+    from elbencho_tpu.config.args import BenchConfig, ConfigError
+    from elbencho_tpu.tpu.pod import (METADATA_URL_ENV,
+                                      parse_worker_network_endpoints)
+
+    # env var wins
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "tpu-w0, tpu-w1,tpu-w2")
+    cfg = BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                      use_pod_hosts=True, paths=["/tmp/x"])
+    cfg.derive(probe_paths=False)
+    assert cfg.hosts == ["tpu-w0", "tpu-w1", "tpu-w2"]
+    with pytest.raises(ConfigError, match="mutually exclusive"):
+        BenchConfig(use_pod_hosts=True, hosts_str="a",
+                    paths=["/tmp/x"]).derive(probe_paths=False)
+
+    # metadata server path (mocked; header must be Metadata-Flavor)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen["flavor"] = self.headers.get("Metadata-Flavor")
+            body = b"0:8470:10.0.0.5,1:8470:10.0.0.6"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: D102 - silence test output
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        monkeypatch.setenv(
+            METADATA_URL_ENV,
+            f"http://127.0.0.1:{server.server_port}/endpoints")
+        cfg2 = BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                           use_pod_hosts=True, paths=["/tmp/x"])
+        cfg2.derive(probe_paths=False)
+        assert cfg2.hosts == ["10.0.0.5", "10.0.0.6"]
+        assert seen["flavor"] == "Google"
+    finally:
+        server.shutdown()
+
+    assert parse_worker_network_endpoints("hostA,hostB") == \
+        ["hostA", "hostB"]
+    with pytest.raises(RuntimeError):
+        parse_worker_network_endpoints("  ")
