@@ -144,12 +144,19 @@ class Worker:
 
     def check_interruption_request(self, force: bool = False) -> None:
         """Cheap periodic check in hot loops; also the stonewall snapshot
-        point (reference: checkInterruptionRequest + stonewall polling)."""
+        point (reference: checkInterruptionRequest + stonewall polling).
+        Worker-thread only (counter snapshot + _ops_since_check are not
+        thread-safe) — helper threads use check_interruption_flag_only."""
         self._ops_since_check += 1
         if not force and self._ops_since_check < INTERRUPT_CHECK_INTERVAL:
             return
         self._ops_since_check = 0
         self.create_stonewall_stats_if_triggered()
+        self.check_interruption_flag_only()
+
+    def check_interruption_flag_only(self) -> None:
+        """Thread-safe interruption test (no stonewall snapshot, no
+        counters) for request threads of the S3 pipeline."""
         if (self.is_interrupted or self.shared.interrupt_requested
                 or self.shared.phase_time_expired):
             raise WorkerInterruptedException("worker interruption requested")

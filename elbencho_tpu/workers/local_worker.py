@@ -136,6 +136,9 @@ class LocalWorker(Worker):
             except OSError:
                 pass
         self._own_path_fds = []
+        if getattr(self, "_s3_pipeline", None) is not None:
+            self._s3_pipeline.close()
+            self._s3_pipeline = None
         if self._tpu is not None:
             self._tpu.close()  # drop device arrays before buffer teardown
             self._tpu = None

@@ -34,6 +34,109 @@ def _client(worker):
     return worker._s3_client
 
 
+# ---------------------------------------------------------------------------
+# async request pipeline (reference: the async S3 phase variants keep up to
+# --iodepth requests in flight via promise/future contexts,
+# LocalWorker.cpp:109-161 + MPU-async :5155 / download-async :6280)
+# ---------------------------------------------------------------------------
+
+class _S3Pipeline:
+    """Up to --iodepth S3 requests in flight per worker. Submission and
+    counter updates stay on the worker thread (seed-then-refill like the
+    AIO loop); executor threads only run the HTTP round-trips, each on its
+    own S3Client connection."""
+
+    def __init__(self, worker, depth: int):
+        import concurrent.futures
+        import threading
+        self.worker = worker
+        self.depth = max(depth, 1)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.depth,
+            thread_name_prefix=f"s3pipe-r{worker.rank}")
+        self._tls = threading.local()
+        self._clients: "list" = []
+        self._clients_lock = threading.Lock()
+        self._inflight: "list" = []
+
+    def _thread_client(self):
+        client = getattr(self._tls, "client", None)
+        if client is None:
+            from ..toolkits.s3_tk import make_client_for_rank
+            # rank-based endpoint/credential selection stays per WORKER so
+            # round-robin semantics don't depend on executor thread count;
+            # flag-only interrupt check: stonewall snapshots are worker-
+            # thread business
+            client = make_client_for_rank(
+                self.worker.cfg, self.worker.rank,
+                interrupt_check=self.worker.check_interruption_flag_only)
+            self._tls.client = client
+            with self._clients_lock:
+                self._clients.append(client)
+        return client
+
+    def submit(self, fn, *args, **kwargs):
+        """fn(client, *args) -> bytes_done; returns once a slot is free.
+        Completed requests are harvested (counters updated) here and at
+        drain()."""
+        while len(self._inflight) >= self.depth:
+            self._harvest()
+
+        def task():
+            client = self._thread_client()  # construction outside t0
+            t0 = time.perf_counter_ns()
+            nbytes = fn(client, *args, **kwargs)
+            return nbytes, (time.perf_counter_ns() - t0) // 1000
+
+        self._inflight.append(self._pool.submit(task))
+
+    def _harvest(self) -> None:
+        import concurrent.futures
+        done, pending = concurrent.futures.wait(
+            self._inflight,
+            return_when=concurrent.futures.FIRST_COMPLETED)
+        self._inflight = list(pending)
+        worker = self.worker
+        for fut in done:
+            nbytes, lat_usec = fut.result()  # re-raises request errors
+            worker.iops_latency_histo.add_latency(lat_usec)
+            worker.live_ops.num_bytes_done += nbytes
+            worker.live_ops.num_iops_done += 1
+            worker._num_iops_submitted += 1
+
+    def drain(self) -> None:
+        while self._inflight:
+            self._harvest()
+
+    def abort(self) -> None:
+        """Interrupt/error path: wait out in-flight requests (their
+        clients poll the worker interrupt flag) without raising."""
+        for fut in self._inflight:
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 - phase is aborting anyway
+                pass
+        self._inflight = []
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        with self._clients_lock:
+            for client in self._clients:
+                client.close()
+            self._clients = []
+
+
+def _pipeline(worker) -> "_S3Pipeline | None":
+    """Per-phase pipeline when --iodepth > 1 (reference async variants)."""
+    if worker.cfg.io_depth <= 1:
+        return None
+    pipe = getattr(worker, "_s3_pipeline", None)
+    if pipe is None:
+        pipe = _S3Pipeline(worker, worker.cfg.io_depth)
+        worker._s3_pipeline = pipe
+    return pipe
+
+
 def dispatch_s3_phase(worker, phase: BenchPhase) -> None:
     cfg = worker.cfg
     handlers = {
@@ -257,6 +360,20 @@ def _upload_object(worker, bucket: str, key: str) -> None:
     upload_id = client.create_multipart_upload(
         bucket, key, extra_headers=_mpu_init_headers(cfg))
     parts: "list[tuple]" = []
+    # async variant: up to --iodepth part uploads in flight (reference:
+    # s3ModeUploadObjectMultiPartAsync, LocalWorker.cpp:5155)
+    pipe = _pipeline(worker)
+
+    def upload_one(part_client, part_number, body, headers):
+        etag = part_client.upload_part(bucket, key, upload_id, part_number,
+                                       body, extra_headers=headers)
+        if algo:  # completion XML must carry each part's checksum
+            parts.append((part_number, etag,
+                          headers[f"x-amz-checksum-{algo}"]))
+        else:
+            parts.append((part_number, etag))
+        return len(body)
+
     try:
         offset = 0
         part_number = 1
@@ -284,21 +401,20 @@ def _upload_object(worker, bucket: str, key: str) -> None:
                                        min(bs, length - sub))
                     for sub in range(0, length, bs))
             headers = _body_headers(cfg, body, _sse_c_headers(cfg) or None)
-            t0 = time.perf_counter_ns()
-            etag = client.upload_part(bucket, key, upload_id, part_number,
-                                      body, extra_headers=headers)
-            worker.iops_latency_histo.add_latency(
-                (time.perf_counter_ns() - t0) // 1000)
-            if algo:  # completion XML must carry each part's checksum
-                parts.append((part_number, etag,
-                              headers[f"x-amz-checksum-{algo}"]))
+            if pipe is not None:
+                pipe.submit(upload_one, part_number, body, headers)
             else:
-                parts.append((part_number, etag))
-            worker.live_ops.num_bytes_done += length
-            worker.live_ops.num_iops_done += 1
-            worker._num_iops_submitted += 1
+                t0 = time.perf_counter_ns()
+                upload_one(client, part_number, body, headers)
+                worker.iops_latency_histo.add_latency(
+                    (time.perf_counter_ns() - t0) // 1000)
+                worker.live_ops.num_bytes_done += length
+                worker.live_ops.num_iops_done += 1
+                worker._num_iops_submitted += 1
             offset += length
             part_number += 1
+        if pipe is not None:
+            pipe.drain()  # all parts must finish before completion
         if cfg.s3_no_mpu_completion:
             return  # --s3nompucompl: leave the upload incomplete on purpose
         _complete_mpu_ignoring_404(worker, client, bucket, key, upload_id,
@@ -306,6 +422,8 @@ def _upload_object(worker, bucket: str, key: str) -> None:
     except BaseException:
         # abort on interrupt/error so no orphaned MPU is left behind
         # (reference: LocalWorker.cpp:6044-6135)
+        if pipe is not None:
+            pipe.abort()
         try:
             client.abort_multipart_upload(bucket, key, upload_id)
         except Exception:  # noqa: BLE001
@@ -389,13 +507,59 @@ def _next_upload_block(worker, offset: int, length: int) -> bytes:
     return bytes(buf[:length])
 
 
+def _get_block(client, cfg, bucket: str, key: str, whole_object: bool,
+               offset: int, length: int, sse_c) -> "tuple[int, bytes]":
+    """One download block: whole-object or ranged GET, optionally
+    stream-and-discard (--s3fastget). Returns (bytes_got, data) — data is
+    b'' in discard mode. Raises on short reads."""
+    rng = (None, None) if whole_object else (offset, length)
+    if cfg.s3_fast_get:
+        got, data = client.get_object_discard(
+            bucket, key, range_start=rng[0], range_len=rng[1],
+            extra_headers=sse_c), b""
+    else:
+        data = client.get_object(bucket, key, range_start=rng[0],
+                                 range_len=rng[1], extra_headers=sse_c)
+        got = len(data)
+    if got != length:
+        raise WorkerException(
+            f"short S3 read for {bucket}/{key} at {offset}: "
+            f"{got} != {length}")
+    return got, data
+
+
 def _download_object(worker, bucket: str, key: str) -> None:
     """Whole-object GET when blocksize >= filesize, ranged GETs per block
-    otherwise (reference: download :6137)."""
+    otherwise (reference: download :6137). With --iodepth > 1 and no
+    buffer post-processing (no --verify / --tpuids), up to iodepth ranged
+    GETs run in flight (reference: async download :6280)."""
     cfg = worker.cfg
     client = _client(worker)
     size, bs = cfg.file_size, cfg.block_size
+    whole = size <= bs
     limiter = worker._rate_limiter_read
+    sse_c = _sse_c_headers(cfg) or None
+    pipe = _pipeline(worker) if (worker._tpu is None
+                                 and not cfg.integrity_check_salt) else None
+    if pipe is not None:
+        def get_one(get_client, offset, length):
+            return _get_block(get_client, cfg, bucket, key, whole, offset,
+                              length, sse_c)[0]
+
+        try:
+            offset = 0
+            while offset < size:
+                worker.check_interruption_request()
+                length = min(bs, size - offset)
+                if limiter:
+                    limiter.wait(length)
+                pipe.submit(get_one, offset, length)
+                offset += length
+            pipe.drain()  # entry completes when every block arrived
+        except BaseException:
+            pipe.abort()
+            raise
+        return
     offset = 0
     while offset < size:
         worker.check_interruption_request()
@@ -403,30 +567,16 @@ def _download_object(worker, bucket: str, key: str) -> None:
         if limiter:
             limiter.wait(length)
         t0 = time.perf_counter_ns()
-        sse_c = _sse_c_headers(cfg) or None
-        rng = (None, None) if size <= bs else (offset, length)
-        if cfg.s3_fast_get:
-            # --s3fastget: stream-and-discard, no buffer post-processing
-            got = client.get_object_discard(bucket, key,
-                                            range_start=rng[0],
-                                            range_len=rng[1],
-                                            extra_headers=sse_c)
-        else:
-            data = client.get_object(bucket, key, range_start=rng[0],
-                                     range_len=rng[1], extra_headers=sse_c)
-            got = len(data)
-        lat_usec = (time.perf_counter_ns() - t0) // 1000
-        if got != length:
-            raise WorkerException(
-                f"short S3 read for {bucket}/{key} at {offset}: "
-                f"{got} != {length}")
-        worker.iops_latency_histo.add_latency(lat_usec)
+        got, data = _get_block(client, cfg, bucket, key, whole, offset,
+                               length, sse_c)
+        worker.iops_latency_histo.add_latency(
+            (time.perf_counter_ns() - t0) // 1000)
         if not cfg.s3_fast_get:
             buf = worker._io_bufs[
                 worker._num_iops_submitted % len(worker._io_bufs)]
             buf[:length] = data
             worker._post_read_actions(buf, offset, length)
-        worker.live_ops.num_bytes_done += length
+        worker.live_ops.num_bytes_done += got
         worker.live_ops.num_iops_done += 1
         worker._num_iops_submitted += 1
         offset += length
