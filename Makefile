@@ -10,9 +10,15 @@ native:
 	$(MAKE) -C csrc
 
 # ThreadSanitizer build of the native engine (SURVEY.md section 5.2: the
-# reference has no sanitizer targets; we add one since the engine is new)
+# reference has no sanitizer targets; we add one since the engine is new).
+# Always rebuilds — the sanitized .so replaces the normal one until the
+# next `make native`.
 native-tsan:
+	$(MAKE) -C csrc clean
 	$(MAKE) -C csrc CXXFLAGS="-O1 -g -fsanitize=thread -fPIC -std=c++17"
+	@touch csrc/ioengine.cpp  # so the next `make native` rebuilds normally
+	@echo "tsan build done; run tests with:" \
+		"LD_PRELOAD=\$$(gcc -print-file-name=libtsan.so) pytest ..."
 
 test: native
 	python -m pytest tests/ -q

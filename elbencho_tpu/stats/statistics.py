@@ -142,8 +142,20 @@ class Statistics:
             elif not cfg.single_line_live_stats_no_erase:
                 print("\r\x1b[2K", end="", flush=True)
 
-    #: worker rows per fullscreen frame (scrollable window)
+    #: fallback worker rows per fullscreen frame when no tty size known
     _FS_ROWS = 40
+    #: header/footer lines around the worker table
+    _FS_CHROME_LINES = 6
+    #: per-frame snapshot of the terminal-derived row count
+    _fs_rows = _FS_ROWS
+
+    def _term_fs_rows(self) -> int:
+        """Worker rows that fit the current terminal (reference:
+        TerminalTk console size; read once per frame to follow resizes)."""
+        import shutil
+        lines = shutil.get_terminal_size(fallback=(80, 0)).lines
+        return max(lines - self._FS_CHROME_LINES, 4) if lines \
+            else self._FS_ROWS
 
     def _render_fullscreen(self, phase, elapsed, rate, ops_per_s, unit,
                            div, done) -> None:
@@ -153,6 +165,7 @@ class Statistics:
         cfg = self.cfg
         shared = self.manager.shared
         workers = self.manager.workers
+        self._fs_rows = self._term_fs_rows()  # one consistent size/frame
         self._poll_fullscreen_keys(len(workers))
         scroll = getattr(self, "_fs_scroll", 0)
         lines = []
@@ -172,7 +185,7 @@ class Statistics:
         lines.append("")
         lines.append(f"{'Rank':>6} {'Entries':>10} {unit:>10} {'IOPS':>12} "
                      f"{'State':>8}")
-        window = workers[scroll:scroll + self._FS_ROWS]
+        window = workers[scroll:scroll + self._fs_rows]
         for w in window:
             state = "done" if w.phase_finished else "run"
             lines.append(
@@ -225,7 +238,7 @@ class Statistics:
             return
         import select
         scroll = getattr(self, "_fs_scroll", 0)
-        max_scroll = max(num_workers - self._FS_ROWS, 0)
+        max_scroll = max(num_workers - self._fs_rows, 0)
         buf = b""
         try:
             while select.select([sys.stdin], [], [], 0)[0]:
@@ -246,9 +259,9 @@ class Statistics:
             elif seq in ("down", "j"):
                 scroll += 1
             elif seq in ("pgup", "\x02"):
-                scroll -= self._FS_ROWS
+                scroll -= self._fs_rows
             elif seq in ("pgdn", "\x06"):
-                scroll += self._FS_ROWS
+                scroll += self._fs_rows
             elif seq in ("home", "g"):
                 scroll = 0
             elif seq in ("end", "G"):
