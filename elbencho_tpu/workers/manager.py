@@ -96,7 +96,18 @@ class WorkerManager:
             flags |= os.O_CREAT
         if cfg.use_direct_io:
             flags |= os.O_DIRECT
-        self._shared_fds = [os.open(p, flags, 0o644) for p in cfg.paths]
+        self._shared_fds = []
+        for p in cfg.paths:
+            try:
+                # append as we go so a partial failure leaves the already-
+                # opened fds where join_all_threads can close them
+                self._shared_fds.append(os.open(p, flags, 0o644))
+            except OSError as err:
+                # reference: "Unable to open benchmark path" ProgException
+                # (prepareBenchPathFDsVec) — a clean error, not a crash
+                raise WorkerException(
+                    f"unable to open benchmark path: {err.filename}: "
+                    f"{err.strerror}") from err
         cfg.bench_path_fds = self._shared_fds
 
     def _wait_for_prep_done(self) -> None:

@@ -271,3 +271,14 @@ def test_csv_compat_check(tmp_path):
     rc2 = main(["-w", "-t", "1", "-s", "4K", "-b", "4K", "--nolive",
                 "--nocsvlabels", "--csvfile", str(csv), str(target)])
     assert rc2 == 1
+
+
+def test_missing_file_read_clean_error(tmp_path, capsys):
+    """Reading a non-existing file path fails with a clean error, not a
+    traceback (reference: prepareBenchPathFDsVec ProgException)."""
+    from elbencho_tpu.cli import main
+    rc = main(["-r", "-t", "1", "-s", "4K", "-b", "4K", "--nolive",
+               str(tmp_path / "nope")])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "unable to open benchmark path" in err.lower()
