@@ -35,7 +35,9 @@
 #include <linux/aio_abi.h>
 #include <linux/io_uring.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
 #include <sys/syscall.h>
+#include <fcntl.h>
 #include <unistd.h>
 
 namespace {
@@ -483,12 +485,111 @@ int run_uring_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
     return ret;
 }
 
+// ---------------------------------------------------------------------------
+// dir-mode file loop: open -> write/read blocks -> close per file (LOSF
+// hot path; reference: dirModeIterateFiles, LocalWorker.cpp:3055-3281 with
+// unlinkat/fstatat for the delete/stat phases)
+
+enum {
+    FILE_OP_WRITE = 0,
+    FILE_OP_READ = 1,
+    FILE_OP_STAT = 2,
+    FILE_OP_UNLINK = 3,
+};
+
+int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
+                  uint64_t n_files, int op, int open_flags,
+                  uint64_t file_size, uint64_t block_size, char* buf,
+                  int ignore_delete_errors, uint64_t* out_entry_lat,
+                  uint64_t* out_block_lat, uint64_t* out_bytes,
+                  uint64_t* out_entries, uint64_t* out_fail_idx,
+                  volatile int* interrupt_flag) {
+    uint64_t bytes_done = 0;
+    uint64_t entries_done = 0;
+    uint64_t block_idx = 0;
+    const uint64_t blocks_per_file = block_size
+        ? (file_size + block_size - 1) / block_size : 0;
+
+    for (uint64_t i = 0; i < n_files; ++i) {
+        if (interrupt_flag && *interrupt_flag)
+            break;
+        const char* path = paths_blob + path_offs[i];
+        const uint64_t t_entry = now_usec();
+
+        *out_fail_idx = i;  // pre-set: any error below names file i
+        if (op == FILE_OP_STAT) {
+            struct stat st;
+            if (stat(path, &st) != 0)
+                return -errno;
+        } else if (op == FILE_OP_UNLINK) {
+            if (unlink(path) != 0) {
+                if (!(errno == ENOENT && ignore_delete_errors))
+                    return -errno;
+            }
+        } else {
+            const int fd = open(path, open_flags, 0644);
+            if (fd < 0)
+                return -errno;
+            uint64_t off = 0;
+            uint64_t file_blocks = blocks_per_file;
+            while (file_blocks--) {
+                const uint64_t len = (off + block_size <= file_size)
+                    ? block_size : (file_size - off);
+                const uint64_t t0 = now_usec();
+                const ssize_t res = (op == FILE_OP_WRITE)
+                    ? pwrite(fd, buf, len, static_cast<off_t>(off))
+                    : pread(fd, buf, len, static_cast<off_t>(off));
+                out_block_lat[block_idx++] = now_usec() - t0;
+                if (res < 0) {
+                    const int err = errno;
+                    close(fd);
+                    return -err;
+                }
+                if (static_cast<uint64_t>(res) != len) {
+                    close(fd);
+                    return -EIO;
+                }
+                bytes_done += static_cast<uint64_t>(res);
+                off += len;
+            }
+            if (close(fd) != 0)
+                return -errno;
+        }
+        out_entry_lat[i] = now_usec() - t_entry;
+        ++entries_done;
+    }
+    *out_bytes = bytes_done;
+    *out_entries = entries_done;
+    return 0;
+}
+
 }  // namespace
 
 extern "C" {
 
 // engine selector values for ioengine_run_block_loop2
 enum { ENGINE_AUTO = 0, ENGINE_SYNC = 1, ENGINE_AIO = 2, ENGINE_URING = 3 };
+
+int ioengine_run_file_loop(const char* paths_blob,
+                           const uint32_t* path_offs, uint64_t n_files,
+                           int op, int open_flags, uint64_t file_size,
+                           uint64_t block_size, void* buf,
+                           int ignore_delete_errors,
+                           uint64_t* out_entry_lat, uint64_t* out_block_lat,
+                           uint64_t* out_bytes, uint64_t* out_entries,
+                           uint64_t* out_fail_idx, int* interrupt_flag) {
+    *out_fail_idx = 0;
+    if (n_files == 0) {
+        *out_bytes = 0;
+        *out_entries = 0;
+        return 0;
+    }
+    return run_file_loop(paths_blob, path_offs, n_files, op, open_flags,
+                         file_size, block_size, static_cast<char*>(buf),
+                         ignore_delete_errors, out_entry_lat, out_block_lat,
+                         out_bytes, out_entries, out_fail_idx,
+                         interrupt_flag);
+}
 
 int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
                              const uint64_t* lengths, uint64_t n,
@@ -539,7 +640,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 2 (sync+aio+uring)";
+    return "elbencho-tpu ioengine 3 (sync+aio+uring+fileloop)";
 }
 
 }  // extern "C"

@@ -51,9 +51,77 @@ class _NativeEngine:
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
+        lib.ioengine_run_file_loop.restype = ctypes.c_int
+        lib.ioengine_run_file_loop.argtypes = [
+            ctypes.c_char_p,                  # NUL-separated paths blob
+            ctypes.POINTER(ctypes.c_uint32),  # per-path blob offsets
+            ctypes.c_uint64,                  # num files
+            ctypes.c_int,                     # op (FILE_OPS)
+            ctypes.c_int,                     # open flags
+            ctypes.c_uint64,                  # file size
+            ctypes.c_uint64,                  # block size
+            ctypes.c_void_p,                  # io buffer
+            ctypes.c_int,                     # ignore delete errors
+            ctypes.POINTER(ctypes.c_uint64),  # out: entry latencies
+            ctypes.POINTER(ctypes.c_uint64),  # out: block latencies
+            ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
+            ctypes.POINTER(ctypes.c_uint64),  # out: entries done
+            ctypes.POINTER(ctypes.c_uint64),  # out: failing file index
+            ctypes.POINTER(ctypes.c_int),     # interrupt flag
+        ]
 
     def uring_supported(self) -> bool:
         return bool(self._lib.ioengine_uring_supported())
+
+    #: op codes of ioengine_run_file_loop (csrc/ioengine.cpp FILE_OP_*)
+    FILE_OPS = {"write": 0, "read": 1, "stat": 2, "unlink": 3}
+
+    def run_file_loop(self, paths: "list[str]", op: str, open_flags: int,
+                      file_size: int, block_size: int, buf_addr: int,
+                      ignore_delete_errors: bool, worker,
+                      interrupt_flag=None) -> None:
+        """Dir-mode LOSF hot path: open->blocks->close (or stat/unlink)
+        per file, entirely in C++. Counters/histograms update after the
+        call; partial (interrupted) chunks attribute only completed
+        files."""
+        n = len(paths)
+        encoded = [os.fsencode(p) for p in paths]
+        blob = b"\0".join(encoded) + b"\0"
+        offs = (ctypes.c_uint32 * n)()
+        pos = 0
+        for i, e in enumerate(encoded):
+            offs[i] = pos
+            pos += len(e) + 1
+        blocks_per_file = (file_size + block_size - 1) // block_size \
+            if block_size and op in ("write", "read") and file_size else 0
+        entry_lat = (ctypes.c_uint64 * n)()
+        block_lat = (ctypes.c_uint64 * max(n * blocks_per_file, 1))()
+        bytes_done = ctypes.c_uint64(0)
+        entries_done = ctypes.c_uint64(0)
+        fail_idx = ctypes.c_uint64(0)
+        interrupt = (interrupt_flag if interrupt_flag is not None
+                     else ctypes.c_int(0))
+        ret = self._lib.ioengine_run_file_loop(
+            blob, offs, n, self.FILE_OPS[op], open_flags, file_size,
+            block_size, ctypes.c_void_p(buf_addr),
+            1 if ignore_delete_errors else 0, entry_lat, block_lat,
+            ctypes.byref(bytes_done), ctypes.byref(entries_done),
+            ctypes.byref(fail_idx), ctypes.byref(interrupt))
+        if ret < 0:
+            failed = paths[min(fail_idx.value, n - 1)]
+            raise OSError(-ret, f"{os.strerror(-ret)} "
+                                f"({op}: {failed})", failed)
+        done = entries_done.value
+        for i in range(done):
+            worker.entries_latency_histo.add_latency(entry_lat[i])
+        num_blocks = done * blocks_per_file
+        for j in range(num_blocks):
+            worker.iops_latency_histo.add_latency(block_lat[j])
+        worker.live_ops.num_entries_done += done
+        worker.live_ops.num_iops_done += num_blocks
+        worker.live_ops.num_bytes_done += bytes_done.value
+        worker._num_iops_submitted += num_blocks
+        worker.create_stonewall_stats_if_triggered()
 
     def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,
                        buf_addr: int, iodepth: int, worker,

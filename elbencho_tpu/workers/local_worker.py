@@ -383,11 +383,99 @@ class LocalWorker(Worker):
             self.entries_latency_histo.add_latency(lat_usec)
             self.live_ops.num_entries_done += 1
 
+    _NATIVE_FILE_OPS = {BenchPhase.CREATEFILES: "write",
+                        BenchPhase.READFILES: "read",
+                        BenchPhase.STATFILES: "stat",
+                        BenchPhase.DELETEFILES: "unlink"}
+
+    def _can_use_native_file_loop(self, native, phase: BenchPhase) -> bool:
+        """The whole open->blocks->close per-file loop runs in C++ when no
+        per-op Python feature is active (the LOSF hot path; reference:
+        dirModeIterateFiles is native there by construction)."""
+        cfg = self.cfg
+        return (native is not None
+                and phase in self._NATIVE_FILE_OPS
+                and cfg.io_engine in ("auto", "sync")
+                and cfg.io_depth <= 1
+                and self._ops_log is None
+                and self._tpu is None
+                and not cfg.integrity_check_salt
+                and not cfg.block_variance_pct
+                and not cfg.rwmix_read_pct
+                and not getattr(self, "_rwmix_thread_reader", False)
+                and not cfg.do_read_inline
+                and not cfg.do_direct_verify
+                and not cfg.do_stat_inline
+                and not cfg.do_prealloc_file
+                and not cfg.do_truncate_to_size
+                and not cfg.fadvise_flags
+                and not cfg.use_mmap
+                and not cfg.use_file_locks
+                and not cfg.use_random_offsets
+                and not cfg.do_reverse_seq_offsets
+                and self._rate_limiter_read is None
+                and self._rate_limiter_write is None)
+
+    def _run_native_file_loop(self, native, phase: BenchPhase) -> None:
+        """Chunked delegation of the per-file loop to the C++ engine."""
+        cfg = self.cfg
+        op = self._NATIVE_FILE_OPS[phase]
+        if phase == BenchPhase.CREATEFILES:
+            open_flags = self._open_flags_write()
+        else:
+            open_flags = os.O_RDONLY | (os.O_DIRECT if cfg.use_direct_io
+                                        else 0)
+        if op in ("write", "read") and cfg.file_size:
+            # cap each native call at ~8192 blocks AND ~256 MiB of I/O so
+            # live stats/stonewall snapshots stay fresh (same bounds as
+            # _native_chunk_blocks)
+            blocks_per_file = max(
+                (cfg.file_size + cfg.block_size - 1) // cfg.block_size, 1)
+            chunk = max(1, min(8192 // blocks_per_file,
+                               (256 << 20) // cfg.file_size))
+        else:
+            chunk = 8192  # stat/unlink: no block I/O, only path batching
+        paths: "list[str]" = []
+
+        def submit():
+            self.check_interruption_request(force=True)
+            try:
+                native.run_file_loop(
+                    paths, op, open_flags, cfg.file_size, cfg.block_size,
+                    # stat/unlink (and 0-byte files) never touch the buffer
+                    buf_addr=self._buf_addr() if self._io_bufs else 0,
+                    ignore_delete_errors=cfg.ignore_delete_errors,
+                    worker=self, interrupt_flag=self._native_interrupt)
+            except FileNotFoundError as err:
+                if phase == BenchPhase.CREATEFILES \
+                        and not cfg.run_create_dirs:
+                    # parity hint (reference: dirModeOpenAndPrepFile :7395)
+                    raise WorkerException(
+                        "File create/open failed. Did you forget to enable "
+                        "directory creation ('--mkdirs'/-d)?") from err
+                raise
+
+        for dir_idx in range(cfg.num_dirs):
+            base = self._bench_path_for_dir(dir_idx)
+            for file_idx in range(cfg.num_files):
+                paths.append(os.path.join(
+                    base, self._file_rel_path(dir_idx, file_idx)))
+                if len(paths) >= chunk:
+                    submit()
+                    paths = []
+        if paths:
+            submit()
+
     def _dir_mode_iterate_files(self, phase: BenchPhase) -> None:
         """open -> [stat-inline] -> block loop -> close per file; entry
         latency histogram per file (reference: dirModeIterateFiles
         :3055-3281, unlinkat/fstatat for del/stat :3237-3249)."""
         cfg = self.cfg
+        from ..utils.native import get_native_engine
+        native = get_native_engine()
+        if self._can_use_native_file_loop(native, phase):
+            self._run_native_file_loop(native, phase)
+            return
         for dir_idx in range(cfg.num_dirs):
             for file_idx in range(cfg.num_files):
                 self.check_interruption_request(force=True)

@@ -204,3 +204,63 @@ def test_worker_uses_native_engine(tmp_path, monkeypatch):
                "--nolive", str(target)])
     assert rc == 0
     reset_native_engine_cache()
+
+
+def test_native_file_loop_dir_mode(tmp_path, monkeypatch):
+    """LOSF dir-mode phases run through the C++ file loop end-to-end:
+    create, stat, read, delete — correct tree, sizes and counts."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils.native import (get_native_engine,
+                                           reset_native_engine_cache)
+    reset_native_engine_cache()
+    if get_native_engine() is None:
+        pytest.skip("native engine unavailable")
+    from elbencho_tpu.cli import main
+    args = ["-t", "2", "-n", "2", "-N", "3", "-s", "8K", "-b", "4K",
+            "--nolive", str(tmp_path)]
+    assert main(["-w", "-d"] + args) == 0
+    files = sorted(tmp_path.rglob("r*-f*"))
+    assert len(files) == 2 * 2 * 3
+    assert all(f.stat().st_size == 8192 for f in files)
+    assert main(["-r", "--stat"] + args) == 0
+    assert main(["-F", "-D"] + args) == 0
+    assert not any(tmp_path.iterdir())
+    reset_native_engine_cache()
+
+
+def test_native_file_loop_nodelerr(tmp_path, monkeypatch):
+    """--nodelerr through the native loop: deleting missing files is only
+    an error when the flag is off."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils.native import (get_native_engine,
+                                           reset_native_engine_cache)
+    reset_native_engine_cache()
+    if get_native_engine() is None:
+        pytest.skip("native engine unavailable")
+    from elbencho_tpu.cli import main
+    args = ["-t", "1", "-n", "1", "-N", "2", "-s", "0", "--nolive",
+            str(tmp_path)]
+    assert main(["-F"] + args) != 0          # nothing to delete: error
+    assert main(["-F", "--nodelerr"] + args) == 0
+    reset_native_engine_cache()
+
+
+def test_native_file_loop_matches_python_content(tmp_path, monkeypatch):
+    """Files written by the native loop read back identically through the
+    Python path (same buffer-fill source)."""
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    from elbencho_tpu.cli import main
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    reset_native_engine_cache()
+    assert main(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "16K",
+                 "-b", "4K", "--nolive", str(tmp_path)]) == 0
+    f = next(tmp_path.rglob("r0-f0"))
+    data = f.read_bytes()
+    assert len(data) == 16384
+    assert data != b"\0" * 16384  # random-filled, not sparse zeros
+    # python path reads it fine with identical accounting
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    reset_native_engine_cache()
+    assert main(["-r", "-t", "1", "-n", "1", "-N", "1", "-s", "16K",
+                 "-b", "4K", "--nolive", str(tmp_path)]) == 0
+    reset_native_engine_cache()
