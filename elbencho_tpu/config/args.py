@@ -293,6 +293,10 @@ FLAG_DEFS = [
      "Derive --hosts from this TPU pod slice's worker VMs "
      "(TPU_WORKER_HOSTNAMES env or GCE metadata; each worker must run "
      "--service)"),
+    ("tpumultihost", None, "tpu_multihost", "str", "", "tpu",
+     "Join the multi-host JAX runtime before device use so --tpubench/"
+     "--tpuids meshes span the whole pod ('auto' on TPU VMs, or "
+     "'host:port[,nprocs,procid]')"),
 
     # NUMA/core binding
     ("zones", None, "numa_zones_str", "str", "", "multi",
@@ -730,6 +734,24 @@ class BenchConfig(BenchConfigBase):
                 raise ConfigError(
                     "direct I/O requires file size and block size to be "
                     "multiples of 512 bytes (use --nodiocheck to override)")
+        if self.tpu_multihost and self.tpu_multihost != "auto":
+            parts = self.tpu_multihost.split(",")
+            if ":" not in parts[0] or len(parts) > 3:
+                raise ConfigError(
+                    "--tpumultihost must be 'auto' or "
+                    "'host:port[,num_processes,process_id]'")
+            try:
+                [int(p) for p in parts[1:]]
+            except ValueError as err:
+                raise ConfigError(
+                    "--tpumultihost process counts must be integers") \
+                    from err
+            if len(parts) == 3 and self.hosts:
+                raise ConfigError(
+                    "--tpumultihost with an explicit process_id cannot be "
+                    "combined with --hosts (every service would join with "
+                    "the same id; give just 'host:port' and the master "
+                    "assigns per-host ids)")
         if self.io_engine not in ("auto", "sync", "aio", "uring"):
             raise ConfigError("--ioengine must be auto|sync|aio|uring")
         if self.io_engine == "sync" and self.io_depth > 1:
@@ -901,6 +923,14 @@ class BenchConfig(BenchConfigBase):
             host_idx = service_rank_offset // max(self.num_threads, 1)
             d["tpu_ids_str"] = str(
                 self.tpu_ids[host_idx % len(self.tpu_ids)])
+        if self.tpu_multihost and self.tpu_multihost != "auto" \
+                and self.hosts:
+            # manual coordinator: every service joins with its own
+            # process_id (host index); num_processes = number of hosts
+            host_idx = service_rank_offset // max(self.num_threads, 1)
+            coordinator = self.tpu_multihost.split(",")[0]
+            d["tpu_multihost"] = \
+                f"{coordinator},{len(self.hosts)},{host_idx}"
         if self.run_netbench and self.hosts:
             # netbench topology: server data port = service port + 1000
             # (reference: LocalWorker.cpp:646 servers listen on svc+1000)

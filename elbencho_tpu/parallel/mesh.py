@@ -14,6 +14,39 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+_multihost_lock = __import__("threading").Lock()
+_multihost_initialized = False
+
+
+def init_multihost(spec: str = "auto") -> bool:
+    """Join this process into a multi-host JAX runtime so meshes span the
+    whole pod slice (--tpumultihost; each service VM of a distributed run
+    calls this before first device use).
+
+    spec: "auto" lets the TPU runtime discover the coordinator (GCE TPU
+    VMs); "host:port[,num_processes,process_id]" configures it manually
+    (the master rewrites process_id per service host). Returns True when
+    initialization ran, False when this process already joined. Real
+    init failures (unreachable coordinator etc.) propagate — a silent
+    single-host fallback would publish wrong pod-wide numbers.
+    """
+    global _multihost_initialized
+    kwargs = {}
+    if spec and spec != "auto":
+        parts = spec.split(",")
+        kwargs["coordinator_address"] = parts[0]
+        if len(parts) > 1:
+            kwargs["num_processes"] = int(parts[1])
+        if len(parts) > 2:
+            kwargs["process_id"] = int(parts[2])
+    with _multihost_lock:  # worker threads prep concurrently
+        if _multihost_initialized:
+            return False
+        jax.distributed.initialize(**kwargs)
+        _multihost_initialized = True
+        return True
+
+
 def make_ingest_mesh(devices: "list | None" = None,
                      num_hosts: "int | None" = None) -> Mesh:
     """2D ("host", "chip") mesh over the given devices.

@@ -82,6 +82,11 @@ class LocalWorker(Worker):
                 or cfg.bench_mode in (BenchMode.NETBENCH, BenchMode.S3):
             self._alloc_io_buffer()
         self._s3_client = None  # created lazily by workers/s3_worker.py
+        if cfg.tpu_multihost and cfg.tpu_ids:
+            # join the pod-wide runtime BEFORE first device use so jax
+            # meshes span every host (idempotent across re-preps)
+            from ..parallel.mesh import init_multihost
+            init_multihost(cfg.tpu_multihost)
         if cfg.tpu_ids:
             from ..tpu.device import TpuWorkerContext
             chip = cfg.tpu_ids[self.rank % len(cfg.tpu_ids)]

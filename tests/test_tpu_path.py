@@ -235,3 +235,47 @@ def test_podhosts_enumeration(monkeypatch):
         ["hostA", "hostB"]
     with pytest.raises(RuntimeError):
         parse_worker_network_endpoints("  ")
+
+
+def test_tpu_multihost_init(monkeypatch):
+    """--tpumultihost: jax.distributed.initialize runs exactly once per
+    process (thread-safe) with the parsed spec; real failures propagate;
+    the master assigns per-host process ids on the wire."""
+    import jax
+    from elbencho_tpu.parallel import mesh
+
+    calls = []
+    monkeypatch.setattr(mesh, "_multihost_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    assert mesh.init_multihost("coord:1234,4,2") is True
+    assert calls == [{"coordinator_address": "coord:1234",
+                      "num_processes": 4, "process_id": 2}]
+    assert mesh.init_multihost("auto") is False  # once per process
+    assert len(calls) == 1
+
+    # real init failures propagate (no silent single-host fallback)
+    monkeypatch.setattr(mesh, "_multihost_initialized", False)
+    def boom(**kw):
+        raise RuntimeError("coordinator unreachable")
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        mesh.init_multihost("auto")
+
+    # config validation + per-host id assignment on the service wire
+    from elbencho_tpu.config.args import BenchConfig, ConfigError
+    with pytest.raises(ConfigError, match="process_id"):
+        BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                    tpu_multihost="c:1,2,0", hosts_str="a,b",
+                    paths=["/tmp/x"]).derive(probe_paths=False).check()
+    with pytest.raises(ConfigError, match="integers"):
+        BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                    tpu_multihost="c:1,four",
+                    paths=["/tmp/x"]).derive(probe_paths=False).check()
+    cfg = BenchConfig(run_read_files=True, file_size=1, block_size=1,
+                      num_threads=2, tpu_multihost="coord:9999",
+                      hosts_str="a,b,c", paths=["/tmp/x"])
+    cfg.derive(probe_paths=False)
+    wires = [cfg.to_service_dict(service_rank_offset=i * 2)["tpu_multihost"]
+             for i in range(3)]
+    assert wires == ["coord:9999,3,0", "coord:9999,3,1", "coord:9999,3,2"]
