@@ -68,7 +68,8 @@ inline int sys_io_getevents(aio_context_t ctx, long min_nr, long nr,
         syscall(SYS_io_getevents, ctx, min_nr, nr, events, timeout));
 }
 
-int run_sync_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
+int run_sync_loop(const int* fds, const uint32_t* fd_idx,
+                  const uint64_t* offsets, const uint64_t* lengths,
                   uint64_t n, int is_write, char* buf,
                   uint64_t* out_lat_usec, uint64_t* out_bytes,
                   volatile int* interrupt_flag) {
@@ -77,6 +78,7 @@ int run_sync_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
         if ((i % kInterruptCheckInterval) == 0 && interrupt_flag
                 && *interrupt_flag)
             break;
+        const int fd = fds[fd_idx ? fd_idx[i] : 0];
         const uint64_t len = lengths[i];
         const uint64_t off = offsets[i];
         const uint64_t t0 = now_usec();
@@ -101,7 +103,8 @@ struct AioSlot {
     uint64_t block_idx;
 };
 
-int run_aio_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
+int run_aio_loop(const int* fds, const uint32_t* fd_idx,
+                 const uint64_t* offsets, const uint64_t* lengths,
                  uint64_t n, int is_write, const char* src_buf,
                  uint64_t buf_size, int iodepth, uint64_t* out_lat_usec,
                  uint64_t* out_bytes, volatile int* interrupt_flag) {
@@ -135,7 +138,8 @@ int run_aio_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
         while (in_flight < iodepth && next_submit < n) {
             AioSlot& s = slots[in_flight];
             memset(&s.cb, 0, sizeof(s.cb));
-            s.cb.aio_fildes = static_cast<uint32_t>(fd);
+            s.cb.aio_fildes = static_cast<uint32_t>(
+                fds[fd_idx ? fd_idx[next_submit] : 0]);
             s.cb.aio_lio_opcode = is_write ? IOCB_CMD_PWRITE : IOCB_CMD_PREAD;
             s.cb.aio_buf = reinterpret_cast<uint64_t>(s.buf);
             s.cb.aio_nbytes = lengths[next_submit];
@@ -184,7 +188,8 @@ int run_aio_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
                 --in_flight;
                 if (next_submit < n) {  // refill this slot
                     memset(&s->cb, 0, sizeof(s->cb));
-                    s->cb.aio_fildes = static_cast<uint32_t>(fd);
+                    s->cb.aio_fildes = static_cast<uint32_t>(
+                        fds[fd_idx ? fd_idx[next_submit] : 0]);
                     s->cb.aio_lio_opcode =
                         is_write ? IOCB_CMD_PWRITE : IOCB_CMD_PREAD;
                     s->cb.aio_buf = reinterpret_cast<uint64_t>(s->buf);
@@ -339,7 +344,8 @@ struct UringRings {
     }
 };
 
-int run_uring_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
+int run_uring_loop(const int* fds, const uint32_t* fd_idx,
+                   const uint64_t* offsets, const uint64_t* lengths,
                    uint64_t n, int is_write, const char* src_buf,
                    uint64_t buf_size, int iodepth, uint64_t* out_lat_usec,
                    uint64_t* out_bytes, volatile int* interrupt_flag) {
@@ -380,7 +386,7 @@ int run_uring_loop(int fd, const uint64_t* offsets, const uint64_t* lengths,
         io_uring_sqe* sqe = &ring.sqes[idx];
         memset(sqe, 0, sizeof(*sqe));
         sqe->opcode = is_write ? IORING_OP_WRITE : IORING_OP_READ;
-        sqe->fd = fd;
+        sqe->fd = fds[fd_idx ? fd_idx[next_submit] : 0];
         sqe->addr = reinterpret_cast<uint64_t>(s.buf);
         sqe->len = static_cast<uint32_t>(lengths[next_submit]);
         sqe->off = offsets[next_submit];
@@ -591,28 +597,43 @@ int ioengine_run_file_loop(const char* paths_blob,
                          interrupt_flag);
 }
 
+// multi-fd variant: fd_idx[i] selects fds[] per block (NULL -> fds[0]);
+// this is the shared-file striping path (calcFileIdxAndOffsetStriped)
+int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
+                               const uint64_t* offsets,
+                               const uint64_t* lengths, uint64_t n,
+                               int is_write, void* buf, uint64_t buf_size,
+                               int iodepth, uint64_t* out_lat_usec,
+                               uint64_t* out_bytes, int* interrupt_flag,
+                               int engine) {
+    if (n == 0) {
+        *out_bytes = 0;
+        return 0;
+    }
+    if (engine == ENGINE_URING)
+        return run_uring_loop(fds, fd_idx, offsets, lengths, n, is_write,
+                              static_cast<const char*>(buf), buf_size,
+                              iodepth, out_lat_usec, out_bytes,
+                              interrupt_flag);
+    if (engine == ENGINE_SYNC || (engine == ENGINE_AUTO && iodepth <= 1))
+        return run_sync_loop(fds, fd_idx, offsets, lengths, n, is_write,
+                             static_cast<char*>(buf), out_lat_usec,
+                             out_bytes, interrupt_flag);
+    return run_aio_loop(fds, fd_idx, offsets, lengths, n, is_write,
+                        static_cast<const char*>(buf), buf_size, iodepth,
+                        out_lat_usec, out_bytes, interrupt_flag);
+}
+
 int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
                              const uint64_t* lengths, uint64_t n,
                              int is_write, void* buf, uint64_t buf_size,
                              int iodepth, uint64_t* out_lat_usec,
                              uint64_t* out_bytes, int* interrupt_flag,
                              int engine) {
-    if (n == 0) {
-        *out_bytes = 0;
-        return 0;
-    }
-    if (engine == ENGINE_URING)
-        return run_uring_loop(fd, offsets, lengths, n, is_write,
-                              static_cast<const char*>(buf), buf_size,
-                              iodepth, out_lat_usec, out_bytes,
-                              interrupt_flag);
-    if (engine == ENGINE_SYNC || (engine == ENGINE_AUTO && iodepth <= 1))
-        return run_sync_loop(fd, offsets, lengths, n, is_write,
-                             static_cast<char*>(buf), out_lat_usec,
-                             out_bytes, interrupt_flag);
-    return run_aio_loop(fd, offsets, lengths, n, is_write,
-                        static_cast<const char*>(buf), buf_size, iodepth,
-                        out_lat_usec, out_bytes, interrupt_flag);
+    return ioengine_run_block_loop_mf(&fd, nullptr, offsets, lengths, n,
+                                      is_write, buf, buf_size, iodepth,
+                                      out_lat_usec, out_bytes,
+                                      interrupt_flag, engine);
 }
 
 int ioengine_run_block_loop(int fd, const uint64_t* offsets,

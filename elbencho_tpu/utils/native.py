@@ -34,9 +34,10 @@ class _NativeEngine:
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
-        lib.ioengine_run_block_loop2.restype = ctypes.c_int
-        lib.ioengine_run_block_loop2.argtypes = [
-            ctypes.c_int,                     # fd
+        lib.ioengine_run_block_loop_mf.restype = ctypes.c_int
+        lib.ioengine_run_block_loop_mf.argtypes = [
+            ctypes.POINTER(ctypes.c_int),     # fds
+            ctypes.POINTER(ctypes.c_uint32),  # per-block fd index (or None)
             ctypes.POINTER(ctypes.c_uint64),  # offsets
             ctypes.POINTER(ctypes.c_uint64),  # lengths
             ctypes.c_uint64,                  # num_blocks
@@ -125,7 +126,11 @@ class _NativeEngine:
 
     def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,
                        buf_addr: int, iodepth: int, worker,
-                       interrupt_flag=None, engine: str = "auto") -> bool:
+                       interrupt_flag=None, engine: str = "auto",
+                       fds: "list[int] | None" = None,
+                       fd_idx: "list[int] | None" = None) -> bool:
+        """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
+        file of block i (reference: calcFileIdxAndOffsetStriped)."""
         n = len(offsets)
         off_arr = (ctypes.c_uint64 * n)(*offsets)
         len_arr = (ctypes.c_uint64 * n)(*lengths)
@@ -134,8 +139,14 @@ class _NativeEngine:
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))  # c_int(0) is falsy: no `or`!
         buf_size = max(lengths)
-        ret = self._lib.ioengine_run_block_loop2(
-            fd, off_arr, len_arr, n, 1 if is_write else 0,
+        if fds is None:
+            fds_arr = (ctypes.c_int * 1)(fd)
+            idx_arr = None
+        else:
+            fds_arr = (ctypes.c_int * len(fds))(*fds)
+            idx_arr = (ctypes.c_uint32 * n)(*fd_idx)
+        ret = self._lib.ioengine_run_block_loop_mf(
+            fds_arr, idx_arr, off_arr, len_arr, n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), buf_size, iodepth,
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt),
             ENGINE_CODES[engine])

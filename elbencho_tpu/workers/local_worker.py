@@ -609,31 +609,45 @@ class LocalWorker(Worker):
 
     def _rw_block_sized(self, fd: int, gen, is_write: bool,
                         file_offset_base: int = 0,
-                        multi_file: "object | None" = None) -> None:
+                        multi_file: "object | None" = None,
+                        stripe: "tuple | None" = None) -> None:
         """offset-gen loop -> rate limit -> [rwmix decision] -> [fill buf] ->
         positional I/O -> [verify] -> [TPU H2D] -> latency + counters.
 
         When the native C++ ioengine is available and the workload qualifies
-        (no verify/rwmix/TPU/opslog), the whole loop is delegated to it.
+        (no verify/rwmix/TPU/opslog), the whole loop is delegated to it —
+        including striped multi-file mode via ``stripe=(fds, file_size)``
+        (the structured form of the ``multi_file`` mapping).
         """
         cfg = self.cfg
+        if stripe is not None and multi_file is None:
+            # single source of truth: derive the Python-fallback mapping
+            # from the structured stripe info
+            stripe_fds, stripe_size = stripe
+
+            def multi_file(global_off, length):  # noqa: ARG001
+                return (stripe_fds[global_off // stripe_size],
+                        global_off % stripe_size)
         from ..utils.native import get_native_engine
         native = get_native_engine()
-        if (native is not None and multi_file is None and self._tpu is None
+        if (native is not None
+                and (multi_file is None or stripe is not None)
+                and self._tpu is None
                 and not cfg.integrity_check_salt and not cfg.rwmix_read_pct
                 and not cfg.block_variance_pct and self._ops_log is None
                 and not cfg.do_read_inline and not cfg.do_direct_verify
+                and not cfg.use_file_locks
                 and self._rate_limiter_read is None
                 and self._rate_limiter_write is None):
             if self._run_native_block_loop(native, fd, gen, is_write,
-                                           file_offset_base):
+                                           file_offset_base, stripe):
                 return
         if cfg.io_engine != "auto":
             raise WorkerException(
                 f"--ioengine {cfg.io_engine} only supports the plain native "
                 f"block loop — incompatible with --verify/--verifydirect/"
-                f"--readinline/--rwmixpct/--blockvarpct/--opslog/rate "
-                f"limits/--tpuids/multi-file striping")
+                f"--readinline/--rwmixpct/--blockvarpct/--opslog/--flock/"
+                f"rate limits/--tpuids")
         num_bufs = len(self._io_bufs)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
         # the byte-ratio balancer only applies to the mixed WRITE phase
@@ -711,14 +725,18 @@ class LocalWorker(Worker):
         return max(1, min(8192, by_bytes))
 
     def _run_native_block_loop(self, native, fd, gen, is_write,
-                               file_offset_base) -> bool:
+                               file_offset_base, stripe=None) -> bool:
         """Delegate the block loop to the C++ engine in chunks (bounded
         memory, live-stats progress, interruptibility between chunks);
         counters and latency buckets sync back per chunk. The engine also
-        polls our interrupt flag every 128 ops within a chunk."""
+        polls our interrupt flag every 128 ops within a chunk. With
+        ``stripe=(fds, file_size)`` global offsets map to per-block
+        (file, in-file offset) pairs (calcFileIdxAndOffsetStriped)."""
         chunk = self._native_chunk_blocks()
+        stripe_fds, stripe_size = stripe if stripe else (None, 0)
         offsets: "list[int]" = []
         lengths: "list[int]" = []
+        fd_idx: "list[int]" = []
 
         def submit():
             self.check_interruption_request(force=True)
@@ -726,14 +744,20 @@ class LocalWorker(Worker):
                 fd=fd, offsets=offsets, lengths=lengths, is_write=is_write,
                 buf_addr=self._buf_addr(), iodepth=self.cfg.io_depth,
                 worker=self, interrupt_flag=self._native_interrupt,
-                engine=self.cfg.io_engine)
+                engine=self.cfg.io_engine, fds=stripe_fds,
+                fd_idx=fd_idx if stripe_fds else None)
 
         for off, length in gen:
-            offsets.append(file_offset_base + off)
+            if stripe_fds:
+                goff = file_offset_base + off
+                fd_idx.append(goff // stripe_size)
+                offsets.append(goff % stripe_size)
+            else:
+                offsets.append(file_offset_base + off)
             lengths.append(length)
             if len(offsets) >= chunk:
                 submit()
-                offsets, lengths = [], []
+                offsets, lengths, fd_idx = [], [], []
         if offsets:
             submit()
         return True
@@ -920,13 +944,6 @@ class LocalWorker(Worker):
         num_files = len(cfg.paths)
         total_range = cfg.file_size * num_files
 
-        def multi_file(global_off: int, length: int) -> "tuple[int, int]":
-            """Map a global offset over the logical concatenated range to
-            (fd, in-file offset) (reference: calcFileIdxAndOffsetStriped,
-            LocalWorker.cpp:2084)."""
-            file_idx = global_off // cfg.file_size
-            return (self._path_fds[file_idx], global_off % cfg.file_size)
-
         gen = self._make_file_mode_offset_gen(is_write, total_range)
         if gen is None:
             self.got_phase_work = False
@@ -934,10 +951,15 @@ class LocalWorker(Worker):
         if is_write and cfg.do_truncate_to_size:
             for fd in self._path_fds:
                 os.ftruncate(fd, cfg.file_size)
-        # single file/bdev: global offsets ARE in-file offsets, so skip the
-        # mapping closure and let the native C++ engine take the hot loop
-        self._rw_block_sized(self._path_fds[0], gen, is_write,
-                             multi_file=multi_file if num_files > 1 else None)
+        # single file/bdev: global offsets ARE in-file offsets; striped
+        # multi-file passes the (fds, file_size) mapping — the native C++
+        # engine takes the hot loop in both shapes, the Python fallback
+        # derives its per-block mapping from the same stripe tuple
+        # (reference: calcFileIdxAndOffsetStriped, LocalWorker.cpp:2084)
+        self._rw_block_sized(
+            self._path_fds[0], gen, is_write,
+            stripe=(list(self._path_fds), cfg.file_size)
+            if num_files > 1 else None)
 
     def _make_file_mode_offset_gen(self, is_write: bool, total_range: int):
         """Per-worker share of the shared file/bdev range: seq mode slices a

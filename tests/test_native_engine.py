@@ -264,3 +264,56 @@ def test_native_file_loop_matches_python_content(tmp_path, monkeypatch):
     assert main(["-r", "-t", "1", "-n", "1", "-N", "1", "-s", "16K",
                  "-b", "4K", "--nolive", str(tmp_path)]) == 0
     reset_native_engine_cache()
+
+
+def test_native_striped_multifile(tmp_path, monkeypatch):
+    """Shared-file striping (multiple file paths as one logical range)
+    runs through the native multi-fd block loop for sync, aio and uring,
+    filling every file fully."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils.native import (get_native_engine,
+                                           reset_native_engine_cache)
+    reset_native_engine_cache()
+    native = get_native_engine()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    from elbencho_tpu.cli import main
+    f1, f2 = tmp_path / "a", tmp_path / "b"
+    cases = [("sync", "1"), ("aio", "4")]
+    if native.uring_supported():
+        cases.append(("uring", "4"))
+    for engine, depth in cases:
+        f1.write_bytes(b""); f2.write_bytes(b"")
+        rc = main(["-w", "-t", "2", "-s", "256K", "-b", "32K",
+                   "--ioengine", engine, "--iodepth", depth, "--nolive",
+                   str(f1), str(f2)])
+        assert rc == 0, engine
+        assert f1.stat().st_size == 256 * 1024
+        assert f2.stat().st_size == 256 * 1024
+        assert f1.read_bytes() != b"\0" * (256 * 1024)  # data written
+        rc = main(["-r", "-t", "2", "-s", "256K", "-b", "32K",
+                   "--ioengine", engine, "--iodepth", depth, "--nolive",
+                   str(f1), str(f2)])
+        assert rc == 0, engine
+    reset_native_engine_cache()
+
+
+def test_flock_takes_locking_python_path(tmp_path, monkeypatch):
+    """--flock must NOT be delegated to the (lockless) native loop."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils.native import (get_native_engine,
+                                           reset_native_engine_cache)
+    reset_native_engine_cache()
+    native = get_native_engine()
+    if native is None:
+        pytest.skip("native engine unavailable")
+
+    def forbidden(*a, **kw):
+        raise AssertionError("native block loop used despite --flock")
+
+    monkeypatch.setattr(type(native), "run_block_loop", forbidden)
+    from elbencho_tpu.cli import main
+    rc = main(["-w", "-r", "-t", "1", "-s", "64K", "-b", "16K",
+               "--flock", "range", "--nolive", str(tmp_path / "f")])
+    assert rc == 0
+    reset_native_engine_cache()
