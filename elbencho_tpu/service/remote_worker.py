@@ -162,8 +162,7 @@ class RemoteWorker(Worker):
             service_rank_offset=self.host_idx * self.cfg.num_threads)
         status, reply = self.client.post_json(proto.PATH_PREPARE_PHASE,
                                               cfg_dict, timeout=300.0)
-        for line in reply.get(proto.KEY_ERROR_HISTORY, []):
-            logger.log_error(f"[{self.host}] {line}")
+        self._replay_error_history(reply)
         if status != 200:
             raise WorkerRemoteException(
                 f"preparation on {self.host} failed: "
@@ -210,12 +209,39 @@ class RemoteWorker(Worker):
                 stats.get(proto.KEY_NUM_IOPS_DONE, 0)
             if stats.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
                 raise WorkerRemoteException(
-                    f"worker error on service {self.host}")
+                    f"worker error on service {self.host}"
+                    + self._fetch_remote_error_detail())
             done = stats.get(proto.KEY_NUM_WORKERS_DONE, 0)
             if done >= self.num_remote_threads:
                 return
             time.sleep(interval)
             interval = min(interval * 2, max_interval)
+
+    def _replay_error_history(self, reply: dict) -> "list[str]":
+        """Log the service's error-history lines under this host's prefix
+        (reference: XFER_PREP_ERRORHISTORY replay)."""
+        lines = reply.get(proto.KEY_ERROR_HISTORY, [])
+        for line in lines:
+            logger.log_error(f"[{self.host}] {line}")
+        return lines
+
+    @staticmethod
+    def _strip_log_prefix(line: str) -> str:
+        """'2026-.. ERROR: Worker 0 ...' -> 'Worker 0 ...' so an embedded
+        root cause doesn't nest timestamps."""
+        return line.split("ERROR: ", 1)[-1]
+
+    def _fetch_remote_error_detail(self) -> str:
+        """Pull the service's error history so the master shows the REAL
+        failure, not just 'worker error' (reference: error history replay,
+        Common.h XFER_PREP_ERRORHISTORY + finishPhase ingestion)."""
+        try:
+            status, result = self.client.get_json(proto.PATH_BENCH_RESULT,
+                                                  timeout=15.0)
+        except Exception:  # noqa: BLE001 - detail fetch must not mask
+            return ""
+        lines = self._replay_error_history(result) if status == 200 else []
+        return f": {self._strip_log_prefix(lines[-1])}" if lines else ""
 
     def _finish_phase_remote(self) -> None:
         """GET /benchresult and ingest per-thread elapsed + histograms
@@ -225,11 +251,12 @@ class RemoteWorker(Worker):
         if status != 200:
             raise WorkerRemoteException(
                 f"result fetch from {self.host} failed ({status})")
-        for line in result.get(proto.KEY_ERROR_HISTORY, []):
-            logger.log_error(f"[{self.host}] {line}")
+        lines = self._replay_error_history(result)
         if result.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
+            detail = f": {self._strip_log_prefix(lines[-1])}" if lines \
+                else ""
             raise WorkerRemoteException(
-                f"service {self.host} reported worker errors")
+                f"service {self.host} reported worker errors{detail}")
         final = result.get("Final", {})
         stonewall = result.get("StoneWall", {})
         self.live_ops.num_entries_done = final.get("entries", 0)

@@ -208,3 +208,23 @@ def test_quit_services(services):
         except OSError:
             return  # service is gone
     raise AssertionError("service still alive after --quit")
+
+
+def test_worker_error_relays_service_detail(services, tmp_path):
+    """When a remote worker fails mid-phase, the master surfaces the
+    service's actual error message, not just 'worker error on service X'
+    (reference: error history replay)."""
+    import io
+    from contextlib import redirect_stderr
+    hosts = ",".join(f"127.0.0.1:{p}" for p in services)
+    # -w without -d on an existing dir with no rank subdirs: the service
+    # workers fail at file open
+    bench = tmp_path / "emptydir"
+    bench.mkdir()
+    buf = io.StringIO()
+    with redirect_stderr(buf):
+        rc = _master(["-w", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+                      "-b", "4K", "--hosts", hosts, str(bench)])
+    assert rc != 0
+    err = buf.getvalue()
+    assert "File create/open failed" in err  # the real root cause
