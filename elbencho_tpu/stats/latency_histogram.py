@@ -52,6 +52,31 @@ class LatencyHistogram:
         self.num_values += 1
         self.sum_micro += micro_secs
 
+    def add_latencies_array(self, micro_secs) -> None:
+        """Vectorized bulk insert of a uint64 numpy array (the native
+        engine returns per-block latencies in bulk; per-value Python
+        add_latency would dominate small-block hot paths)."""
+        import numpy as np
+        n = len(micro_secs)
+        if not n:
+            return
+        vals = np.asarray(micro_secs, dtype=np.uint64)
+        lo = int(vals.min())
+        if not self.num_values or lo < self.min_micro:
+            self.min_micro = lo
+        hi = int(vals.max())
+        if hi > self.max_micro:
+            self.max_micro = hi
+        self.num_values += n
+        self.sum_micro += int(vals.sum())
+        # bucket = floor(4*log2(v)) for v >= 1 (bucket_index, vectorized)
+        clipped = np.maximum(vals, 1).astype(np.float64)
+        idx = np.minimum((_LOG2_QUARTERS * np.log2(clipped)).astype(np.int64),
+                         NUM_BUCKETS - 1)
+        counts = np.bincount(idx, minlength=NUM_BUCKETS)
+        for i in np.nonzero(counts)[0]:
+            self.buckets[int(i)] += int(counts[i])
+
     # -- aggregation --------------------------------------------------------
 
     def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":

@@ -12,6 +12,8 @@ returns None when the configured amount of bytes has been generated.
 
 from __future__ import annotations
 
+import numpy as np
+
 from .random_algos import RandAlgo
 
 
@@ -28,6 +30,39 @@ class OffsetGenerator:
             if blk is None:
                 return
             yield blk
+
+    def next_batch(self, max_n: int):
+        """Up to max_n blocks as (offsets, lengths) uint64 numpy arrays,
+        or None when exhausted. The deterministic generators override this
+        with closed-form array math so the native C++ loop is fed without
+        per-block Python iteration; the PRNG-driven ones fall back to this
+        loop (their sequence must match the scalar path exactly)."""
+        offs = np.empty(max_n, dtype=np.uint64)
+        lens = np.empty(max_n, dtype=np.uint64)
+        i = 0
+        while i < max_n:
+            blk = self.next_block()
+            if blk is None:
+                break
+            offs[i] = blk[0]
+            lens[i] = blk[1]
+            i += 1
+        if i == 0:
+            return None
+        return offs[:i], lens[:i]
+
+    @staticmethod
+    def _batch_arrays(max_n: int, remaining: int, block_size: int,
+                      first_off: int, step: int):
+        """Shared closed-form batch: k offsets first_off + i*step, full
+        blocks except a short final one when remaining isn't divisible."""
+        k = min(max_n, (remaining + block_size - 1) // block_size)
+        offs = (np.uint64(first_off)
+                + np.arange(k, dtype=np.uint64) * np.uint64(step))
+        lens = np.full(k, block_size, dtype=np.uint64)
+        if k * block_size > remaining:  # short final block
+            lens[-1] = remaining - (k - 1) * block_size
+        return offs, lens, k
 
 
 class OffsetGenSequential(OffsetGenerator):
@@ -52,6 +87,15 @@ class OffsetGenSequential(OffsetGenerator):
         off = self.start + self._pos
         self._pos += length
         return (off, length)
+
+    def next_batch(self, max_n: int):
+        if self._pos >= self.num_bytes:
+            return None
+        offs, lens, _ = self._batch_arrays(
+            max_n, self.num_bytes - self._pos, self.block_size,
+            self.start + self._pos, self.block_size)
+        self._pos += int(lens.sum())
+        return offs, lens
 
 
 class OffsetGenReverseSeq(OffsetGenerator):
@@ -225,6 +269,16 @@ class OffsetGenStrided(OffsetGenerator):
         self._off += self.stride
         self._bytes_done += length
         return (off, length)
+
+    def next_batch(self, max_n: int):
+        if self._bytes_done >= self.num_bytes:
+            return None
+        offs, lens, k = self._batch_arrays(
+            max_n, self.num_bytes - self._bytes_done, self.block_size,
+            self._off, self.stride)
+        self._off += k * self.stride
+        self._bytes_done += int(lens.sum())
+        return offs, lens
 
 
 def num_blocks_for(num_bytes: int, block_size: int) -> int:

@@ -29,6 +29,21 @@ _SO_PATH_INSTALLED = os.path.join(_PKG_DIR, "_native", "libioengine.so")
 ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 
 
+def _as_ptr(values, n, np_dtype_name, c_type):
+    """ctypes view of a numpy array (zero-copy) or python list."""
+    import numpy as np
+    if isinstance(values, np.ndarray):
+        arr = np.ascontiguousarray(values, dtype=np.dtype(np_dtype_name))
+        ptr = arr.ctypes.data_as(ctypes.POINTER(c_type))
+        ptr._keepalive = arr  # the view must outlive the native call
+        return ptr
+    return (c_type * n)(*values)
+
+
+def _as_u64_ptr(values, n):
+    return _as_ptr(values, n, "uint64", ctypes.c_uint64)
+
+
 class _NativeEngine:
     """Thin wrapper over libioengine.so. See csrc/ioengine.cpp for the ABI."""
 
@@ -112,12 +127,15 @@ class _NativeEngine:
             failed = paths[min(fail_idx.value, n - 1)]
             raise OSError(-ret, f"{os.strerror(-ret)} "
                                 f"({op}: {failed})", failed)
+        import numpy as np
         done = entries_done.value
-        for i in range(done):
-            worker.entries_latency_histo.add_latency(entry_lat[i])
+        if done:
+            worker.entries_latency_histo.add_latencies_array(
+                np.frombuffer(entry_lat, dtype=np.uint64)[:done])
         num_blocks = done * blocks_per_file
-        for j in range(num_blocks):
-            worker.iops_latency_histo.add_latency(block_lat[j])
+        if num_blocks:
+            worker.iops_latency_histo.add_latencies_array(
+                np.frombuffer(block_lat, dtype=np.uint64)[:num_blocks])
         worker.live_ops.num_entries_done += done
         worker.live_ops.num_iops_done += num_blocks
         worker.live_ops.num_bytes_done += bytes_done.value
@@ -130,21 +148,25 @@ class _NativeEngine:
                        fds: "list[int] | None" = None,
                        fd_idx: "list[int] | None" = None) -> bool:
         """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
-        file of block i (reference: calcFileIdxAndOffsetStriped)."""
+        file of block i (reference: calcFileIdxAndOffsetStriped). offsets/
+        lengths/fd_idx may be numpy uint64/uint32 arrays, passed zero-copy
+        (the vectorized offset-generator path)."""
+        import numpy as np
         n = len(offsets)
-        off_arr = (ctypes.c_uint64 * n)(*offsets)
-        len_arr = (ctypes.c_uint64 * n)(*lengths)
+        off_arr = _as_u64_ptr(offsets, n)
+        len_arr = _as_u64_ptr(lengths, n)
         lat_arr = (ctypes.c_uint64 * n)()
         bytes_done = ctypes.c_uint64(0)
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))  # c_int(0) is falsy: no `or`!
-        buf_size = max(lengths)
+        buf_size = int(lengths.max() if isinstance(lengths, np.ndarray)
+                       else max(lengths))
         if fds is None:
             fds_arr = (ctypes.c_int * 1)(fd)
             idx_arr = None
         else:
             fds_arr = (ctypes.c_int * len(fds))(*fds)
-            idx_arr = (ctypes.c_uint32 * n)(*fd_idx)
+            idx_arr = _as_ptr(fd_idx, n, "uint32", ctypes.c_uint32)
         ret = self._lib.ioengine_run_block_loop_mf(
             fds_arr, idx_arr, off_arr, len_arr, n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), buf_size, iodepth,
@@ -152,10 +174,11 @@ class _NativeEngine:
             ENGINE_CODES[engine])
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
-        total_bytes = sum(lengths)
+        total_bytes = int(lengths.sum()) if isinstance(lengths, np.ndarray) \
+            else sum(lengths)
         if bytes_done.value == total_bytes:
-            for i in range(n):
-                worker.iops_latency_histo.add_latency(lat_arr[i])
+            worker.iops_latency_histo.add_latencies_array(
+                np.frombuffer(lat_arr, dtype=np.uint64))
             worker.live_ops.num_iops_done += n
         else:
             # interrupted chunk: AIO completes out of order, so per-block

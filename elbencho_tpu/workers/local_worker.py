@@ -734,32 +734,31 @@ class LocalWorker(Worker):
         (file, in-file offset) pairs (calcFileIdxAndOffsetStriped)."""
         chunk = self._native_chunk_blocks()
         stripe_fds, stripe_size = stripe if stripe else (None, 0)
-        offsets: "list[int]" = []
-        lengths: "list[int]" = []
-        fd_idx: "list[int]" = []
 
-        def submit():
+        def submit(offsets, lengths):
             self.check_interruption_request(force=True)
+            if stripe_fds:
+                # vectorized calcFileIdxAndOffsetStriped: global offset ->
+                # (file index, in-file offset)
+                goffs = offsets + np.uint64(file_offset_base)
+                fd_idx = (goffs // np.uint64(stripe_size)).astype(np.uint32)
+                offsets = goffs % np.uint64(stripe_size)
+                fds, idx = stripe_fds, fd_idx
+            else:
+                if file_offset_base:
+                    offsets = offsets + np.uint64(file_offset_base)
+                fds = idx = None
             native.run_block_loop(
                 fd=fd, offsets=offsets, lengths=lengths, is_write=is_write,
                 buf_addr=self._buf_addr(), iodepth=self.cfg.io_depth,
                 worker=self, interrupt_flag=self._native_interrupt,
-                engine=self.cfg.io_engine, fds=stripe_fds,
-                fd_idx=fd_idx if stripe_fds else None)
+                engine=self.cfg.io_engine, fds=fds, fd_idx=idx)
 
-        for off, length in gen:
-            if stripe_fds:
-                goff = file_offset_base + off
-                fd_idx.append(goff // stripe_size)
-                offsets.append(goff % stripe_size)
-            else:
-                offsets.append(file_offset_base + off)
-            lengths.append(length)
-            if len(offsets) >= chunk:
-                submit()
-                offsets, lengths, fd_idx = [], [], []
-        if offsets:
-            submit()
+        while True:
+            batch = gen.next_batch(chunk)
+            if batch is None:
+                break
+            submit(batch[0], batch[1])
         return True
 
     def _buf_addr(self) -> int:

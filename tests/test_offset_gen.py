@@ -84,3 +84,42 @@ def test_reset_reproduces():
     first = list(gen)
     gen.reset()
     assert list(gen) == first
+
+
+def test_next_batch_matches_scalar():
+    """Vectorized next_batch must produce exactly the scalar sequence for
+    every generator (short final block, start offset, chunk splits)."""
+    import numpy as np
+    from elbencho_tpu.toolkits.offset_gen import (
+        OffsetGenReverseSeq, OffsetGenSequential, OffsetGenStrided)
+    cases = [
+        OffsetGenSequential(100_000, 4096, start=512),
+        OffsetGenSequential(4096 * 7, 4096),
+        OffsetGenReverseSeq(100_000, 4096, start=64),
+        OffsetGenStrided(48 * 1024, 4096, rank=2, num_dataset_threads=4,
+                         start=128),
+    ]
+    for gen in cases:
+        scalar = list(gen)
+        gen.reset()
+        batched = []
+        while True:
+            b = gen.next_batch(5)  # odd chunk size to hit split edges
+            if b is None:
+                break
+            batched += list(zip((int(o) for o in b[0]),
+                                (int(l) for l in b[1])))
+        assert batched == scalar, type(gen).__name__
+
+
+def test_histogram_bulk_matches_scalar():
+    import numpy as np
+    from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+    vals = [0, 1, 2, 3, 7, 8, 100, 10_000, 2**29, 5, 5, 5]
+    h1, h2 = LatencyHistogram(), LatencyHistogram()
+    for v in vals:
+        h1.add_latency(v)
+    h2.add_latencies_array(np.array(vals, dtype=np.uint64))
+    assert h1.buckets == h2.buckets
+    assert (h1.num_values, h1.sum_micro, h1.min_micro, h1.max_micro) == \
+        (h2.num_values, h2.sum_micro, h2.min_micro, h2.max_micro)
