@@ -52,16 +52,23 @@ class OffsetGenerator:
         return offs[:i], lens[:i]
 
     @staticmethod
-    def _batch_arrays(max_n: int, remaining: int, block_size: int,
-                      first_off: int, step: int):
-        """Shared closed-form batch: k offsets first_off + i*step, full
-        blocks except a short final one when remaining isn't divisible."""
+    def _batch_lens(max_n: int, remaining: int, block_size: int):
+        """Shared batch sizing: k full blocks except a short final one
+        when remaining isn't block-divisible -> (k, lengths array)."""
         k = min(max_n, (remaining + block_size - 1) // block_size)
-        offs = (np.uint64(first_off)
-                + np.arange(k, dtype=np.uint64) * np.uint64(step))
         lens = np.full(k, block_size, dtype=np.uint64)
         if k * block_size > remaining:  # short final block
             lens[-1] = remaining - (k - 1) * block_size
+        return k, lens
+
+    @classmethod
+    def _batch_arrays(cls, max_n: int, remaining: int, block_size: int,
+                      first_off: int, step: int):
+        """Closed-form batch for arithmetic progressions: k offsets
+        first_off + i*step with the shared length sizing."""
+        k, lens = cls._batch_lens(max_n, remaining, block_size)
+        offs = (np.uint64(first_off)
+                + np.arange(k, dtype=np.uint64) * np.uint64(step))
         return offs, lens, k
 
 
@@ -155,6 +162,31 @@ class OffsetGenRandom(OffsetGenerator):
         self._bytes_left -= length
         return (off, length)
 
+    def next_batch(self, max_n: int):
+        if self._bytes_left <= 0:
+            return None
+        bs = self.block_size
+        k, lens = self._batch_lens(max_n, self._bytes_left, bs)
+        # all but a short final block share the same offset modulus, so
+        # the whole batch is one vector draw + modulo
+        full = k if self._bytes_left >= k * bs else k - 1
+        offs = np.empty(k, dtype=np.uint64)
+        if full:
+            if self.range_len > bs:
+                span = np.uint64(self.range_len - bs + 1)
+                offs[:full] = np.uint64(self.start) \
+                    + self.rand.next64_batch(full) % span
+            else:
+                # max_off == 0: the scalar path draws NOTHING here — keep
+                # the shared RNG stream identical
+                offs[:full] = np.uint64(self.start)
+        if full < k:  # short final block, scalar (different modulus)
+            self._bytes_left -= full * bs
+            offs[-1], lens[-1] = self.next_block()
+            return offs, lens
+        self._bytes_left -= full * bs
+        return offs, lens
+
 
 class OffsetGenRandomAligned(OffsetGenerator):
     """Block-aligned uniform-random offsets (may repeat/miss blocks)
@@ -183,6 +215,15 @@ class OffsetGenRandomAligned(OffsetGenerator):
         blk = self.rand.next64() % self.num_blocks_in_range
         self._bytes_left -= length
         return (self.start + blk * self.block_size, length)
+
+    def next_batch(self, max_n: int):
+        if self._bytes_left <= 0:
+            return None
+        k, lens = self._batch_lens(max_n, self._bytes_left, self.block_size)
+        blks = self.rand.next64_batch(k) % np.uint64(self.num_blocks_in_range)
+        offs = np.uint64(self.start) + blks * np.uint64(self.block_size)
+        self._bytes_left -= int(lens.sum())
+        return offs, lens
 
 
 class OffsetGenRandomAlignedFullCoverage(OffsetGenerator):
@@ -239,6 +280,62 @@ class OffsetGenRandomAlignedFullCoverage(OffsetGenerator):
         length = min(self.block_size, self._bytes_left)
         self._bytes_left -= length
         return (self.start + self._x * self.block_size, length)
+
+    _JUMP = 4096  # raw LCG steps per vectorized advance
+
+    def _ensure_jump_tables(self) -> None:
+        """A[i] = a^(i+1) mod m and C[i] = c*(a^i + ... + 1) mod m, so
+        x_{n+i+1} = A[i]*x_n + C[i]: one vector op yields _JUMP successive
+        raw LCG states (same exactly-once sequence as next_block)."""
+        if getattr(self, "_jump_a", None) is not None:
+            return
+        A = np.empty(self._JUMP, dtype=np.uint64)
+        C = np.empty(self._JUMP, dtype=np.uint64)
+        a_acc, c_acc = self._a, self._c
+        for i in range(self._JUMP):
+            A[i] = a_acc
+            C[i] = c_acc
+            a_acc = (a_acc * self._a) & self._mask
+            c_acc = (c_acc * self._a + self._c) & self._mask
+        self._jump_a = A
+        self._jump_c = C
+
+    def next_batch(self, max_n: int):
+        if self._bytes_left <= 0:
+            return None
+        self._ensure_jump_tables()
+        bs = self.block_size
+        k_target, lens = self._batch_lens(max_n, self._bytes_left, bs)
+        blks = np.empty(k_target, dtype=np.uint64)
+        filled = 0
+        mask = np.uint64(self._mask)
+        with np.errstate(over="ignore"):
+            while filled < k_target:
+                # raw candidates: never cross a period boundary in one go
+                take = min(self._JUMP, self._m - self._emitted)
+                cand = (self._jump_a[:take] * np.uint64(self._x)
+                        + self._jump_c[:take]) & mask
+                good_pos = np.nonzero(cand < self.num_blocks)[0]
+                need = k_target - filled
+                if len(good_pos) > need:
+                    # stop at the raw step of the last value we emit, so
+                    # the scalar path resumes mid-stream identically
+                    last_raw = int(good_pos[need - 1])
+                    good_pos = good_pos[:need]
+                    consumed = last_raw + 1
+                else:
+                    consumed = take
+                n_good = len(good_pos)
+                blks[filled:filled + n_good] = cand[good_pos]
+                filled += n_good
+                if consumed:
+                    self._x = int(cand[consumed - 1])
+                    self._emitted += consumed
+                if self._emitted >= self._m:
+                    self._emitted = 0
+        offs = np.uint64(self.start) + blks * np.uint64(bs)
+        self._bytes_left -= int(lens.sum())
+        return offs, lens
 
 
 class OffsetGenStrided(OffsetGenerator):

@@ -38,6 +38,15 @@ class RandAlgo:
     def next64(self) -> int:
         raise NotImplementedError
 
+    def next64_batch(self, n: int) -> np.ndarray:
+        """n draws as a uint64 array. The default loops next64 (exact
+        sequence); the fast tier overrides with closed-form vector math so
+        random offset generation can feed the native engine in bulk."""
+        out = np.empty(n, dtype=np.uint64)
+        for i in range(n):
+            out[i] = self.next64()
+        return out
+
     def next_in_range(self, lo: int, hi: int) -> int:
         """Uniform value in [lo, hi] (inclusive), like RandAlgoRange.h."""
         span = hi - lo + 1
@@ -143,6 +152,16 @@ class RandAlgoXoshiro256pp(RandAlgo):
             chunks[i] = self._next_vec()
         return chunks.tobytes()[:num_bytes]
 
+    def next64_batch(self, n: int) -> np.ndarray:
+        """Batch draws come from the N-lane vector stream (like
+        fill_buffer); the scalar next64 intentionally uses its own
+        single-lane stream, mirroring the reference's SIMD/scalar split."""
+        n_vecs = (n + self.LANES - 1) // self.LANES
+        chunks = np.empty((n_vecs, self.LANES), dtype=np.uint64)
+        for i in range(n_vecs):
+            chunks[i] = self._next_vec()
+        return chunks.reshape(-1)[:n]
+
 
 class RandAlgoGoldenPrime(RandAlgo):
     """'fast' tier: golden-prime multiplicative generator; weak randomness,
@@ -163,6 +182,44 @@ class RandAlgoGoldenPrime(RandAlgo):
             self._bytes_since_reseed = 0
         self._state = (self._state * _GOLDEN_PRIME) & _MASK64
         return _rotl(self._state, 32)
+
+    _prime_powers: "np.ndarray | None" = None  # prime^(i+1), shared table
+
+    def next64_batch(self, n: int) -> np.ndarray:
+        """Closed-form batch: state_i = state0 * prime^i (mod 2^64), so a
+        precomputed power table yields the EXACT scalar sequence in one
+        vector multiply (reseed boundaries handled per sub-batch)."""
+        cls = type(self)
+        if cls._prime_powers is None or len(cls._prime_powers) < n:
+            size = max(n, 8192)
+            powers = np.empty(size, dtype=np.uint64)
+            acc = 1
+            for i in range(size):
+                acc = (acc * _GOLDEN_PRIME) & _MASK64
+                powers[i] = acc
+            cls._prime_powers = powers
+        out = np.empty(n, dtype=np.uint64)
+        filled = 0
+        with np.errstate(over="ignore"):
+            while filled < n:
+                # scalar semantics: the call whose counter reaches the
+                # limit reseeds first, draws from the NEW state and leaves
+                # the counter at 0 — so from the current state we may draw
+                # exactly (calls-until-trigger - 1) values
+                trigger = (_GOLDEN_RESEED_BYTES
+                           - self._bytes_since_reseed + 7) // 8
+                if trigger <= 1:
+                    out[filled] = self.next64()  # the reseeding call
+                    filled += 1
+                    continue
+                k = min(n - filled, trigger - 1)
+                states = np.uint64(self._state) * cls._prime_powers[:k]
+                out[filled:filled + k] = \
+                    (states << np.uint64(32)) | (states >> np.uint64(32))
+                self._state = int(states[-1])
+                self._bytes_since_reseed += 8 * k
+                filled += k
+        return out
 
     def fill_buffer(self, num_bytes: int) -> bytes:
         n = (num_bytes + 7) // 8

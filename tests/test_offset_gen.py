@@ -123,3 +123,78 @@ def test_histogram_bulk_matches_scalar():
     assert h1.buckets == h2.buckets
     assert (h1.num_values, h1.sum_micro, h1.min_micro, h1.max_micro) == \
         (h2.num_values, h2.sum_micro, h2.min_micro, h2.max_micro)
+
+
+def test_random_next_batch_matches_scalar():
+    """Random generators: batch must reproduce the exact scalar sequence
+    for the deterministic-stream algorithms (fast golden-prime incl. the
+    256KiB reseed boundary, full-coverage LCG incl. skip handling)."""
+
+    from elbencho_tpu.toolkits.offset_gen import (
+        OffsetGenRandom, OffsetGenRandomAligned,
+        OffsetGenRandomAlignedFullCoverage)
+    from elbencho_tpu.toolkits.random_algos import create_rand_algo
+
+    def compare(make_gen, chunk):
+        g1 = make_gen(create_rand_algo("fast", seed=42))
+        scalar = list(g1)
+        g2 = make_gen(create_rand_algo("fast", seed=42))
+        batched = []
+        while True:
+            b = g2.next_batch(chunk)
+            if b is None:
+                break
+            batched += list(zip((int(o) for o in b[0]),
+                                (int(v) for v in b[1])))
+        assert batched == scalar
+
+    # aligned random over a non-power-of-2 block count, short final block
+    compare(lambda r: OffsetGenRandomAligned(r, 700 * 1024 + 100, 4096,
+                                             52 * 4096), 37)
+    # unaligned random (per-op modulus, short final block)
+    compare(lambda r: OffsetGenRandom(r, 123_456, 4096, 1 << 20), 64)
+    # full coverage: exactly-once over every block, batch == scalar
+    def mk_fc(r):
+        return OffsetGenRandomAlignedFullCoverage(r, 300 * 4096, 4096,
+                                                  300 * 4096)
+    compare(mk_fc, 41)
+    g = mk_fc(create_rand_algo("fast", seed=7))
+    seen = set()
+    while True:
+        b = g.next_batch(33)
+        if b is None:
+            break
+        seen.update(int(o) for o in b[0])
+    assert len(seen) == 300  # every block exactly once
+
+
+def test_golden_prime_batch_crosses_reseed():
+    """next64_batch over >256KiB of draws equals scalar next64 exactly."""
+
+    from elbencho_tpu.toolkits.random_algos import create_rand_algo
+    n = 70_000  # > 32768 draws: crosses the reseed boundary twice
+    a = create_rand_algo("fast", seed=5)
+    b = create_rand_algo("fast", seed=5)
+    scalar = [a.next64() for _ in range(n)]
+    batched = []
+    for sz in (10_000, 1, 25_000, 34_999):
+        batched += [int(v) for v in b.next64_batch(sz)]
+    assert batched == scalar[:len(batched)]
+
+
+def test_random_batch_no_draw_when_single_position():
+    """range_len == block_size: neither path consumes RNG draws, so the
+    shared stream stays identical between scalar and batch modes."""
+    from elbencho_tpu.toolkits.offset_gen import OffsetGenRandom
+    from elbencho_tpu.toolkits.random_algos import create_rand_algo
+    r1 = create_rand_algo("fast", seed=3)
+    r2 = create_rand_algo("fast", seed=3)
+    g1 = OffsetGenRandom(r1, 8 * 4096, 4096, 4096)
+    g2 = OffsetGenRandom(r2, 8 * 4096, 4096, 4096)
+    scalar = list(g1)
+    batched = []
+    while (b := g2.next_batch(3)) is not None:
+        batched += list(zip((int(o) for o in b[0]),
+                            (int(v) for v in b[1])))
+    assert batched == scalar
+    assert r1.next64() == r2.next64()  # streams did not diverge
