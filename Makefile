@@ -20,6 +20,15 @@ native-tsan:
 	@echo "tsan build done; run tests with:" \
 		"LD_PRELOAD=\$$(gcc -print-file-name=libtsan.so) pytest ..."
 
+# AddressSanitizer build (same replace-then-restore dance as tsan)
+native-asan:
+	$(MAKE) -C csrc clean
+	$(MAKE) -C csrc CXXFLAGS="-O1 -g -fsanitize=address -fPIC -std=c++17"
+	@touch csrc/ioengine.cpp
+	@echo "asan build done; run tests with:" \
+		"LD_PRELOAD=\$$(gcc -print-file-name=libasan.so)" \
+		"ASAN_OPTIONS=detect_leaks=0 pytest ..."
+
 test: native
 	python -m pytest tests/ -q
 

@@ -646,6 +646,35 @@ int ioengine_run_block_loop(int fd, const uint64_t* offsets,
                                     out_bytes, interrupt_flag, ENGINE_AUTO);
 }
 
+// mmap-backed block loop: pure memcpy between the mapping and the io
+// buffer with the usual latency/interrupt semantics (reference: the mmap
+// wrappers of LocalWorker; --mmap)
+int ioengine_run_mmap_loop(void* map_base, const uint64_t* offsets,
+                           const uint64_t* lengths, uint64_t n,
+                           int is_write, void* buf,
+                           uint64_t* out_lat_usec, uint64_t* out_bytes,
+                           int* interrupt_flag) {
+    char* base = static_cast<char*>(map_base);
+    char* io = static_cast<char*>(buf);
+    uint64_t bytes_done = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+        if ((i % kInterruptCheckInterval) == 0 && interrupt_flag
+                && *interrupt_flag)
+            break;
+        const uint64_t len = lengths[i];
+        const uint64_t off = offsets[i];
+        const uint64_t t0 = now_usec();
+        if (is_write)
+            memcpy(base + off, io, len);
+        else
+            memcpy(io, base + off, len);
+        out_lat_usec[i] = now_usec() - t0;
+        bytes_done += len;
+    }
+    *out_bytes = bytes_done;
+    return 0;
+}
+
 // 1 if this kernel accepts io_uring_setup (it may be compiled out or
 // disabled via the io_uring_disabled sysctl) AND provides EXT_ARG timed
 // waits (5.11+), which the engine's interruptible wait loops require

@@ -773,6 +773,12 @@ class BenchConfig(BenchConfigBase):
             pass  # full-coverage LCG makes this safe (every block exactly once)
         if self.use_mmap and self.use_direct_io:
             raise ConfigError("--mmap and --direct are incompatible")
+        if self.use_mmap and self.bench_mode == BenchMode.POSIX \
+                and self.bench_path_type != BenchPathType.DIR \
+                and len(self.paths) > 1:
+            raise ConfigError(
+                "--mmap supports a single file/blockdev path (striping "
+                "across multiple mappings is not implemented)")
         if self.bench_mode == BenchMode.POSIX \
                 and self.bench_path_type != BenchPathType.DIR \
                 and (self.run_create_dirs or self.run_delete_dirs

@@ -67,6 +67,18 @@ class _NativeEngine:
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
+        lib.ioengine_run_mmap_loop.restype = ctypes.c_int
+        lib.ioengine_run_mmap_loop.argtypes = [
+            ctypes.c_void_p,                  # mapping base address
+            ctypes.POINTER(ctypes.c_uint64),  # offsets
+            ctypes.POINTER(ctypes.c_uint64),  # lengths
+            ctypes.c_uint64,                  # num blocks
+            ctypes.c_int,                     # is_write
+            ctypes.c_void_p,                  # io buffer
+            ctypes.POINTER(ctypes.c_uint64),  # out: latencies
+            ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
+            ctypes.POINTER(ctypes.c_int),     # interrupt flag
+        ]
         lib.ioengine_run_file_loop.restype = ctypes.c_int
         lib.ioengine_run_file_loop.argtypes = [
             ctypes.c_char_p,                  # NUL-separated paths blob
@@ -140,6 +152,33 @@ class _NativeEngine:
         worker.live_ops.num_iops_done += num_blocks
         worker.live_ops.num_bytes_done += bytes_done.value
         worker._num_iops_submitted += num_blocks
+        worker.create_stonewall_stats_if_triggered()
+
+    def run_mmap_loop(self, map_addr: int, offsets, lengths,
+                      is_write: bool, buf_addr: int, worker,
+                      interrupt_flag=None) -> None:
+        """--mmap hot loop: memcpy between the mapping and the io buffer
+        entirely in C++ (same accounting as run_block_loop)."""
+        import numpy as np
+        n = len(offsets)
+        lat_arr = (ctypes.c_uint64 * n)()
+        bytes_done = ctypes.c_uint64(0)
+        interrupt = (interrupt_flag if interrupt_flag is not None
+                     else ctypes.c_int(0))
+        ret = self._lib.ioengine_run_mmap_loop(
+            ctypes.c_void_p(map_addr), _as_u64_ptr(offsets, n),
+            _as_u64_ptr(lengths, n), n, 1 if is_write else 0,
+            ctypes.c_void_p(buf_addr), lat_arr, ctypes.byref(bytes_done),
+            ctypes.byref(interrupt))
+        if ret < 0:
+            raise OSError(-ret, os.strerror(-ret))
+        total = int(lengths.sum()) if isinstance(lengths, np.ndarray) \
+            else sum(lengths)
+        if bytes_done.value == total:  # not interrupted mid-chunk
+            worker.iops_latency_histo.add_latencies_array(
+                np.frombuffer(lat_arr, dtype=np.uint64))
+            worker.live_ops.num_iops_done += n
+        worker.live_ops.num_bytes_done += bytes_done.value
         worker.create_stonewall_stats_if_triggered()
 
     def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import mmap
 import os
+import stat as stat_mod
 import time
 
 import numpy as np
@@ -398,16 +399,10 @@ class LocalWorker(Worker):
         per-op Python feature is active (the LOSF hot path; reference:
         dirModeIterateFiles is native there by construction)."""
         cfg = self.cfg
-        return (native is not None
+        return (self._native_loop_eligible(native)
                 and phase in self._NATIVE_FILE_OPS
                 and cfg.io_engine in ("auto", "sync")
                 and cfg.io_depth <= 1
-                and self._ops_log is None
-                and self._tpu is None
-                and not cfg.integrity_check_salt
-                and not cfg.block_variance_pct
-                and not cfg.rwmix_read_pct
-                and not getattr(self, "_rwmix_thread_reader", False)
                 and not cfg.do_read_inline
                 and not cfg.do_direct_verify
                 and not cfg.do_stat_inline
@@ -417,9 +412,7 @@ class LocalWorker(Worker):
                 and not cfg.use_mmap
                 and not cfg.use_file_locks
                 and not cfg.use_random_offsets
-                and not cfg.do_reverse_seq_offsets
-                and self._rate_limiter_read is None
-                and self._rate_limiter_write is None)
+                and not cfg.do_reverse_seq_offsets)
 
     def _run_native_file_loop(self, native, phase: BenchPhase) -> None:
         """Chunked delegation of the per-file loop to the C++ engine."""
@@ -520,7 +513,11 @@ class LocalWorker(Worker):
     def _write_one_file(self, path: str) -> None:
         cfg = self.cfg
         try:
-            fd = os.open(path, self._open_flags_write(), MKFILE_MODE)
+            flags = self._open_flags_write()
+            if cfg.use_mmap:
+                # a writable mapping needs a read-write fd
+                flags = (flags & ~os.O_WRONLY) | os.O_RDWR
+            fd = os.open(path, flags, MKFILE_MODE)
         except FileNotFoundError as err:
             if not cfg.run_create_dirs:
                 # parity hint (reference: dirModeOpenAndPrepFile :7395)
@@ -537,8 +534,11 @@ class LocalWorker(Worker):
             if cfg.do_truncate_to_size:
                 os.ftruncate(fd, cfg.file_size)
             if cfg.file_size:
-                gen = self._make_offset_gen_for_file(is_write=True)
-                self._rw_block_sized(fd, gen, is_write=True)
+                if cfg.use_mmap:
+                    self._rw_block_sized_mmap(fd, is_write=True)
+                else:
+                    gen = self._make_offset_gen_for_file(is_write=True)
+                    self._rw_block_sized(fd, gen, is_write=True)
             self._apply_fadvise(fd)
         finally:
             os.close(fd)
@@ -630,15 +630,10 @@ class LocalWorker(Worker):
                         global_off % stripe_size)
         from ..utils.native import get_native_engine
         native = get_native_engine()
-        if (native is not None
+        if (self._native_loop_eligible(native)
                 and (multi_file is None or stripe is not None)
-                and self._tpu is None
-                and not cfg.integrity_check_salt and not cfg.rwmix_read_pct
-                and not cfg.block_variance_pct and self._ops_log is None
                 and not cfg.do_read_inline and not cfg.do_direct_verify
-                and not cfg.use_file_locks
-                and self._rate_limiter_read is None
-                and self._rate_limiter_write is None):
+                and not cfg.use_file_locks):
             if self._run_native_block_loop(native, fd, gen, is_write,
                                            file_offset_base, stripe):
                 return
@@ -716,6 +711,22 @@ class LocalWorker(Worker):
             t0 = time.perf_counter_ns()
             self._tpu.flush()  # drain pipelined transfers before phase end
             self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+
+    def _native_loop_eligible(self, native) -> bool:
+        """Conditions every native delegation shares: no per-op Python
+        feature may be active (verify/rwmix/variance/opslog/TPU staging/
+        rate limits). Loop-specific extras (flock, read-inline, random
+        offsets...) are checked at the call sites."""
+        cfg = self.cfg
+        return (native is not None
+                and self._tpu is None
+                and not cfg.integrity_check_salt
+                and not cfg.rwmix_read_pct
+                and not getattr(self, "_rwmix_thread_reader", False)
+                and not cfg.block_variance_pct
+                and self._ops_log is None
+                and self._rate_limiter_read is None
+                and self._rate_limiter_write is None)
 
     def _native_chunk_blocks(self) -> int:
         """Cap each native call at ~256 MiB of I/O and 8192 blocks so live
@@ -866,16 +877,23 @@ class LocalWorker(Worker):
     # mmap I/O path (reference: mmap wrappers, LocalWorker.cpp:2534+)
     # ------------------------------------------------------------------
 
-    def _rw_block_sized_mmap(self, fd: int, is_write: bool) -> None:
+    def _rw_block_sized_mmap(self, fd: int, is_write: bool,
+                             gen=None) -> None:
         cfg = self.cfg
         size = cfg.file_size
-        if is_write:
-            os.ftruncate(fd, size)
+        if is_write and stat_mod.S_ISREG(os.fstat(fd).st_mode):
+            os.ftruncate(fd, size)  # block devices keep their size
         prot = mmap.PROT_WRITE | mmap.PROT_READ if is_write else mmap.PROT_READ
         mapped = mmap.mmap(fd, size, prot=prot)
         try:
             self._apply_madvise(mapped)
-            gen = self._make_offset_gen_for_file(is_write)
+            if gen is None:
+                gen = self._make_offset_gen_for_file(is_write)
+            from ..utils.native import get_native_engine
+            native = get_native_engine()
+            if self._native_loop_eligible(native):
+                self._run_native_mmap_loop(native, mapped, gen, is_write)
+                return
             num_bufs = len(self._io_bufs)
             for off, length in gen:
                 self.check_interruption_request()
@@ -900,6 +918,24 @@ class LocalWorker(Worker):
                     (time.perf_counter_ns() - t0) // 1000
         finally:
             mapped.close()
+
+    def _run_native_mmap_loop(self, native, mapped, gen, is_write) -> None:
+        """Chunked C++ memcpy loop over the mapping (the --mmap analogue
+        of _run_native_block_loop; same eligibility idea)."""
+        # np.frombuffer works for read-only PROT_READ mappings too (ctypes
+        # from_buffer would demand writability); the address stays valid
+        # while `mapped` is open
+        map_addr = np.frombuffer(mapped, dtype=np.uint8).ctypes.data
+        chunk = self._native_chunk_blocks()
+        while True:
+            batch = gen.next_batch(chunk)
+            if batch is None:
+                break
+            self.check_interruption_request(force=True)
+            native.run_mmap_loop(
+                map_addr, batch[0], batch[1], is_write,
+                buf_addr=self._buf_addr(), worker=self,
+                interrupt_flag=self._native_interrupt)
 
     def _apply_madvise(self, mapped: mmap.mmap) -> None:
         flags_str = self.cfg.madvise_flags
@@ -950,6 +986,11 @@ class LocalWorker(Worker):
         if is_write and cfg.do_truncate_to_size:
             for fd in self._path_fds:
                 os.ftruncate(fd, cfg.file_size)
+        if cfg.use_mmap and num_files == 1:
+            # file/bdev mode via memory mapping (reference: prepareMmapVec,
+            # ProgArgs.cpp:2109); worker's share drives the same gen
+            self._rw_block_sized_mmap(self._path_fds[0], is_write, gen=gen)
+            return
         # single file/bdev: global offsets ARE in-file offsets; striped
         # multi-file passes the (fds, file_size) mapping — the native C++
         # engine takes the hot loop in both shapes, the Python fallback

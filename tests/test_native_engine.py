@@ -317,3 +317,47 @@ def test_flock_takes_locking_python_path(tmp_path, monkeypatch):
                "--flock", "range", "--nolive", str(tmp_path / "f")])
     assert rc == 0
     reset_native_engine_cache()
+
+
+def test_native_mmap_loop_roundtrip(tmp_path, monkeypatch):
+    """--mmap runs through the C++ memcpy loop in BOTH dir mode and
+    single-file mode, for writes and reads (incl. the read-only
+    PROT_READ mapping the native loop must accept)."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils import native as native_mod
+    native_mod.reset_native_engine_cache()
+    native = native_mod.get_native_engine()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    calls = []
+    orig = type(native).run_mmap_loop
+
+    def spy(self, *a, **kw):
+        calls.append(kw.get("is_write", a[3] if len(a) > 3 else None))
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(type(native), "run_mmap_loop", spy)
+    from elbencho_tpu.cli import main
+    # dir mode: write AND read through mmap
+    assert main(["-w", "-d", "-r", "--mmap", "-t", "1", "-n", "1",
+                 "-N", "2", "-s", "64K", "-b", "16K", "--madv", "seq",
+                 "--nolive", str(tmp_path)]) == 0
+    f = next(tmp_path.rglob("r0-f0"))
+    assert f.stat().st_size == 64 * 1024
+    assert f.read_bytes() != b"\0" * (64 * 1024)
+    # file mode, single path
+    single = tmp_path / "single"
+    assert main(["-w", "-r", "--mmap", "-t", "1", "-s", "128K", "-b",
+                 "16K", "--nolive", str(single)]) == 0
+    assert single.stat().st_size == 128 * 1024
+    assert True in calls and False in calls, calls  # both directions ran
+    assert len(calls) >= 4  # dir w+r, file w+r at minimum
+    # multi-path --mmap is rejected with a clear config error
+    assert main(["-w", "--mmap", "-t", "1", "-s", "64K", "-b", "16K",
+                 "--nolive", str(tmp_path / "a"),
+                 str(tmp_path / "b")]) != 0
+    # and mmap + --verify still goes through the checking Python path
+    assert main(["-w", "-r", "--mmap", "--verify", "5", "-t", "1", "-s",
+                 "64K", "-b", "16K", "--nolive",
+                 str(tmp_path / "v")]) == 0
+    native_mod.reset_native_engine_cache()
