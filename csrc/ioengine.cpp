@@ -34,7 +34,9 @@
 
 #include <linux/aio_abi.h>
 #include <linux/io_uring.h>
+#include <poll.h>
 #include <sys/mman.h>
+#include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
 #include <fcntl.h>
@@ -644,6 +646,186 @@ int ioengine_run_block_loop(int fd, const uint64_t* offsets,
     return ioengine_run_block_loop2(fd, offsets, lengths, n, is_write, buf,
                                     buf_size, iodepth, out_lat_usec,
                                     out_bytes, interrupt_flag, ENGINE_AUTO);
+}
+
+// netbench data plane (reference: BasicSocket C++ + the transfer loops of
+// LocalWorker :7789-8064): request/response over established TCP
+// connections, fully in native code.
+
+static int send_all_fd(int fd, const char* buf, uint64_t len) {
+    uint64_t sent = 0;
+    while (sent < len) {
+        const ssize_t res = send(fd, buf + sent, len - sent, MSG_NOSIGNAL);
+        if (res < 0) {
+            if (errno == EINTR)
+                continue;
+            return -errno;
+        }
+        sent += static_cast<uint64_t>(res);
+    }
+    return 0;
+}
+
+static int recv_exact_fd(int fd, char* buf, uint64_t len,
+                         volatile int* interrupt_flag) {
+    uint64_t got = 0;
+    int timeouts = 0;  // consecutive SO_RCVTIMEO expiries
+    while (got < len) {
+        if (interrupt_flag && *interrupt_flag)
+            return -EINTR;
+        const ssize_t res = recv(fd, buf + got, len - got, 0);
+        if (res < 0) {
+            if (errno == EINTR)
+                continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                // SO_RCVTIMEO expiry: re-check the interrupt flag, give
+                // up after ~6 expiries (a wedged peer, like the Python
+                // path's bounded recv timeout)
+                if (++timeouts > 6)
+                    return -ETIMEDOUT;
+                continue;
+            }
+            return -errno;
+        }
+        if (res == 0)
+            return -ECONNRESET;  // peer closed mid-message
+        timeouts = 0;
+        got += static_cast<uint64_t>(res);
+    }
+    return 0;
+}
+
+// client: n_ops request/response round trips (payload -> block_size bytes,
+// response <- resp_size bytes), per-op latency out
+int ioengine_net_client_loop(int fd, const void* payload,
+                             uint64_t block_size, uint64_t resp_size,
+                             uint64_t n_ops, uint64_t* out_lat_usec,
+                             uint64_t* out_bytes, int* interrupt_flag) {
+    const char* buf = static_cast<const char*>(payload);
+    char* resp = resp_size ? static_cast<char*>(malloc(resp_size)) : nullptr;
+    if (resp_size && !resp)
+        return -ENOMEM;
+    uint64_t bytes_done = 0;
+    int ret = 0;
+    for (uint64_t i = 0; i < n_ops; ++i) {
+        if (interrupt_flag && *interrupt_flag)
+            break;
+        const uint64_t t0 = now_usec();
+        ret = send_all_fd(fd, buf, block_size);
+        if (ret == 0 && resp_size)
+            ret = recv_exact_fd(fd, resp, resp_size, interrupt_flag);
+        if (ret != 0)
+            break;
+        out_lat_usec[i] = now_usec() - t0;
+        bytes_done += block_size + resp_size;
+    }
+    free(resp);
+    *out_bytes = bytes_done;
+    return ret == -EINTR ? 0 : ret;
+}
+
+// server: poll this worker's connection share, answer each full block of
+// block_size bytes with resp_size bytes. conn_state[i] carries the bytes
+// received toward the current block across calls; UINT64_MAX marks a
+// closed connection. Returns after max_responses replies, after
+// slice_msecs of polling, or when every connection reached EOF — so the
+// Python side can refresh live stats and interrupts between slices.
+int ioengine_net_server_loop(const int* fds, uint64_t n_conns,
+                             uint64_t* conn_state, uint64_t block_size,
+                             uint64_t resp_size, const void* resp_payload,
+                             uint64_t max_responses, uint64_t slice_msecs,
+                             uint64_t* out_lat_usec, uint64_t* out_bytes,
+                             uint64_t* out_responses,
+                             uint64_t* out_open_conns,
+                             int* interrupt_flag) {
+    const uint64_t kClosed = ~0ULL;
+    const char* resp = static_cast<const char*>(resp_payload);
+    char* scratch = static_cast<char*>(malloc(1 << 20));
+    if (!scratch)
+        return -ENOMEM;
+    pollfd* pfds = new pollfd[n_conns];
+    uint64_t responses = 0;
+    uint64_t bytes_done = 0;
+    int ret = 0;
+    const uint64_t t_end = now_usec() + slice_msecs * 1000;
+
+    while (responses < max_responses && now_usec() < t_end) {
+        if (interrupt_flag && *interrupt_flag)
+            break;
+        nfds_t n_open = 0;
+        for (uint64_t i = 0; i < n_conns; ++i)
+            if (conn_state[i] != kClosed) {
+                pfds[n_open].fd = fds[i];
+                pfds[n_open].events = POLLIN;
+                pfds[n_open].revents = 0;
+                ++n_open;
+            }
+        if (n_open == 0)
+            break;
+        const int n_ready = poll(pfds, n_open, 100);
+        if (n_ready < 0) {
+            if (errno == EINTR)
+                continue;
+            ret = -errno;
+            break;
+        }
+        if (n_ready == 0)
+            continue;
+        for (nfds_t p = 0; p < n_open && ret == 0; ++p) {
+            if (!(pfds[p].revents & (POLLIN | POLLHUP | POLLERR)))
+                continue;
+            // map back to the conn index (fds may repeat across slices)
+            uint64_t idx = 0;
+            for (uint64_t i = 0; i < n_conns; ++i)
+                if (conn_state[i] != kClosed && fds[i] == pfds[p].fd) {
+                    idx = i;
+                    break;
+                }
+            const ssize_t got = recv(pfds[p].fd, scratch, 1 << 20, 0);
+            if (got < 0) {
+                if (errno == EINTR || errno == EAGAIN
+                        || errno == EWOULDBLOCK)
+                    continue;
+                conn_state[idx] = kClosed;  // treat errors as disconnect
+                continue;
+            }
+            if (got == 0) {
+                conn_state[idx] = kClosed;
+                continue;
+            }
+            bytes_done += static_cast<uint64_t>(got);
+            conn_state[idx] += static_cast<uint64_t>(got);
+            // residual >= block_size carries into the next slice when the
+            // response cap is hit, so the cap is checked BEFORE any write
+            while (conn_state[idx] != kClosed
+                   && conn_state[idx] >= block_size
+                   && responses < max_responses) {
+                conn_state[idx] -= block_size;
+                const uint64_t t0 = now_usec();
+                if (resp_size
+                        && send_all_fd(pfds[p].fd, resp, resp_size) != 0) {
+                    // client died mid-benchmark: only THIS connection is
+                    // gone (parity with the recv error handling above)
+                    conn_state[idx] = kClosed;
+                    break;
+                }
+                out_lat_usec[responses++] = now_usec() - t0;
+                bytes_done += resp_size;
+            }
+            if (responses >= max_responses)
+                break;
+        }
+    }
+    uint64_t open_conns = 0;
+    for (uint64_t i = 0; i < n_conns; ++i)
+        if (conn_state[i] != kClosed)
+            ++open_conns;
+    delete[] pfds;
+    free(scratch);
+    *out_bytes = bytes_done;
+    *out_responses = responses;
+    *out_open_conns = open_conns;
+    return ret;
 }
 
 // mmap-backed block loop: pure memcpy between the mapping and the io

@@ -79,6 +79,33 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
         ]
+        lib.ioengine_net_client_loop.restype = ctypes.c_int
+        lib.ioengine_net_client_loop.argtypes = [
+            ctypes.c_int,                     # connected socket fd
+            ctypes.c_void_p,                  # request payload
+            ctypes.c_uint64,                  # block (request) size
+            ctypes.c_uint64,                  # response size
+            ctypes.c_uint64,                  # number of round trips
+            ctypes.POINTER(ctypes.c_uint64),  # out: latencies
+            ctypes.POINTER(ctypes.c_uint64),  # out: bytes moved
+            ctypes.POINTER(ctypes.c_int),     # interrupt flag
+        ]
+        lib.ioengine_net_server_loop.restype = ctypes.c_int
+        lib.ioengine_net_server_loop.argtypes = [
+            ctypes.POINTER(ctypes.c_int),     # connection fds
+            ctypes.c_uint64,                  # number of connections
+            ctypes.POINTER(ctypes.c_uint64),  # in/out per-conn state
+            ctypes.c_uint64,                  # block size
+            ctypes.c_uint64,                  # response size
+            ctypes.c_void_p,                  # response payload
+            ctypes.c_uint64,                  # max responses this slice
+            ctypes.c_uint64,                  # slice duration msecs
+            ctypes.POINTER(ctypes.c_uint64),  # out: latencies
+            ctypes.POINTER(ctypes.c_uint64),  # out: bytes moved
+            ctypes.POINTER(ctypes.c_uint64),  # out: responses sent
+            ctypes.POINTER(ctypes.c_uint64),  # out: open connections left
+            ctypes.POINTER(ctypes.c_int),     # interrupt flag
+        ]
         lib.ioengine_run_file_loop.restype = ctypes.c_int
         lib.ioengine_run_file_loop.argtypes = [
             ctypes.c_char_p,                  # NUL-separated paths blob
@@ -153,6 +180,58 @@ class _NativeEngine:
         worker.live_ops.num_bytes_done += bytes_done.value
         worker._num_iops_submitted += num_blocks
         worker.create_stonewall_stats_if_triggered()
+
+    def run_net_client_loop(self, fd: int, payload: bytes, resp_size: int,
+                            n_ops: int, worker,
+                            interrupt_flag=None) -> None:
+        """n_ops netbench round trips (send payload, await resp_size)."""
+        import numpy as np
+        lat_arr = (ctypes.c_uint64 * n_ops)()
+        bytes_done = ctypes.c_uint64(0)
+        interrupt = (interrupt_flag if interrupt_flag is not None
+                     else ctypes.c_int(0))
+        ret = self._lib.ioengine_net_client_loop(
+            fd, payload, len(payload), resp_size, n_ops, lat_arr,
+            ctypes.byref(bytes_done), ctypes.byref(interrupt))
+        if ret < 0:
+            raise OSError(-ret, os.strerror(-ret))
+        per_op = len(payload) + resp_size
+        done_ops = bytes_done.value // per_op if per_op else 0
+        worker.iops_latency_histo.add_latencies_array(
+            np.frombuffer(lat_arr, dtype=np.uint64)[:done_ops])
+        worker.live_ops.num_iops_done += done_ops
+        worker.live_ops.num_bytes_done += bytes_done.value
+        worker.create_stonewall_stats_if_triggered()
+
+    def run_net_server_slice(self, fds, conn_state, block_size: int,
+                             resp_payload: bytes, worker,
+                             max_responses: int = 4096,
+                             slice_msecs: int = 500,
+                             interrupt_flag=None) -> int:
+        """One polling slice of the netbench server loop; returns the
+        number of still-open connections (conn_state mutated in place)."""
+        import numpy as np
+        n = len(fds)
+        fds_arr = (ctypes.c_int * n)(*fds)
+        lat_arr = (ctypes.c_uint64 * max_responses)()
+        bytes_done = ctypes.c_uint64(0)
+        responses = ctypes.c_uint64(0)
+        open_conns = ctypes.c_uint64(0)
+        interrupt = (interrupt_flag if interrupt_flag is not None
+                     else ctypes.c_int(0))
+        ret = self._lib.ioengine_net_server_loop(
+            fds_arr, n, conn_state, block_size, len(resp_payload),
+            resp_payload, max_responses, slice_msecs, lat_arr,
+            ctypes.byref(bytes_done), ctypes.byref(responses),
+            ctypes.byref(open_conns), ctypes.byref(interrupt))
+        if ret < 0:
+            raise OSError(-ret, os.strerror(-ret))
+        worker.iops_latency_histo.add_latencies_array(
+            np.frombuffer(lat_arr, dtype=np.uint64)[:responses.value])
+        worker.live_ops.num_iops_done += responses.value
+        worker.live_ops.num_bytes_done += bytes_done.value
+        worker.create_stonewall_stats_if_triggered()
+        return open_conns.value
 
     def run_mmap_loop(self, map_addr: int, offsets, lengths,
                       is_write: bool, buf_addr: int, worker,

@@ -157,6 +157,34 @@ def run_netbench_phase(worker, phase: BenchPhase) -> None:
         _run_client(worker)
 
 
+def _set_native_socket_mode(basic_sock, recv_timeout_secs: int,
+                            send_timeout_secs: int) -> None:
+    """Blocking fd with kernel-level timeouts for the C++ data plane
+    (python settimeout() would flip the fd to non-blocking instead)."""
+    import struct
+    s = basic_sock.sock
+    s.setblocking(True)
+    if recv_timeout_secs:
+        s.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVTIMEO,
+                     struct.pack("ll", recv_timeout_secs, 0))
+    if send_timeout_secs:
+        s.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDTIMEO,
+                     struct.pack("ll", send_timeout_secs, 0))
+
+
+def _native_engine(worker):
+    """The C++ data plane handles the hot loops when no per-op Python
+    feature (rate limit, opslog) is active — the netbench analogue of the
+    block-loop delegation (reference: BasicSocket C++ plane)."""
+    from ..utils.native import get_native_engine
+    native = get_native_engine()
+    if (native is not None and worker._ops_log is None
+            and worker._rate_limiter_read is None
+            and worker._rate_limiter_write is None):
+        return native
+    return None
+
+
 def _run_client(worker) -> None:
     """Send --size bytes in --block requests; each answered with
     --respsize bytes. Latency = request+response round trip."""
@@ -167,6 +195,27 @@ def _run_client(worker) -> None:
     # trailing partial block would deadlock awaiting a response
     total = max(bs, (cfg.file_size // bs) * bs)
     payload = bytes(worker._io_buf[:bs])
+    native = _native_engine(worker)
+    if native is not None:
+        # BasicSocket timeouts leave the fd non-blocking; the C++ loop
+        # needs blocking send/recv. SO_RCVTIMEO bounds each recv to 5s
+        # (like the Python path) so the EAGAIN retry inside the C++ loop
+        # re-checks the interrupt flag without busy-spinning.
+        _set_native_socket_mode(sock, recv_timeout_secs=5,
+                                send_timeout_secs=30)
+        n_ops = total // bs
+        # chunk round trips so interrupts/live stats stay fresh
+        per_call = max(1, min(4096, (64 << 20) // max(bs, 1)))
+        done = 0
+        while done < n_ops:
+            worker.check_interruption_request(force=True)
+            native.run_net_client_loop(
+                sock.sock.fileno(), payload, cfg.netbench_response_size,
+                min(per_call, n_ops - done), worker,
+                interrupt_flag=worker._native_interrupt)
+            done += min(per_call, n_ops - done)
+        _client_shutdown(sock)
+        return
     sent = 0
     while sent < total:
         worker.check_interruption_request()
@@ -184,6 +233,10 @@ def _run_client(worker) -> None:
         worker.live_ops.num_bytes_done += length + len(resp)
         worker.live_ops.num_iops_done += 1
         sent += length
+    _client_shutdown(sock)
+
+
+def _client_shutdown(sock) -> None:
     # clean shutdown signals EOF to the server's poll loop; ignore a peer
     # that already closed — the measured transfer is complete either way
     try:
@@ -203,6 +256,28 @@ def _run_server(worker) -> None:
         return
     bs = cfg.block_size
     response = bytes(cfg.netbench_response_size)
+    native = _native_engine(worker)
+    if native is not None:
+        import ctypes
+        for c in conns:
+            # poll() gates the recvs; sends must be blocking (with a
+            # bound) so a full socket buffer never reads as a dead conn
+            _set_native_socket_mode(c, recv_timeout_secs=0,
+                                    send_timeout_secs=30)
+        fds = [c.sock.fileno() for c in conns]
+        conn_state = (ctypes.c_uint64 * len(fds))(*([0] * len(fds)))
+        try:
+            while True:
+                worker.check_interruption_request(force=True)
+                open_left = native.run_net_server_slice(
+                    fds, conn_state, bs, response, worker,
+                    interrupt_flag=worker._native_interrupt)
+                if not open_left:
+                    return
+        finally:
+            for conn in conns:
+                conn.close()
+            worker._netbench_conns = []
     sel = selectors.DefaultSelector()
     states = {}
     for conn in conns:

@@ -14,11 +14,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PORTS = (17311, 17312)
 
 
-@pytest.fixture()
-def services():
+@pytest.fixture(params=["native", "python"])
+def services(request):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    if request.param == "python":
+        env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    else:
+        env.pop("ELBENCHO_TPU_NO_NATIVE", None)
     env["JAX_PLATFORMS"] = "cpu"
     procs = [subprocess.Popen(
         [sys.executable, "-m", "elbencho_tpu", "--service", "--foreground",
