@@ -508,6 +508,7 @@ enum {
 int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                   uint64_t n_files, int op, int open_flags,
                   uint64_t file_size, uint64_t block_size, char* buf,
+                  const uint64_t* range_starts, const uint64_t* range_lens,
                   int ignore_delete_errors, uint64_t* out_entry_lat,
                   uint64_t* out_block_lat, uint64_t* out_bytes,
                   uint64_t* out_entries, uint64_t* out_fail_idx,
@@ -515,13 +516,14 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
     uint64_t bytes_done = 0;
     uint64_t entries_done = 0;
     uint64_t block_idx = 0;
-    const uint64_t blocks_per_file = block_size
-        ? (file_size + block_size - 1) / block_size : 0;
 
     for (uint64_t i = 0; i < n_files; ++i) {
         if (interrupt_flag && *interrupt_flag)
             break;
         const char* path = paths_blob + path_offs[i];
+        // per-file byte range (custom-tree slices); default [0, file_size)
+        const uint64_t r_start = range_starts ? range_starts[i] : 0;
+        const uint64_t r_len = range_lens ? range_lens[i] : file_size;
         const uint64_t t_entry = now_usec();
 
         *out_fail_idx = i;  // pre-set: any error below names file i
@@ -538,11 +540,13 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
             const int fd = open(path, open_flags, 0644);
             if (fd < 0)
                 return -errno;
-            uint64_t off = 0;
-            uint64_t file_blocks = blocks_per_file;
+            uint64_t off = r_start;
+            const uint64_t r_end = r_start + r_len;
+            uint64_t file_blocks = block_size
+                ? (r_len + block_size - 1) / block_size : 0;
             while (file_blocks--) {
-                const uint64_t len = (off + block_size <= file_size)
-                    ? block_size : (file_size - off);
+                const uint64_t len = (off + block_size <= r_end)
+                    ? block_size : (r_end - off);
                 const uint64_t t0 = now_usec();
                 const ssize_t res = (op == FILE_OP_WRITE)
                     ? pwrite(fd, buf, len, static_cast<off_t>(off))
@@ -582,6 +586,8 @@ int ioengine_run_file_loop(const char* paths_blob,
                            const uint32_t* path_offs, uint64_t n_files,
                            int op, int open_flags, uint64_t file_size,
                            uint64_t block_size, void* buf,
+                           const uint64_t* range_starts,
+                           const uint64_t* range_lens,
                            int ignore_delete_errors,
                            uint64_t* out_entry_lat, uint64_t* out_block_lat,
                            uint64_t* out_bytes, uint64_t* out_entries,
@@ -594,9 +600,9 @@ int ioengine_run_file_loop(const char* paths_blob,
     }
     return run_file_loop(paths_blob, path_offs, n_files, op, open_flags,
                          file_size, block_size, static_cast<char*>(buf),
-                         ignore_delete_errors, out_entry_lat, out_block_lat,
-                         out_bytes, out_entries, out_fail_idx,
-                         interrupt_flag);
+                         range_starts, range_lens, ignore_delete_errors,
+                         out_entry_lat, out_block_lat, out_bytes,
+                         out_entries, out_fail_idx, interrupt_flag);
 }
 
 // multi-fd variant: fd_idx[i] selects fds[] per block (NULL -> fds[0]);

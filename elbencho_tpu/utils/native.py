@@ -116,6 +116,8 @@ class _NativeEngine:
             ctypes.c_uint64,                  # file size
             ctypes.c_uint64,                  # block size
             ctypes.c_void_p,                  # io buffer
+            ctypes.POINTER(ctypes.c_uint64),  # per-file range starts (opt)
+            ctypes.POINTER(ctypes.c_uint64),  # per-file range lengths (opt)
             ctypes.c_int,                     # ignore delete errors
             ctypes.POINTER(ctypes.c_uint64),  # out: entry latencies
             ctypes.POINTER(ctypes.c_uint64),  # out: block latencies
@@ -134,11 +136,13 @@ class _NativeEngine:
     def run_file_loop(self, paths: "list[str]", op: str, open_flags: int,
                       file_size: int, block_size: int, buf_addr: int,
                       ignore_delete_errors: bool, worker,
-                      interrupt_flag=None) -> None:
+                      interrupt_flag=None, ranges=None) -> None:
         """Dir-mode LOSF hot path: open->blocks->close (or stat/unlink)
         per file, entirely in C++. Counters/histograms update after the
         call; partial (interrupted) chunks attribute only completed
-        files."""
+        files. ranges: optional (starts, lens) uint64 arrays for
+        custom-tree per-file byte slices (default: [0, file_size))."""
+        import numpy as np
         n = len(paths)
         encoded = [os.fsencode(p) for p in paths]
         blob = b"\0".join(encoded) + b"\0"
@@ -147,10 +151,22 @@ class _NativeEngine:
         for i, e in enumerate(encoded):
             offs[i] = pos
             pos += len(e) + 1
-        blocks_per_file = (file_size + block_size - 1) // block_size \
-            if block_size and op in ("write", "read") and file_size else 0
+        io_op = op in ("write", "read") and block_size
+        if ranges is not None:
+            starts_arr = _as_u64_ptr(ranges[0], n)
+            lens_arr = _as_u64_ptr(ranges[1], n)
+            per_file_blocks = (
+                (np.asarray(ranges[1], dtype=np.uint64)
+                 + np.uint64(block_size - 1)) // np.uint64(block_size)
+            ).astype(np.int64) if io_op else None
+            total_blocks = int(per_file_blocks.sum()) if io_op else 0
+        else:
+            starts_arr = lens_arr = per_file_blocks = None
+            bpf = (file_size + block_size - 1) // block_size \
+                if io_op and file_size else 0
+            total_blocks = n * bpf
         entry_lat = (ctypes.c_uint64 * n)()
-        block_lat = (ctypes.c_uint64 * max(n * blocks_per_file, 1))()
+        block_lat = (ctypes.c_uint64 * max(total_blocks, 1))()
         bytes_done = ctypes.c_uint64(0)
         entries_done = ctypes.c_uint64(0)
         fail_idx = ctypes.c_uint64(0)
@@ -158,7 +174,7 @@ class _NativeEngine:
                      else ctypes.c_int(0))
         ret = self._lib.ioengine_run_file_loop(
             blob, offs, n, self.FILE_OPS[op], open_flags, file_size,
-            block_size, ctypes.c_void_p(buf_addr),
+            block_size, ctypes.c_void_p(buf_addr), starts_arr, lens_arr,
             1 if ignore_delete_errors else 0, entry_lat, block_lat,
             ctypes.byref(bytes_done), ctypes.byref(entries_done),
             ctypes.byref(fail_idx), ctypes.byref(interrupt))
@@ -166,12 +182,14 @@ class _NativeEngine:
             failed = paths[min(fail_idx.value, n - 1)]
             raise OSError(-ret, f"{os.strerror(-ret)} "
                                 f"({op}: {failed})", failed)
-        import numpy as np
         done = entries_done.value
         if done:
             worker.entries_latency_histo.add_latencies_array(
                 np.frombuffer(entry_lat, dtype=np.uint64)[:done])
-        num_blocks = done * blocks_per_file
+        if per_file_blocks is not None:
+            num_blocks = int(per_file_blocks[:done].sum())
+        else:
+            num_blocks = done * (total_blocks // n if n else 0)
         if num_blocks:
             worker.iops_latency_histo.add_latencies_array(
                 np.frombuffer(block_lat, dtype=np.uint64)[:num_blocks])

@@ -429,10 +429,12 @@ class LocalWorker(Worker):
             # _native_chunk_blocks)
             blocks_per_file = max(
                 (cfg.file_size + cfg.block_size - 1) // cfg.block_size, 1)
-            chunk = max(1, min(8192 // blocks_per_file,
-                               (256 << 20) // cfg.file_size))
+            chunk = max(1, min(
+                self._NATIVE_CHUNK_MAX_BLOCKS // blocks_per_file,
+                self._NATIVE_CHUNK_MAX_BYTES // cfg.file_size))
         else:
-            chunk = 8192  # stat/unlink: no block I/O, only path batching
+            # stat/unlink: no block I/O, only path batching
+            chunk = self._NATIVE_CHUNK_MAX_BLOCKS
         paths: "list[str]" = []
 
         def submit():
@@ -728,12 +730,14 @@ class LocalWorker(Worker):
                 and self._rate_limiter_read is None
                 and self._rate_limiter_write is None)
 
+    #: bounds for one native engine call, so live stats progress and
+    #: interrupts stay responsive (shared by every native delegation)
+    _NATIVE_CHUNK_MAX_BLOCKS = 8192
+    _NATIVE_CHUNK_MAX_BYTES = 256 << 20
+
     def _native_chunk_blocks(self) -> int:
-        """Cap each native call at ~256 MiB of I/O and 8192 blocks so live
-        stats progress and interrupts stay responsive."""
-        per_call_bytes = 256 << 20
-        by_bytes = per_call_bytes // max(self.cfg.block_size, 1)
-        return max(1, min(8192, by_bytes))
+        by_bytes = self._NATIVE_CHUNK_MAX_BYTES // max(self.cfg.block_size, 1)
+        return max(1, min(self._NATIVE_CHUNK_MAX_BLOCKS, by_bytes))
 
     def _run_native_block_loop(self, native, fd, gen, is_write,
                                file_offset_base, stripe=None) -> bool:
@@ -1069,6 +1073,19 @@ class LocalWorker(Worker):
         else:
             my_files += shared.get_worker_sublist_shared(rank, ndst).elems
         base = cfg.paths[0]
+        if phase == BenchPhase.DELETEFILES:
+            # only one worker deletes a shared file (the slice at offset
+            # 0); skipped slices are no phase work — identical accounting
+            # on the native and fallback paths
+            my_files = [e for e in my_files if e.range_start == 0]
+            if not my_files:
+                self.got_phase_work = False
+                return
+        from ..utils.native import get_native_engine
+        native = get_native_engine()
+        if self._can_use_native_file_loop(native, phase):
+            self._run_native_tree_loop(native, phase, base, my_files)
+            return
         for elem in my_files:
             self.check_interruption_request(force=True)
             path = os.path.join(base, elem.path)
@@ -1098,15 +1115,56 @@ class LocalWorker(Worker):
             elif phase == BenchPhase.STATFILES:
                 os.stat(path)
             elif phase == BenchPhase.DELETEFILES:
-                if elem.range_start == 0:  # only one worker deletes shared
-                    try:
-                        os.unlink(path)
-                    except FileNotFoundError:
-                        if not cfg.ignore_delete_errors:
-                            raise
+                try:  # non-zero shared slices were filtered out above
+                    os.unlink(path)
+                except FileNotFoundError:
+                    if not cfg.ignore_delete_errors:
+                        raise
             lat_usec = (time.perf_counter_ns() - t0) // 1000
             self.entries_latency_histo.add_latency(lat_usec)
             self.live_ops.num_entries_done += 1
+
+    def _run_native_tree_loop(self, native, phase: BenchPhase, base: str,
+                              my_files) -> None:
+        """Custom-tree files through the C++ file loop with per-file byte
+        ranges (shared-file slices keep their [range_start, range_len))."""
+        cfg = self.cfg
+        op = self._NATIVE_FILE_OPS[phase]
+        if phase == BenchPhase.CREATEFILES:
+            open_flags = self._open_flags_write()
+            # dirs are created up front (the reference pre-creates the
+            # tree's dirs in their own phase; mkdir is not per-file work)
+            for d in {os.path.dirname(os.path.join(base, e.path))
+                      for e in my_files}:
+                os.makedirs(d or ".", exist_ok=True)
+        else:
+            open_flags = os.O_RDONLY | (os.O_DIRECT if cfg.use_direct_io
+                                        else 0)
+        paths: "list[str]" = []
+        starts: "list[int]" = []
+        lens: "list[int]" = []
+        chunk_bytes = 0
+
+        def submit():
+            self.check_interruption_request(force=True)
+            native.run_file_loop(
+                paths, op, open_flags, cfg.file_size, cfg.block_size,
+                buf_addr=self._buf_addr() if self._io_bufs else 0,
+                ignore_delete_errors=cfg.ignore_delete_errors,
+                worker=self, interrupt_flag=self._native_interrupt,
+                ranges=(starts, lens) if op in ("write", "read") else None)
+
+        for elem in my_files:
+            paths.append(os.path.join(base, elem.path))
+            starts.append(elem.range_start)
+            lens.append(elem.range_len)
+            chunk_bytes += elem.range_len
+            if len(paths) >= self._NATIVE_CHUNK_MAX_BLOCKS \
+                    or chunk_bytes >= self._NATIVE_CHUNK_MAX_BYTES:
+                submit()
+                paths, starts, lens, chunk_bytes = [], [], [], 0
+        if paths:
+            submit()
 
     # ------------------------------------------------------------------
     # sync / dropcaches (reference: anyModeSync :8075 / DropCaches :8118)

@@ -361,3 +361,44 @@ def test_native_mmap_loop_roundtrip(tmp_path, monkeypatch):
                  "64K", "-b", "16K", "--nolive",
                  str(tmp_path / "v")]) == 0
     native_mod.reset_native_engine_cache()
+
+
+def test_native_tree_loop(tmp_path, monkeypatch):
+    """Custom-tree phases run through the C++ per-file-range loop; shared
+    slices write disjoint ranges of one file and sizes come out right."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils import native as native_mod
+    native_mod.reset_native_engine_cache()
+    native = native_mod.get_native_engine()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    calls = []
+    orig = type(native).run_file_loop
+
+    def spy(self, paths, op, *a, **kw):
+        calls.append(op)
+        return orig(self, paths, op, *a, **kw)
+
+    monkeypatch.setattr(type(native), "run_file_loop", spy)
+    tree = tmp_path / "tree.txt"
+    # two small exclusive files + one large shared file (sliced)
+    tree.write_text("f 1024 d1/small1\nf 2048 d2/small2\nf 262144 big\n")
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    from elbencho_tpu.cli import main
+    args = ["-t", "2", "-b", "16K", "--treefile", str(tree),
+            "--sharesize", "64K", "--nolive", str(bench)]
+    assert main(["-w"] + args) == 0
+    assert (bench / "d1/small1").stat().st_size == 1024
+    assert (bench / "d2/small2").stat().st_size == 2048
+    assert (bench / "big").stat().st_size == 262144
+    data = (bench / "big").read_bytes()
+    for s in range(0, len(data), 64 * 1024):  # every share-size slice
+        piece = data[s:s + 64 * 1024]
+        assert piece != b"\0" * len(piece), f"slice at {s} not written"
+    assert main(["-r", "--stat"] + args) == 0
+    assert main(["-F"] + args) == 0
+    assert not (bench / "big").exists()
+    assert "write" in calls and "read" in calls and "stat" in calls \
+        and "unlink" in calls, calls
+    native_mod.reset_native_engine_cache()
