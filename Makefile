@@ -36,6 +36,10 @@ test-fast: native
 	python -m pytest tests/ -q -x --ignore=tests/test_service_mode.py \
 		--ignore=tests/test_netbench.py
 
+# end-to-end example suite against real resources (loopdevs, services)
+test-examples: native
+	tools/test-examples $${BASEDIR:-/tmp}
+
 bench: native
 	python bench.py
 
