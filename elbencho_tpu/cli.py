@@ -93,8 +93,15 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"ERROR: {err}", file=sys.stderr)
         return 1
     if ns.version:
+        from .utils.native import get_native_engine
+        native = get_native_engine(try_build=False)  # no compile here
+        engine = "not built (make -C csrc)"
+        if native is not None:
+            engine = native.version()
+            if native.uring_supported():
+                engine += ", io_uring ok"
         print(f"elbencho-tpu {__version__} (jax-based TPU data path; "
-              f"C++ ioengine optional)")
+              f"native engine: {engine})")
         return 0
     for help_flag, cat in HELP_CATEGORIES.items():
         if getattr(ns, help_flag.replace("-", "_")):

@@ -67,6 +67,11 @@ class _NativeEngine:
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
+        # resolved here so a stale .so missing any symbol downgrades to
+        # the pure-Python fallback via get_native_engine's AttributeError
+        # catch instead of crashing at call time
+        lib.ioengine_version.restype = ctypes.c_char_p
+        lib.ioengine_version.argtypes = []
         lib.ioengine_run_mmap_loop.restype = ctypes.c_int
         lib.ioengine_run_mmap_loop.argtypes = [
             ctypes.c_void_p,                  # mapping base address
@@ -129,6 +134,9 @@ class _NativeEngine:
 
     def uring_supported(self) -> bool:
         return bool(self._lib.ioengine_uring_supported())
+
+    def version(self) -> str:
+        return self._lib.ioengine_version().decode()
 
     #: op codes of ioengine_run_file_loop (csrc/ioengine.cpp FILE_OP_*)
     FILE_OPS = {"write": 0, "read": 1, "stat": 2, "unlink": 3}
@@ -328,9 +336,10 @@ class _NativeEngine:
         return True
 
 
-def get_native_engine() -> "_NativeEngine | None":
+def get_native_engine(try_build: bool = True) -> "_NativeEngine | None":
     """Lazily load the native engine; None if not built or disabled via
-    ELBENCHO_TPU_NO_NATIVE=1."""
+    ELBENCHO_TPU_NO_NATIVE=1. try_build=False only loads an existing .so
+    (diagnostics paths like --version must not kick off a compile)."""
     global _engine, _engine_checked
     if _engine_checked:
         return _engine
@@ -338,7 +347,7 @@ def get_native_engine() -> "_NativeEngine | None":
         if _engine_checked:
             return _engine
         if os.environ.get("ELBENCHO_TPU_NO_NATIVE") != "1":
-            if not os.path.exists(_SO_PATH) \
+            if try_build and not os.path.exists(_SO_PATH) \
                     and not os.path.exists(_SO_PATH_INSTALLED):
                 _try_build()
             for so in (_SO_PATH, _SO_PATH_INSTALLED):
@@ -348,7 +357,10 @@ def get_native_engine() -> "_NativeEngine | None":
                         break
                     except (OSError, AttributeError):
                         _engine = None
-        _engine_checked = True
+        # a build-skipping probe must not cache "unavailable" — a later
+        # real run still gets its chance to compile the engine
+        if _engine is not None or try_build:
+            _engine_checked = True
         return _engine
 
 
