@@ -1,0 +1,97 @@
+"""Single source of truth for the axon-TPU-plugin mitigation.
+
+This box loads the axon PJRT plugin via PYTHONPATH=/root/.axon_site, whose
+sitecustomize imports jax at interpreter startup pinned to
+JAX_PLATFORMS="axon,cpu". When the axon tunnel is down, ANY call that
+initializes jax backends (jax.devices(), even jax.devices("cpu"), since
+backend init walks every listed platform) blocks forever.
+
+Two consumers need the same three mitigations (strip the plugin path,
+force platform cpu, set the virtual host device count):
+- tests/conftest.py (in-process, before pytest imports repo code)
+- __graft_entry__.dryrun_multichip (sanitized subprocess env)
+
+Must not import jax (or anything heavy) at module level.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+AXON_MARK = ".axon_site"
+DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def strip_axon_paths(paths: str) -> str:
+    """Drop axon plugin entries from a PYTHONPATH-style string."""
+    return os.pathsep.join(
+        p for p in paths.split(os.pathsep) if p and AXON_MARK not in p)
+
+
+def strip_axon_sys_path() -> None:
+    """Drop axon plugin entries from THIS process's sys.path."""
+    sys.path[:] = [p for p in sys.path if AXON_MARK not in p]
+
+
+def sanitized_env(n_devices: int, base: "dict | None" = None) -> dict:
+    """Environment for a fresh subprocess that must run jax on a virtual
+    n-device CPU mesh, immune to the axon plugin."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = strip_axon_paths(env.get("PYTHONPATH", ""))
+    flags = re.sub(DEVICE_COUNT_FLAG + r"=\d+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" {DEVICE_COUNT_FLAG}={n_devices}").strip()
+    return env
+
+
+def apply_in_process(n_devices: int) -> None:
+    """Apply all three mitigations to THIS process. Env-var changes only
+    help code that has not read them yet; if sitecustomize already imported
+    jax, its config captured the axon platform, so force the config too
+    (safe: it only switches the platform allowlist, never touches devices).
+    The device count flag only takes effect if no CPU backend exists yet.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if DEVICE_COUNT_FLAG not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" {DEVICE_COUNT_FLAG}={n_devices}").strip()
+    strip_axon_sys_path()
+    os.environ["PYTHONPATH"] = strip_axon_paths(
+        os.environ.get("PYTHONPATH", ""))
+    if "jax" in sys.modules:
+        sys.modules["jax"].config.update("jax_platforms", "cpu")
+
+
+def probe_default_backend(timeout_sec: float = 60.0) -> bool:
+    """True when the default jax backend (the real TPU on this box) can be
+    initialized. Probed in a bounded subprocess because a dead axon tunnel
+    makes initialization block forever in-process."""
+    import subprocess
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout_sec)
+    except subprocess.TimeoutExpired:
+        return False
+    return res.returncode == 0
+
+
+def jax_safe_for_cpu_mesh(n_devices: int) -> bool:
+    """True when this process's jax can serve an n-device CPU mesh without
+    any risk of touching the axon backend: jax imported, platform config
+    EXACTLY cpu, and enough virtual CPU devices."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        platforms = [p for p in str(jax.config.jax_platforms or "").split(",")
+                     if p]
+        if platforms != ["cpu"]:
+            return False
+        return len(jax.devices("cpu")) >= n_devices
+    except Exception:
+        return False

@@ -1,32 +1,24 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so sharding
-tests run without TPU hardware (SURVEY.md environment notes)."""
+tests run without TPU hardware (SURVEY.md environment notes).
+
+The axon TPU plugin (loaded via PYTHONPATH=/root/.axon_site) blocks jax
+initialization when its tunnel is unreachable — even with platform=cpu in
+the env, because its sitecustomize imports jax at interpreter startup and
+jax's config captures the axon platform before this file runs. The shared
+mitigation in _axon_mitigation strips the plugin path (also from the env
+that subprocess-based tests inherit), forces the config to cpu directly,
+and sets the virtual device count.
+"""
 
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _axon_mitigation
 
 # tests must not write default result files into /var/tmp (reference
 # parity behavior of non-service runs)
 os.environ["ELBENCHO_TPU_NO_DEFAULT_RESFILES"] = "1"
 
-# this box pins JAX_PLATFORMS=axon (one real TPU chip); tests must run on
-# the virtual 8-device CPU mesh instead
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-# the axon TPU plugin (loaded via PYTHONPATH=/root/.axon_site) blocks jax
-# initialization when its tunnel is unreachable — even with platform=cpu.
-# Tests are CPU-only by design, so strip it from this process and from the
-# environment that subprocess-based tests inherit.
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = os.pathsep.join(
-    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-    if p and ".axon_site" not in p)
-
-# the plugin's sitecustomize imports jax at interpreter startup, so jax's
-# config captured JAX_PLATFORMS=axon before this file ran — the env-var
-# override above is too late for THIS process. Force the config directly.
-if "jax" in sys.modules:
-    sys.modules["jax"].config.update("jax_platforms", "cpu")
+_axon_mitigation.apply_in_process(n_devices=8)
