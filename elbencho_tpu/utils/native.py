@@ -28,6 +28,12 @@ _SO_PATH_INSTALLED = os.path.join(_PKG_DIR, "_native", "libioengine.so")
 # engine selector values (must match csrc/ioengine.cpp)
 ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 
+# ABI generation expected from the .so; ioengine_version() reports
+# "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
+# (e.g. installed prebuilt vs newer source) — refuse it rather than run
+# benchmarks against outdated native code.
+EXPECTED_ABI = 3
+
 
 def _as_ptr(values, n, np_dtype_name, c_type):
     """ctypes view of a numpy array (zero-copy) or python list."""
@@ -137,6 +143,14 @@ class _NativeEngine:
 
     def version(self) -> str:
         return self._lib.ioengine_version().decode()
+
+    def abi_version(self) -> int:
+        # "elbencho-tpu ioengine <N> (...)" -> N; 0 if unparseable
+        parts = self.version().split()
+        try:
+            return int(parts[2])
+        except (IndexError, ValueError):
+            return 0
 
     #: op codes of ioengine_run_file_loop (csrc/ioengine.cpp FILE_OP_*)
     FILE_OPS = {"write": 0, "read": 1, "stat": 2, "unlink": 3}
@@ -347,13 +361,29 @@ def get_native_engine(try_build: bool = True) -> "_NativeEngine | None":
         if _engine_checked:
             return _engine
         if os.environ.get("ELBENCHO_TPU_NO_NATIVE") != "1":
-            if try_build and not os.path.exists(_SO_PATH) \
-                    and not os.path.exists(_SO_PATH_INSTALLED):
+            # always invoke make in the checkout layout: it is an mtime
+            # no-op when the .so is fresh, and it prevents silently
+            # benchmarking a stale binary after an ioengine.cpp edit
+            if try_build and os.path.exists(
+                    os.path.join(os.path.dirname(_SO_PATH), "ioengine.cpp")):
                 _try_build()
             for so in (_SO_PATH, _SO_PATH_INSTALLED):
                 if os.path.exists(so):
                     try:
-                        _engine = _NativeEngine(ctypes.CDLL(so))
+                        candidate = _NativeEngine(ctypes.CDLL(so))
+                        if candidate.abi_version() != EXPECTED_ABI:
+                            # visible refusal: otherwise the silent
+                            # pure-Python fallback looks like a storage
+                            # slowdown to the user
+                            from ..toolkits.logger import log_error
+                            log_error(
+                                f"ignoring stale native ioengine {so} "
+                                f"(ABI {candidate.abi_version()}, expected "
+                                f"{EXPECTED_ABI}); falling back to the "
+                                f"pure-Python I/O loop unless another "
+                                f"build is found")
+                            continue
+                        _engine = candidate
                         break
                     except (OSError, AttributeError):
                         _engine = None
