@@ -728,6 +728,20 @@ class BenchConfig(BenchConfigBase):
         if self.file_size and self.block_size > self.file_size:
             # reference reduces blocksize to filesize with a note
             self.block_size = self.file_size
+        if (self.use_direct_io or self.use_random_offsets
+                or self.do_strided_access) and self.file_size \
+                and (self.run_create_files or self.run_read_files) \
+                and self.file_size % self.block_size:
+            # reference auto-adjusts (ProgArgs.cpp:1664-1676): a trailing
+            # partial block would straddle a file boundary in striped
+            # random/strided mode and hard-fail with a short read
+            new_size = self.file_size - (self.file_size % self.block_size)
+            from ..toolkits.logger import LOG_NORMAL, log
+            log(LOG_NORMAL,
+                "NOTE: File size has to be a multiple of block size for "
+                "direct IO, random IO and strided IO. Reducing file size. "
+                f"Old: {self.file_size}; New: {new_size}")
+            self.file_size = new_size
         if self.use_direct_io and not self.no_direct_io_check:
             align = 512
             if self.file_size % align or self.block_size % align:

@@ -270,6 +270,8 @@ class RemoteWorker(Worker):
         self.live_ops_rwmix_read.num_entries_done = final_rw.get("entries", 0)
         self.live_ops_rwmix_read.num_bytes_done = final_rw.get("bytes", 0)
         self.live_ops_rwmix_read.num_iops_done = final_rw.get("iops", 0)
+        self.stonewall_ops_rwmix_read.num_entries_done = \
+            stone_rw.get("entries", 0)
         self.stonewall_ops_rwmix_read.num_bytes_done = \
             stone_rw.get("bytes", 0)
         self.stonewall_ops_rwmix_read.num_iops_done = stone_rw.get("iops", 0)

@@ -190,8 +190,11 @@ class RandAlgoGoldenPrime(RandAlgo):
         precomputed power table yields the EXACT scalar sequence in one
         vector multiply (reseed boundaries handled per sub-batch)."""
         cls = type(self)
-        if cls._prime_powers is None or len(cls._prime_powers) < n:
-            size = max(n, 8192)
+        if cls._prime_powers is None:
+            # sub-batches never exceed one reseed span (k <= trigger-1 <
+            # _GOLDEN_RESEED_BYTES/8), so a fixed-size table built once
+            # suffices — and being write-once, it is thread-safe to share
+            size = _GOLDEN_RESEED_BYTES // 8
             powers = np.empty(size, dtype=np.uint64)
             acc = 1
             for i in range(size):
@@ -222,20 +225,11 @@ class RandAlgoGoldenPrime(RandAlgo):
         return out
 
     def fill_buffer(self, num_bytes: int) -> bytes:
+        # next64_batch reseeds at the 256 KiB boundaries mid-stream, so
+        # large buffers keep the exact scalar-stream (and reference
+        # RandAlgoGoldenPrime) compressibility characteristics
         n = (num_bytes + 7) // 8
-        out = np.empty(n, dtype=np.uint64)
-        state = np.uint64(self._state)
-        prime = np.uint64(_GOLDEN_PRIME)
-        with np.errstate(over="ignore"):
-            for i in range(n):
-                state = state * prime
-                out[i] = (state << np.uint64(32)) | (state >> np.uint64(32))
-        self._state = int(state)
-        self._bytes_since_reseed += n * 8
-        if self._bytes_since_reseed >= _GOLDEN_RESEED_BYTES:
-            self._state = self._reseed_src.next64() | 1
-            self._bytes_since_reseed = 0
-        return out.tobytes()[:num_bytes]
+        return self.next64_batch(n).tobytes()[:num_bytes]
 
 
 RAND_ALGO_NAMES = ("strong", "balanced_single", "balanced", "fast")

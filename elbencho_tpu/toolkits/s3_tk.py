@@ -214,6 +214,18 @@ class S3Client:
             self._log_fh.close()
             self._log_fh = None
 
+    @staticmethod
+    def _encode_query(query: "dict[str, str]", sort: bool = False) -> str:
+        """Percent-encode a query dict. The SAME encoding must serve the
+        SigV4 canonical query (sorted) and the wire URL: quote_plus-style
+        '+' for space would yield SignatureDoesNotMatch on servers that
+        canonicalize the raw query string."""
+        items = sorted(query.items()) if sort else query.items()
+        return "&".join(
+            f"{urllib.parse.quote(k, safe='')}"
+            f"={urllib.parse.quote(str(v), safe='')}"
+            for k, v in items)
+
     def _sign_v4(self, method: str, path: str, query: "dict[str, str]",
                  headers: "dict[str, str]", payload_hash: str) -> None:
         """AWS Signature Version 4 (public algorithm: canonical request ->
@@ -228,10 +240,7 @@ class S3Client:
         if self.session_token:
             # temporary credentials: token is part of the signed headers
             headers["x-amz-security-token"] = self.session_token
-        canon_query = "&".join(
-            f"{urllib.parse.quote(k, safe='')}"
-            f"={urllib.parse.quote(str(v), safe='')}"
-            for k, v in sorted(query.items()))
+        canon_query = self._encode_query(query, sort=True)
         signed_names = sorted(h.lower() for h in headers)
         canon_headers = "".join(
             f"{name}:{str(headers[next(h for h in headers if h.lower() == name)]).strip()}\n"
@@ -315,7 +324,7 @@ class S3Client:
         self._sign_v4(method, path, query, headers, payload_hash)
         url = path
         if query:
-            url += "?" + urllib.parse.urlencode(query)
+            url += "?" + self._encode_query(query)
         conn = self._connection()
         try:
             conn.request(method, url, body=body or None, headers=headers)

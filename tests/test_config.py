@@ -107,6 +107,25 @@ def test_random_amount_default(tmp_path):
     assert cfg.random_amount == 1 << 20
 
 
+def test_file_size_reduced_to_block_multiple(tmp_path):
+    """Direct/random/strided IO reduces file size to a block-size multiple
+    (reference ProgArgs.cpp:1664-1676) instead of short-read failing."""
+    d = tmp_path / "bench"
+    d.mkdir()
+    for extra in (["--rand"], ["--direct"], ["--strided"]):
+        cfg, _ = parse_cli(["-w", "-d", "-s", "100K", "-b", "64K",
+                            "-t", "1", *extra, str(d)])
+        cfg.derive()
+        cfg.check()
+        assert cfg.file_size == 64 * 1024, extra
+    # no adjustment for plain sequential IO
+    cfg2, _ = parse_cli(["-w", "-d", "-s", "100K", "-b", "64K",
+                         "-t", "1", str(d)])
+    cfg2.derive()
+    cfg2.check()
+    assert cfg2.file_size == 100 * 1024
+
+
 def test_config_file_merge(tmp_path):
     cfgfile = tmp_path / "bench.conf"
     cfgfile.write_text("threads = 8\nblock = 64K\nwrite = true\n")

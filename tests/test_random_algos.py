@@ -28,6 +28,20 @@ def test_deterministic_with_seed(name):
     assert [a.next64() for _ in range(10)] == [b.next64() for _ in range(10)]
 
 
+def test_golden_prime_fill_buffer_reseeds_mid_stream():
+    """fill_buffer crossing the 256 KiB reseed threshold must match the
+    scalar next64 stream exactly (reference RandAlgoGoldenPrime reseeds
+    mid-stream, not once at the end)."""
+    import numpy as np
+    num_bytes = 300 * 1024  # crosses the 256 KiB boundary
+    a = create_rand_algo("fast", seed=42)
+    b = create_rand_algo("fast", seed=42)
+    buf = a.fill_buffer(num_bytes)
+    want = np.array([b.next64() for _ in range(num_bytes // 8)],
+                    dtype=np.uint64).tobytes()
+    assert buf == want
+
+
 def test_next_in_range():
     rng = create_rand_algo("balanced_single", seed=3)
     for _ in range(100):
