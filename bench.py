@@ -13,7 +13,12 @@ TPU HBM (1.0 = the TPU leg is fully hidden by pipelining). The reference
 publishes no GPU-path numbers (BASELINE.md: published == {}), so the
 self-relative ratio is the honest comparison.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line. Core keys: {"metric", "value", "unit",
+"vs_baseline"}; value is the MEDIAN of HBM_PASSES measured passes, with
+dispersion and context in the extra keys {"median_of", "min", "max",
+"host_read_mibs", "per_chip_hbm_mibs", "io_lat_usec_p50",
+"io_lat_usec_p99"}. If TPU accounting yields no TpuHbmMiBPerSec the run
+FAILS rather than substituting the host-only storage rate.
 """
 
 from __future__ import annotations
@@ -30,7 +35,7 @@ FILE_SIZE = "256M"
 BLOCK_SIZE = "16M"
 IO_DEPTH = "4"     # per-thread transfer pipeline depth
 THREADS = "2"      # two workers overlap tunnel round-trips
-HBM_PASSES = 2     # report the best pass (transfer-path jitter is high)
+HBM_PASSES = 5     # report the median pass, with min/max dispersion
 
 
 def _run_cli(args, jsonfile):
@@ -46,7 +51,7 @@ def _run_cli(args, jsonfile):
         return [json.loads(ln) for ln in f if ln.strip()]
 
 
-def _probe_tpu(timeout_secs: int = 180) -> None:
+def _probe_tpu(timeout_secs: int = 180) -> str:
     """Fail fast (with a clear message) when the TPU backend is
     unreachable — jax.devices() otherwise blocks forever on a dead
     tunnel and the whole bench run times out without explanation."""
@@ -59,15 +64,22 @@ def _probe_tpu(timeout_secs: int = 180) -> None:
             f"TPU probe failed: {probe.stderr[-500:]}")
     platform = probe.stdout.strip().lower()
     if platform not in ("tpu", "axon"):  # axon = tunneled TPU plugin
+        if os.environ.get("ELBENCHO_TPU_BENCH_ALLOW_NONTPU") == "1":
+            # harness self-test only: the metric name is rewritten so a
+            # non-TPU number can never masquerade as the TPU result
+            print(f"# WARNING: non-TPU platform {platform!r} allowed by "
+                  f"ELBENCHO_TPU_BENCH_ALLOW_NONTPU", file=sys.stderr)
+            return platform
         raise RuntimeError(
             f"default jax backend is {platform!r}, not a TPU — refusing "
             f"to publish HBM-ingest numbers measured on a CPU fallback")
     print(f"# TPU probe ok: platform={platform}", file=sys.stderr)
+    return platform
 
 
 def main() -> int:
     try:
-        _probe_tpu()
+        platform = _probe_tpu()
     except (RuntimeError, subprocess.TimeoutExpired) as err:
         print(f"ERROR: TPU device unreachable, cannot run the HBM ingest "
               f"benchmark: {err}", file=sys.stderr)
@@ -91,21 +103,48 @@ def main() -> int:
         # warmup (jit compile) then measured passes: read -> HBM, pipelined
         _run_cli(["-r", "-t", "1", "-s", BLOCK_SIZE, "-b", BLOCK_SIZE,
                   "--tpuids", "0", target], warm)
-        hbm_mibs = 0.0
+        passes = []
         for _ in range(HBM_PASSES):
             open(j3, "w").close()  # fresh result file per pass
             hbm = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
                             "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
                             "--tpuids", "0", target], j3)
             hbm_rec = next(r for r in hbm if r["Phase"] == "READ")
-            hbm_mibs = max(hbm_mibs, hbm_rec["TpuHbmMiBPerSec"]
-                           or hbm_rec["MiBPerSecLast"])
+            mibs = hbm_rec.get("TpuHbmMiBPerSec") or 0.0
+            if mibs <= 0:
+                # the headline metric IS the HBM-ingest rate; silently
+                # substituting the host-only read rate would publish a
+                # storage number as a TPU number (round-1 verdict item 2)
+                raise RuntimeError(
+                    "TpuHbmMiBPerSec missing or 0 in the READ record — "
+                    "TPU accounting is broken; refusing to substitute "
+                    f"the host-only rate. Record: {json.dumps(hbm_rec)[:600]}")
+            passes.append((mibs, hbm_rec))
+        passes.sort(key=lambda p: p[0])
+        med_mibs, med_rec = passes[len(passes) // 2]
+        per_chip = {
+            chip: round(v["Bytes"] / 1048576 / (v["USec"] / 1e6), 1)
+            for chip, v in med_rec.get("TpuPerChip", {}).items()
+            if v.get("USec")}
+        sys.path.insert(0, REPO)
+        from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+        histo = LatencyHistogram.from_dict(med_rec.get("IOLatHisto", {}))
+        metric = ("seq read 16M blocks into TPU HBM "
+                  "(1 chip, 2 threads, iodepth 4)")
+        if platform not in ("tpu", "axon"):
+            metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
         print(json.dumps({
-            "metric": "seq read 16M blocks into TPU HBM "
-                      "(1 chip, 2 threads, iodepth 4)",
-            "value": round(hbm_mibs, 1),
+            "metric": metric,
+            "value": round(med_mibs, 1),
             "unit": "MiB/s",
-            "vs_baseline": round(hbm_mibs / max(host_mibs, 1e-9), 3),
+            "vs_baseline": round(med_mibs / max(host_mibs, 1e-9), 3),
+            "median_of": HBM_PASSES,
+            "min": round(passes[0][0], 1),
+            "max": round(passes[-1][0], 1),
+            "host_read_mibs": round(host_mibs, 1),
+            "per_chip_hbm_mibs": per_chip,
+            "io_lat_usec_p50": round(histo.percentile(50), 1),
+            "io_lat_usec_p99": round(histo.percentile(99), 1),
         }))
         return 0
     finally:

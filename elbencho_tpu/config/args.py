@@ -636,7 +636,31 @@ class BenchConfig(BenchConfigBase):
         else:
             self.num_dataset_threads = self.num_threads
 
+    def _reduce_file_size_to_block_multiple(self) -> None:
+        """Direct/random/strided IO: a trailing partial block would straddle
+        a file boundary in striped modes and hard-fail with a short read;
+        the reference auto-adjusts with a note (ProgArgs.cpp:1664-1676).
+        Must run BEFORE the random_amount default so the amount matches the
+        reduced dataset size (reference order: :1664 before :1680)."""
+        if (self.use_direct_io or self.use_random_offsets
+                or self.do_strided_access) and self.file_size \
+                and self.block_size \
+                and (self.run_create_files or self.run_read_files) \
+                and self.file_size % self.block_size:
+            new_size = self.file_size - (self.file_size % self.block_size)
+            from ..toolkits.logger import LOG_NORMAL, log
+            log(LOG_NORMAL,
+                "NOTE: File size has to be a multiple of block size for "
+                "direct IO, random IO and strided IO. Reducing file size. "
+                f"Old: {self.file_size}; New: {new_size}")
+            self.file_size = new_size
+
     def _apply_implicit_values(self) -> None:
+        if self.file_size and 0 < self.file_size < self.block_size:
+            # reference reduces blocksize to filesize (also before the
+            # reductions below; check() re-applies for non-derive callers)
+            self.block_size = self.file_size
+        self._reduce_file_size_to_block_multiple()
         if self.use_random_offsets and not self.random_amount:
             # default random amount = full dataset size
             if self.bench_path_type != BenchPathType.DIR:
@@ -728,20 +752,7 @@ class BenchConfig(BenchConfigBase):
         if self.file_size and self.block_size > self.file_size:
             # reference reduces blocksize to filesize with a note
             self.block_size = self.file_size
-        if (self.use_direct_io or self.use_random_offsets
-                or self.do_strided_access) and self.file_size \
-                and (self.run_create_files or self.run_read_files) \
-                and self.file_size % self.block_size:
-            # reference auto-adjusts (ProgArgs.cpp:1664-1676): a trailing
-            # partial block would straddle a file boundary in striped
-            # random/strided mode and hard-fail with a short read
-            new_size = self.file_size - (self.file_size % self.block_size)
-            from ..toolkits.logger import LOG_NORMAL, log
-            log(LOG_NORMAL,
-                "NOTE: File size has to be a multiple of block size for "
-                "direct IO, random IO and strided IO. Reducing file size. "
-                f"Old: {self.file_size}; New: {new_size}")
-            self.file_size = new_size
+        self._reduce_file_size_to_block_multiple()
         if self.use_direct_io and not self.no_direct_io_check:
             align = 512
             if self.file_size % align or self.block_size % align:

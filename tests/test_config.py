@@ -118,6 +118,10 @@ def test_file_size_reduced_to_block_multiple(tmp_path):
         cfg.derive()
         cfg.check()
         assert cfg.file_size == 64 * 1024, extra
+        if extra == ["--rand"]:
+            # the default random amount must match the REDUCED dataset
+            # size (reference order: ProgArgs.cpp:1664 before :1680)
+            assert cfg.random_amount == 64 * 1024
     # no adjustment for plain sequential IO
     cfg2, _ = parse_cli(["-w", "-d", "-s", "100K", "-b", "64K",
                          "-t", "1", str(d)])
