@@ -54,6 +54,130 @@ inline uint64_t now_usec() {
         + static_cast<uint64_t>(ts.tv_nsec) / 1000ull;
 }
 
+// ---------------------------------------------------------------------------
+// per-block modifiers: integrity verify fill/check, rwmix read split, block
+// variance refill — the reference runs all three INSIDE its native hot loop
+// (LocalWorker.cpp:1741 rwmix modulo, :2124 verify fill, :2242 variance), so
+// enabling them must not drop the loop out of native code.
+
+constexpr uint64_t kGoldenPrime = 0x9E3779B97F4A7C15ull;
+constexpr uint64_t kVarReseedBytes = 256 * 1024;  // RandAlgoGoldenPrime.h:14
+
+inline uint64_t splitmix64(uint64_t& s) {
+    s += 0x9E3779B97F4A7C15ull;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+}
+
+// golden-prime 'fast' tier PRNG for --blockvarpct buffer refills: weak
+// multiplicative stream, reseeded from a strong source every 256 KiB
+// (same structure as toolkits/random_algos.py RandAlgoGoldenPrime; the
+// reseed source here is splitmix64 — content characteristics match, the
+// exact stream is not part of any contract)
+struct VarRng {
+    uint64_t state;
+    uint64_t reseed_state;
+    uint64_t bytes_since = 0;
+
+    explicit VarRng(uint64_t seed) : reseed_state(seed) {
+        state = splitmix64(reseed_state) | 1;
+    }
+
+    inline uint64_t next64() {
+        bytes_since += 8;
+        if (bytes_since >= kVarReseedBytes) {
+            state = splitmix64(reseed_state) | 1;
+            bytes_since = 0;
+        }
+        state *= kGoldenPrime;
+        return (state << 32) | (state >> 32);
+    }
+
+    // refill the first `pct`% of a block (preWriteBufRandRefill :2242)
+    void refill(char* buf, uint64_t len, int pct) {
+        const uint64_t refill_len = len * static_cast<uint64_t>(pct) / 100;
+        uint64_t whole = refill_len / 8;
+        char* p = buf;
+        while (whole--) {
+            const uint64_t v = next64();
+            memcpy(p, &v, 8);
+            p += 8;
+        }
+        const uint64_t tail = refill_len % 8;
+        if (tail) {
+            const uint64_t v = next64();
+            memcpy(p, &v, tail);
+        }
+    }
+};
+
+// verify pattern: 8-byte word j of a block at file offset `off` holds
+// (off + 8j + salt); tail bytes (len % 8) are zero — exactly the host-side
+// pattern of workers/local_worker.py::_fill_verify_pattern (reference:
+// preWriteIntegrityCheckFillBuf, LocalWorker.cpp:2124)
+inline void verify_fill(char* buf, uint64_t off, uint64_t len,
+                        uint64_t salt) {
+    const uint64_t n_words = len / 8;
+    for (uint64_t j = 0; j < n_words; ++j) {
+        const uint64_t v = off + 8 * j + salt;
+        memcpy(buf + 8 * j, &v, 8);
+    }
+    if (len % 8)
+        memset(buf + n_words * 8, 0, len % 8);
+}
+
+// 0 on match; on mismatch fills info[] = {block_idx, word_idx, want, got}
+// (postReadIntegrityCheckVerifyBuf :2170 — exact mismatch offset report)
+inline int verify_check(const char* buf, uint64_t off, uint64_t len,
+                        uint64_t salt, uint64_t block_idx, uint64_t* info) {
+    const uint64_t n_words = len / 8;
+    for (uint64_t j = 0; j < n_words; ++j) {
+        const uint64_t want = off + 8 * j + salt;
+        uint64_t got;
+        memcpy(&got, buf + 8 * j, 8);
+        if (got != want) {
+            info[0] = block_idx;
+            info[1] = j;
+            info[2] = want;
+            info[3] = got;
+            return -EILSEQ;
+        }
+    }
+    return 0;
+}
+
+// bundled modifier config threaded through all block loops; disabled
+// members are no-ops so the plain path stays branch-light
+struct BlockMod {
+    const unsigned char* op_is_read = nullptr;  // rwmix: per-op read flag
+    uint64_t verify_salt = 0;
+    int do_verify = 0;
+    int var_pct = 0;
+    VarRng* var_rng = nullptr;
+    uint64_t* verify_info = nullptr;  // out[4] on -EILSEQ
+
+    inline bool op_reads(uint64_t i, int phase_is_write) const {
+        return op_is_read ? (op_is_read[i] != 0) : !phase_is_write;
+    }
+
+    inline void pre_write(char* buf, uint64_t off, uint64_t len) const {
+        if (do_verify)
+            verify_fill(buf, off, len, verify_salt);
+        else if (var_rng && var_pct)
+            var_rng->refill(buf, len, var_pct);
+    }
+
+    inline int post_read(const char* buf, uint64_t off, uint64_t len,
+                         uint64_t block_idx) const {
+        if (!do_verify)
+            return 0;
+        return verify_check(buf, off, len, verify_salt, block_idx,
+                            verify_info);
+    }
+};
+
 // raw syscall wrappers (kernel AIO without libaio)
 inline int sys_io_setup(unsigned nr, aio_context_t* ctx) {
     return static_cast<int>(syscall(SYS_io_setup, nr, ctx));
@@ -74,7 +198,7 @@ int run_sync_loop(const int* fds, const uint32_t* fd_idx,
                   const uint64_t* offsets, const uint64_t* lengths,
                   uint64_t n, int is_write, char* buf,
                   uint64_t* out_lat_usec, uint64_t* out_bytes,
-                  volatile int* interrupt_flag) {
+                  volatile int* interrupt_flag, const BlockMod& mod) {
     uint64_t bytes_done = 0;
     for (uint64_t i = 0; i < n; ++i) {
         if ((i % kInterruptCheckInterval) == 0 && interrupt_flag
@@ -83,15 +207,23 @@ int run_sync_loop(const int* fds, const uint32_t* fd_idx,
         const int fd = fds[fd_idx ? fd_idx[i] : 0];
         const uint64_t len = lengths[i];
         const uint64_t off = offsets[i];
+        const bool is_read_op = mod.op_reads(i, is_write);
+        if (!is_read_op)
+            mod.pre_write(buf, off, len);
         const uint64_t t0 = now_usec();
-        ssize_t res = is_write
-            ? pwrite(fd, buf, len, static_cast<off_t>(off))
-            : pread(fd, buf, len, static_cast<off_t>(off));
+        ssize_t res = is_read_op
+            ? pread(fd, buf, len, static_cast<off_t>(off))
+            : pwrite(fd, buf, len, static_cast<off_t>(off));
         out_lat_usec[i] = now_usec() - t0;
         if (res < 0)
             return -errno;
         if (static_cast<uint64_t>(res) != len)
             return -EIO;  // short read/write is an error, like the reference
+        if (is_read_op) {
+            const int vret = mod.post_read(buf, off, len, i);
+            if (vret != 0)
+                return vret;
+        }
         bytes_done += static_cast<uint64_t>(res);
     }
     *out_bytes = bytes_done;
@@ -109,7 +241,8 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
                  const uint64_t* offsets, const uint64_t* lengths,
                  uint64_t n, int is_write, const char* src_buf,
                  uint64_t buf_size, int iodepth, uint64_t* out_lat_usec,
-                 uint64_t* out_bytes, volatile int* interrupt_flag) {
+                 uint64_t* out_bytes, volatile int* interrupt_flag,
+                 const BlockMod& mod) {
     aio_context_t ctx = 0;
     if (sys_io_setup(static_cast<unsigned>(iodepth), &ctx) < 0)
         return -errno;
@@ -139,10 +272,14 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
         // aioBlockSized seeds the ring the same way)
         while (in_flight < iodepth && next_submit < n) {
             AioSlot& s = slots[in_flight];
+            const bool rd = mod.op_reads(next_submit, is_write);
+            if (!rd)
+                mod.pre_write(s.buf, offsets[next_submit],
+                              lengths[next_submit]);
             memset(&s.cb, 0, sizeof(s.cb));
             s.cb.aio_fildes = static_cast<uint32_t>(
                 fds[fd_idx ? fd_idx[next_submit] : 0]);
-            s.cb.aio_lio_opcode = is_write ? IOCB_CMD_PWRITE : IOCB_CMD_PREAD;
+            s.cb.aio_lio_opcode = rd ? IOCB_CMD_PREAD : IOCB_CMD_PWRITE;
             s.cb.aio_buf = reinterpret_cast<uint64_t>(s.buf);
             s.cb.aio_nbytes = lengths[next_submit];
             s.cb.aio_offset = static_cast<int64_t>(offsets[next_submit]);
@@ -184,16 +321,26 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
                     ret = -EIO;
                     break;
                 }
+                if (mod.op_reads(s->block_idx, is_write)) {
+                    ret = mod.post_read(s->buf, offsets[s->block_idx],
+                                        lengths[s->block_idx], s->block_idx);
+                    if (ret != 0)
+                        break;
+                }
                 out_lat_usec[s->block_idx] = t_now - s->submit_usec;
                 bytes_done += static_cast<uint64_t>(res);
                 ++completed;
                 --in_flight;
                 if (next_submit < n) {  // refill this slot
+                    const bool rd = mod.op_reads(next_submit, is_write);
+                    if (!rd)
+                        mod.pre_write(s->buf, offsets[next_submit],
+                                      lengths[next_submit]);
                     memset(&s->cb, 0, sizeof(s->cb));
                     s->cb.aio_fildes = static_cast<uint32_t>(
                         fds[fd_idx ? fd_idx[next_submit] : 0]);
                     s->cb.aio_lio_opcode =
-                        is_write ? IOCB_CMD_PWRITE : IOCB_CMD_PREAD;
+                        rd ? IOCB_CMD_PREAD : IOCB_CMD_PWRITE;
                     s->cb.aio_buf = reinterpret_cast<uint64_t>(s->buf);
                     s->cb.aio_nbytes = lengths[next_submit];
                     s->cb.aio_offset =
@@ -350,7 +497,8 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
                    const uint64_t* offsets, const uint64_t* lengths,
                    uint64_t n, int is_write, const char* src_buf,
                    uint64_t buf_size, int iodepth, uint64_t* out_lat_usec,
-                   uint64_t* out_bytes, volatile int* interrupt_flag) {
+                   uint64_t* out_bytes, volatile int* interrupt_flag,
+                   const BlockMod& mod) {
     if (iodepth < 1)
         iodepth = 1;
     UringRings ring;
@@ -383,11 +531,14 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
     // queue one block on a free slot; sq tail advance is published with a
     // release store (kernel reads it with acquire semantics)
     auto queue_one = [&](UringSlot& s) {
+        const bool rd = mod.op_reads(next_submit, is_write);
+        if (!rd)
+            mod.pre_write(s.buf, offsets[next_submit], lengths[next_submit]);
         const unsigned tail = *ring.sq_tail;
         const unsigned idx = tail & *ring.sq_mask;
         io_uring_sqe* sqe = &ring.sqes[idx];
         memset(sqe, 0, sizeof(*sqe));
-        sqe->opcode = is_write ? IORING_OP_WRITE : IORING_OP_READ;
+        sqe->opcode = rd ? IORING_OP_READ : IORING_OP_WRITE;
         sqe->fd = fds[fd_idx ? fd_idx[next_submit] : 0];
         sqe->addr = reinterpret_cast<uint64_t>(s.buf);
         sqe->len = static_cast<uint32_t>(lengths[next_submit]);
@@ -443,6 +594,12 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
                 } else if (static_cast<uint64_t>(cqe.res)
                            != lengths[s->block_idx]) {
                     ret = -EIO;
+                } else if (mod.op_reads(s->block_idx, is_write)
+                           && (ret = mod.post_read(
+                                   s->buf, offsets[s->block_idx],
+                                   lengths[s->block_idx], s->block_idx))
+                              != 0) {
+                    // verify mismatch: ret carries -EILSEQ, info[] is set
                 } else {
                     out_lat_usec[s->block_idx] = t_now - s->submit_usec;
                     bytes_done += static_cast<uint64_t>(cqe.res);
@@ -605,6 +762,50 @@ int ioengine_run_file_loop(const char* paths_blob,
                          out_entries, out_fail_idx, interrupt_flag);
 }
 
+// full-featured variant: adds the in-loop block modifiers (rwmix per-op
+// read flags, integrity verify fill/check with exact mismatch reporting,
+// block variance refill) so --rwmixpct/--verify/--blockvarpct keep the
+// native loop engaged like the reference's hot loop does
+// (LocalWorker.cpp:1741,2124,2242). out_verify_info must point to 4
+// uint64 slots; on -EILSEQ they hold {block_idx, word_idx, want, got}.
+int ioengine_run_block_loop3(const int* fds, const uint32_t* fd_idx,
+                             const uint64_t* offsets,
+                             const uint64_t* lengths, uint64_t n,
+                             int is_write, void* buf, uint64_t buf_size,
+                             int iodepth, uint64_t* out_lat_usec,
+                             uint64_t* out_bytes, int* interrupt_flag,
+                             int engine, const unsigned char* op_is_read,
+                             uint64_t verify_salt, int do_verify,
+                             int block_var_pct, uint64_t block_var_seed,
+                             uint64_t* out_verify_info) {
+    if (n == 0) {
+        *out_bytes = 0;
+        return 0;
+    }
+    VarRng var_rng(block_var_seed);
+    uint64_t info_fallback[4];
+    BlockMod mod;
+    mod.op_is_read = op_is_read;
+    mod.verify_salt = verify_salt;
+    mod.do_verify = do_verify;
+    mod.var_pct = do_verify ? 0 : block_var_pct;  // verify wins, like the
+                                                  // Python _pre_write_fill
+    mod.var_rng = &var_rng;
+    mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+    if (engine == ENGINE_URING)
+        return run_uring_loop(fds, fd_idx, offsets, lengths, n, is_write,
+                              static_cast<const char*>(buf), buf_size,
+                              iodepth, out_lat_usec, out_bytes,
+                              interrupt_flag, mod);
+    if (engine == ENGINE_SYNC || (engine == ENGINE_AUTO && iodepth <= 1))
+        return run_sync_loop(fds, fd_idx, offsets, lengths, n, is_write,
+                             static_cast<char*>(buf), out_lat_usec,
+                             out_bytes, interrupt_flag, mod);
+    return run_aio_loop(fds, fd_idx, offsets, lengths, n, is_write,
+                        static_cast<const char*>(buf), buf_size, iodepth,
+                        out_lat_usec, out_bytes, interrupt_flag, mod);
+}
+
 // multi-fd variant: fd_idx[i] selects fds[] per block (NULL -> fds[0]);
 // this is the shared-file striping path (calcFileIdxAndOffsetStriped)
 int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
@@ -614,22 +815,10 @@ int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
                                int iodepth, uint64_t* out_lat_usec,
                                uint64_t* out_bytes, int* interrupt_flag,
                                int engine) {
-    if (n == 0) {
-        *out_bytes = 0;
-        return 0;
-    }
-    if (engine == ENGINE_URING)
-        return run_uring_loop(fds, fd_idx, offsets, lengths, n, is_write,
-                              static_cast<const char*>(buf), buf_size,
-                              iodepth, out_lat_usec, out_bytes,
-                              interrupt_flag);
-    if (engine == ENGINE_SYNC || (engine == ENGINE_AUTO && iodepth <= 1))
-        return run_sync_loop(fds, fd_idx, offsets, lengths, n, is_write,
-                             static_cast<char*>(buf), out_lat_usec,
-                             out_bytes, interrupt_flag);
-    return run_aio_loop(fds, fd_idx, offsets, lengths, n, is_write,
-                        static_cast<const char*>(buf), buf_size, iodepth,
-                        out_lat_usec, out_bytes, interrupt_flag);
+    return ioengine_run_block_loop3(fds, fd_idx, offsets, lengths, n,
+                                    is_write, buf, buf_size, iodepth,
+                                    out_lat_usec, out_bytes, interrupt_flag,
+                                    engine, nullptr, 0, 0, 0, 0, nullptr);
 }
 
 int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
@@ -878,7 +1067,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 3 (sync+aio+uring+fileloop)";
+    return "elbencho-tpu ioengine 4 (sync+aio+uring+fileloop+blockmods)";
 }
 
 }  // extern "C"

@@ -787,6 +787,9 @@ class BenchConfig(BenchConfigBase):
                 "it does not apply to S3/HDFS/netbench modes")
         if self.rwmix_read_pct and not (0 <= self.rwmix_read_pct <= 100):
             raise ConfigError("--rwmixpct must be in 0..100")
+        if self.block_variance_pct and \
+                not (0 <= self.block_variance_pct <= 100):
+            raise ConfigError("--blockvarpct must be in 0..100")
         if self.num_rwmix_read_threads >= max(1, self.num_threads):
             if self.num_rwmix_read_threads:
                 raise ConfigError("--rwmixthr must be < number of threads")

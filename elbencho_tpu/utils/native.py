@@ -11,6 +11,7 @@ Build: ``make -C csrc`` (g++; no external deps beyond libaio if present).
 from __future__ import annotations
 
 import ctypes
+import errno as errno_mod
 import os
 import threading
 
@@ -32,7 +33,23 @@ ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 3
+EXPECTED_ABI = 4
+
+_EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
+
+
+class NativeVerifyError(Exception):
+    """In-loop data integrity check failed (ioengine -EILSEQ). Carries the
+    exact mismatch location so the caller can report the file offset the
+    way postReadIntegrityCheckVerifyBuf does (LocalWorker.cpp:2170)."""
+
+    def __init__(self, block_idx: int, word_idx: int, want: int, got: int):
+        self.block_idx = block_idx
+        self.word_idx = word_idx
+        self.want = want
+        self.got = got
+        super().__init__(f"integrity check failed at block {block_idx} "
+                         f"word {word_idx}")
 
 
 def _as_ptr(values, n, np_dtype_name, c_type):
@@ -55,8 +72,8 @@ class _NativeEngine:
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
-        lib.ioengine_run_block_loop_mf.restype = ctypes.c_int
-        lib.ioengine_run_block_loop_mf.argtypes = [
+        lib.ioengine_run_block_loop3.restype = ctypes.c_int
+        lib.ioengine_run_block_loop3.argtypes = [
             ctypes.POINTER(ctypes.c_int),     # fds
             ctypes.POINTER(ctypes.c_uint32),  # per-block fd index (or None)
             ctypes.POINTER(ctypes.c_uint64),  # offsets
@@ -70,6 +87,12 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
             ctypes.c_int,                     # engine (ENGINE_CODES)
+            ctypes.POINTER(ctypes.c_ubyte),   # rwmix per-op read flags
+            ctypes.c_uint64,                  # verify salt
+            ctypes.c_int,                     # do_verify
+            ctypes.c_int,                     # block variance pct
+            ctypes.c_uint64,                  # block variance seed
+            ctypes.POINTER(ctypes.c_uint64),  # out: verify mismatch info[4]
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
@@ -304,17 +327,28 @@ class _NativeEngine:
                        buf_addr: int, iodepth: int, worker,
                        interrupt_flag=None, engine: str = "auto",
                        fds: "list[int] | None" = None,
-                       fd_idx: "list[int] | None" = None) -> bool:
+                       fd_idx: "list[int] | None" = None,
+                       op_is_read=None, verify_salt: int = 0,
+                       block_var_pct: int = 0,
+                       block_var_seed: int = 0) -> bool:
         """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
         file of block i (reference: calcFileIdxAndOffsetStriped). offsets/
         lengths/fd_idx may be numpy uint64/uint32 arrays, passed zero-copy
-        (the vectorized offset-generator path)."""
+        (the vectorized offset-generator path).
+
+        In-loop block modifiers (reference LocalWorker.cpp:1741,2124,2242):
+        op_is_read — uint8 array, rwmix per-op read flags for a write
+        phase (accounting is split into the worker's rwmix-read counters);
+        verify_salt — --verify fill-on-write/check-on-read, raising
+        NativeVerifyError with the exact mismatch location;
+        block_var_pct/seed — --blockvarpct refill of each write block."""
         import numpy as np
         n = len(offsets)
         off_arr = _as_u64_ptr(offsets, n)
         len_arr = _as_u64_ptr(lengths, n)
         lat_arr = (ctypes.c_uint64 * n)()
         bytes_done = ctypes.c_uint64(0)
+        verify_info = (ctypes.c_uint64 * 4)()
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))  # c_int(0) is falsy: no `or`!
         buf_size = int(lengths.max() if isinstance(lengths, np.ndarray)
@@ -325,27 +359,66 @@ class _NativeEngine:
         else:
             fds_arr = (ctypes.c_int * len(fds))(*fds)
             idx_arr = _as_ptr(fd_idx, n, "uint32", ctypes.c_uint32)
-        ret = self._lib.ioengine_run_block_loop_mf(
+        flags_arr = None
+        if op_is_read is not None:
+            flags_arr = _as_ptr(op_is_read, n, "uint8", ctypes.c_ubyte)
+        ret = self._lib.ioengine_run_block_loop3(
             fds_arr, idx_arr, off_arr, len_arr, n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), buf_size, iodepth,
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt),
-            ENGINE_CODES[engine])
+            ENGINE_CODES[engine], flags_arr, verify_salt,
+            1 if verify_salt else 0, block_var_pct, block_var_seed,
+            verify_info)
+        if ret == -_EILSEQ:
+            raise NativeVerifyError(int(verify_info[0]),
+                                    int(verify_info[1]),
+                                    int(verify_info[2]),
+                                    int(verify_info[3]))
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
         total_bytes = int(lengths.sum()) if isinstance(lengths, np.ndarray) \
             else sum(lengths)
+        lengths_np = (lengths if isinstance(lengths, np.ndarray)
+                      else np.asarray(lengths, dtype=np.uint64))
         if bytes_done.value == total_bytes:
-            worker.iops_latency_histo.add_latencies_array(
-                np.frombuffer(lat_arr, dtype=np.uint64))
-            worker.live_ops.num_iops_done += n
+            lat = np.frombuffer(lat_arr, dtype=np.uint64)
+            if op_is_read is not None and op_is_read.any():
+                # rwmix write phase: reads go to the rwmix-read counters
+                # (reference: separate LiveOps/histogram pair, Worker.h)
+                rd = op_is_read.astype(bool)
+                worker.iops_latency_histo_rwmix.add_latencies_array(lat[rd])
+                worker.iops_latency_histo.add_latencies_array(lat[~rd])
+                n_read = int(rd.sum())
+                read_bytes = int(lengths_np[rd].sum())
+                worker.live_ops_rwmix_read.num_iops_done += n_read
+                worker.live_ops_rwmix_read.num_bytes_done += read_bytes
+                worker.live_ops.num_iops_done += n - n_read
+                worker.live_ops.num_bytes_done += total_bytes - read_bytes
+            else:
+                worker.iops_latency_histo.add_latencies_array(lat)
+                worker.live_ops.num_iops_done += n
+                worker.live_ops.num_bytes_done += bytes_done.value
         else:
             # interrupted chunk: AIO completes out of order, so per-block
             # latencies can't be attributed reliably — count bytes/ops only
-            # (the phase is being aborted; its results are partial anyway)
+            # (the phase is being aborted; its results are partial anyway).
+            # With rwmix flags the done-prefix split keeps the read/write
+            # ratio roughly right (exact for the in-order sync engine).
             avg_len = max(total_bytes // n, 1)
-            worker.live_ops.num_iops_done += \
-                min(n, bytes_done.value // avg_len)
-        worker.live_ops.num_bytes_done += bytes_done.value
+            done = min(n, bytes_done.value // avg_len)
+            if op_is_read is not None and done:
+                rd = op_is_read[:done].astype(bool)
+                n_read = int(rd.sum())
+                read_bytes = int(lengths_np[:done][rd].sum())
+                worker.live_ops_rwmix_read.num_iops_done += n_read
+                worker.live_ops_rwmix_read.num_bytes_done += read_bytes
+                worker.live_ops.num_iops_done += done - n_read
+                worker.live_ops.num_bytes_done += \
+                    max(bytes_done.value - read_bytes, 0)
+            else:
+                worker.live_ops.num_iops_done += done
+                worker.live_ops.num_bytes_done += bytes_done.value
+        worker._num_iops_submitted += n
         worker.create_stonewall_stats_if_triggered()
         return True
 

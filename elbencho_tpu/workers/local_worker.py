@@ -400,6 +400,7 @@ class LocalWorker(Worker):
         dirModeIterateFiles is native there by construction)."""
         cfg = self.cfg
         return (self._native_loop_eligible(native)
+                and not self._block_mods_active()
                 and phase in self._NATIVE_FILE_OPS
                 and cfg.io_engine in ("auto", "sync")
                 and cfg.io_depth <= 1
@@ -617,9 +618,11 @@ class LocalWorker(Worker):
         positional I/O -> [verify] -> [TPU H2D] -> latency + counters.
 
         When the native C++ ioengine is available and the workload qualifies
-        (no verify/rwmix/TPU/opslog), the whole loop is delegated to it —
-        including striped multi-file mode via ``stripe=(fds, file_size)``
-        (the structured form of the ``multi_file`` mapping).
+        (no TPU staging/opslog/rate limits), the whole loop is delegated to
+        it — verify, rwmix-pct and block variance run INSIDE the engine
+        (BlockMod), and striped multi-file mode maps through
+        ``stripe=(fds, file_size)`` (the structured form of the
+        ``multi_file`` mapping).
         """
         cfg = self.cfg
         if stripe is not None and multi_file is None:
@@ -641,10 +644,10 @@ class LocalWorker(Worker):
                 return
         if cfg.io_engine != "auto":
             raise WorkerException(
-                f"--ioengine {cfg.io_engine} only supports the plain native "
-                f"block loop — incompatible with --verify/--verifydirect/"
-                f"--readinline/--rwmixpct/--blockvarpct/--opslog/--flock/"
-                f"rate limits/--tpuids")
+                f"--ioengine {cfg.io_engine} only supports the native "
+                f"block loop — incompatible with --verifydirect/"
+                f"--readinline/--opslog/--flock/rate limits/--rwmixthrpct/"
+                f"--tpuids/non-'fast' --blockvaralgo")
         num_bufs = len(self._io_bufs)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
         # the byte-ratio balancer only applies to the mixed WRITE phase
@@ -716,19 +719,30 @@ class LocalWorker(Worker):
 
     def _native_loop_eligible(self, native) -> bool:
         """Conditions every native delegation shares: no per-op Python
-        feature may be active (verify/rwmix/variance/opslog/TPU staging/
-        rate limits). Loop-specific extras (flock, read-inline, random
-        offsets...) are checked at the call sites."""
+        feature may be active. Verify/rwmix-pct/block-variance run INSIDE
+        the native loop (csrc BlockMod — the reference keeps them in its
+        hot loop too, LocalWorker.cpp:1741,2124,2242); what still drops to
+        Python is opslog, TPU staging, rate limits, the rwmix-threads
+        byte-ratio balancer, and non-default variance PRNGs. Loop-specific
+        extras (flock, read-inline...) are checked at the call sites."""
         cfg = self.cfg
         return (native is not None
                 and self._tpu is None
-                and not cfg.integrity_check_salt
-                and not cfg.rwmix_read_pct
-                and not getattr(self, "_rwmix_thread_reader", False)
-                and not cfg.block_variance_pct
                 and self._ops_log is None
                 and self._rate_limiter_read is None
-                and self._rate_limiter_write is None)
+                and self._rate_limiter_write is None
+                and self.shared.rwmix_balancer is None
+                and (not cfg.block_variance_pct
+                     or cfg.block_variance_algo == "fast"))
+
+    def _block_mods_active(self) -> bool:
+        """True when a per-block modifier (verify fill/check, rwmix per-op
+        split, variance refill) is configured. The main block loops run
+        these natively; loops without modifier support (mmap memcpy, LOSF
+        whole-file) must fall back to Python when any is active."""
+        cfg = self.cfg
+        return bool(cfg.integrity_check_salt or cfg.rwmix_read_pct
+                    or cfg.block_variance_pct)
 
     #: bounds for one native engine call, so live stats progress and
     #: interrupts stay responsive (shared by every native delegation)
@@ -746,7 +760,10 @@ class LocalWorker(Worker):
         counters and latency buckets sync back per chunk. The engine also
         polls our interrupt flag every 128 ops within a chunk. With
         ``stripe=(fds, file_size)`` global offsets map to per-block
-        (file, in-file offset) pairs (calcFileIdxAndOffsetStriped)."""
+        (file, in-file offset) pairs (calcFileIdxAndOffsetStriped).
+        Verify/rwmix-pct/variance run inside the engine (BlockMod)."""
+        from ..utils.native import NativeVerifyError
+        cfg = self.cfg
         chunk = self._native_chunk_blocks()
         stripe_fds, stripe_size = stripe if stripe else (None, 0)
 
@@ -763,11 +780,34 @@ class LocalWorker(Worker):
                 if file_offset_base:
                     offsets = offsets + np.uint64(file_offset_base)
                 fds = idx = None
-            native.run_block_loop(
-                fd=fd, offsets=offsets, lengths=lengths, is_write=is_write,
-                buf_addr=self._buf_addr(), iodepth=self.cfg.io_depth,
-                worker=self, interrupt_flag=self._native_interrupt,
-                engine=self.cfg.io_engine, fds=fds, fd_idx=idx)
+            n = len(offsets)
+            flags = None
+            if is_write and cfg.rwmix_read_pct:
+                # per-op modulo split, vectorized (reference:
+                # (workerRank+numIOPSSubmitted)%100 < pct, :1741-1742)
+                base = np.uint64(self.rank + self._num_iops_submitted)
+                flags = (((base + np.arange(n, dtype=np.uint64))
+                          % np.uint64(100))
+                         < np.uint64(cfg.rwmix_read_pct)).astype(np.uint8)
+            try:
+                native.run_block_loop(
+                    fd=fd, offsets=offsets, lengths=lengths,
+                    is_write=is_write, buf_addr=self._buf_addr(),
+                    iodepth=cfg.io_depth, worker=self,
+                    interrupt_flag=self._native_interrupt,
+                    engine=cfg.io_engine, fds=fds, fd_idx=idx,
+                    op_is_read=flags,
+                    verify_salt=cfg.integrity_check_salt,
+                    block_var_pct=cfg.block_variance_pct,
+                    # vary the refill stream per worker and per chunk
+                    block_var_seed=((self.rank << 32)
+                                    ^ self._num_iops_submitted))
+            except NativeVerifyError as err:
+                file_off = int(offsets[err.block_idx]) + err.word_idx * 8
+                raise WorkerException(
+                    f"data integrity check failed at file offset "
+                    f"{file_off}: expected {err.want:#x}, "
+                    f"got {err.got:#x}") from None
 
         while True:
             batch = gen.next_batch(chunk)
@@ -895,7 +935,8 @@ class LocalWorker(Worker):
                 gen = self._make_offset_gen_for_file(is_write)
             from ..utils.native import get_native_engine
             native = get_native_engine()
-            if self._native_loop_eligible(native):
+            if self._native_loop_eligible(native) \
+                    and not self._block_mods_active():
                 self._run_native_mmap_loop(native, mapped, gen, is_write)
                 return
             num_bufs = len(self._io_bufs)
