@@ -103,7 +103,8 @@ FLAG_DEFS = [
     ("fadv", None, "fadvise_flags", "str", "", "misc",
      "posix_fadvise flags (comma-sep: seq,rand,willneed,dontneed,noreuse)"),
     ("madv", None, "madvise_flags", "str", "", "misc",
-     "madvise flags for mmap (comma-sep: seq,rand,willneed,dontneed)"),
+     "madvise flags for mmap (comma-sep: seq,rand,willneed,dontneed,"
+     "hugepage,nohugepage)"),
     ("trunc", None, "do_truncate", "bool", False, "misc",
      "Truncate files to 0 on open for write"),
     ("trunctosize", None, "do_truncate_to_size", "bool", False, "misc",

@@ -989,11 +989,17 @@ class LocalWorker(Worker):
         advice_map = {"seq": mmap.MADV_SEQUENTIAL,
                       "rand": mmap.MADV_RANDOM,
                       "willneed": mmap.MADV_WILLNEED,
-                      "dontneed": mmap.MADV_DONTNEED}
+                      "dontneed": mmap.MADV_DONTNEED,
+                      # reference: ARG_MADVISE_FLAG_{,NO}HUGEPAGE_NAME
+                      "hugepage": getattr(mmap, "MADV_HUGEPAGE", 14),
+                      "nohugepage": getattr(mmap, "MADV_NOHUGEPAGE", 15)}
         for name in flags_str.split(","):
             name = name.strip()
-            if name:
-                mapped.madvise(advice_map[name])
+            if not name:
+                continue
+            if name not in advice_map:
+                raise WorkerException(f"unknown madvise flag: {name}")
+            mapped.madvise(advice_map[name])
 
     # ------------------------------------------------------------------
     # file/bdev mode (reference: fileModeIterateFilesSeq :3597,

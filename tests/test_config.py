@@ -153,3 +153,24 @@ def test_mmap_direct_incompatible():
     cfg.derive(probe_paths=False)
     with pytest.raises(ConfigError):
         cfg.check()
+
+
+def test_flags_parity_accounted():
+    """Every reference ARG_* define stays accounted (FLAGS-PARITY.md
+    generator exits non-zero on drift)."""
+    import os
+    import subprocess
+    import sys
+    ref = os.path.join(
+        os.environ.get("ELBENCHO_TPU_REFERENCE", "/root/reference"),
+        "source", "ProgArgs.h")
+    if not os.path.exists(ref):
+        import pytest
+        pytest.skip("reference tree not available "
+                    "(set ELBENCHO_TPU_REFERENCE)")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "gen-flags-parity"),
+         ref],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
