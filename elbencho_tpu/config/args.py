@@ -434,6 +434,21 @@ FLAG_DEFS = [
     ("s3ignoreerrors", None, "s3_ignore_errors", "bool", False, "s3",
      "Continue on S3 request errors (stress mode)"),
 
+    # GCS-native backend (JSON API; selected by gs:// paths)
+    ("gcsendpoint", None, "gcs_endpoint_str", "str", "", "s3",
+     "GCS JSON API endpoint(s), comma-sep, round-robin by worker rank "
+     "(default https://storage.googleapis.com; any use selects the "
+     "GCS-native backend like gs:// paths do)"),
+    ("gcsproject", None, "gcs_project", "str", "", "s3",
+     "GCP project id (required by GCS for bucket creation)"),
+    ("gcstoken", None, "gcs_token", "str", "", "s3",
+     "OAuth2 access token (default: GOOGLE_OAUTH_ACCESS_TOKEN env, then "
+     "the GCE/TPU-VM metadata server / workload identity)"),
+    ("gcsanon", None, "gcs_anonymous", "bool", False, "s3",
+     "Anonymous GCS access (public buckets, unauthenticated endpoints)"),
+    ("objectbackend", None, "object_backend", "str", "", "s3",
+     "Object-storage backend: s3|gcs (derived from path scheme if unset)"),
+
     # misc
     ("configfile", "c", "config_file_path", "str", "", "misc",
      "Read benchmark settings from this file (ini-style: flag = value)"),
@@ -590,10 +605,18 @@ class BenchConfig(BenchConfigBase):
         if self.run_netbench:
             self.bench_mode = BenchMode.NETBENCH
             return
-        if self.s3_endpoints_str or any(
-                p.startswith("s3://") for p in self.paths):
+        has_gs = any(p.startswith("gs://") for p in self.paths)
+        has_s3 = any(p.startswith("s3://") for p in self.paths)
+        if (has_gs or has_s3 or self.s3_endpoints_str
+                or self.gcs_endpoint_str or self.object_backend):
+            # object mode; backend from the explicit --objectbackend if
+            # given (e.g. the S3-interop XML path against gs:// buckets),
+            # else derived from the path scheme / endpoint flags
             self.bench_mode = BenchMode.S3
-            self.paths = [p[len("s3://"):] if p.startswith("s3://") else p
+            if not self.object_backend:
+                self.object_backend = "gcs" \
+                    if (has_gs or self.gcs_endpoint_str) else "s3"
+            self.paths = [p.removeprefix("s3://").removeprefix("gs://")
                           for p in self.paths]
             return
         if self.use_hdfs or any(p.startswith("hdfs://") for p in self.paths):
@@ -720,6 +743,10 @@ class BenchConfig(BenchConfigBase):
         if not self.csv_file_path:
             self.csv_file_path = \
                 f"{res_dir}/elbencho-tpu_results_{date}.csv"
+            # an implicit file may be rotated on column-count mismatch
+            # (a flag-set change across versions must not fail runs that
+            # never asked for CSV output; explicit --csvfile still errors)
+            self._defaulted_csv = True
         if not self.json_file_path:
             self.json_file_path = \
                 f"{res_dir}/elbencho-tpu_results_{date}.json"
@@ -780,6 +807,8 @@ class BenchConfig(BenchConfigBase):
                     "assigns per-host ids)")
         if self.io_engine not in ("auto", "sync", "aio", "uring"):
             raise ConfigError("--ioengine must be auto|sync|aio|uring")
+        if self.object_backend not in ("", "s3", "gcs"):
+            raise ConfigError("--objectbackend must be s3 or gcs")
         if self.io_engine == "sync" and self.io_depth > 1:
             raise ConfigError("--ioengine sync requires --iodepth 1")
         if self.io_engine != "auto" and self.bench_mode != BenchMode.POSIX:

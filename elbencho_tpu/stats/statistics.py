@@ -567,12 +567,35 @@ class Statistics:
         found = first_line.count(",")
         labels = 0 if cfg.no_csv_labels else len(cfg.config_labels())
         expected = len(cls.CSV_RESULT_COLUMNS) + labels - 1
-        if found != expected:
-            raise ValueError(
-                f"CSV output file exists and the column compatibility "
-                f"check failed (was it written by a different version or "
-                f"with different label settings?). Found commas: {found}; "
-                f"expected: {expected}; file: {path}")
+        if found == expected:
+            return
+        if getattr(cfg, "_defaulted_csv", False):
+            # implicit default file (user never asked for CSV): rotate to
+            # a fresh suffixed name instead of failing the run — a new
+            # release adding flags would otherwise break every run until
+            # the stale default file is deleted by hand
+            base, ext = os.path.splitext(path)
+            for n in range(2, 1000):
+                candidate = f"{base}_{n}{ext}"
+                if not os.path.exists(candidate) \
+                        or os.path.getsize(candidate) == 0 \
+                        or cls._csv_columns_match(candidate, expected):
+                    from ..toolkits.logger import log
+                    log(0, f"NOTE: default CSV result file {path} has an "
+                           f"incompatible column count (old version?); "
+                           f"writing to {candidate} instead")
+                    cfg.csv_file_path = candidate
+                    return
+        raise ValueError(
+            f"CSV output file exists and the column compatibility "
+            f"check failed (was it written by a different version or "
+            f"with different label settings?). Found commas: {found}; "
+            f"expected: {expected}; file: {path}")
+
+    @staticmethod
+    def _csv_columns_match(path: str, expected: int) -> bool:
+        with open(path) as f:
+            return f.readline().rstrip("\n").count(",") == expected
 
     def _write_csv(self, res: PhaseResults) -> None:
         rec = self._result_record(res)

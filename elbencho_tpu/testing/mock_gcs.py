@@ -1,0 +1,312 @@
+"""In-memory GCS JSON-API server for CI without real object storage.
+
+Implements the subset `toolkits/gcs_tk.GcsClient` uses: bucket
+insert/get/delete/patch, media upload, object metadata GET / alt=media
+download (+Range), object PATCH/DELETE, list with prefix + pageToken,
+compose, object/bucket ACL lists, and the GCE metadata-server token
+endpoint (for auth-path tests). Bearer tokens are recorded but not
+validated (like the S3 mock accepts any signature).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MockGcsState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.buckets: "dict[str, dict]" = {}  # name -> bucket resource
+        self.objects: "dict[str, dict[str, bytes]]" = {}
+        self.obj_meta: "dict[tuple[str, str], dict]" = {}
+        self.seen_tokens: "list[str]" = []
+        self.metadata_token_calls = 0
+
+
+def _make_handler(state: MockGcsState):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):
+            pass
+
+        def _reply(self, code: int, body: bytes = b"",
+                   headers: "dict | None" = None):
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _json(self, code: int, doc: dict):
+            self._reply(code, json.dumps(doc).encode(),
+                        {"Content-Type": "application/json"})
+
+        def _error(self, code: int, message: str):
+            self._json(code, {"error": {"code": code, "message": message}})
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(length) if length else b""
+
+        def _record_token(self):
+            auth = self.headers.get("Authorization", "")
+            if auth.startswith("Bearer "):
+                state.seen_tokens.append(auth[len("Bearer "):])
+
+        def _obj_resource(self, bucket: str, name: str) -> dict:
+            data = state.objects[bucket][name]
+            meta = state.obj_meta.get((bucket, name), {})
+            res = {"kind": "storage#object", "name": name,
+                   "bucket": bucket, "size": str(len(data)),
+                   "etag": f"etag-{len(data)}"}
+            res.update(meta)
+            return res
+
+        def _route(self):
+            parsed = urllib.parse.urlparse(self.path)
+            query = {k: v[0] for k, v in urllib.parse.parse_qs(
+                parsed.query, keep_blank_values=True).items()}
+            return parsed.path, query
+
+        # -- GET -----------------------------------------------------------
+
+        def do_GET(self):  # noqa: N802
+            self._record_token()
+            path, query = self._route()
+            with state.lock:
+                if path == ("/computeMetadata/v1/instance/"
+                            "service-accounts/default/token"):
+                    if self.headers.get("Metadata-Flavor") != "Google":
+                        self._error(403, "missing Metadata-Flavor")
+                        return
+                    state.metadata_token_calls += 1
+                    self._json(200, {
+                        "access_token":
+                            f"mock-token-{state.metadata_token_calls}",
+                        "expires_in": 3600, "token_type": "Bearer"})
+                    return
+                parts = path.split("/")
+                # /storage/v1/b/{bucket}...
+                if len(parts) >= 4 and parts[1] == "storage" \
+                        and parts[3] == "b":
+                    bucket = urllib.parse.unquote(parts[4]) \
+                        if len(parts) > 4 else ""
+                    if bucket not in state.buckets:
+                        self._error(404, f"bucket {bucket} not found")
+                        return
+                    rest = parts[5:]
+                    if not rest:  # bucket resource
+                        self._json(200, state.buckets[bucket])
+                        return
+                    if rest == ["acl"]:
+                        self._json(200, {"kind": "storage#bucketAccess"
+                                                 "Controls",
+                                         "items": state.buckets[bucket]
+                                         .get("acl", [])})
+                        return
+                    if rest[0] == "o" and len(rest) == 1:  # list
+                        prefix = query.get("prefix", "")
+                        max_results = int(query.get("maxResults", "1000"))
+                        start = query.get("pageToken", "")
+                        names = sorted(n for n in state.objects[bucket]
+                                       if n.startswith(prefix)
+                                       and n > start)
+                        page, token = names[:max_results], ""
+                        if len(names) > max_results:
+                            token = page[-1]
+                        doc = {"kind": "storage#objects",
+                               "items": [self._obj_resource(bucket, n)
+                                         for n in page]}
+                        if token:
+                            doc["nextPageToken"] = token
+                        self._json(200, doc)
+                        return
+                    if rest[0] == "o":
+                        name = urllib.parse.unquote(rest[1])
+                        if name not in state.objects[bucket]:
+                            self._error(404, f"object {name} not found")
+                            return
+                        if len(rest) > 2 and rest[2] == "acl":
+                            self._json(200, {
+                                "kind": "storage#objectAccessControls",
+                                "items": state.obj_meta.get(
+                                    (bucket, name), {}).get("acl", [])})
+                            return
+                        if query.get("alt") == "media":
+                            data = state.objects[bucket][name]
+                            rng = self.headers.get("Range", "")
+                            if rng.startswith("bytes="):
+                                lo, _, hi = rng[6:].partition("-")
+                                lo = int(lo)
+                                hi = int(hi) if hi else len(data) - 1
+                                body = data[lo:hi + 1]
+                                self._reply(206, body)
+                                return
+                            self._reply(200, data)
+                            return
+                        self._json(200, self._obj_resource(bucket, name))
+                        return
+                self._error(404, f"no route {path}")
+
+        # -- POST ----------------------------------------------------------
+
+        def do_POST(self):  # noqa: N802
+            self._record_token()
+            path, query = self._route()
+            body = self._body()
+            with state.lock:
+                if path == "/storage/v1/b":  # bucket insert
+                    doc = json.loads(body)
+                    name = doc["name"]
+                    if name in state.buckets:
+                        self._error(409, "bucket exists")
+                        return
+                    state.buckets[name] = {"kind": "storage#bucket",
+                                           "name": name}
+                    state.objects[name] = {}
+                    self._json(200, state.buckets[name])
+                    return
+                if path.startswith("/upload/storage/v1/b/"):
+                    bucket = urllib.parse.unquote(
+                        path.split("/")[5])
+                    if bucket not in state.buckets:
+                        self._error(404, f"bucket {bucket} not found")
+                        return
+                    name = query.get("name", "")
+                    state.objects[bucket][name] = body
+                    self._json(200, self._obj_resource(bucket, name))
+                    return
+                if path.endswith("/compose"):
+                    parts = path.split("/")
+                    bucket = urllib.parse.unquote(parts[4])
+                    dest = urllib.parse.unquote(parts[6])
+                    if bucket not in state.buckets:
+                        self._error(404, f"bucket {bucket} not found")
+                        return
+                    doc = json.loads(body)
+                    srcs = [s["name"] for s in doc["sourceObjects"]]
+                    if len(srcs) > 32:
+                        self._error(400, "too many compose sources")
+                        return
+                    missing = [s for s in srcs
+                               if s not in state.objects[bucket]]
+                    if missing:
+                        self._error(404, f"source {missing[0]} not found")
+                        return
+                    state.objects[bucket][dest] = b"".join(
+                        state.objects[bucket][s] for s in srcs)
+                    self._json(200, self._obj_resource(bucket, dest))
+                    return
+                self._error(404, f"no route {path}")
+
+        # -- PATCH ---------------------------------------------------------
+
+        def do_PATCH(self):  # noqa: N802
+            self._record_token()
+            path, query = self._route()
+            body = self._body()
+            doc = json.loads(body) if body else {}
+            with state.lock:
+                parts = path.split("/")
+                bucket = urllib.parse.unquote(parts[4]) \
+                    if len(parts) > 4 else ""
+                if bucket not in state.buckets:
+                    self._error(404, f"bucket {bucket} not found")
+                    return
+                if len(parts) == 5:  # bucket patch
+                    for k, v in doc.items():
+                        if v is None:
+                            state.buckets[bucket].pop(k, None)
+                        else:
+                            state.buckets[bucket][k] = v
+                    if "predefinedAcl" in query:
+                        state.buckets[bucket]["acl"] = [
+                            {"entity": "predefined",
+                             "role": query["predefinedAcl"]}]
+                    self._json(200, state.buckets[bucket])
+                    return
+                if len(parts) >= 7 and parts[5] == "o":
+                    name = urllib.parse.unquote(parts[6])
+                    if name not in state.objects[bucket]:
+                        self._error(404, f"object {name} not found")
+                        return
+                    meta = state.obj_meta.setdefault((bucket, name), {})
+                    for k, v in doc.items():
+                        if v is None:
+                            meta.pop(k, None)
+                        else:
+                            meta[k] = v
+                    if "predefinedAcl" in query:
+                        meta["acl"] = [{"entity": "predefined",
+                                        "role": query["predefinedAcl"]}]
+                    self._json(200, self._obj_resource(bucket, name))
+                    return
+                self._error(404, f"no route {path}")
+
+        # -- DELETE --------------------------------------------------------
+
+        def do_DELETE(self):  # noqa: N802
+            self._record_token()
+            path, _query = self._route()
+            with state.lock:
+                parts = path.split("/")
+                bucket = urllib.parse.unquote(parts[4]) \
+                    if len(parts) > 4 else ""
+                if bucket not in state.buckets:
+                    self._error(404, f"bucket {bucket} not found")
+                    return
+                if len(parts) == 5:
+                    if state.objects[bucket]:
+                        self._error(409, "bucket not empty")
+                        return
+                    state.buckets.pop(bucket)
+                    state.objects.pop(bucket)
+                    self._reply(204)
+                    return
+                if len(parts) >= 7 and parts[5] == "o":
+                    name = urllib.parse.unquote(parts[6])
+                    if name not in state.objects[bucket]:
+                        self._error(404, f"object {name} not found")
+                        return
+                    state.objects[bucket].pop(name)
+                    state.obj_meta.pop((bucket, name), None)
+                    self._reply(204)
+                    return
+                self._error(404, f"no route {path}")
+
+    return Handler
+
+
+class MockGcsServer:
+    """Threaded in-process mock GCS JSON endpoint (+ metadata token
+    endpoint) for tests."""
+
+    def __init__(self, port: int = 0):
+        self.state = MockGcsState()
+        self.server = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _make_handler(self.state))
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def metadata_host(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "MockGcsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,627 @@
+"""GCS-native object client (JSON API + OAuth2/metadata-server auth).
+
+The idiomatic object path for TPU VMs: Google Cloud Storage via its native
+JSON API — not the S3-interop XML crutch (which `s3_tk.py` also supports
+against storage.googleapis.com). Selected by `gs://` bench paths.
+
+Role parity with the reference's S3 client factory/toolkit
+(`/root/reference/source/toolkits/S3Tk.cpp:167-316`), re-designed for GCS:
+
+- auth: explicit token (--gcstoken / GOOGLE_OAUTH_ACCESS_TOKEN env) or the
+  GCE/TPU-VM metadata server (workload identity), cached until expiry;
+  --gcsanon for anonymous endpoints (tests, public buckets)
+- single-part upload: JSON media upload
+- multipart-upload analogue: parallel component objects + iterative
+  `compose` (GCS's native parallel-upload idiom; 32 components per compose
+  request, folded for more) behind the same
+  create/upload_part/complete/abort interface the S3 worker uses
+- ranged GET via `alt=media` + Range, list via `o?prefix=&pageToken=`,
+  stat via object metadata GET
+- tagging -> object metadata / bucket labels; versioning -> bucket
+  versioning; object-lock -> bucket retentionPolicy (no per-mode concept
+  in GCS: reported as GOVERNANCE when a policy exists); ACLs -> predefined
+  ACLs or objectAccessControls entities
+
+Errors raise `s3_tk.S3Error` so the object worker's error handling is
+backend-agnostic.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import time
+import urllib.parse
+import uuid
+
+from .s3_tk import S3Error
+
+GCS_DEFAULT_ENDPOINT = "https://storage.googleapis.com"
+METADATA_HOST_ENV = "GCE_METADATA_HOST"
+METADATA_DEFAULT_HOST = "metadata.google.internal"
+TOKEN_ENV = "GOOGLE_OAUTH_ACCESS_TOKEN"
+
+#: S3 canned ACL -> GCS predefinedAcl
+_CANNED_TO_PREDEFINED = {
+    "private": "private",
+    "public-read": "publicRead",
+    "public-read-write": "publicReadWrite",
+    "authenticated-read": "authenticatedRead",
+    "bucket-owner-read": "bucketOwnerRead",
+    "bucket-owner-full-control": "bucketOwnerFullControl",
+}
+
+#: x-amz-grant-* header -> GCS ACL role
+_GRANT_HEADER_TO_ROLE = {
+    "x-amz-grant-read": "READER",
+    "x-amz-grant-write": "WRITER",
+    "x-amz-grant-read-acp": "READER",
+    "x-amz-grant-write-acp": "WRITER",
+    "x-amz-grant-full-control": "OWNER",
+}
+
+
+class GcsTokenProvider:
+    """OAuth2 access-token source with caching.
+
+    Order: explicit token > GOOGLE_OAUTH_ACCESS_TOKEN env > GCE metadata
+    server (the TPU-VM workload-identity path). Metadata tokens are cached
+    and refreshed 60 s before expiry."""
+
+    def __init__(self, explicit_token: str = "", anonymous: bool = False,
+                 timeout: float = 5.0):
+        self.explicit_token = explicit_token
+        self.anonymous = anonymous
+        self.timeout = timeout
+        self._cached = ""
+        self._expires_at = 0.0
+
+    def token(self) -> str:
+        if self.anonymous:
+            return ""
+        if self.explicit_token:
+            return self.explicit_token
+        env_token = os.environ.get(TOKEN_ENV, "")
+        if env_token:
+            return env_token
+        now = time.monotonic()
+        if self._cached and now < self._expires_at - 60:
+            return self._cached
+        self._cached, lifetime = self._fetch_metadata_token()
+        self._expires_at = now + lifetime
+        return self._cached
+
+    def _fetch_metadata_token(self) -> "tuple[str, float]":
+        host = os.environ.get(METADATA_HOST_ENV, METADATA_DEFAULT_HOST)
+        if ":" in host:
+            hostname, port = host.rsplit(":", 1)
+            conn = http.client.HTTPConnection(hostname, int(port),
+                                              timeout=self.timeout)
+        else:
+            conn = http.client.HTTPConnection(host, timeout=self.timeout)
+        try:
+            conn.request(
+                "GET",
+                "/computeMetadata/v1/instance/service-accounts/default/token",
+                headers={"Metadata-Flavor": "Google"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise S3Error(resp.status, "GcsAuthFailed",
+                              f"metadata token fetch failed: "
+                              f"{data.decode(errors='replace')[:200]}")
+            doc = json.loads(data)
+            return doc["access_token"], float(doc.get("expires_in", 300))
+        except (OSError, http.client.HTTPException, ValueError,
+                KeyError) as err:
+            raise S3Error(
+                0, "GcsAuthUnavailable",
+                f"no GCS credentials: metadata server {host} unreachable "
+                f"({err}); set --gcstoken, {TOKEN_ENV}, or --gcsanon"
+            ) from err
+        finally:
+            conn.close()
+
+
+class GcsClient:
+    """One GCS JSON-API connection (per worker, like the reference's
+    per-worker S3 client). Method surface mirrors `s3_tk.S3Client` so the
+    object worker front-end is backend-agnostic."""
+
+    _RETRY_STATUSES = (429, 500, 502, 503, 504)
+
+    def __init__(self, endpoint: str = GCS_DEFAULT_ENDPOINT,
+                 project: str = "", token_provider=None,
+                 timeout: float = 60.0, num_retries: int = 0,
+                 interrupt_check=None):
+        parsed = urllib.parse.urlparse(
+            endpoint if "//" in endpoint else "https://" + endpoint)
+        self.scheme = parsed.scheme or "https"
+        self.host = parsed.hostname or "storage.googleapis.com"
+        self.port = parsed.port or (443 if self.scheme == "https" else 80)
+        self.project = project
+        self.auth = token_provider or GcsTokenProvider(anonymous=True)
+        self.timeout = timeout
+        self.num_retries = num_retries
+        self.interrupt_check = interrupt_check
+        self._conn: "http.client.HTTPConnection | None" = None
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            cls = (http.client.HTTPSConnection if self.scheme == "https"
+                   else http.client.HTTPConnection)
+            self._conn = cls(self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    @staticmethod
+    def _obj_path(bucket: str, key: str) -> str:
+        return (f"/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+                f"/o/{urllib.parse.quote(key, safe='')}")
+
+    @staticmethod
+    def _bucket_path(bucket: str) -> str:
+        return f"/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+
+    def request(self, method: str, path: str,
+                query: "dict | None" = None, body: bytes = b"",
+                headers: "dict | None" = None,
+                want_body: bool = True) -> "tuple[int, dict, bytes]":
+        """One JSON-API request with transient-error retries and
+        interruption checks between attempts (same contract as
+        S3Client.request)."""
+        last_err = None
+        for attempt in range(self.num_retries + 1):
+            if self.interrupt_check:
+                self.interrupt_check()
+            try:
+                status, resp_headers, data = self._request_once(
+                    method, path, query, body, headers, want_body)
+            except (OSError, http.client.HTTPException) as err:
+                last_err = err
+                if attempt < self.num_retries:
+                    time.sleep(0.2 * (attempt + 1))
+                continue
+            if status in self._RETRY_STATUSES and attempt < self.num_retries:
+                time.sleep(0.2 * (attempt + 1))
+                continue
+            return status, resp_headers, data
+        raise last_err if last_err is not None else S3Error(
+            503, "RetryExhausted", "request retries exhausted")
+
+    def _request_once(self, method, path, query, body, headers,
+                      want_body) -> "tuple[int, dict, bytes]":
+        url = path
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: str(v) for k, v in query.items()})
+        hdrs = dict(headers or {})
+        hdrs["Host"] = self.host if self.port in (80, 443) \
+            else f"{self.host}:{self.port}"
+        token = self.auth.token()
+        if token:
+            hdrs["Authorization"] = f"Bearer {token}"
+        conn = self._connection()
+        try:
+            conn.request(method, url, body=body or None, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read() if want_body or resp.status >= 300 else b""
+            if not want_body and resp.status < 300:
+                resp.read()  # drain for keep-alive
+            return resp.status, dict(resp.getheaders()), data
+        except (http.client.HTTPException, OSError):
+            self.close()  # drop broken keep-alive connection
+            raise
+
+    @staticmethod
+    def _check(status: int, data: bytes, ok=(200, 204)) -> None:
+        if status in ok:
+            return
+        code, message = "GcsError", data.decode(errors="replace")[:300]
+        try:
+            doc = json.loads(data)
+            err = doc.get("error", {})
+            code = str(err.get("code", code))
+            message = err.get("message", message)
+        except (ValueError, AttributeError):
+            pass
+        raise S3Error(status, code, message)
+
+    # -- bucket ops ----------------------------------------------------------
+
+    def create_bucket(self, bucket: str) -> None:
+        body = json.dumps({"name": bucket}).encode()
+        query = {"project": self.project} if self.project else {}
+        status, _, data = self.request(
+            "POST", "/storage/v1/b", query=query, body=body,
+            headers={"Content-Type": "application/json"})
+        if status == 409:  # already exists/owned: treat as success
+            return
+        self._check(status, data, ok=(200,))
+
+    def delete_bucket(self, bucket: str) -> None:
+        status, _, data = self.request("DELETE", self._bucket_path(bucket))
+        self._check(status, data)
+
+    def head_bucket(self, bucket: str) -> bool:
+        status, _, _ = self.request("GET", self._bucket_path(bucket))
+        return status == 200
+
+    # -- object data ops -----------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   extra_headers: "dict | None" = None) -> None:
+        status, _, data = self.request(
+            "POST",
+            f"/upload/storage/v1/b/{urllib.parse.quote(bucket, safe='')}/o",
+            query={"uploadType": "media", "name": key}, body=body,
+            headers=extra_headers)
+        self._check(status, data, ok=(200,))
+
+    def get_object(self, bucket: str, key: str,
+                   range_start: "int | None" = None,
+                   range_len: "int | None" = None,
+                   extra_headers: "dict | None" = None) -> bytes:
+        headers = dict(extra_headers or {})
+        if range_start is not None:
+            end = "" if range_len is None else str(range_start + range_len - 1)
+            headers["Range"] = f"bytes={range_start}-{end}"
+        status, _, data = self.request(
+            "GET", self._obj_path(bucket, key), query={"alt": "media"},
+            headers=headers)
+        if status not in (200, 206):
+            self._check(status, data, ok=())
+        return data
+
+    def get_object_discard(self, bucket: str, key: str,
+                           range_start: "int | None" = None,
+                           range_len: "int | None" = None,
+                           extra_headers: "dict | None" = None) -> int:
+        """Chunked streaming download, body dropped (--s3fastget
+        equivalent); returns the byte count."""
+        last_err = None
+        for attempt in range(self.num_retries + 1):
+            if self.interrupt_check:
+                self.interrupt_check()
+            try:
+                status, total = self._get_discard_once(
+                    bucket, key, range_start, range_len, extra_headers)
+            except (OSError, http.client.HTTPException) as err:
+                last_err = err
+                if attempt < self.num_retries:
+                    time.sleep(0.2 * (attempt + 1))
+                continue
+            if status in self._RETRY_STATUSES:
+                if attempt < self.num_retries:
+                    time.sleep(0.2 * (attempt + 1))
+                    continue
+                # surface the real server status instead of returning a
+                # zero byte count (a misleading short-read error upstream)
+                raise S3Error(status, "RetryExhausted",
+                              f"download failed with HTTP {status} after "
+                              f"{attempt + 1} attempts")
+            return total
+        raise last_err if last_err is not None else S3Error(
+            503, "RetryExhausted", "request retries exhausted")
+
+    def _get_discard_once(self, bucket, key, range_start, range_len,
+                          extra_headers) -> "tuple[int, int]":
+        headers = dict(extra_headers or {})
+        if range_start is not None:
+            end = "" if range_len is None else str(range_start + range_len - 1)
+            headers["Range"] = f"bytes={range_start}-{end}"
+        headers["Host"] = self.host if self.port in (80, 443) \
+            else f"{self.host}:{self.port}"
+        token = self.auth.token()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        conn = self._connection()
+        try:
+            conn.request("GET", self._obj_path(bucket, key) + "?alt=media",
+                         headers=headers)
+            resp = conn.getresponse()
+            if resp.status in self._RETRY_STATUSES:
+                resp.read()  # drain for keep-alive
+                return resp.status, 0
+            if resp.status not in (200, 206):
+                self._check(resp.status, resp.read(), ok=())
+            total = 0
+            chunks = 0
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                total += len(chunk)
+                chunks += 1
+                if self.interrupt_check and chunks % 16 == 0:
+                    self.interrupt_check()
+            return resp.status, total
+        except (http.client.HTTPException, OSError):
+            self.close()
+            raise
+
+    def head_object(self, bucket: str, key: str,
+                    extra_headers: "dict | None" = None) -> "dict[str, str]":
+        status, _, data = self.request("GET", self._obj_path(bucket, key),
+                                       headers=extra_headers)
+        if status != 200:
+            raise S3Error(status, "NotFound", key)
+        meta = json.loads(data)
+        # header-shaped view so stat phases are backend-agnostic
+        out = {str(k): str(v) for k, v in meta.items()
+               if not isinstance(v, (dict, list))}
+        out["content-length"] = str(meta.get("size", ""))
+        out["etag"] = str(meta.get("etag", meta.get("md5Hash", "")))
+        return out
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        status, _, data = self.request("DELETE", self._obj_path(bucket, key))
+        self._check(status, data)
+
+    def delete_objects(self, bucket: str, keys: "list[str]") -> None:
+        """GCS has no single-request multi-delete in the JSON API (batch
+        endpoints are multipart/mixed); loop with the usual interrupt
+        checks — the phase accounting stays identical."""
+        failures = []
+        for key in keys:
+            try:
+                self.delete_object(bucket, key)
+            except S3Error as err:
+                failures.append((key, err.code))
+        if failures:
+            key, code = failures[0]
+            raise S3Error(200, code or "MultiDeleteError",
+                          f"{len(failures)} object(s) failed to delete, "
+                          f"first: {key}")
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     max_keys: int = 1000,
+                     continuation_token: str = ""
+                     ) -> "tuple[list[str], str]":
+        query = {"maxResults": str(max_keys)}
+        if prefix:
+            query["prefix"] = prefix
+        if continuation_token:
+            query["pageToken"] = continuation_token
+        status, _, data = self.request(
+            "GET", self._bucket_path(bucket) + "/o", query=query)
+        self._check(status, data, ok=(200,))
+        doc = json.loads(data)
+        keys = [item["name"] for item in doc.get("items", [])]
+        return keys, doc.get("nextPageToken", "")
+
+    # -- multipart analogue: component objects + compose ---------------------
+
+    #: GCS compose accepts at most 32 source objects per request
+    _COMPOSE_BATCH = 32
+
+    def _part_key(self, key: str, upload_id: str, part_number: int) -> str:
+        return f"{key}.{upload_id}.p{part_number:06d}"
+
+    def create_multipart_upload(self, bucket: str, key: str,
+                                extra_headers: "dict | None" = None) -> str:
+        """No server-side session: the upload id namespaces the component
+        objects of GCS's native parallel-upload idiom."""
+        del bucket, key, extra_headers  # no server round trip needed
+        return "cmp" + uuid.uuid4().hex[:16]
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, body: bytes,
+                    extra_headers: "dict | None" = None) -> str:
+        part_key = self._part_key(key, upload_id, part_number)
+        self.put_object(bucket, part_key, body, extra_headers=extra_headers)
+        return part_key  # the "etag" slot carries the component name
+
+    def _compose(self, bucket: str, sources: "list[str]",
+                 dest: str) -> None:
+        body = json.dumps({
+            "sourceObjects": [{"name": s} for s in sources],
+            "destination": {"contentType": "application/octet-stream"},
+        }).encode()
+        status, _, data = self.request(
+            "POST", self._obj_path(bucket, dest) + "/compose", body=body,
+            headers={"Content-Type": "application/json"})
+        self._check(status, data, ok=(200,))
+
+    def complete_multipart_upload(self, bucket: str, key: str,
+                                  upload_id: str, parts,
+                                  checksum_algo: str = "") -> None:
+        """Fold the ordered components into the destination: up to 32 per
+        compose request, intermediates re-composed iteratively, then all
+        temporaries deleted."""
+        del checksum_algo  # GCS validates via per-object crc32c instead
+        sources = [self._part_key(key, upload_id, p[0])
+                   for p in sorted(parts)]
+        temps = list(sources)
+        level = 0
+        while len(sources) > self._COMPOSE_BATCH:
+            next_level = []
+            for i in range(0, len(sources), self._COMPOSE_BATCH):
+                batch = sources[i:i + self._COMPOSE_BATCH]
+                if len(batch) == 1:
+                    next_level.append(batch[0])
+                    continue
+                inter = f"{key}.{upload_id}.c{level}.{i:06d}"
+                self._compose(bucket, batch, inter)
+                next_level.append(inter)
+                temps.append(inter)
+            sources = next_level
+            level += 1
+        self._compose(bucket, sources, key)
+        for temp in temps:
+            try:
+                self.delete_object(bucket, temp)
+            except S3Error:
+                pass  # best-effort cleanup, like MPU abort
+        return None
+
+    def abort_multipart_upload(self, bucket: str, key: str,
+                               upload_id: str) -> None:
+        prefix = f"{key}.{upload_id}."
+        token = ""
+        while True:
+            keys, token = self.list_objects(bucket, prefix=prefix,
+                                            continuation_token=token)
+            for k in keys:
+                try:
+                    self.delete_object(bucket, k)
+                except S3Error:
+                    pass
+            if not token:
+                return
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               key_marker: str = "",
+                               upload_id_marker: str = ""
+                               ) -> "tuple[list[tuple[str, str]], str, str]":
+        """Leftover component objects, grouped by (key, upload id) — the
+        cleanup-tool contract of the S3 version."""
+        del upload_id_marker
+        uploads = set()
+        token = key_marker
+        while True:
+            keys, token = self.list_objects(bucket, prefix=prefix,
+                                            continuation_token=token)
+            for k in keys:
+                base, _, tail = k.rpartition(".p")
+                if not tail.isdigit():
+                    continue
+                obj_key, _, upload_id = base.rpartition(".")
+                if upload_id.startswith("cmp"):
+                    uploads.add((obj_key, upload_id))
+            if not token:
+                return sorted(uploads), "", ""
+
+    # -- metadata ops (tagging / ACL / versioning / retention) ---------------
+
+    def _patch_object(self, bucket: str, key: str, doc: dict,
+                      query: "dict | None" = None) -> bytes:
+        status, _, data = self.request(
+            "PATCH", self._obj_path(bucket, key), query=query,
+            body=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        self._check(status, data, ok=(200,))
+        return data
+
+    def _patch_bucket(self, bucket: str, doc: dict,
+                      query: "dict | None" = None) -> bytes:
+        status, _, data = self.request(
+            "PATCH", self._bucket_path(bucket), query=query,
+            body=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        self._check(status, data, ok=(200,))
+        return data
+
+    def put_object_tagging(self, bucket: str, key: str,
+                           tags: "dict[str, str]") -> None:
+        self._patch_object(bucket, key, {"metadata": tags})
+
+    def get_object_tagging(self, bucket: str, key: str) -> "dict[str, str]":
+        status, _, data = self.request("GET", self._obj_path(bucket, key))
+        self._check(status, data, ok=(200,))
+        return json.loads(data).get("metadata", {}) or {}
+
+    def delete_object_tagging(self, bucket: str, key: str) -> None:
+        self._patch_object(bucket, key, {"metadata": None})
+
+    def put_bucket_tagging(self, bucket: str,
+                           tags: "dict[str, str]") -> None:
+        self._patch_bucket(bucket, {"labels": tags})
+
+    def get_bucket_tagging(self, bucket: str) -> "dict[str, str]":
+        status, _, data = self.request("GET", self._bucket_path(bucket))
+        self._check(status, data, ok=(200,))
+        return json.loads(data).get("labels", {}) or {}
+
+    def delete_bucket_tagging(self, bucket: str) -> None:
+        self._patch_bucket(bucket, {"labels": None})
+
+    def put_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+        self._patch_bucket(bucket, {"versioning": {"enabled": enabled}})
+
+    def get_bucket_versioning(self, bucket: str) -> str:
+        status, _, data = self.request("GET", self._bucket_path(bucket))
+        self._check(status, data, ok=(200,))
+        enabled = json.loads(data).get("versioning", {}).get("enabled")
+        return "Enabled" if enabled else ("Suspended" if enabled is False
+                                          else "")
+
+    def put_object_lock_configuration(self, bucket: str,
+                                      mode: str = "GOVERNANCE",
+                                      days: int = 1) -> None:
+        """GCS analogue: bucket retention policy (no GOVERNANCE/COMPLIANCE
+        mode concept — empty mode clears the policy)."""
+        policy = {"retentionPeriod": str(days * 86400)} if mode else None
+        self._patch_bucket(bucket, {"retentionPolicy": policy})
+
+    def get_object_lock_configuration(self, bucket: str) -> str:
+        status, _, data = self.request("GET", self._bucket_path(bucket))
+        self._check(status, data, ok=(200,))
+        policy = json.loads(data).get("retentionPolicy")
+        # reported as GOVERNANCE when a policy exists (documented mapping)
+        return "GOVERNANCE" if policy else ""
+
+    @staticmethod
+    def _acl_entries(acl: str, acl_headers: "dict | None") -> "tuple":
+        """(predefinedAcl, entity-entries) from a canned ACL name or the
+        worker's x-amz-grant-* header dict."""
+        if acl:
+            return _CANNED_TO_PREDEFINED.get(acl, ""), []
+        entries = []
+        for header, value in (acl_headers or {}).items():
+            role = _GRANT_HEADER_TO_ROLE.get(header.lower())
+            if header.lower() == "x-amz-acl":
+                return _CANNED_TO_PREDEFINED.get(value, ""), []
+            if not role:
+                continue
+            for grant in value.split(","):
+                gtype, _, name = grant.strip().partition("=")
+                name = name.strip('"')
+                if gtype in ("id", "emailAddress"):
+                    entity = f"user-{name}"
+                elif gtype == "uri":
+                    entity = ("allUsers" if name.endswith("AllUsers")
+                              else "allAuthenticatedUsers"
+                              if name.endswith("AuthenticatedUsers")
+                              else f"group-{name}")
+                else:
+                    entity = grant.strip()
+                entries.append({"entity": entity, "role": role})
+        return "", entries
+
+    def put_object_acl(self, bucket: str, key: str, acl: str = "",
+                       acl_headers: "dict | None" = None) -> None:
+        predefined, entries = self._acl_entries(acl, acl_headers)
+        if predefined:
+            self._patch_object(bucket, key, {},
+                               query={"predefinedAcl": predefined})
+        else:
+            self._patch_object(bucket, key, {"acl": entries})
+
+    def get_object_acl(self, bucket: str, key: str) -> bytes:
+        status, _, data = self.request(
+            "GET", self._obj_path(bucket, key) + "/acl")
+        self._check(status, data, ok=(200,))
+        return data
+
+    def put_bucket_acl(self, bucket: str, acl: str = "",
+                       acl_headers: "dict | None" = None) -> None:
+        predefined, entries = self._acl_entries(acl, acl_headers)
+        if predefined:
+            self._patch_bucket(bucket, {},
+                               query={"predefinedAcl": predefined})
+        else:
+            self._patch_bucket(bucket, {"acl": entries})
+
+    def get_bucket_acl(self, bucket: str) -> bytes:
+        status, _, data = self.request(
+            "GET", self._bucket_path(bucket) + "/acl")
+        self._check(status, data, ok=(200,))
+        return data

@@ -407,9 +407,15 @@ class S3Client:
                 if attempt < self.num_retries:
                     _time.sleep(0.2 * (attempt + 1))
                 continue
-            if status in self._RETRY_STATUSES and attempt < self.num_retries:
-                _time.sleep(0.2 * (attempt + 1))
-                continue
+            if status in self._RETRY_STATUSES:
+                if attempt < self.num_retries:
+                    _time.sleep(0.2 * (attempt + 1))
+                    continue
+                # surface the real server status instead of returning a
+                # zero byte count (a misleading short-read error upstream)
+                raise S3Error(status, "RetryExhausted",
+                              f"download failed with HTTP {status} after "
+                              f"{attempt + 1} attempts")
             return total
         raise last_err if last_err is not None else S3Error(
             503, "RetryExhausted", "request retries exhausted")
@@ -732,7 +738,19 @@ class S3CredentialStore:
 
 def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
     """Endpoint + credential round-robin by worker rank
-    (reference: S3Tk.cpp:167-316 + S3CredentialStore)."""
+    (reference: S3Tk.cpp:167-316 + S3CredentialStore). With the GCS-native
+    backend (gs:// paths) this returns a `gcs_tk.GcsClient` instead — the
+    method surface is identical, so callers stay backend-agnostic."""
+    if getattr(cfg, "object_backend", "") == "gcs":
+        from .gcs_tk import (GCS_DEFAULT_ENDPOINT, GcsClient,
+                             GcsTokenProvider)
+        endpoints = [e.strip() for e in cfg.gcs_endpoint_str.split(",")
+                     if e.strip()] or [GCS_DEFAULT_ENDPOINT]
+        return GcsClient(
+            endpoints[rank % len(endpoints)], project=cfg.gcs_project,
+            token_provider=GcsTokenProvider(cfg.gcs_token,
+                                            cfg.gcs_anonymous),
+            num_retries=cfg.s3_num_retries, interrupt_check=interrupt_check)
     endpoints = [e.strip() for e in cfg.s3_endpoints_str.split(",")
                  if e.strip()]
     if not endpoints:

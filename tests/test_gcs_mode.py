@@ -1,0 +1,220 @@
+"""GCS-native backend tests against the in-memory JSON-API mock
+(round-1 verdict item 5: native GCS client behind the object front-end,
+selected by gs:// paths; reference role: S3Tk.cpp:167-316)."""
+
+import json
+
+import pytest
+
+from elbencho_tpu.cli import main
+from elbencho_tpu.testing.mock_gcs import MockGcsServer
+from elbencho_tpu.toolkits.gcs_tk import GcsClient, GcsTokenProvider
+from elbencho_tpu.toolkits.s3_tk import S3Error
+
+
+@pytest.fixture(scope="module")
+def mock_gcs():
+    server = MockGcsServer().start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(mock_gcs):
+    c = GcsClient(mock_gcs.endpoint, project="test-proj")
+    yield c
+    c.close()
+
+
+def run_cli(mock_gcs, args):
+    return main(args + ["--nolive", "--gcsendpoint", mock_gcs.endpoint,
+                        "--gcsanon"])
+
+
+# -- client-level tests -------------------------------------------------------
+
+def test_bucket_lifecycle(client):
+    client.create_bucket("gb1")
+    assert client.head_bucket("gb1")
+    client.delete_bucket("gb1")
+    assert not client.head_bucket("gb1")
+
+
+def test_object_roundtrip_and_range(client):
+    client.create_bucket("gb2")
+    client.put_object("gb2", "hello.txt", b"payload123")
+    assert client.get_object("gb2", "hello.txt") == b"payload123"
+    assert client.get_object("gb2", "hello.txt", range_start=3,
+                             range_len=4) == b"load"
+    head = client.head_object("gb2", "hello.txt")
+    assert head["content-length"] == "10"
+    assert client.get_object_discard("gb2", "hello.txt") == 10
+    client.delete_object("gb2", "hello.txt")
+    with pytest.raises(S3Error):
+        client.get_object("gb2", "hello.txt")
+
+
+def test_compose_multipart_analogue(client):
+    """MPU maps to parallel component objects + iterative compose."""
+    client.create_bucket("gb3")
+    upload_id = client.create_multipart_upload("gb3", "big.bin")
+    parts = []
+    for num, chunk in enumerate([b"a" * 100, b"b" * 100, b"c" * 50], 1):
+        etag = client.upload_part("gb3", "big.bin", upload_id, num, chunk)
+        parts.append((num, etag))
+    client.complete_multipart_upload("gb3", "big.bin", upload_id, parts)
+    assert client.get_object("gb3", "big.bin") == \
+        b"a" * 100 + b"b" * 100 + b"c" * 50
+    # temporaries are cleaned up
+    keys, _ = client.list_objects("gb3")
+    assert keys == ["big.bin"]
+
+
+def test_compose_folds_over_32_parts(client):
+    client.create_bucket("gb4")
+    upload_id = client.create_multipart_upload("gb4", "huge.bin")
+    parts = []
+    for num in range(1, 41):  # 40 parts > the 32-component compose limit
+        etag = client.upload_part("gb4", "huge.bin", upload_id, num,
+                                  bytes([num]) * 10)
+        parts.append((num, etag))
+    client.complete_multipart_upload("gb4", "huge.bin", upload_id, parts)
+    data = client.get_object("gb4", "huge.bin")
+    assert data == b"".join(bytes([n]) * 10 for n in range(1, 41))
+    keys, _ = client.list_objects("gb4")
+    assert keys == ["huge.bin"]
+
+
+def test_abort_cleans_components(client):
+    client.create_bucket("gb5")
+    upload_id = client.create_multipart_upload("gb5", "dead.bin")
+    client.upload_part("gb5", "dead.bin", upload_id, 1, b"x" * 10)
+    client.upload_part("gb5", "dead.bin", upload_id, 2, b"y" * 10)
+    uploads, _, _ = client.list_multipart_uploads("gb5")
+    assert uploads == [("dead.bin", upload_id)]
+    client.abort_multipart_upload("gb5", "dead.bin", upload_id)
+    keys, _ = client.list_objects("gb5")
+    assert keys == []
+
+
+def test_listing_pagination(client):
+    client.create_bucket("gb6")
+    for i in range(7):
+        client.put_object("gb6", f"obj{i:02d}", b"x")
+    got, token = client.list_objects("gb6", max_keys=3)
+    assert len(got) == 3 and token
+    rest = []
+    while token:
+        page, token = client.list_objects("gb6", max_keys=3,
+                                          continuation_token=token)
+        rest.extend(page)
+    assert got + rest == [f"obj{i:02d}" for i in range(7)]
+
+
+def test_tagging_versioning_lock_acl(client):
+    client.create_bucket("gb7")
+    client.put_object("gb7", "o1", b"d")
+    client.put_object_tagging("gb7", "o1", {"k1": "v1"})
+    assert client.get_object_tagging("gb7", "o1") == {"k1": "v1"}
+    client.delete_object_tagging("gb7", "o1")
+    assert client.get_object_tagging("gb7", "o1") == {}
+    client.put_bucket_tagging("gb7", {"env": "test"})
+    assert client.get_bucket_tagging("gb7") == {"env": "test"}
+    client.put_bucket_versioning("gb7", True)
+    assert client.get_bucket_versioning("gb7") == "Enabled"
+    client.put_bucket_versioning("gb7", False)
+    assert client.get_bucket_versioning("gb7") == "Suspended"
+    client.put_object_lock_configuration("gb7", "GOVERNANCE", days=1)
+    assert client.get_object_lock_configuration("gb7") == "GOVERNANCE"
+    client.put_object_lock_configuration("gb7", "", days=0)  # clear
+    assert client.get_object_lock_configuration("gb7") == ""
+    client.put_object_acl("gb7", "o1", acl="public-read")
+    assert b"publicRead" in client.get_object_acl("gb7", "o1")
+    client.put_bucket_acl("gb7", acl="private")
+    assert b"private" in client.get_bucket_acl("gb7")
+
+
+def test_metadata_server_auth(mock_gcs, monkeypatch):
+    """Workload-identity path: token from the (mock) metadata server,
+    cached until expiry."""
+    monkeypatch.setenv("GCE_METADATA_HOST", mock_gcs.metadata_host)
+    monkeypatch.delenv("GOOGLE_OAUTH_ACCESS_TOKEN", raising=False)
+    provider = GcsTokenProvider()
+    before = mock_gcs.state.metadata_token_calls
+    t1 = provider.token()
+    t2 = provider.token()  # cached: no second metadata call
+    assert t1 == t2 and t1.startswith("mock-token-")
+    assert mock_gcs.state.metadata_token_calls == before + 1
+    c = GcsClient(mock_gcs.endpoint, token_provider=provider)
+    c.create_bucket("authbkt")
+    c.close()
+    assert t1 in mock_gcs.state.seen_tokens
+
+
+def test_env_token_auth(mock_gcs, monkeypatch):
+    monkeypatch.setenv("GOOGLE_OAUTH_ACCESS_TOKEN", "env-tok-1")
+    provider = GcsTokenProvider()
+    assert provider.token() == "env-tok-1"
+
+
+# -- end-to-end CLI phases through the object front-end -----------------------
+
+def test_gcs_full_cycle(mock_gcs, tmp_path):
+    """gs:// path selects the GCS backend; write/read/stat/list/delete
+    phases run end-to-end against the mock JSON API."""
+    rc = run_cli(mock_gcs, ["-w", "-d", "-r", "--stat", "-F", "-D",
+                            "-t", "2", "-n", "1", "-N", "2", "-s", "8K",
+                            "-b", "8K", "gs://e2ebkt"])
+    assert rc == 0
+    assert "e2ebkt" not in mock_gcs.state.buckets  # -D deleted it
+
+
+def test_gcs_multipart_upload_download(mock_gcs):
+    """Object larger than block size goes through the compose-MPU path."""
+    rc = run_cli(mock_gcs, ["-w", "-d", "-t", "1", "-n", "1", "-N", "1",
+                            "-s", "64K", "-b", "16K", "gs://mpubkt"])
+    assert rc == 0
+    objs = mock_gcs.state.objects["mpubkt"]
+    key = next(iter(objs))
+    assert len(objs) == 1  # components cleaned up after compose
+    assert len(objs[key]) == 64 * 1024
+    rc = run_cli(mock_gcs, ["-r", "-t", "1", "-n", "1", "-N", "1",
+                            "-s", "64K", "-b", "16K", "gs://mpubkt"])
+    assert rc == 0
+    rc = run_cli(mock_gcs, ["-F", "-D", "-t", "1", "-n", "1", "-N", "1",
+                            "-s", "64K", "-b", "16K", "gs://mpubkt"])
+    assert rc == 0
+
+
+def test_gcs_verify_integrity(mock_gcs):
+    rc = run_cli(mock_gcs, ["-w", "-d", "-r", "--verify", "13", "-t", "1",
+                            "-n", "1", "-N", "2", "-s", "16K", "-b", "16K",
+                            "gs://vrfbkt"])
+    assert rc == 0
+    rc = run_cli(mock_gcs, ["-F", "-D", "-t", "1", "-n", "1", "-N", "2",
+                            "-s", "16K", "-b", "16K", "gs://vrfbkt"])
+    assert rc == 0
+
+
+def test_gcs_listing_phase(mock_gcs):
+    assert run_cli(mock_gcs, ["-w", "-d", "-t", "1", "-n", "1", "-N", "3",
+                              "-s", "4K", "-b", "4K", "gs://listbkt"]) == 0
+    assert run_cli(mock_gcs, ["--s3listobj", "10", "-t", "1",
+                              "gs://listbkt"]) == 0
+    assert run_cli(mock_gcs, ["-F", "-D", "-t", "1", "-n", "1", "-N", "3",
+                              "-s", "4K", "-b", "4K", "gs://listbkt"]) == 0
+
+
+def test_backend_survives_service_wire(mock_gcs):
+    """object_backend is a flag field: to_service_dict/from_service_dict
+    round-trips it even though gs:// prefixes were stripped."""
+    from elbencho_tpu.config.args import parse_cli
+    cfg, _ns = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                          "--gcsanon", "--gcsendpoint", mock_gcs.endpoint,
+                          "gs://wirebkt"])
+    cfg.derive()
+    assert cfg.object_backend == "gcs"
+    from elbencho_tpu.config.args import BenchConfig
+    cfg2 = BenchConfig.from_service_dict(cfg.to_service_dict())
+    assert cfg2.object_backend == "gcs"
+    assert cfg2.bench_mode == cfg.bench_mode
