@@ -611,7 +611,17 @@ class BenchConfig(BenchConfigBase):
                 or self.gcs_endpoint_str or self.object_backend):
             # object mode; backend from the explicit --objectbackend if
             # given (e.g. the S3-interop XML path against gs:// buckets),
-            # else derived from the path scheme / endpoint flags
+            # else derived from the path scheme / endpoint flags —
+            # ambiguous mixes are rejected rather than silently routed
+            if has_gs and has_s3:
+                raise ConfigError(
+                    "cannot mix gs:// and s3:// bench paths in one run")
+            if not self.object_backend and (
+                    (has_gs or self.gcs_endpoint_str)
+                    and (has_s3 or self.s3_endpoints_str)):
+                raise ConfigError(
+                    "both S3 and GCS endpoints/paths configured — pick "
+                    "the backend explicitly with --objectbackend s3|gcs")
             self.bench_mode = BenchMode.S3
             if not self.object_backend:
                 self.object_backend = "gcs" \

@@ -16,6 +16,19 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+def _predefined_acl(name: str) -> "list[dict]":
+    """Expand a predefinedAcl name to entity entries like real GCS does
+    (e.g. publicRead -> allUsers READER)."""
+    base = [{"entity": "user-owner", "role": "OWNER"}]
+    if name in ("publicRead", "publicReadWrite"):
+        base.append({"entity": "allUsers",
+                     "role": "WRITER" if name.endswith("Write")
+                     else "READER"})
+    elif name == "authenticatedRead":
+        base.append({"entity": "allAuthenticatedUsers", "role": "READER"})
+    return base
+
+
 class MockGcsState:
     def __init__(self):
         self.lock = threading.Lock()
@@ -226,9 +239,8 @@ def _make_handler(state: MockGcsState):
                         else:
                             state.buckets[bucket][k] = v
                     if "predefinedAcl" in query:
-                        state.buckets[bucket]["acl"] = [
-                            {"entity": "predefined",
-                             "role": query["predefinedAcl"]}]
+                        state.buckets[bucket]["acl"] = _predefined_acl(
+                            query["predefinedAcl"])
                     self._json(200, state.buckets[bucket])
                     return
                 if len(parts) >= 7 and parts[5] == "o":
@@ -243,8 +255,8 @@ def _make_handler(state: MockGcsState):
                         else:
                             meta[k] = v
                     if "predefinedAcl" in query:
-                        meta["acl"] = [{"entity": "predefined",
-                                        "role": query["predefinedAcl"]}]
+                        meta["acl"] = _predefined_acl(
+                            query["predefinedAcl"])
                     self._json(200, self._obj_resource(bucket, name))
                     return
                 self._error(404, f"no route {path}")

@@ -67,15 +67,31 @@ class GcsTokenProvider:
 
     Order: explicit token > GOOGLE_OAUTH_ACCESS_TOKEN env > GCE metadata
     server (the TPU-VM workload-identity path). Metadata tokens are cached
-    and refreshed 60 s before expiry."""
+    and refreshed 60 s before expiry. Use `for_config` so all workers of a
+    process share ONE provider (one metadata fetch per expiry, not one per
+    worker — large -t runs would otherwise hammer the metadata server)."""
+
+    _cache: "dict[tuple, GcsTokenProvider]" = {}
+    _cache_lock = __import__("threading").Lock()
 
     def __init__(self, explicit_token: str = "", anonymous: bool = False,
                  timeout: float = 5.0):
         self.explicit_token = explicit_token
         self.anonymous = anonymous
         self.timeout = timeout
+        self._lock = __import__("threading").Lock()
         self._cached = ""
         self._expires_at = 0.0
+
+    @classmethod
+    def for_config(cls, cfg) -> "GcsTokenProvider":
+        key = (cfg.gcs_token, cfg.gcs_anonymous)
+        with cls._cache_lock:
+            provider = cls._cache.get(key)
+            if provider is None:
+                provider = cls(cfg.gcs_token, cfg.gcs_anonymous)
+                cls._cache[key] = provider
+            return provider
 
     def token(self) -> str:
         if self.anonymous:
@@ -85,12 +101,13 @@ class GcsTokenProvider:
         env_token = os.environ.get(TOKEN_ENV, "")
         if env_token:
             return env_token
-        now = time.monotonic()
-        if self._cached and now < self._expires_at - 60:
+        with self._lock:  # one refresh at a time across worker threads
+            now = time.monotonic()
+            if self._cached and now < self._expires_at - 60:
+                return self._cached
+            self._cached, lifetime = self._fetch_metadata_token()
+            self._expires_at = now + lifetime
             return self._cached
-        self._cached, lifetime = self._fetch_metadata_token()
-        self._expires_at = now + lifetime
-        return self._cached
 
     def _fetch_metadata_token(self) -> "tuple[str, float]":
         host = os.environ.get(METADATA_HOST_ENV, METADATA_DEFAULT_HOST)
@@ -286,30 +303,11 @@ class GcsClient:
                            extra_headers: "dict | None" = None) -> int:
         """Chunked streaming download, body dropped (--s3fastget
         equivalent); returns the byte count."""
-        last_err = None
-        for attempt in range(self.num_retries + 1):
-            if self.interrupt_check:
-                self.interrupt_check()
-            try:
-                status, total = self._get_discard_once(
-                    bucket, key, range_start, range_len, extra_headers)
-            except (OSError, http.client.HTTPException) as err:
-                last_err = err
-                if attempt < self.num_retries:
-                    time.sleep(0.2 * (attempt + 1))
-                continue
-            if status in self._RETRY_STATUSES:
-                if attempt < self.num_retries:
-                    time.sleep(0.2 * (attempt + 1))
-                    continue
-                # surface the real server status instead of returning a
-                # zero byte count (a misleading short-read error upstream)
-                raise S3Error(status, "RetryExhausted",
-                              f"download failed with HTTP {status} after "
-                              f"{attempt + 1} attempts")
-            return total
-        raise last_err if last_err is not None else S3Error(
-            503, "RetryExhausted", "request retries exhausted")
+        from .s3_tk import run_discard_with_retries
+        return run_discard_with_retries(
+            lambda: self._get_discard_once(bucket, key, range_start,
+                                           range_len, extra_headers),
+            self.num_retries, self._RETRY_STATUSES, self.interrupt_check)
 
     def _get_discard_once(self, bucket, key, range_start, range_len,
                           extra_headers) -> "tuple[int, int]":

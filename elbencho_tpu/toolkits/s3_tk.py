@@ -151,6 +151,37 @@ def build_checksum_headers(algo: str, body: bytes) -> "dict":
             f"x-amz-checksum-{algo}": base64.b64encode(digest).decode()}
 
 
+def run_discard_with_retries(attempt_fn, num_retries: int,
+                             retry_statuses, interrupt_check) -> int:
+    """Shared retry skeleton for streaming-discard downloads (used by the
+    S3 and GCS clients): attempt_fn() -> (status, total_bytes). Retries
+    connection errors and retryable statuses with linear backoff, checks
+    for interruption between attempts, and raises the REAL final HTTP
+    status on exhaustion instead of returning a zero byte count."""
+    import time as _time
+    last_err = None
+    for attempt in range(num_retries + 1):
+        if interrupt_check:
+            interrupt_check()
+        try:
+            status, total = attempt_fn()
+        except (OSError, http.client.HTTPException) as err:
+            last_err = err
+            if attempt < num_retries:
+                _time.sleep(0.2 * (attempt + 1))
+            continue
+        if status in retry_statuses:
+            if attempt < num_retries:
+                _time.sleep(0.2 * (attempt + 1))
+                continue
+            raise S3Error(status, "RetryExhausted",
+                          f"download failed with HTTP {status} after "
+                          f"{attempt + 1} attempts")
+        return total
+    raise last_err if last_err is not None else S3Error(
+        503, "RetryExhausted", "request retries exhausted")
+
+
 class S3Client:
     """One S3 endpoint connection (per worker; endpoint picked round-robin
     by worker rank like the reference's client factory)."""
@@ -394,31 +425,10 @@ class S3Client:
         only the byte count (reference: useS3FastRead sends downloads to
         /dev/null instead of a memory buffer). Same transient-error retry
         and interrupt semantics as request()."""
-        import time as _time
-        last_err = None
-        for attempt in range(self.num_retries + 1):
-            if self.interrupt_check:
-                self.interrupt_check()
-            try:
-                status, total = self._get_discard_once(
-                    bucket, key, range_start, range_len, extra_headers)
-            except (OSError, http.client.HTTPException) as err:
-                last_err = err
-                if attempt < self.num_retries:
-                    _time.sleep(0.2 * (attempt + 1))
-                continue
-            if status in self._RETRY_STATUSES:
-                if attempt < self.num_retries:
-                    _time.sleep(0.2 * (attempt + 1))
-                    continue
-                # surface the real server status instead of returning a
-                # zero byte count (a misleading short-read error upstream)
-                raise S3Error(status, "RetryExhausted",
-                              f"download failed with HTTP {status} after "
-                              f"{attempt + 1} attempts")
-            return total
-        raise last_err if last_err is not None else S3Error(
-            503, "RetryExhausted", "request retries exhausted")
+        return run_discard_with_retries(
+            lambda: self._get_discard_once(bucket, key, range_start,
+                                           range_len, extra_headers),
+            self.num_retries, self._RETRY_STATUSES, self.interrupt_check)
 
     def _get_discard_once(self, bucket, key, range_start, range_len,
                           extra_headers) -> "tuple[int, int]":
@@ -748,8 +758,7 @@ def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
                      if e.strip()] or [GCS_DEFAULT_ENDPOINT]
         return GcsClient(
             endpoints[rank % len(endpoints)], project=cfg.gcs_project,
-            token_provider=GcsTokenProvider(cfg.gcs_token,
-                                            cfg.gcs_anonymous),
+            token_provider=GcsTokenProvider.for_config(cfg),
             num_retries=cfg.s3_num_retries, interrupt_check=interrupt_check)
     endpoints = [e.strip() for e in cfg.s3_endpoints_str.split(",")
                  if e.strip()]

@@ -715,24 +715,34 @@ def _acl_headers(cfg) -> "dict":
         raise WorkerException(str(err)) from err
 
 
-#: canned ACL -> grantee group URI that must appear in the ACL document
+#: canned ACL -> grantee marker that must appear in the ACL document,
+#: per object backend (S3 XML group URIs vs GCS JSON ACL entities)
 _CANNED_ACL_MARKERS = {
-    "public-read": b"groups/global/AllUsers",
-    "public-read-write": b"groups/global/AllUsers",
-    "authenticated-read": b"groups/global/AuthenticatedUsers",
+    "s3": {
+        "public-read": b"groups/global/AllUsers",
+        "public-read-write": b"groups/global/AllUsers",
+        "authenticated-read": b"groups/global/AuthenticatedUsers",
+    },
+    "gcs": {
+        "public-read": b"allUsers",
+        "public-read-write": b"allUsers",
+        "authenticated-read": b"allAuthenticatedUsers",
+    },
 }
 
 
 def _verify_acl(cfg, acl_xml: bytes, what: str) -> None:
     """--s3aclverify: the configured grantee (or the canned ACL's group
-    URI) must appear in the returned ACL document (reference:
-    doS3AclVerify in the get-ACL phases)."""
+    URI / predefined-ACL name) must appear in the returned ACL document
+    (reference: doS3AclVerify in the get-ACL phases)."""
     if not cfg.do_s3_acl_verify or not cfg.s3_acl_grantee:
         return
     grantee = cfg.s3_acl_grantee
     if grantee == "private":
         return  # owner-only ACL: nothing beyond the owner grant to check
-    marker = _CANNED_ACL_MARKERS.get(grantee) \
+    backend = getattr(cfg, "object_backend", "") or "s3"
+    markers = _CANNED_ACL_MARKERS.get(backend, _CANNED_ACL_MARKERS["s3"])
+    marker = markers.get(grantee) \
         or (grantee.partition("=")[2] or grantee).encode()
     if marker not in acl_xml:
         raise WorkerException(

@@ -129,9 +129,10 @@ def test_tagging_versioning_lock_acl(client):
     client.put_object_lock_configuration("gb7", "", days=0)  # clear
     assert client.get_object_lock_configuration("gb7") == ""
     client.put_object_acl("gb7", "o1", acl="public-read")
-    assert b"publicRead" in client.get_object_acl("gb7", "o1")
+    # predefined ACLs expand to entities like real GCS (allUsers READER)
+    assert b"allUsers" in client.get_object_acl("gb7", "o1")
     client.put_bucket_acl("gb7", acl="private")
-    assert b"private" in client.get_bucket_acl("gb7")
+    assert b"user-owner" in client.get_bucket_acl("gb7")
 
 
 def test_metadata_server_auth(mock_gcs, monkeypatch):
@@ -218,3 +219,26 @@ def test_backend_survives_service_wire(mock_gcs):
     cfg2 = BenchConfig.from_service_dict(cfg.to_service_dict())
     assert cfg2.object_backend == "gcs"
     assert cfg2.bench_mode == cfg.bench_mode
+
+
+def test_mixed_scheme_rejected(mock_gcs):
+    from elbencho_tpu.config.args import ConfigError, parse_cli
+    cfg, _ = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                        "gs://a", "s3://b"])
+    with pytest.raises(ConfigError, match="cannot mix"):
+        cfg.derive()
+    cfg, _ = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                        "--s3endpoints", "http://x", "--gcsendpoint",
+                        mock_gcs.endpoint, "bkt"])
+    with pytest.raises(ConfigError, match="objectbackend"):
+        cfg.derive()
+
+
+def test_gcs_acl_verify_e2e(mock_gcs):
+    """--s3aclverify uses GCS entity markers on the gcs backend."""
+    assert run_cli(mock_gcs, ["-w", "-d", "-t", "1", "-n", "1", "-N", "1",
+                              "-s", "4K", "-b", "4K", "gs://aclbkt"]) == 0
+    assert run_cli(mock_gcs, ["--s3aclput", "--s3aclget",
+                              "--s3aclgrantee", "public-read",
+                              "--s3aclverify", "-t", "1", "-n", "1",
+                              "-N", "1", "gs://aclbkt"]) == 0
