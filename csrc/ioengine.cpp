@@ -662,6 +662,22 @@ enum {
     FILE_OP_UNLINK = 3,
 };
 
+// per-block modifiers for the file loop: rwmix decided by the in-loop
+// modulo (rank + ops submitted so far, continuing across chunk calls via
+// rwmix_base) since block indices are implicit here, unlike the flag
+// array of the block loops
+struct FileLoopMod {
+    uint64_t verify_salt = 0;
+    int do_verify = 0;
+    int var_pct = 0;
+    VarRng* var_rng = nullptr;
+    int rwmix_pct = 0;          // only meaningful for FILE_OP_WRITE
+    uint64_t rwmix_base = 0;    // workerRank + numIOPSSubmitted at entry
+    uint64_t* verify_info = nullptr;  // out[4] on -EILSEQ
+    uint64_t* out_rwmix_blocks = nullptr;
+    uint64_t* out_rwmix_bytes = nullptr;
+};
+
 int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                   uint64_t n_files, int op, int open_flags,
                   uint64_t file_size, uint64_t block_size, char* buf,
@@ -669,10 +685,12 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                   int ignore_delete_errors, uint64_t* out_entry_lat,
                   uint64_t* out_block_lat, uint64_t* out_bytes,
                   uint64_t* out_entries, uint64_t* out_fail_idx,
-                  volatile int* interrupt_flag) {
+                  volatile int* interrupt_flag, const FileLoopMod& mod) {
     uint64_t bytes_done = 0;
     uint64_t entries_done = 0;
     uint64_t block_idx = 0;
+    uint64_t rwmix_blocks = 0;
+    uint64_t rwmix_bytes = 0;
 
     for (uint64_t i = 0; i < n_files; ++i) {
         if (interrupt_flag && *interrupt_flag)
@@ -704,10 +722,22 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
             while (file_blocks--) {
                 const uint64_t len = (off + block_size <= r_end)
                     ? block_size : (r_end - off);
+                // rwmix per-op split within the write phase (reference:
+                // (rank+numIOPSSubmitted)%100 < pct, LocalWorker.cpp:1741)
+                const bool rd = (op == FILE_OP_READ)
+                    || (mod.rwmix_pct
+                        && ((mod.rwmix_base + block_idx) % 100)
+                           < static_cast<uint64_t>(mod.rwmix_pct));
+                if (!rd) {
+                    if (mod.do_verify)
+                        verify_fill(buf, off, len, mod.verify_salt);
+                    else if (mod.var_rng && mod.var_pct)
+                        mod.var_rng->refill(buf, len, mod.var_pct);
+                }
                 const uint64_t t0 = now_usec();
-                const ssize_t res = (op == FILE_OP_WRITE)
-                    ? pwrite(fd, buf, len, static_cast<off_t>(off))
-                    : pread(fd, buf, len, static_cast<off_t>(off));
+                const ssize_t res = rd
+                    ? pread(fd, buf, len, static_cast<off_t>(off))
+                    : pwrite(fd, buf, len, static_cast<off_t>(off));
                 out_block_lat[block_idx++] = now_usec() - t0;
                 if (res < 0) {
                     const int err = errno;
@@ -717,6 +747,19 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                 if (static_cast<uint64_t>(res) != len) {
                     close(fd);
                     return -EIO;
+                }
+                if (rd && mod.do_verify) {
+                    const int vret = verify_check(
+                        buf, off, len, mod.verify_salt, block_idx - 1,
+                        mod.verify_info);
+                    if (vret != 0) {
+                        close(fd);
+                        return vret;
+                    }
+                }
+                if (rd && op == FILE_OP_WRITE) {
+                    rwmix_blocks++;
+                    rwmix_bytes += static_cast<uint64_t>(res);
                 }
                 bytes_done += static_cast<uint64_t>(res);
                 off += len;
@@ -729,6 +772,10 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
     }
     *out_bytes = bytes_done;
     *out_entries = entries_done;
+    if (mod.out_rwmix_blocks)
+        *mod.out_rwmix_blocks = rwmix_blocks;
+    if (mod.out_rwmix_bytes)
+        *mod.out_rwmix_bytes = rwmix_bytes;
     return 0;
 }
 
@@ -738,6 +785,56 @@ extern "C" {
 
 // engine selector values for ioengine_run_block_loop2
 enum { ENGINE_AUTO = 0, ENGINE_SYNC = 1, ENGINE_AIO = 2, ENGINE_URING = 3 };
+
+// file loop with per-block modifiers (verify fill/check, rwmix in-loop
+// modulo split, block variance refill) so LOSF phases keep the native
+// loop with --verify/--rwmixpct/--blockvarpct active. out_verify_info:
+// 4 uint64 slots, {global_block_idx, word_idx, want, got} on -EILSEQ;
+// out_rwmix[2]: {blocks, bytes} read by the rwmix split of a write op.
+int ioengine_run_file_loop2(const char* paths_blob,
+                            const uint32_t* path_offs, uint64_t n_files,
+                            int op, int open_flags, uint64_t file_size,
+                            uint64_t block_size, void* buf,
+                            const uint64_t* range_starts,
+                            const uint64_t* range_lens,
+                            int ignore_delete_errors,
+                            uint64_t* out_entry_lat,
+                            uint64_t* out_block_lat,
+                            uint64_t* out_bytes, uint64_t* out_entries,
+                            uint64_t* out_fail_idx, int* interrupt_flag,
+                            uint64_t verify_salt, int do_verify,
+                            int block_var_pct, uint64_t block_var_seed,
+                            int rwmix_pct, uint64_t rwmix_base,
+                            uint64_t* out_verify_info,
+                            uint64_t* out_rwmix) {
+    *out_fail_idx = 0;
+    if (n_files == 0) {
+        *out_bytes = 0;
+        *out_entries = 0;
+        if (out_rwmix)
+            out_rwmix[0] = out_rwmix[1] = 0;
+        return 0;
+    }
+    VarRng var_rng(block_var_seed);
+    uint64_t info_fallback[4];
+    FileLoopMod mod;
+    mod.verify_salt = verify_salt;
+    mod.do_verify = do_verify;
+    mod.var_pct = do_verify ? 0 : block_var_pct;
+    mod.var_rng = &var_rng;
+    mod.rwmix_pct = (op == FILE_OP_WRITE) ? rwmix_pct : 0;
+    mod.rwmix_base = rwmix_base;
+    mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+    if (out_rwmix) {
+        mod.out_rwmix_blocks = &out_rwmix[0];
+        mod.out_rwmix_bytes = &out_rwmix[1];
+    }
+    return run_file_loop(paths_blob, path_offs, n_files, op, open_flags,
+                         file_size, block_size, static_cast<char*>(buf),
+                         range_starts, range_lens, ignore_delete_errors,
+                         out_entry_lat, out_block_lat, out_bytes,
+                         out_entries, out_fail_idx, interrupt_flag, mod);
+}
 
 int ioengine_run_file_loop(const char* paths_blob,
                            const uint32_t* path_offs, uint64_t n_files,
@@ -749,17 +846,11 @@ int ioengine_run_file_loop(const char* paths_blob,
                            uint64_t* out_entry_lat, uint64_t* out_block_lat,
                            uint64_t* out_bytes, uint64_t* out_entries,
                            uint64_t* out_fail_idx, int* interrupt_flag) {
-    *out_fail_idx = 0;
-    if (n_files == 0) {
-        *out_bytes = 0;
-        *out_entries = 0;
-        return 0;
-    }
-    return run_file_loop(paths_blob, path_offs, n_files, op, open_flags,
-                         file_size, block_size, static_cast<char*>(buf),
-                         range_starts, range_lens, ignore_delete_errors,
-                         out_entry_lat, out_block_lat, out_bytes,
-                         out_entries, out_fail_idx, interrupt_flag);
+    return ioengine_run_file_loop2(
+        paths_blob, path_offs, n_files, op, open_flags, file_size,
+        block_size, buf, range_starts, range_lens, ignore_delete_errors,
+        out_entry_lat, out_block_lat, out_bytes, out_entries, out_fail_idx,
+        interrupt_flag, 0, 0, 0, 0, 0, 0, nullptr, nullptr);
 }
 
 // full-featured variant: adds the in-loop block modifiers (rwmix per-op
@@ -1025,14 +1116,29 @@ int ioengine_net_server_loop(const int* fds, uint64_t n_conns,
 
 // mmap-backed block loop: pure memcpy between the mapping and the io
 // buffer with the usual latency/interrupt semantics (reference: the mmap
-// wrappers of LocalWorker; --mmap)
-int ioengine_run_mmap_loop(void* map_base, const uint64_t* offsets,
-                           const uint64_t* lengths, uint64_t n,
-                           int is_write, void* buf,
-                           uint64_t* out_lat_usec, uint64_t* out_bytes,
-                           int* interrupt_flag) {
+// wrappers of LocalWorker; --mmap). The "2" variant carries the same
+// per-block modifiers as the block loops (verify fill/check, rwmix
+// per-op flags, variance refill).
+int ioengine_run_mmap_loop2(void* map_base, const uint64_t* offsets,
+                            const uint64_t* lengths, uint64_t n,
+                            int is_write, void* buf,
+                            uint64_t* out_lat_usec, uint64_t* out_bytes,
+                            int* interrupt_flag,
+                            const unsigned char* op_is_read,
+                            uint64_t verify_salt, int do_verify,
+                            int block_var_pct, uint64_t block_var_seed,
+                            uint64_t* out_verify_info) {
     char* base = static_cast<char*>(map_base);
     char* io = static_cast<char*>(buf);
+    VarRng var_rng(block_var_seed);
+    uint64_t info_fallback[4];
+    BlockMod mod;
+    mod.op_is_read = op_is_read;
+    mod.verify_salt = verify_salt;
+    mod.do_verify = do_verify;
+    mod.var_pct = do_verify ? 0 : block_var_pct;
+    mod.var_rng = &var_rng;
+    mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
     uint64_t bytes_done = 0;
     for (uint64_t i = 0; i < n; ++i) {
         if ((i % kInterruptCheckInterval) == 0 && interrupt_flag
@@ -1040,16 +1146,35 @@ int ioengine_run_mmap_loop(void* map_base, const uint64_t* offsets,
             break;
         const uint64_t len = lengths[i];
         const uint64_t off = offsets[i];
+        const bool rd = mod.op_reads(i, is_write);
+        if (!rd)
+            mod.pre_write(io, off, len);
         const uint64_t t0 = now_usec();
-        if (is_write)
-            memcpy(base + off, io, len);
-        else
+        if (rd)
             memcpy(io, base + off, len);
+        else
+            memcpy(base + off, io, len);
         out_lat_usec[i] = now_usec() - t0;
+        if (rd) {
+            const int vret = mod.post_read(io, off, len, i);
+            if (vret != 0)
+                return vret;
+        }
         bytes_done += len;
     }
     *out_bytes = bytes_done;
     return 0;
+}
+
+int ioengine_run_mmap_loop(void* map_base, const uint64_t* offsets,
+                           const uint64_t* lengths, uint64_t n,
+                           int is_write, void* buf,
+                           uint64_t* out_lat_usec, uint64_t* out_bytes,
+                           int* interrupt_flag) {
+    return ioengine_run_mmap_loop2(map_base, offsets, lengths, n, is_write,
+                                   buf, out_lat_usec, out_bytes,
+                                   interrupt_flag, nullptr, 0, 0, 0, 0,
+                                   nullptr);
 }
 
 // 1 if this kernel accepts io_uring_setup (it may be compiled out or
@@ -1067,7 +1192,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 4 (sync+aio+uring+fileloop+blockmods)";
+    return "elbencho-tpu ioengine 5 (sync+aio+uring+fileloop+blockmods)";
 }
 
 }  // extern "C"

@@ -33,7 +33,7 @@ ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 4
+EXPECTED_ABI = 5
 
 _EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
 
@@ -65,6 +65,51 @@ def _as_ptr(values, n, np_dtype_name, c_type):
 
 def _as_u64_ptr(values, n):
     return _as_ptr(values, n, "uint64", ctypes.c_uint64)
+
+
+def _account_chunk(worker, lat_arr, lengths_np, n: int, bytes_done: int,
+                   total_bytes: int, op_is_read) -> None:
+    """Post-chunk accounting shared by the block and mmap loops: on a
+    complete chunk, latencies and counters are attributed exactly (split
+    into the rwmix-read counters when per-op flags are present); on an
+    interrupted chunk, completions can be out of order (AIO), so only the
+    done-prefix estimate is booked and latencies are skipped — with flags
+    the prefix split keeps the read/write ratio roughly right (exact for
+    the in-order sync/mmap paths)."""
+    import numpy as np
+    if bytes_done == total_bytes:
+        lat = np.frombuffer(lat_arr, dtype=np.uint64)
+        if op_is_read is not None and op_is_read.any():
+            rd = op_is_read.astype(bool)
+            worker.iops_latency_histo_rwmix.add_latencies_array(lat[rd])
+            worker.iops_latency_histo.add_latencies_array(lat[~rd])
+            n_read = int(rd.sum())
+            read_bytes = int(lengths_np[rd].sum())
+            worker.live_ops_rwmix_read.num_iops_done += n_read
+            worker.live_ops_rwmix_read.num_bytes_done += read_bytes
+            worker.live_ops.num_iops_done += n - n_read
+            worker.live_ops.num_bytes_done += total_bytes - read_bytes
+        else:
+            worker.iops_latency_histo.add_latencies_array(lat)
+            worker.live_ops.num_iops_done += n
+            worker.live_ops.num_bytes_done += bytes_done
+    else:
+        avg_len = max(total_bytes // n, 1)
+        done = min(n, bytes_done // avg_len)
+        if op_is_read is not None and done:
+            rd = op_is_read[:done].astype(bool)
+            n_read = int(rd.sum())
+            read_bytes = int(lengths_np[:done][rd].sum())
+            worker.live_ops_rwmix_read.num_iops_done += n_read
+            worker.live_ops_rwmix_read.num_bytes_done += read_bytes
+            worker.live_ops.num_iops_done += done - n_read
+            worker.live_ops.num_bytes_done += \
+                max(bytes_done - read_bytes, 0)
+        else:
+            worker.live_ops.num_iops_done += done
+            worker.live_ops.num_bytes_done += bytes_done
+    worker._num_iops_submitted += n
+    worker.create_stonewall_stats_if_triggered()
 
 
 class _NativeEngine:
@@ -101,8 +146,8 @@ class _NativeEngine:
         # catch instead of crashing at call time
         lib.ioengine_version.restype = ctypes.c_char_p
         lib.ioengine_version.argtypes = []
-        lib.ioengine_run_mmap_loop.restype = ctypes.c_int
-        lib.ioengine_run_mmap_loop.argtypes = [
+        lib.ioengine_run_mmap_loop2.restype = ctypes.c_int
+        lib.ioengine_run_mmap_loop2.argtypes = [
             ctypes.c_void_p,                  # mapping base address
             ctypes.POINTER(ctypes.c_uint64),  # offsets
             ctypes.POINTER(ctypes.c_uint64),  # lengths
@@ -112,6 +157,12 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: latencies
             ctypes.POINTER(ctypes.c_uint64),  # out: bytes done
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
+            ctypes.POINTER(ctypes.c_ubyte),   # rwmix per-op read flags
+            ctypes.c_uint64,                  # verify salt
+            ctypes.c_int,                     # do_verify
+            ctypes.c_int,                     # block variance pct
+            ctypes.c_uint64,                  # block variance seed
+            ctypes.POINTER(ctypes.c_uint64),  # out: verify mismatch info[4]
         ]
         lib.ioengine_net_client_loop.restype = ctypes.c_int
         lib.ioengine_net_client_loop.argtypes = [
@@ -140,8 +191,8 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: open connections left
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
         ]
-        lib.ioengine_run_file_loop.restype = ctypes.c_int
-        lib.ioengine_run_file_loop.argtypes = [
+        lib.ioengine_run_file_loop2.restype = ctypes.c_int
+        lib.ioengine_run_file_loop2.argtypes = [
             ctypes.c_char_p,                  # NUL-separated paths blob
             ctypes.POINTER(ctypes.c_uint32),  # per-path blob offsets
             ctypes.c_uint64,                  # num files
@@ -159,6 +210,14 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: entries done
             ctypes.POINTER(ctypes.c_uint64),  # out: failing file index
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
+            ctypes.c_uint64,                  # verify salt
+            ctypes.c_int,                     # do_verify
+            ctypes.c_int,                     # block variance pct
+            ctypes.c_uint64,                  # block variance seed
+            ctypes.c_int,                     # rwmix read pct (write op)
+            ctypes.c_uint64,                  # rwmix base (rank+submitted)
+            ctypes.POINTER(ctypes.c_uint64),  # out: verify mismatch info[4]
+            ctypes.POINTER(ctypes.c_uint64),  # out: rwmix {blocks, bytes}
         ]
 
     def uring_supported(self) -> bool:
@@ -181,12 +240,18 @@ class _NativeEngine:
     def run_file_loop(self, paths: "list[str]", op: str, open_flags: int,
                       file_size: int, block_size: int, buf_addr: int,
                       ignore_delete_errors: bool, worker,
-                      interrupt_flag=None, ranges=None) -> None:
+                      interrupt_flag=None, ranges=None,
+                      verify_salt: int = 0, block_var_pct: int = 0,
+                      block_var_seed: int = 0,
+                      rwmix_pct: int = 0) -> None:
         """Dir-mode LOSF hot path: open->blocks->close (or stat/unlink)
         per file, entirely in C++. Counters/histograms update after the
         call; partial (interrupted) chunks attribute only completed
         files. ranges: optional (starts, lens) uint64 arrays for
-        custom-tree per-file byte slices (default: [0, file_size))."""
+        custom-tree per-file byte slices (default: [0, file_size)).
+        verify/rwmix/variance run inside the loop (FileLoopMod); a
+        verify mismatch raises NativeVerifyError with the global block
+        index."""
         import numpy as np
         n = len(paths)
         encoded = [os.fsencode(p) for p in paths]
@@ -215,14 +280,24 @@ class _NativeEngine:
         bytes_done = ctypes.c_uint64(0)
         entries_done = ctypes.c_uint64(0)
         fail_idx = ctypes.c_uint64(0)
+        verify_info = (ctypes.c_uint64 * 4)()
+        rwmix_out = (ctypes.c_uint64 * 2)()
+        rwmix_base = worker.rank + worker._num_iops_submitted
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))
-        ret = self._lib.ioengine_run_file_loop(
+        ret = self._lib.ioengine_run_file_loop2(
             blob, offs, n, self.FILE_OPS[op], open_flags, file_size,
             block_size, ctypes.c_void_p(buf_addr), starts_arr, lens_arr,
             1 if ignore_delete_errors else 0, entry_lat, block_lat,
             ctypes.byref(bytes_done), ctypes.byref(entries_done),
-            ctypes.byref(fail_idx), ctypes.byref(interrupt))
+            ctypes.byref(fail_idx), ctypes.byref(interrupt),
+            verify_salt, 1 if verify_salt else 0, block_var_pct,
+            block_var_seed, rwmix_pct, rwmix_base, verify_info, rwmix_out)
+        if ret == -_EILSEQ:
+            raise NativeVerifyError(int(verify_info[0]),
+                                    int(verify_info[1]),
+                                    int(verify_info[2]),
+                                    int(verify_info[3]))
         if ret < 0:
             failed = paths[min(fail_idx.value, n - 1)]
             raise OSError(-ret, f"{os.strerror(-ret)} "
@@ -235,12 +310,23 @@ class _NativeEngine:
             num_blocks = int(per_file_blocks[:done].sum())
         else:
             num_blocks = done * (total_blocks // n if n else 0)
+        rwmix_blocks, rwmix_bytes = rwmix_out[0], rwmix_out[1]
         if num_blocks:
-            worker.iops_latency_histo.add_latencies_array(
-                np.frombuffer(block_lat, dtype=np.uint64)[:num_blocks])
+            lat = np.frombuffer(block_lat, dtype=np.uint64)[:num_blocks]
+            if rwmix_pct and op == "write" and rwmix_blocks:
+                # same in-loop modulo as the engine: flags are exact
+                rd = (((np.uint64(rwmix_base)
+                        + np.arange(num_blocks, dtype=np.uint64))
+                       % np.uint64(100)) < np.uint64(rwmix_pct))
+                worker.iops_latency_histo_rwmix.add_latencies_array(lat[rd])
+                worker.iops_latency_histo.add_latencies_array(lat[~rd])
+            else:
+                worker.iops_latency_histo.add_latencies_array(lat)
         worker.live_ops.num_entries_done += done
-        worker.live_ops.num_iops_done += num_blocks
-        worker.live_ops.num_bytes_done += bytes_done.value
+        worker.live_ops.num_iops_done += num_blocks - rwmix_blocks
+        worker.live_ops.num_bytes_done += bytes_done.value - rwmix_bytes
+        worker.live_ops_rwmix_read.num_iops_done += rwmix_blocks
+        worker.live_ops_rwmix_read.num_bytes_done += rwmix_bytes
         worker._num_iops_submitted += num_blocks
         worker.create_stonewall_stats_if_triggered()
 
@@ -298,30 +384,40 @@ class _NativeEngine:
 
     def run_mmap_loop(self, map_addr: int, offsets, lengths,
                       is_write: bool, buf_addr: int, worker,
-                      interrupt_flag=None) -> None:
+                      interrupt_flag=None, op_is_read=None,
+                      verify_salt: int = 0, block_var_pct: int = 0,
+                      block_var_seed: int = 0) -> None:
         """--mmap hot loop: memcpy between the mapping and the io buffer
-        entirely in C++ (same accounting as run_block_loop)."""
+        entirely in C++ (same accounting and block modifiers as
+        run_block_loop)."""
         import numpy as np
         n = len(offsets)
         lat_arr = (ctypes.c_uint64 * n)()
         bytes_done = ctypes.c_uint64(0)
+        verify_info = (ctypes.c_uint64 * 4)()
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))
-        ret = self._lib.ioengine_run_mmap_loop(
+        flags_arr = None
+        if op_is_read is not None:
+            flags_arr = _as_ptr(op_is_read, n, "uint8", ctypes.c_ubyte)
+        ret = self._lib.ioengine_run_mmap_loop2(
             ctypes.c_void_p(map_addr), _as_u64_ptr(offsets, n),
             _as_u64_ptr(lengths, n), n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), lat_arr, ctypes.byref(bytes_done),
-            ctypes.byref(interrupt))
+            ctypes.byref(interrupt), flags_arr, verify_salt,
+            1 if verify_salt else 0, block_var_pct, block_var_seed,
+            verify_info)
+        if ret == -_EILSEQ:
+            raise NativeVerifyError(int(verify_info[0]),
+                                    int(verify_info[1]),
+                                    int(verify_info[2]),
+                                    int(verify_info[3]))
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
-        total = int(lengths.sum()) if isinstance(lengths, np.ndarray) \
-            else sum(lengths)
-        if bytes_done.value == total:  # not interrupted mid-chunk
-            worker.iops_latency_histo.add_latencies_array(
-                np.frombuffer(lat_arr, dtype=np.uint64))
-            worker.live_ops.num_iops_done += n
-        worker.live_ops.num_bytes_done += bytes_done.value
-        worker.create_stonewall_stats_if_triggered()
+        lengths_np = (lengths if isinstance(lengths, np.ndarray)
+                      else np.asarray(lengths, dtype=np.uint64))
+        _account_chunk(worker, lat_arr, lengths_np, n, bytes_done.value,
+                       int(lengths_np.sum()), op_is_read)
 
     def run_block_loop(self, fd: int, offsets, lengths, is_write: bool,
                        buf_addr: int, iodepth: int, worker,
@@ -376,50 +472,10 @@ class _NativeEngine:
                                     int(verify_info[3]))
         if ret < 0:
             raise OSError(-ret, os.strerror(-ret))
-        total_bytes = int(lengths.sum()) if isinstance(lengths, np.ndarray) \
-            else sum(lengths)
         lengths_np = (lengths if isinstance(lengths, np.ndarray)
                       else np.asarray(lengths, dtype=np.uint64))
-        if bytes_done.value == total_bytes:
-            lat = np.frombuffer(lat_arr, dtype=np.uint64)
-            if op_is_read is not None and op_is_read.any():
-                # rwmix write phase: reads go to the rwmix-read counters
-                # (reference: separate LiveOps/histogram pair, Worker.h)
-                rd = op_is_read.astype(bool)
-                worker.iops_latency_histo_rwmix.add_latencies_array(lat[rd])
-                worker.iops_latency_histo.add_latencies_array(lat[~rd])
-                n_read = int(rd.sum())
-                read_bytes = int(lengths_np[rd].sum())
-                worker.live_ops_rwmix_read.num_iops_done += n_read
-                worker.live_ops_rwmix_read.num_bytes_done += read_bytes
-                worker.live_ops.num_iops_done += n - n_read
-                worker.live_ops.num_bytes_done += total_bytes - read_bytes
-            else:
-                worker.iops_latency_histo.add_latencies_array(lat)
-                worker.live_ops.num_iops_done += n
-                worker.live_ops.num_bytes_done += bytes_done.value
-        else:
-            # interrupted chunk: AIO completes out of order, so per-block
-            # latencies can't be attributed reliably — count bytes/ops only
-            # (the phase is being aborted; its results are partial anyway).
-            # With rwmix flags the done-prefix split keeps the read/write
-            # ratio roughly right (exact for the in-order sync engine).
-            avg_len = max(total_bytes // n, 1)
-            done = min(n, bytes_done.value // avg_len)
-            if op_is_read is not None and done:
-                rd = op_is_read[:done].astype(bool)
-                n_read = int(rd.sum())
-                read_bytes = int(lengths_np[:done][rd].sum())
-                worker.live_ops_rwmix_read.num_iops_done += n_read
-                worker.live_ops_rwmix_read.num_bytes_done += read_bytes
-                worker.live_ops.num_iops_done += done - n_read
-                worker.live_ops.num_bytes_done += \
-                    max(bytes_done.value - read_bytes, 0)
-            else:
-                worker.live_ops.num_iops_done += done
-                worker.live_ops.num_bytes_done += bytes_done.value
-        worker._num_iops_submitted += n
-        worker.create_stonewall_stats_if_triggered()
+        _account_chunk(worker, lat_arr, lengths_np, n, bytes_done.value,
+                       int(lengths_np.sum()), op_is_read)
         return True
 
 

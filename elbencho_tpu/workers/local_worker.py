@@ -400,7 +400,6 @@ class LocalWorker(Worker):
         dirModeIterateFiles is native there by construction)."""
         cfg = self.cfg
         return (self._native_loop_eligible(native)
-                and not self._block_mods_active()
                 and phase in self._NATIVE_FILE_OPS
                 and cfg.io_engine in ("auto", "sync")
                 and cfg.io_depth <= 1
@@ -437,6 +436,7 @@ class LocalWorker(Worker):
             # stat/unlink: no block I/O, only path batching
             chunk = self._NATIVE_CHUNK_MAX_BLOCKS
         paths: "list[str]" = []
+        from ..utils.native import NativeVerifyError
 
         def submit():
             self.check_interruption_request(force=True)
@@ -446,7 +446,22 @@ class LocalWorker(Worker):
                     # stat/unlink (and 0-byte files) never touch the buffer
                     buf_addr=self._buf_addr() if self._io_bufs else 0,
                     ignore_delete_errors=cfg.ignore_delete_errors,
-                    worker=self, interrupt_flag=self._native_interrupt)
+                    worker=self, interrupt_flag=self._native_interrupt,
+                    verify_salt=cfg.integrity_check_salt,
+                    block_var_pct=cfg.block_variance_pct,
+                    block_var_seed=((self.rank << 32)
+                                    ^ self._num_iops_submitted),
+                    rwmix_pct=cfg.rwmix_read_pct
+                    if phase == BenchPhase.CREATEFILES else 0)
+            except NativeVerifyError as err:
+                bpf = max((cfg.file_size + cfg.block_size - 1)
+                          // cfg.block_size, 1)
+                file_off = (err.block_idx % bpf) * cfg.block_size \
+                    + err.word_idx * 8
+                raise WorkerException(
+                    f"data integrity check failed at file offset "
+                    f"{file_off} of {paths[err.block_idx // bpf]}: "
+                    f"expected {err.want:#x}, got {err.got:#x}") from None
             except FileNotFoundError as err:
                 if phase == BenchPhase.CREATEFILES \
                         and not cfg.run_create_dirs:
@@ -735,15 +750,6 @@ class LocalWorker(Worker):
                 and (not cfg.block_variance_pct
                      or cfg.block_variance_algo == "fast"))
 
-    def _block_mods_active(self) -> bool:
-        """True when a per-block modifier (verify fill/check, rwmix per-op
-        split, variance refill) is configured. The main block loops run
-        these natively; loops without modifier support (mmap memcpy, LOSF
-        whole-file) must fall back to Python when any is active."""
-        cfg = self.cfg
-        return bool(cfg.integrity_check_salt or cfg.rwmix_read_pct
-                    or cfg.block_variance_pct)
-
     #: bounds for one native engine call, so live stats progress and
     #: interrupts stay responsive (shared by every native delegation)
     _NATIVE_CHUNK_MAX_BLOCKS = 8192
@@ -935,8 +941,7 @@ class LocalWorker(Worker):
                 gen = self._make_offset_gen_for_file(is_write)
             from ..utils.native import get_native_engine
             native = get_native_engine()
-            if self._native_loop_eligible(native) \
-                    and not self._block_mods_active():
+            if self._native_loop_eligible(native):
                 self._run_native_mmap_loop(native, mapped, gen, is_write)
                 return
             num_bufs = len(self._io_bufs)
@@ -966,7 +971,10 @@ class LocalWorker(Worker):
 
     def _run_native_mmap_loop(self, native, mapped, gen, is_write) -> None:
         """Chunked C++ memcpy loop over the mapping (the --mmap analogue
-        of _run_native_block_loop; same eligibility idea)."""
+        of _run_native_block_loop; same eligibility and block-modifier
+        handling)."""
+        from ..utils.native import NativeVerifyError
+        cfg = self.cfg
         # np.frombuffer works for read-only PROT_READ mappings too (ctypes
         # from_buffer would demand writability); the address stays valid
         # while `mapped` is open
@@ -977,10 +985,30 @@ class LocalWorker(Worker):
             if batch is None:
                 break
             self.check_interruption_request(force=True)
-            native.run_mmap_loop(
-                map_addr, batch[0], batch[1], is_write,
-                buf_addr=self._buf_addr(), worker=self,
-                interrupt_flag=self._native_interrupt)
+            offsets, lengths = batch
+            n = len(offsets)
+            flags = None
+            if is_write and cfg.rwmix_read_pct:
+                base = np.uint64(self.rank + self._num_iops_submitted)
+                flags = (((base + np.arange(n, dtype=np.uint64))
+                          % np.uint64(100))
+                         < np.uint64(cfg.rwmix_read_pct)).astype(np.uint8)
+            try:
+                native.run_mmap_loop(
+                    map_addr, offsets, lengths, is_write,
+                    buf_addr=self._buf_addr(), worker=self,
+                    interrupt_flag=self._native_interrupt,
+                    op_is_read=flags,
+                    verify_salt=cfg.integrity_check_salt,
+                    block_var_pct=cfg.block_variance_pct,
+                    block_var_seed=((self.rank << 32)
+                                    ^ self._num_iops_submitted))
+            except NativeVerifyError as err:
+                file_off = int(offsets[err.block_idx]) + err.word_idx * 8
+                raise WorkerException(
+                    f"data integrity check failed at file offset "
+                    f"{file_off}: expected {err.want:#x}, "
+                    f"got {err.got:#x}") from None
 
     def _apply_madvise(self, mapped: mmap.mmap) -> None:
         flags_str = self.cfg.madvise_flags
@@ -1192,14 +1220,44 @@ class LocalWorker(Worker):
         lens: "list[int]" = []
         chunk_bytes = 0
 
+        from ..utils.native import NativeVerifyError
+
         def submit():
             self.check_interruption_request(force=True)
-            native.run_file_loop(
-                paths, op, open_flags, cfg.file_size, cfg.block_size,
-                buf_addr=self._buf_addr() if self._io_bufs else 0,
-                ignore_delete_errors=cfg.ignore_delete_errors,
-                worker=self, interrupt_flag=self._native_interrupt,
-                ranges=(starts, lens) if op in ("write", "read") else None)
+            try:
+                native.run_file_loop(
+                    paths, op, open_flags, cfg.file_size, cfg.block_size,
+                    buf_addr=self._buf_addr() if self._io_bufs else 0,
+                    ignore_delete_errors=cfg.ignore_delete_errors,
+                    worker=self, interrupt_flag=self._native_interrupt,
+                    ranges=(starts, lens) if op in ("write", "read")
+                    else None,
+                    verify_salt=cfg.integrity_check_salt,
+                    block_var_pct=cfg.block_variance_pct,
+                    block_var_seed=((self.rank << 32)
+                                    ^ self._num_iops_submitted),
+                    rwmix_pct=cfg.rwmix_read_pct
+                    if phase == BenchPhase.CREATEFILES else 0)
+            except NativeVerifyError as err:
+                # map the global block index back through the per-file
+                # [range_start, range_len) slices
+                blk = err.block_idx
+                for path, r_start, r_len in zip(paths, starts, lens):
+                    # zero-length files contribute zero blocks, exactly
+                    # like the engine's per-file block count
+                    n_blocks = (r_len + cfg.block_size - 1) \
+                        // cfg.block_size
+                    if blk < n_blocks:
+                        off = r_start + blk * cfg.block_size \
+                            + err.word_idx * 8
+                        raise WorkerException(
+                            f"data integrity check failed at file offset "
+                            f"{off} of {path}: expected {err.want:#x}, "
+                            f"got {err.got:#x}") from None
+                    blk -= n_blocks
+                raise WorkerException(
+                    f"data integrity check failed (block {err.block_idx}): "
+                    f"expected {err.want:#x}, got {err.got:#x}") from None
 
         for elem in my_files:
             paths.append(os.path.join(base, elem.path))

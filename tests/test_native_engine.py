@@ -542,3 +542,129 @@ def test_verify_and_rwmix_async_engines(tmp_path, monkeypatch, eng):
     # rwmix through the async engine
     assert main(["-w", "--rwmixpct", "30"] + args) == 0
     native_mod.reset_native_engine_cache()
+
+
+def test_losf_verify_in_native_file_loop(tmp_path, monkeypatch, capsys):
+    """Dir-mode LOSF with --verify stays on the whole-file C++ loop
+    (FileLoopMod), round-trips, and reports exact offsets on corruption."""
+    native_mod, native = _native_or_skip(monkeypatch)
+    calls = []
+    orig = type(native).run_file_loop
+
+    def spy(self, paths, op, *a, **kw):
+        calls.append((op, kw.get("verify_salt")))
+        return orig(self, paths, op, *a, **kw)
+
+    monkeypatch.setattr(type(native), "run_file_loop", spy)
+    from elbencho_tpu.cli import main
+    args = ["-t", "1", "-n", "2", "-N", "3", "-s", "48K", "-b", "16K",
+            "--verify", "21", "--nolive", str(tmp_path)]
+    assert main(["-w", "-d", "-r"] + args) == 0
+    assert ("write", 21) in calls and ("read", 21) in calls, calls
+    # pattern on disk matches the per-file word formula
+    import numpy as np
+    f = next(tmp_path.rglob("r0-f1"))
+    words = np.frombuffer(f.read_bytes(), dtype=np.uint64)
+    want = np.arange(len(words), dtype=np.uint64) * 8 + np.uint64(21)
+    assert (words == want).all()
+    # corrupt a byte in the SECOND file -> error names file + offset
+    data = bytearray(f.read_bytes())
+    data[20000] ^= 0xFF
+    f.write_bytes(bytes(data))
+    assert main(["-r"] + args) != 0
+    err = capsys.readouterr().err
+    assert "file offset 20000" in err and "r0-f1" in err, err[-500:]
+    native_mod.reset_native_engine_cache()
+
+
+def test_losf_rwmix_native_accounting(tmp_path, monkeypatch):
+    """LOSF write phase with --rwmixpct: native loop engaged, rwmix reads
+    accounted separately and exactly."""
+    native_mod, native = _native_or_skip(monkeypatch)
+    from elbencho_tpu.cli import main
+    import json as json_mod
+    args = ["-t", "1", "-n", "1", "-N", "4", "-s", "64K", "-b", "4K",
+            "--nolive", str(tmp_path)]
+    assert main(["-w", "-d"] + args) == 0  # pre-create
+    jf = tmp_path / "res.json"
+    assert main(["-w", "--rwmixpct", "25", "--jsonfile", str(jf)]
+                + args) == 0
+    rec = next(json_mod.loads(ln) for ln in jf.read_text().splitlines()
+               if json_mod.loads(ln)["Phase"] == "WRITE")
+    total_blocks = 4 * (64 // 4)
+    mix_iops = rec["RWMixReadIOPSLast"] * rec["ElapsedUSecLast"] / 1e6
+    write_iops = rec["IOPSLast"] * rec["ElapsedUSecLast"] / 1e6
+    # 25% of ops read; totals reconstruct the block count (+-rounding)
+    assert abs(mix_iops + write_iops - total_blocks) <= 2, rec
+    assert mix_iops > 0
+    native_mod.reset_native_engine_cache()
+
+
+def test_mmap_verify_in_native_loop(tmp_path, monkeypatch, capsys):
+    """--mmap with --verify runs the C++ memcpy loop with in-loop
+    fill/check (previously Python-only)."""
+    native_mod, native = _native_or_skip(monkeypatch)
+    calls = []
+    orig = type(native).run_mmap_loop
+
+    def spy(self, *a, **kw):
+        calls.append(kw.get("verify_salt"))
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(type(native), "run_mmap_loop", spy)
+    from elbencho_tpu.cli import main
+    target = tmp_path / "m"
+    args = ["--mmap", "-t", "1", "-s", "64K", "-b", "16K", "--verify",
+            "33", "--nolive", str(target)]
+    assert main(["-w", "-r"] + args) == 0
+    assert 33 in calls, calls
+    data = bytearray(target.read_bytes())
+    data[33000] ^= 0x01
+    target.write_bytes(bytes(data))
+    assert main(["-r"] + args) != 0
+    assert "file offset 33000" in capsys.readouterr().err
+    native_mod.reset_native_engine_cache()
+
+
+def test_tree_verify_in_native_loop(tmp_path, monkeypatch, capsys):
+    """Custom-tree phases keep the native per-file-range loop with
+    --verify; a corrupted shared-file slice reports path + offset."""
+    native_mod, native = _native_or_skip(monkeypatch)
+    from elbencho_tpu.cli import main
+    tree = tmp_path / "tree.txt"
+    tree.write_text("f 16384 d1/a\nf 131072 big\n")
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    args = ["-t", "2", "-b", "16K", "--treefile", str(tree),
+            "--sharesize", "32K", "--verify", "5", "--nolive", str(bench)]
+    assert main(["-w"] + args) == 0
+    assert main(["-r"] + args) == 0
+    data = bytearray((bench / "big").read_bytes())
+    data[100000] ^= 0xFF
+    (bench / "big").write_bytes(bytes(data))
+    assert main(["-r"] + args) != 0
+    err = capsys.readouterr().err
+    assert "big" in err and "file offset 100000" in err, err[-400:]
+    native_mod.reset_native_engine_cache()
+
+
+def test_tree_verify_offset_with_zero_length_files(tmp_path, monkeypatch,
+                                                   capsys):
+    """Zero-length tree entries contribute zero blocks: the corruption
+    report must still name the right file and exact offset."""
+    native_mod, native = _native_or_skip(monkeypatch)
+    from elbencho_tpu.cli import main
+    tree = tmp_path / "tree.txt"
+    tree.write_text("f 0 empty1\nf 0 empty2\nf 65536 big\n")
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    args = ["-t", "1", "-b", "16K", "--treefile", str(tree),
+            "--verify", "5", "--nolive", str(bench)]
+    assert main(["-w"] + args) == 0
+    data = bytearray((bench / "big").read_bytes())
+    data[40000] ^= 0xFF
+    (bench / "big").write_bytes(bytes(data))
+    assert main(["-r"] + args) != 0
+    err = capsys.readouterr().err
+    assert "big" in err and "file offset 40000" in err, err[-400:]
+    native_mod.reset_native_engine_cache()
