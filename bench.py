@@ -134,10 +134,14 @@ def main() -> int:
                 f"errors: {' | '.join(e[-300:] for e in pass_errors)}")
         passes.sort(key=lambda p: p[0])
         med_mibs, med_rec = passes[len(passes) // 2]
+        # per-chip ingest over PHASE WALL TIME: per-worker transfer-busy
+        # usecs overlap across threads, so summing them (TpuPerChip.USec)
+        # would understate a chip's delivered bandwidth
+        wall_s = med_rec.get("ElapsedUSecLast", 0) / 1e6
         per_chip = {
-            chip: round(v["Bytes"] / 1048576 / (v["USec"] / 1e6), 1)
+            chip: round(v["Bytes"] / 1048576 / wall_s, 1)
             for chip, v in med_rec.get("TpuPerChip", {}).items()
-            if v.get("USec")}
+            if wall_s > 0}
         sys.path.insert(0, REPO)
         from elbencho_tpu.stats.latency_histogram import LatencyHistogram
         histo = LatencyHistogram.from_dict(med_rec.get("IOLatHisto", {}))

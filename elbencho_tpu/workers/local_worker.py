@@ -449,8 +449,7 @@ class LocalWorker(Worker):
                     worker=self, interrupt_flag=self._native_interrupt,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
-                    block_var_seed=((self.rank << 32)
-                                    ^ self._num_iops_submitted),
+                    block_var_seed=self._block_var_seed(),
                     rwmix_pct=cfg.rwmix_read_pct
                     if phase == BenchPhase.CREATEFILES else 0)
             except NativeVerifyError as err:
@@ -461,7 +460,8 @@ class LocalWorker(Worker):
                 raise WorkerException(
                     f"data integrity check failed at file offset "
                     f"{file_off} of {paths[err.block_idx // bpf]}: "
-                    f"expected {err.want:#x}, got {err.got:#x}") from None
+                    f"expected {err.want:#x}, got {err.got:#x}"
+                    + self._verify_fail_hint(err.got)) from None
             except FileNotFoundError as err:
                 if phase == BenchPhase.CREATEFILES \
                         and not cfg.run_create_dirs:
@@ -786,15 +786,10 @@ class LocalWorker(Worker):
                 if file_offset_base:
                     offsets = offsets + np.uint64(file_offset_base)
                 fds = idx = None
-            n = len(offsets)
-            flags = None
-            if is_write and cfg.rwmix_read_pct:
-                # per-op modulo split, vectorized (reference:
-                # (workerRank+numIOPSSubmitted)%100 < pct, :1741-1742)
-                base = np.uint64(self.rank + self._num_iops_submitted)
-                flags = (((base + np.arange(n, dtype=np.uint64))
-                          % np.uint64(100))
-                         < np.uint64(cfg.rwmix_read_pct)).astype(np.uint8)
+            # per-op modulo split, vectorized (reference:
+            # (workerRank+numIOPSSubmitted)%100 < pct, :1741-1742)
+            flags = self._rwmix_read_flags(len(offsets)) if is_write \
+                else None
             try:
                 native.run_block_loop(
                     fd=fd, offsets=offsets, lengths=lengths,
@@ -805,15 +800,14 @@ class LocalWorker(Worker):
                     op_is_read=flags,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
-                    # vary the refill stream per worker and per chunk
-                    block_var_seed=((self.rank << 32)
-                                    ^ self._num_iops_submitted))
+                    block_var_seed=self._block_var_seed())
             except NativeVerifyError as err:
                 file_off = int(offsets[err.block_idx]) + err.word_idx * 8
                 raise WorkerException(
                     f"data integrity check failed at file offset "
                     f"{file_off}: expected {err.want:#x}, "
-                    f"got {err.got:#x}") from None
+                    f"got {err.got:#x}"
+                    + self._verify_fail_hint(err.got)) from None
 
         while True:
             batch = gen.next_batch(chunk)
@@ -826,6 +820,29 @@ class LocalWorker(Worker):
         import ctypes
         return ctypes.addressof(
             ctypes.c_char.from_buffer(self._io_buf_mmaps[0]))
+
+    def _rwmix_read_flags(self, n: int) -> "np.ndarray | None":
+        """Per-op rwmix read flags for the next n ops of a write phase —
+        the vectorized form of _rwmix_decides_read, bit-identical to the
+        engine's (rwmix_base + block_idx) % 100 sequence."""
+        pct = self.cfg.rwmix_read_pct
+        if not pct:
+            return None
+        base = np.uint64(self.rank + self._num_iops_submitted)
+        return (((base + np.arange(n, dtype=np.uint64)) % np.uint64(100))
+                < np.uint64(pct)).astype(np.uint8)
+
+    def _block_var_seed(self) -> int:
+        """Variance-refill seed, varied per worker and per chunk."""
+        return (self.rank << 32) ^ self._num_iops_submitted
+
+    @staticmethod
+    def _verify_fail_hint(got: int) -> str:
+        """An all-zero mismatch usually means an unwritten/sparse region
+        was read (e.g. rwmix reads against a file still being created),
+        not on-disk corruption — say so instead of crying corruption."""
+        return (" (read of an unwritten/sparse region?)"
+                if got == 0 else "")
 
     def _rwmix_decides_read(self) -> bool:
         """Per-op modulo split (reference: (workerRank+numIOPSSubmitted)%100
@@ -986,13 +1003,8 @@ class LocalWorker(Worker):
                 break
             self.check_interruption_request(force=True)
             offsets, lengths = batch
-            n = len(offsets)
-            flags = None
-            if is_write and cfg.rwmix_read_pct:
-                base = np.uint64(self.rank + self._num_iops_submitted)
-                flags = (((base + np.arange(n, dtype=np.uint64))
-                          % np.uint64(100))
-                         < np.uint64(cfg.rwmix_read_pct)).astype(np.uint8)
+            flags = self._rwmix_read_flags(len(offsets)) if is_write \
+                else None
             try:
                 native.run_mmap_loop(
                     map_addr, offsets, lengths, is_write,
@@ -1001,14 +1013,17 @@ class LocalWorker(Worker):
                     op_is_read=flags,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
-                    block_var_seed=((self.rank << 32)
-                                    ^ self._num_iops_submitted))
+                    block_var_seed=self._block_var_seed())
             except NativeVerifyError as err:
+                # mmap reads of unwritten sparse regions memcpy zeros (no
+                # short-read signal like the pread loops) — the hint below
+                # covers that case
                 file_off = int(offsets[err.block_idx]) + err.word_idx * 8
                 raise WorkerException(
                     f"data integrity check failed at file offset "
                     f"{file_off}: expected {err.want:#x}, "
-                    f"got {err.got:#x}") from None
+                    f"got {err.got:#x}"
+                    + self._verify_fail_hint(err.got)) from None
 
     def _apply_madvise(self, mapped: mmap.mmap) -> None:
         flags_str = self.cfg.madvise_flags
@@ -1234,14 +1249,14 @@ class LocalWorker(Worker):
                     else None,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
-                    block_var_seed=((self.rank << 32)
-                                    ^ self._num_iops_submitted),
+                    block_var_seed=self._block_var_seed(),
                     rwmix_pct=cfg.rwmix_read_pct
                     if phase == BenchPhase.CREATEFILES else 0)
             except NativeVerifyError as err:
                 # map the global block index back through the per-file
                 # [range_start, range_len) slices
                 blk = err.block_idx
+                hint = self._verify_fail_hint(err.got)
                 for path, r_start, r_len in zip(paths, starts, lens):
                     # zero-length files contribute zero blocks, exactly
                     # like the engine's per-file block count
@@ -1253,11 +1268,12 @@ class LocalWorker(Worker):
                         raise WorkerException(
                             f"data integrity check failed at file offset "
                             f"{off} of {path}: expected {err.want:#x}, "
-                            f"got {err.got:#x}") from None
+                            f"got {err.got:#x}{hint}") from None
                     blk -= n_blocks
                 raise WorkerException(
                     f"data integrity check failed (block {err.block_idx}): "
-                    f"expected {err.want:#x}, got {err.got:#x}") from None
+                    f"expected {err.want:#x}, got {err.got:#x}{hint}"
+                ) from None
 
         for elem in my_files:
             paths.append(os.path.join(base, elem.path))
