@@ -174,3 +174,34 @@ def test_flags_parity_accounted():
          ref],
         capture_output=True, text=True)
     assert res.returncode == 0, res.stderr
+
+
+def test_reference_example_config_file_verbatim(tmp_path):
+    """The reference ships docs/example_configuration/random-write.elbencho
+    (flag=value ini style, '# ' comments, 1/0 bools) — our --configfile
+    must accept it verbatim (reference: -c/--configfile merge,
+    ProgArgs.cpp config-file handling)."""
+    import os
+    import shutil
+    ref = os.path.join(
+        os.environ.get("ELBENCHO_TPU_REFERENCE", "/root/reference"),
+        "docs", "example_configuration", "random-write.elbencho")
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not available")
+    cfgfile = tmp_path / "random-write.elbencho"
+    shutil.copy(ref, cfgfile)
+    cfg, _ = parse_cli(["-c", str(cfgfile), str(tmp_path / "bench")])
+    # the file documents its own equivalent command line:
+    # -t 2 --iodepth 4 --timelimit 10 -b 1M --direct -s 1G -N 10 -n 1
+    # -D -F -d -w --rand
+    assert cfg.num_threads == 2
+    assert cfg.io_depth == 4
+    assert cfg.time_limit_secs == 10
+    assert cfg.block_size == 1 << 20
+    assert cfg.use_direct_io is True
+    assert cfg.file_size == 1 << 30
+    assert cfg.num_files == 10
+    assert cfg.num_dirs == 1
+    assert cfg.run_delete_dirs and cfg.run_delete_files
+    assert cfg.run_create_dirs and cfg.run_create_files
+    assert cfg.use_random_offsets is True
