@@ -148,6 +148,44 @@ inline int verify_check(const char* buf, uint64_t off, uint64_t len,
     return 0;
 }
 
+// per-thread bytes/sec limiter state: 1-second token windows, sleep to
+// the next boundary when the budget is exhausted (reference:
+// RateLimiter.h:1-72; wired as funcRWRateLimiter in the hot loop,
+// LocalWorker.cpp:1306-1361). State lives in caller-provided memory so
+// the window survives chunked engine calls.
+struct RateState {
+    uint64_t window_start_usec;  // 0 = uninitialized
+    uint64_t bytes_in_window;
+};
+
+inline void rate_wait(uint64_t bps, RateState* rs, uint64_t nbytes,
+                      volatile int* interrupt_flag) {
+    if (!bps || !rs)
+        return;
+    uint64_t now = now_usec();
+    if (rs->window_start_usec == 0)
+        rs->window_start_usec = now;
+    const uint64_t elapsed = now - rs->window_start_usec;
+    if (elapsed >= 1000000ull) {
+        rs->window_start_usec = now;
+        rs->bytes_in_window = 0;
+    } else if (rs->bytes_in_window + nbytes > bps) {
+        // sleep to the second boundary in slices so interrupts are
+        // noticed (the Python limiter checks before each wait too)
+        uint64_t remaining = 1000000ull - elapsed;
+        while (remaining > 0) {
+            if (interrupt_flag && *interrupt_flag)
+                return;
+            const uint64_t slice = remaining > 100000 ? 100000 : remaining;
+            usleep(static_cast<useconds_t>(slice));
+            remaining -= slice;
+        }
+        rs->window_start_usec = now_usec();
+        rs->bytes_in_window = 0;
+    }
+    rs->bytes_in_window += nbytes;
+}
+
 // bundled modifier config threaded through all block loops; disabled
 // members are no-ops so the plain path stays branch-light
 struct BlockMod {
@@ -157,9 +195,21 @@ struct BlockMod {
     int var_pct = 0;
     VarRng* var_rng = nullptr;
     uint64_t* verify_info = nullptr;  // out[4] on -EILSEQ
+    uint64_t limit_read_bps = 0;
+    uint64_t limit_write_bps = 0;
+    RateState* rl_read = nullptr;
+    RateState* rl_write = nullptr;
 
     inline bool op_reads(uint64_t i, int phase_is_write) const {
         return op_is_read ? (op_is_read[i] != 0) : !phase_is_write;
+    }
+
+    inline void rate_limit(bool rd, uint64_t len,
+                           volatile int* interrupt_flag) const {
+        if (rd)
+            rate_wait(limit_read_bps, rl_read, len, interrupt_flag);
+        else
+            rate_wait(limit_write_bps, rl_write, len, interrupt_flag);
     }
 
     inline void pre_write(char* buf, uint64_t off, uint64_t len) const {
@@ -208,6 +258,7 @@ int run_sync_loop(const int* fds, const uint32_t* fd_idx,
         const uint64_t len = lengths[i];
         const uint64_t off = offsets[i];
         const bool is_read_op = mod.op_reads(i, is_write);
+        mod.rate_limit(is_read_op, len, interrupt_flag);
         if (!is_read_op)
             mod.pre_write(buf, off, len);
         const uint64_t t0 = now_usec();
@@ -273,6 +324,7 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
         while (in_flight < iodepth && next_submit < n) {
             AioSlot& s = slots[in_flight];
             const bool rd = mod.op_reads(next_submit, is_write);
+            mod.rate_limit(rd, lengths[next_submit], interrupt_flag);
             if (!rd)
                 mod.pre_write(s.buf, offsets[next_submit],
                               lengths[next_submit]);
@@ -309,7 +361,13 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
                 ret = -errno;
                 break;
             }
+            // pass 1: account every completion BEFORE any refill — the
+            // refill's rate limiter may sleep, and stamping later
+            // completions after that sleep would book limiter time as
+            // device latency
             const uint64_t t_now = now_usec();
+            AioSlot* free_slots[4];
+            int n_free = 0;
             for (int e = 0; e < got; ++e) {
                 AioSlot* s = reinterpret_cast<AioSlot*>(events[e].data);
                 const int64_t res = events[e].res;
@@ -331,31 +389,37 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
                 bytes_done += static_cast<uint64_t>(res);
                 ++completed;
                 --in_flight;
-                if (next_submit < n) {  // refill this slot
-                    const bool rd = mod.op_reads(next_submit, is_write);
-                    if (!rd)
-                        mod.pre_write(s->buf, offsets[next_submit],
-                                      lengths[next_submit]);
-                    memset(&s->cb, 0, sizeof(s->cb));
-                    s->cb.aio_fildes = static_cast<uint32_t>(
-                        fds[fd_idx ? fd_idx[next_submit] : 0]);
-                    s->cb.aio_lio_opcode =
-                        rd ? IOCB_CMD_PREAD : IOCB_CMD_PWRITE;
-                    s->cb.aio_buf = reinterpret_cast<uint64_t>(s->buf);
-                    s->cb.aio_nbytes = lengths[next_submit];
-                    s->cb.aio_offset =
-                        static_cast<int64_t>(offsets[next_submit]);
-                    s->cb.aio_data = reinterpret_cast<uint64_t>(s);
-                    s->submit_usec = now_usec();
-                    s->block_idx = next_submit;
-                    iocb* cbp = &s->cb;
-                    if (sys_io_submit(ctx, 1, &cbp) != 1) {
-                        ret = -errno;
-                        break;
-                    }
-                    ++next_submit;
-                    ++in_flight;
+                free_slots[n_free++] = s;
+            }
+            // pass 2: refill the freed slots (rate limit + fill + submit)
+            for (int f = 0; f < n_free && ret == 0; ++f) {
+                if (next_submit >= n)
+                    break;
+                AioSlot* s = free_slots[f];
+                const bool rd = mod.op_reads(next_submit, is_write);
+                mod.rate_limit(rd, lengths[next_submit], interrupt_flag);
+                if (!rd)
+                    mod.pre_write(s->buf, offsets[next_submit],
+                                  lengths[next_submit]);
+                memset(&s->cb, 0, sizeof(s->cb));
+                s->cb.aio_fildes = static_cast<uint32_t>(
+                    fds[fd_idx ? fd_idx[next_submit] : 0]);
+                s->cb.aio_lio_opcode =
+                    rd ? IOCB_CMD_PREAD : IOCB_CMD_PWRITE;
+                s->cb.aio_buf = reinterpret_cast<uint64_t>(s->buf);
+                s->cb.aio_nbytes = lengths[next_submit];
+                s->cb.aio_offset =
+                    static_cast<int64_t>(offsets[next_submit]);
+                s->cb.aio_data = reinterpret_cast<uint64_t>(s);
+                s->submit_usec = now_usec();
+                s->block_idx = next_submit;
+                iocb* cbp = &s->cb;
+                if (sys_io_submit(ctx, 1, &cbp) != 1) {
+                    ret = -errno;
+                    break;
                 }
+                ++next_submit;
+                ++in_flight;
             }
         }
     }
@@ -527,11 +591,21 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
     int queued = 0;     // SQEs written to the ring but not yet submitted
     int in_flight = 0;  // ops the kernel owns (submitted, not yet reaped) —
                         // ONLY these can DMA into slot buffers
+    // slots queued since the last enter: their submit stamps are refreshed
+    // right before the enter so rate-limiter sleeps between queue_one
+    // calls never count as device latency
+    UringSlot** pending = new UringSlot*[iodepth];
+    int n_pending = 0;
+    // completions reaped per pass before their slots are refilled; sized
+    // to the ring (cq depth can reach 2x sq, but never more slots exist
+    // than iodepth)
+    UringSlot** freed = new UringSlot*[iodepth];
 
     // queue one block on a free slot; sq tail advance is published with a
     // release store (kernel reads it with acquire semantics)
     auto queue_one = [&](UringSlot& s) {
         const bool rd = mod.op_reads(next_submit, is_write);
+        mod.rate_limit(rd, lengths[next_submit], interrupt_flag);
         if (!rd)
             mod.pre_write(s.buf, offsets[next_submit], lengths[next_submit]);
         const unsigned tail = *ring.sq_tail;
@@ -550,6 +624,7 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
         __atomic_store_n(ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
         ++next_submit;
         ++queued;
+        pending[n_pending++] = &s;
     };
 
     if (ret == 0) {
@@ -565,6 +640,12 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
             UringGetEventsArg arg;
             memset(&arg, 0, sizeof(arg));
             arg.ts = reinterpret_cast<uint64_t>(&ts);
+            // the queued SQEs only reach the kernel NOW: refresh their
+            // stamps (queue_one may have slept in the rate limiter since)
+            const uint64_t t_enter = now_usec();
+            for (int q = 0; q < n_pending; ++q)
+                pending[q]->submit_usec = t_enter;
+            n_pending = 0;
             int res = sys_io_uring_enter(
                 ring.ring_fd, static_cast<unsigned>(queued), 1,
                 IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
@@ -579,11 +660,13 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
                 in_flight += res;
                 queued -= res;
             }
-            // reap completions; refill freed slots
+            // reap completions (pass 1: account — no refill sleeps may
+            // land between a completion and its latency stamp)
             unsigned head = *ring.cq_head;
             const unsigned tail =
                 __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
             const uint64_t t_now = now_usec();
+            int n_freed = 0;
             while (head != tail && ret == 0) {
                 const io_uring_cqe& cqe = ring.cqes[head & *ring.cq_mask];
                 UringSlot* s = reinterpret_cast<UringSlot*>(cqe.user_data);
@@ -604,11 +687,14 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
                     out_lat_usec[s->block_idx] = t_now - s->submit_usec;
                     bytes_done += static_cast<uint64_t>(cqe.res);
                     ++completed;
-                    if (next_submit < n)
-                        queue_one(*s);  // refill the freed slot
+                    freed[n_freed++] = s;  // <= iodepth slots exist
                 }
             }
             __atomic_store_n(ring.cq_head, head, __ATOMIC_RELEASE);
+            // pass 2: refill freed slots (rate limit + fill + queue)
+            for (int f = 0; f < n_freed && ret == 0; ++f)
+                if (next_submit < n)
+                    queue_one(*freed[f]);
         }
     }
 
@@ -645,6 +731,8 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
     if (!drain_failed)
         for (int i = 0; i < allocated; ++i)
             free(slots[i].buf);
+    delete[] pending;
+    delete[] freed;
     delete[] slots;
     *out_bytes = bytes_done;
     return ret;
@@ -668,6 +756,10 @@ enum {
 // array of the block loops
 struct FileLoopMod {
     uint64_t verify_salt = 0;
+    uint64_t limit_read_bps = 0;
+    uint64_t limit_write_bps = 0;
+    RateState* rl_read = nullptr;
+    RateState* rl_write = nullptr;
     int do_verify = 0;
     int var_pct = 0;
     VarRng* var_rng = nullptr;
@@ -728,6 +820,12 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                     || (mod.rwmix_pct
                         && ((mod.rwmix_base + block_idx) % 100)
                            < static_cast<uint64_t>(mod.rwmix_pct));
+                if (rd)
+                    rate_wait(mod.limit_read_bps, mod.rl_read, len,
+                              interrupt_flag);
+                else
+                    rate_wait(mod.limit_write_bps, mod.rl_write, len,
+                              interrupt_flag);
                 if (!rd) {
                     if (mod.do_verify)
                         verify_fill(buf, off, len, mod.verify_salt);
@@ -791,7 +889,7 @@ enum { ENGINE_AUTO = 0, ENGINE_SYNC = 1, ENGINE_AIO = 2, ENGINE_URING = 3 };
 // loop with --verify/--rwmixpct/--blockvarpct active. out_verify_info:
 // 4 uint64 slots, {global_block_idx, word_idx, want, got} on -EILSEQ;
 // out_rwmix[2]: {blocks, bytes} read by the rwmix split of a write op.
-int ioengine_run_file_loop2(const char* paths_blob,
+int ioengine_run_file_loop3(const char* paths_blob,
                             const uint32_t* path_offs, uint64_t n_files,
                             int op, int open_flags, uint64_t file_size,
                             uint64_t block_size, void* buf,
@@ -806,7 +904,10 @@ int ioengine_run_file_loop2(const char* paths_blob,
                             int block_var_pct, uint64_t block_var_seed,
                             int rwmix_pct, uint64_t rwmix_base,
                             uint64_t* out_verify_info,
-                            uint64_t* out_rwmix) {
+                            uint64_t* out_rwmix,
+                            uint64_t limit_read_bps,
+                            uint64_t limit_write_bps,
+                            uint64_t* rl_state) {
     *out_fail_idx = 0;
     if (n_files == 0) {
         *out_bytes = 0;
@@ -825,6 +926,12 @@ int ioengine_run_file_loop2(const char* paths_blob,
     mod.rwmix_pct = (op == FILE_OP_WRITE) ? rwmix_pct : 0;
     mod.rwmix_base = rwmix_base;
     mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+    mod.limit_read_bps = limit_read_bps;
+    mod.limit_write_bps = limit_write_bps;
+    if (rl_state) {
+        mod.rl_read = reinterpret_cast<RateState*>(rl_state);
+        mod.rl_write = reinterpret_cast<RateState*>(rl_state + 2);
+    }
     if (out_rwmix) {
         mod.out_rwmix_blocks = &out_rwmix[0];
         mod.out_rwmix_bytes = &out_rwmix[1];
@@ -846,11 +953,11 @@ int ioengine_run_file_loop(const char* paths_blob,
                            uint64_t* out_entry_lat, uint64_t* out_block_lat,
                            uint64_t* out_bytes, uint64_t* out_entries,
                            uint64_t* out_fail_idx, int* interrupt_flag) {
-    return ioengine_run_file_loop2(
+    return ioengine_run_file_loop3(
         paths_blob, path_offs, n_files, op, open_flags, file_size,
         block_size, buf, range_starts, range_lens, ignore_delete_errors,
         out_entry_lat, out_block_lat, out_bytes, out_entries, out_fail_idx,
-        interrupt_flag, 0, 0, 0, 0, 0, 0, nullptr, nullptr);
+        interrupt_flag, 0, 0, 0, 0, 0, 0, nullptr, nullptr, 0, 0, nullptr);
 }
 
 // full-featured variant: adds the in-loop block modifiers (rwmix per-op
@@ -859,7 +966,10 @@ int ioengine_run_file_loop(const char* paths_blob,
 // native loop engaged like the reference's hot loop does
 // (LocalWorker.cpp:1741,2124,2242). out_verify_info must point to 4
 // uint64 slots; on -EILSEQ they hold {block_idx, word_idx, want, got}.
-int ioengine_run_block_loop3(const int* fds, const uint32_t* fd_idx,
+// adds per-thread read/write rate limits to loop3; rl_state points to 4
+// caller-owned uint64s {read.window_start, read.bytes, write.window_start,
+// write.bytes} so the 1-second windows survive chunked calls
+int ioengine_run_block_loop4(const int* fds, const uint32_t* fd_idx,
                              const uint64_t* offsets,
                              const uint64_t* lengths, uint64_t n,
                              int is_write, void* buf, uint64_t buf_size,
@@ -868,7 +978,10 @@ int ioengine_run_block_loop3(const int* fds, const uint32_t* fd_idx,
                              int engine, const unsigned char* op_is_read,
                              uint64_t verify_salt, int do_verify,
                              int block_var_pct, uint64_t block_var_seed,
-                             uint64_t* out_verify_info) {
+                             uint64_t* out_verify_info,
+                             uint64_t limit_read_bps,
+                             uint64_t limit_write_bps,
+                             uint64_t* rl_state) {
     if (n == 0) {
         *out_bytes = 0;
         return 0;
@@ -883,6 +996,12 @@ int ioengine_run_block_loop3(const int* fds, const uint32_t* fd_idx,
                                                   // Python _pre_write_fill
     mod.var_rng = &var_rng;
     mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+    mod.limit_read_bps = limit_read_bps;
+    mod.limit_write_bps = limit_write_bps;
+    if (rl_state) {
+        mod.rl_read = reinterpret_cast<RateState*>(rl_state);
+        mod.rl_write = reinterpret_cast<RateState*>(rl_state + 2);
+    }
     if (engine == ENGINE_URING)
         return run_uring_loop(fds, fd_idx, offsets, lengths, n, is_write,
                               static_cast<const char*>(buf), buf_size,
@@ -906,10 +1025,11 @@ int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
                                int iodepth, uint64_t* out_lat_usec,
                                uint64_t* out_bytes, int* interrupt_flag,
                                int engine) {
-    return ioengine_run_block_loop3(fds, fd_idx, offsets, lengths, n,
+    return ioengine_run_block_loop4(fds, fd_idx, offsets, lengths, n,
                                     is_write, buf, buf_size, iodepth,
                                     out_lat_usec, out_bytes, interrupt_flag,
-                                    engine, nullptr, 0, 0, 0, 0, nullptr);
+                                    engine, nullptr, 0, 0, 0, 0, nullptr,
+                                    0, 0, nullptr);
 }
 
 int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
@@ -1119,7 +1239,7 @@ int ioengine_net_server_loop(const int* fds, uint64_t n_conns,
 // wrappers of LocalWorker; --mmap). The "2" variant carries the same
 // per-block modifiers as the block loops (verify fill/check, rwmix
 // per-op flags, variance refill).
-int ioengine_run_mmap_loop2(void* map_base, const uint64_t* offsets,
+int ioengine_run_mmap_loop3(void* map_base, const uint64_t* offsets,
                             const uint64_t* lengths, uint64_t n,
                             int is_write, void* buf,
                             uint64_t* out_lat_usec, uint64_t* out_bytes,
@@ -1127,7 +1247,10 @@ int ioengine_run_mmap_loop2(void* map_base, const uint64_t* offsets,
                             const unsigned char* op_is_read,
                             uint64_t verify_salt, int do_verify,
                             int block_var_pct, uint64_t block_var_seed,
-                            uint64_t* out_verify_info) {
+                            uint64_t* out_verify_info,
+                            uint64_t limit_read_bps,
+                            uint64_t limit_write_bps,
+                            uint64_t* rl_state) {
     char* base = static_cast<char*>(map_base);
     char* io = static_cast<char*>(buf);
     VarRng var_rng(block_var_seed);
@@ -1139,6 +1262,12 @@ int ioengine_run_mmap_loop2(void* map_base, const uint64_t* offsets,
     mod.var_pct = do_verify ? 0 : block_var_pct;
     mod.var_rng = &var_rng;
     mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+    mod.limit_read_bps = limit_read_bps;
+    mod.limit_write_bps = limit_write_bps;
+    if (rl_state) {
+        mod.rl_read = reinterpret_cast<RateState*>(rl_state);
+        mod.rl_write = reinterpret_cast<RateState*>(rl_state + 2);
+    }
     uint64_t bytes_done = 0;
     for (uint64_t i = 0; i < n; ++i) {
         if ((i % kInterruptCheckInterval) == 0 && interrupt_flag
@@ -1147,6 +1276,7 @@ int ioengine_run_mmap_loop2(void* map_base, const uint64_t* offsets,
         const uint64_t len = lengths[i];
         const uint64_t off = offsets[i];
         const bool rd = mod.op_reads(i, is_write);
+        mod.rate_limit(rd, len, interrupt_flag);
         if (!rd)
             mod.pre_write(io, off, len);
         const uint64_t t0 = now_usec();
@@ -1171,10 +1301,10 @@ int ioengine_run_mmap_loop(void* map_base, const uint64_t* offsets,
                            int is_write, void* buf,
                            uint64_t* out_lat_usec, uint64_t* out_bytes,
                            int* interrupt_flag) {
-    return ioengine_run_mmap_loop2(map_base, offsets, lengths, n, is_write,
+    return ioengine_run_mmap_loop3(map_base, offsets, lengths, n, is_write,
                                    buf, out_lat_usec, out_bytes,
                                    interrupt_flag, nullptr, 0, 0, 0, 0,
-                                   nullptr);
+                                   nullptr, 0, 0, nullptr);
 }
 
 // 1 if this kernel accepts io_uring_setup (it may be compiled out or
@@ -1192,7 +1322,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 5 (sync+aio+uring+fileloop+blockmods)";
+    return "elbencho-tpu ioengine 6 (sync+aio+uring+fileloop+blockmods+ratelimit)";
 }
 
 }  // extern "C"

@@ -33,7 +33,7 @@ ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 5
+EXPECTED_ABI = 6
 
 _EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
 
@@ -117,8 +117,8 @@ class _NativeEngine:
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
-        lib.ioengine_run_block_loop3.restype = ctypes.c_int
-        lib.ioengine_run_block_loop3.argtypes = [
+        lib.ioengine_run_block_loop4.restype = ctypes.c_int
+        lib.ioengine_run_block_loop4.argtypes = [
             ctypes.POINTER(ctypes.c_int),     # fds
             ctypes.POINTER(ctypes.c_uint32),  # per-block fd index (or None)
             ctypes.POINTER(ctypes.c_uint64),  # offsets
@@ -138,6 +138,9 @@ class _NativeEngine:
             ctypes.c_int,                     # block variance pct
             ctypes.c_uint64,                  # block variance seed
             ctypes.POINTER(ctypes.c_uint64),  # out: verify mismatch info[4]
+            ctypes.c_uint64,                  # read rate limit (bytes/s)
+            ctypes.c_uint64,                  # write rate limit (bytes/s)
+            ctypes.POINTER(ctypes.c_uint64),  # in/out rate windows [4]
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
@@ -146,8 +149,8 @@ class _NativeEngine:
         # catch instead of crashing at call time
         lib.ioengine_version.restype = ctypes.c_char_p
         lib.ioengine_version.argtypes = []
-        lib.ioengine_run_mmap_loop2.restype = ctypes.c_int
-        lib.ioengine_run_mmap_loop2.argtypes = [
+        lib.ioengine_run_mmap_loop3.restype = ctypes.c_int
+        lib.ioengine_run_mmap_loop3.argtypes = [
             ctypes.c_void_p,                  # mapping base address
             ctypes.POINTER(ctypes.c_uint64),  # offsets
             ctypes.POINTER(ctypes.c_uint64),  # lengths
@@ -163,6 +166,9 @@ class _NativeEngine:
             ctypes.c_int,                     # block variance pct
             ctypes.c_uint64,                  # block variance seed
             ctypes.POINTER(ctypes.c_uint64),  # out: verify mismatch info[4]
+            ctypes.c_uint64,                  # read rate limit (bytes/s)
+            ctypes.c_uint64,                  # write rate limit (bytes/s)
+            ctypes.POINTER(ctypes.c_uint64),  # in/out rate windows [4]
         ]
         lib.ioengine_net_client_loop.restype = ctypes.c_int
         lib.ioengine_net_client_loop.argtypes = [
@@ -191,8 +197,8 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: open connections left
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
         ]
-        lib.ioengine_run_file_loop2.restype = ctypes.c_int
-        lib.ioengine_run_file_loop2.argtypes = [
+        lib.ioengine_run_file_loop3.restype = ctypes.c_int
+        lib.ioengine_run_file_loop3.argtypes = [
             ctypes.c_char_p,                  # NUL-separated paths blob
             ctypes.POINTER(ctypes.c_uint32),  # per-path blob offsets
             ctypes.c_uint64,                  # num files
@@ -218,6 +224,9 @@ class _NativeEngine:
             ctypes.c_uint64,                  # rwmix base (rank+submitted)
             ctypes.POINTER(ctypes.c_uint64),  # out: verify mismatch info[4]
             ctypes.POINTER(ctypes.c_uint64),  # out: rwmix {blocks, bytes}
+            ctypes.c_uint64,                  # read rate limit (bytes/s)
+            ctypes.c_uint64,                  # write rate limit (bytes/s)
+            ctypes.POINTER(ctypes.c_uint64),  # in/out rate windows [4]
         ]
 
     def uring_supported(self) -> bool:
@@ -243,7 +252,8 @@ class _NativeEngine:
                       interrupt_flag=None, ranges=None,
                       verify_salt: int = 0, block_var_pct: int = 0,
                       block_var_seed: int = 0,
-                      rwmix_pct: int = 0) -> None:
+                      rwmix_pct: int = 0, limit_read_bps: int = 0,
+                      limit_write_bps: int = 0, rl_state=None) -> None:
         """Dir-mode LOSF hot path: open->blocks->close (or stat/unlink)
         per file, entirely in C++. Counters/histograms update after the
         call; partial (interrupted) chunks attribute only completed
@@ -285,14 +295,15 @@ class _NativeEngine:
         rwmix_base = worker.rank + worker._num_iops_submitted
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))
-        ret = self._lib.ioengine_run_file_loop2(
+        ret = self._lib.ioengine_run_file_loop3(
             blob, offs, n, self.FILE_OPS[op], open_flags, file_size,
             block_size, ctypes.c_void_p(buf_addr), starts_arr, lens_arr,
             1 if ignore_delete_errors else 0, entry_lat, block_lat,
             ctypes.byref(bytes_done), ctypes.byref(entries_done),
             ctypes.byref(fail_idx), ctypes.byref(interrupt),
             verify_salt, 1 if verify_salt else 0, block_var_pct,
-            block_var_seed, rwmix_pct, rwmix_base, verify_info, rwmix_out)
+            block_var_seed, rwmix_pct, rwmix_base, verify_info, rwmix_out,
+            limit_read_bps, limit_write_bps, rl_state)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),
@@ -386,7 +397,8 @@ class _NativeEngine:
                       is_write: bool, buf_addr: int, worker,
                       interrupt_flag=None, op_is_read=None,
                       verify_salt: int = 0, block_var_pct: int = 0,
-                      block_var_seed: int = 0) -> None:
+                      block_var_seed: int = 0, limit_read_bps: int = 0,
+                      limit_write_bps: int = 0, rl_state=None) -> None:
         """--mmap hot loop: memcpy between the mapping and the io buffer
         entirely in C++ (same accounting and block modifiers as
         run_block_loop)."""
@@ -400,13 +412,13 @@ class _NativeEngine:
         flags_arr = None
         if op_is_read is not None:
             flags_arr = _as_ptr(op_is_read, n, "uint8", ctypes.c_ubyte)
-        ret = self._lib.ioengine_run_mmap_loop2(
+        ret = self._lib.ioengine_run_mmap_loop3(
             ctypes.c_void_p(map_addr), _as_u64_ptr(offsets, n),
             _as_u64_ptr(lengths, n), n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), lat_arr, ctypes.byref(bytes_done),
             ctypes.byref(interrupt), flags_arr, verify_salt,
             1 if verify_salt else 0, block_var_pct, block_var_seed,
-            verify_info)
+            verify_info, limit_read_bps, limit_write_bps, rl_state)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),
@@ -426,7 +438,10 @@ class _NativeEngine:
                        fd_idx: "list[int] | None" = None,
                        op_is_read=None, verify_salt: int = 0,
                        block_var_pct: int = 0,
-                       block_var_seed: int = 0) -> bool:
+                       block_var_seed: int = 0,
+                       limit_read_bps: int = 0,
+                       limit_write_bps: int = 0,
+                       rl_state=None) -> bool:
         """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
         file of block i (reference: calcFileIdxAndOffsetStriped). offsets/
         lengths/fd_idx may be numpy uint64/uint32 arrays, passed zero-copy
@@ -458,13 +473,13 @@ class _NativeEngine:
         flags_arr = None
         if op_is_read is not None:
             flags_arr = _as_ptr(op_is_read, n, "uint8", ctypes.c_ubyte)
-        ret = self._lib.ioengine_run_block_loop3(
+        ret = self._lib.ioengine_run_block_loop4(
             fds_arr, idx_arr, off_arr, len_arr, n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), buf_size, iodepth,
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt),
             ENGINE_CODES[engine], flags_arr, verify_salt,
             1 if verify_salt else 0, block_var_pct, block_var_seed,
-            verify_info)
+            verify_info, limit_read_bps, limit_write_bps, rl_state)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),

@@ -119,6 +119,11 @@ class LocalWorker(Worker):
             self._rate_limiter_read = RateLimiter(cfg.limit_read_bps)
         if cfg.limit_write_bps:
             self._rate_limiter_write = RateLimiter(cfg.limit_write_bps)
+        # native limiter windows (RateState x2: read, write); created once
+        # per prepare and shared by this worker's phases — the exact
+        # lifetime of the Python RateLimiter objects above
+        import ctypes
+        self._native_rl_state = (ctypes.c_uint64 * 4)()
         # load (and first time: build) the native engine here, OUTSIDE the
         # timed phase, so `make` never charges to a measured result
         from ..utils.native import get_native_engine
@@ -451,7 +456,10 @@ class LocalWorker(Worker):
                     block_var_pct=cfg.block_variance_pct,
                     block_var_seed=self._block_var_seed(),
                     rwmix_pct=cfg.rwmix_read_pct
-                    if phase == BenchPhase.CREATEFILES else 0)
+                    if phase == BenchPhase.CREATEFILES else 0,
+                    limit_read_bps=cfg.limit_read_bps,
+                    limit_write_bps=cfg.limit_write_bps,
+                    rl_state=self._native_rl_state)
             except NativeVerifyError as err:
                 bpf = max((cfg.file_size + cfg.block_size - 1)
                           // cfg.block_size, 1)
@@ -661,7 +669,7 @@ class LocalWorker(Worker):
             raise WorkerException(
                 f"--ioengine {cfg.io_engine} only supports the native "
                 f"block loop — incompatible with --verifydirect/"
-                f"--readinline/--opslog/--flock/rate limits/--rwmixthrpct/"
+                f"--readinline/--opslog/--flock/--rwmixthrpct/"
                 f"--tpuids/non-'fast' --blockvaralgo")
         num_bufs = len(self._io_bufs)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
@@ -736,16 +744,15 @@ class LocalWorker(Worker):
         """Conditions every native delegation shares: no per-op Python
         feature may be active. Verify/rwmix-pct/block-variance run INSIDE
         the native loop (csrc BlockMod — the reference keeps them in its
-        hot loop too, LocalWorker.cpp:1741,2124,2242); what still drops to
-        Python is opslog, TPU staging, rate limits, the rwmix-threads
+        hot loop too, LocalWorker.cpp:1741,2124,2242) and so do the
+        per-thread rate limiters (C++ RateLimiter.h analogue); what still
+        drops to Python is opslog, TPU staging, the rwmix-threads
         byte-ratio balancer, and non-default variance PRNGs. Loop-specific
         extras (flock, read-inline...) are checked at the call sites."""
         cfg = self.cfg
         return (native is not None
                 and self._tpu is None
                 and self._ops_log is None
-                and self._rate_limiter_read is None
-                and self._rate_limiter_write is None
                 and self.shared.rwmix_balancer is None
                 and (not cfg.block_variance_pct
                      or cfg.block_variance_algo == "fast"))
@@ -756,7 +763,15 @@ class LocalWorker(Worker):
     _NATIVE_CHUNK_MAX_BYTES = 256 << 20
 
     def _native_chunk_blocks(self) -> int:
-        by_bytes = self._NATIVE_CHUNK_MAX_BYTES // max(self.cfg.block_size, 1)
+        cfg = self.cfg
+        max_bytes = self._NATIVE_CHUNK_MAX_BYTES
+        # under a rate limit, one engine call must not span minutes of
+        # throttled I/O (live stats only refresh between chunks): cap a
+        # chunk at ~2 seconds of the tightest active budget
+        limits = [x for x in (cfg.limit_read_bps, cfg.limit_write_bps) if x]
+        if limits:
+            max_bytes = min(max_bytes, 2 * min(limits))
+        by_bytes = max_bytes // max(cfg.block_size, 1)
         return max(1, min(self._NATIVE_CHUNK_MAX_BLOCKS, by_bytes))
 
     def _run_native_block_loop(self, native, fd, gen, is_write,
@@ -800,7 +815,10 @@ class LocalWorker(Worker):
                     op_is_read=flags,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
-                    block_var_seed=self._block_var_seed())
+                    block_var_seed=self._block_var_seed(),
+                    limit_read_bps=cfg.limit_read_bps,
+                    limit_write_bps=cfg.limit_write_bps,
+                    rl_state=self._native_rl_state)
             except NativeVerifyError as err:
                 file_off = int(offsets[err.block_idx]) + err.word_idx * 8
                 raise WorkerException(
@@ -1013,7 +1031,10 @@ class LocalWorker(Worker):
                     op_is_read=flags,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
-                    block_var_seed=self._block_var_seed())
+                    block_var_seed=self._block_var_seed(),
+                    limit_read_bps=cfg.limit_read_bps,
+                    limit_write_bps=cfg.limit_write_bps,
+                    rl_state=self._native_rl_state)
             except NativeVerifyError as err:
                 # mmap reads of unwritten sparse regions memcpy zeros (no
                 # short-read signal like the pread loops) — the hint below
@@ -1251,7 +1272,10 @@ class LocalWorker(Worker):
                     block_var_pct=cfg.block_variance_pct,
                     block_var_seed=self._block_var_seed(),
                     rwmix_pct=cfg.rwmix_read_pct
-                    if phase == BenchPhase.CREATEFILES else 0)
+                    if phase == BenchPhase.CREATEFILES else 0,
+                    limit_read_bps=cfg.limit_read_bps,
+                    limit_write_bps=cfg.limit_write_bps,
+                    rl_state=self._native_rl_state)
             except NativeVerifyError as err:
                 # map the global block index back through the per-file
                 # [range_start, range_len) slices
