@@ -186,6 +186,20 @@ inline void rate_wait(uint64_t bps, RateState* rs, uint64_t nbytes,
     rs->bytes_in_window += nbytes;
 }
 
+// advisory POSIX record lock around one op (--flock range|full; same
+// fcntl F_SETLKW semantics as toolkits/file_tk.FileRangeLock and the
+// reference's FileTk flock templates)
+inline int op_lock(int fd, int mode, bool is_read, uint64_t off,
+                   uint64_t len, bool unlock) {
+    struct flock fl;
+    memset(&fl, 0, sizeof(fl));
+    fl.l_type = unlock ? F_UNLCK : (is_read ? F_RDLCK : F_WRLCK);
+    fl.l_whence = SEEK_SET;
+    fl.l_start = (mode == 1) ? static_cast<off_t>(off) : 0;
+    fl.l_len = (mode == 1) ? static_cast<off_t>(len) : 0;
+    return fcntl(fd, F_SETLKW, &fl) == 0 ? 0 : -errno;
+}
+
 // bundled modifier config threaded through all block loops; disabled
 // members are no-ops so the plain path stays branch-light
 struct BlockMod {
@@ -199,6 +213,8 @@ struct BlockMod {
     uint64_t limit_write_bps = 0;
     RateState* rl_read = nullptr;
     RateState* rl_write = nullptr;
+    int inline_readback = 0;  // --readinline/--verifydirect (sync only)
+    int flock_mode = 0;       // --flock: 0 none, 1 range, 2 full (sync)
 
     inline bool op_reads(uint64_t i, int phase_is_write) const {
         return op_is_read ? (op_is_read[i] != 0) : !phase_is_write;
@@ -262,15 +278,39 @@ int run_sync_loop(const int* fds, const uint32_t* fd_idx,
         if (!is_read_op)
             mod.pre_write(buf, off, len);
         const uint64_t t0 = now_usec();
+        if (mod.flock_mode) {  // lock wait counts as op latency (Python
+                               // path stamps before the lock too)
+            const int lret = op_lock(fd, mod.flock_mode, is_read_op, off,
+                                     len, /*unlock=*/false);
+            if (lret != 0)
+                return lret;
+        }
         ssize_t res = is_read_op
             ? pread(fd, buf, len, static_cast<off_t>(off))
             : pwrite(fd, buf, len, static_cast<off_t>(off));
+        const int io_errno = res < 0 ? errno : 0;  // before unlock fcntl
         out_lat_usec[i] = now_usec() - t0;
+        if (mod.flock_mode)
+            op_lock(fd, mod.flock_mode, is_read_op, off, len,
+                    /*unlock=*/true);
         if (res < 0)
-            return -errno;
+            return -io_errno;
         if (static_cast<uint64_t>(res) != len)
             return -EIO;  // short read/write is an error, like the reference
         if (is_read_op) {
+            const int vret = mod.post_read(buf, off, len, i);
+            if (vret != 0)
+                return vret;
+        } else if (mod.inline_readback) {
+            // --readinline/--verifydirect: read the block straight back
+            // (outside the latency stamp, like pwriteAndReadWrapper and
+            // the Python _inline_read_back)
+            const ssize_t rres = pread(fd, buf, len,
+                                       static_cast<off_t>(off));
+            if (rres < 0)
+                return -errno;
+            if (static_cast<uint64_t>(rres) != len)
+                return -EIO;
             const int vret = mod.post_read(buf, off, len, i);
             if (vret != 0)
                 return vret;
@@ -756,6 +796,8 @@ enum {
 // array of the block loops
 struct FileLoopMod {
     uint64_t verify_salt = 0;
+    int inline_readback = 0;
+    int flock_mode = 0;
     uint64_t limit_read_bps = 0;
     uint64_t limit_write_bps = 0;
     RateState* rl_read = nullptr;
@@ -833,20 +875,41 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                         mod.var_rng->refill(buf, len, mod.var_pct);
                 }
                 const uint64_t t0 = now_usec();
+                if (mod.flock_mode) {
+                    const int lret = op_lock(fd, mod.flock_mode, rd, off,
+                                             len, /*unlock=*/false);
+                    if (lret != 0) {
+                        close(fd);
+                        return lret;
+                    }
+                }
                 const ssize_t res = rd
                     ? pread(fd, buf, len, static_cast<off_t>(off))
                     : pwrite(fd, buf, len, static_cast<off_t>(off));
+                const int io_errno = res < 0 ? errno : 0;  // before unlock
                 out_block_lat[block_idx++] = now_usec() - t0;
+                if (mod.flock_mode)
+                    op_lock(fd, mod.flock_mode, rd, off, len,
+                            /*unlock=*/true);
                 if (res < 0) {
-                    const int err = errno;
                     close(fd);
-                    return -err;
+                    return -io_errno;
                 }
                 if (static_cast<uint64_t>(res) != len) {
                     close(fd);
                     return -EIO;
                 }
-                if (rd && mod.do_verify) {
+                if (!rd && mod.inline_readback) {
+                    const ssize_t rres = pread(fd, buf, len,
+                                               static_cast<off_t>(off));
+                    if (rres < 0 || static_cast<uint64_t>(rres) != len) {
+                        const int err = rres < 0 ? errno : EIO;
+                        close(fd);
+                        return -err;
+                    }
+                }
+                if ((rd || (mod.inline_readback && !rd))
+                        && mod.do_verify) {
                     const int vret = verify_check(
                         buf, off, len, mod.verify_salt, block_idx - 1,
                         mod.verify_info);
@@ -907,7 +970,8 @@ int ioengine_run_file_loop3(const char* paths_blob,
                             uint64_t* out_rwmix,
                             uint64_t limit_read_bps,
                             uint64_t limit_write_bps,
-                            uint64_t* rl_state) {
+                            uint64_t* rl_state,
+                            int inline_readback, int flock_mode) {
     *out_fail_idx = 0;
     if (n_files == 0) {
         *out_bytes = 0;
@@ -926,6 +990,8 @@ int ioengine_run_file_loop3(const char* paths_blob,
     mod.rwmix_pct = (op == FILE_OP_WRITE) ? rwmix_pct : 0;
     mod.rwmix_base = rwmix_base;
     mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+    mod.inline_readback = (op == FILE_OP_WRITE) ? inline_readback : 0;
+    mod.flock_mode = flock_mode;
     mod.limit_read_bps = limit_read_bps;
     mod.limit_write_bps = limit_write_bps;
     if (rl_state) {
@@ -957,7 +1023,8 @@ int ioengine_run_file_loop(const char* paths_blob,
         paths_blob, path_offs, n_files, op, open_flags, file_size,
         block_size, buf, range_starts, range_lens, ignore_delete_errors,
         out_entry_lat, out_block_lat, out_bytes, out_entries, out_fail_idx,
-        interrupt_flag, 0, 0, 0, 0, 0, 0, nullptr, nullptr, 0, 0, nullptr);
+        interrupt_flag, 0, 0, 0, 0, 0, 0, nullptr, nullptr, 0, 0, nullptr,
+        0, 0);
 }
 
 // full-featured variant: adds the in-loop block modifiers (rwmix per-op
@@ -981,7 +1048,8 @@ int ioengine_run_block_loop4(const int* fds, const uint32_t* fd_idx,
                              uint64_t* out_verify_info,
                              uint64_t limit_read_bps,
                              uint64_t limit_write_bps,
-                             uint64_t* rl_state) {
+                             uint64_t* rl_state,
+                             int inline_readback, int flock_mode) {
     if (n == 0) {
         *out_bytes = 0;
         return 0;
@@ -1002,6 +1070,12 @@ int ioengine_run_block_loop4(const int* fds, const uint32_t* fd_idx,
         mod.rl_read = reinterpret_cast<RateState*>(rl_state);
         mod.rl_write = reinterpret_cast<RateState*>(rl_state + 2);
     }
+    mod.inline_readback = inline_readback;
+    mod.flock_mode = flock_mode;
+    const bool sync_engine = (engine == ENGINE_SYNC
+                              || (engine == ENGINE_AUTO && iodepth <= 1));
+    if ((inline_readback || flock_mode) && !sync_engine)
+        return -EINVAL;  // per-op lock/readback is a sync-loop feature
     if (engine == ENGINE_URING)
         return run_uring_loop(fds, fd_idx, offsets, lengths, n, is_write,
                               static_cast<const char*>(buf), buf_size,
@@ -1029,7 +1103,7 @@ int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
                                     is_write, buf, buf_size, iodepth,
                                     out_lat_usec, out_bytes, interrupt_flag,
                                     engine, nullptr, 0, 0, 0, 0, nullptr,
-                                    0, 0, nullptr);
+                                    0, 0, nullptr, 0, 0);
 }
 
 int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
@@ -1322,7 +1396,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 6 (sync+aio+uring+fileloop+blockmods+ratelimit)";
+    return "elbencho-tpu ioengine 7 (sync+aio+uring+fileloop+blockmods+ratelimit+flock)";
 }
 
 }  // extern "C"

@@ -819,6 +819,8 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError("--ioengine must be auto|sync|aio|uring")
         if self.object_backend not in ("", "s3", "gcs"):
             raise ConfigError("--objectbackend must be s3 or gcs")
+        if self.use_file_locks not in ("", "range", "full"):
+            raise ConfigError("--flock must be range or full")
         if self.io_engine == "sync" and self.io_depth > 1:
             raise ConfigError("--ioengine sync requires --iodepth 1")
         if self.io_engine != "auto" and self.bench_mode != BenchMode.POSIX:

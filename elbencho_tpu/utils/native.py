@@ -33,7 +33,7 @@ ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 6
+EXPECTED_ABI = 7
 
 _EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
 
@@ -141,6 +141,8 @@ class _NativeEngine:
             ctypes.c_uint64,                  # read rate limit (bytes/s)
             ctypes.c_uint64,                  # write rate limit (bytes/s)
             ctypes.POINTER(ctypes.c_uint64),  # in/out rate windows [4]
+            ctypes.c_int,                     # inline readback (sync only)
+            ctypes.c_int,                     # flock mode 0|1=range|2=full
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
@@ -227,6 +229,8 @@ class _NativeEngine:
             ctypes.c_uint64,                  # read rate limit (bytes/s)
             ctypes.c_uint64,                  # write rate limit (bytes/s)
             ctypes.POINTER(ctypes.c_uint64),  # in/out rate windows [4]
+            ctypes.c_int,                     # inline readback (write op)
+            ctypes.c_int,                     # flock mode 0|1=range|2=full
         ]
 
     def uring_supported(self) -> bool:
@@ -253,7 +257,9 @@ class _NativeEngine:
                       verify_salt: int = 0, block_var_pct: int = 0,
                       block_var_seed: int = 0,
                       rwmix_pct: int = 0, limit_read_bps: int = 0,
-                      limit_write_bps: int = 0, rl_state=None) -> None:
+                      limit_write_bps: int = 0, rl_state=None,
+                      inline_readback: bool = False,
+                      flock_mode: int = 0) -> None:
         """Dir-mode LOSF hot path: open->blocks->close (or stat/unlink)
         per file, entirely in C++. Counters/histograms update after the
         call; partial (interrupted) chunks attribute only completed
@@ -303,7 +309,8 @@ class _NativeEngine:
             ctypes.byref(fail_idx), ctypes.byref(interrupt),
             verify_salt, 1 if verify_salt else 0, block_var_pct,
             block_var_seed, rwmix_pct, rwmix_base, verify_info, rwmix_out,
-            limit_read_bps, limit_write_bps, rl_state)
+            limit_read_bps, limit_write_bps, rl_state,
+            1 if inline_readback else 0, flock_mode)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),
@@ -441,7 +448,8 @@ class _NativeEngine:
                        block_var_seed: int = 0,
                        limit_read_bps: int = 0,
                        limit_write_bps: int = 0,
-                       rl_state=None) -> bool:
+                       rl_state=None, inline_readback: bool = False,
+                       flock_mode: int = 0) -> bool:
         """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
         file of block i (reference: calcFileIdxAndOffsetStriped). offsets/
         lengths/fd_idx may be numpy uint64/uint32 arrays, passed zero-copy
@@ -479,7 +487,8 @@ class _NativeEngine:
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt),
             ENGINE_CODES[engine], flags_arr, verify_salt,
             1 if verify_salt else 0, block_var_pct, block_var_seed,
-            verify_info, limit_read_bps, limit_write_bps, rl_state)
+            verify_info, limit_read_bps, limit_write_bps, rl_state,
+            1 if inline_readback else 0, flock_mode)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),

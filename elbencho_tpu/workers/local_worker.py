@@ -408,14 +408,11 @@ class LocalWorker(Worker):
                 and phase in self._NATIVE_FILE_OPS
                 and cfg.io_engine in ("auto", "sync")
                 and cfg.io_depth <= 1
-                and not cfg.do_read_inline
-                and not cfg.do_direct_verify
                 and not cfg.do_stat_inline
                 and not cfg.do_prealloc_file
                 and not cfg.do_truncate_to_size
                 and not cfg.fadvise_flags
                 and not cfg.use_mmap
-                and not cfg.use_file_locks
                 and not cfg.use_random_offsets
                 and not cfg.do_reverse_seq_offsets)
 
@@ -459,7 +456,10 @@ class LocalWorker(Worker):
                     if phase == BenchPhase.CREATEFILES else 0,
                     limit_read_bps=cfg.limit_read_bps,
                     limit_write_bps=cfg.limit_write_bps,
-                    rl_state=self._native_rl_state)
+                    rl_state=self._native_rl_state,
+                    inline_readback=(cfg.do_read_inline
+                                     or cfg.do_direct_verify),
+                    flock_mode=self._flock_mode_code())
             except NativeVerifyError as err:
                 bpf = max((cfg.file_size + cfg.block_size - 1)
                           // cfg.block_size, 1)
@@ -658,10 +658,14 @@ class LocalWorker(Worker):
                         global_off % stripe_size)
         from ..utils.native import get_native_engine
         native = get_native_engine()
+        sync_path = cfg.io_depth <= 1 and cfg.io_engine in ("auto", "sync")
         if (self._native_loop_eligible(native)
                 and (multi_file is None or stripe is not None)
-                and not cfg.do_read_inline and not cfg.do_direct_verify
-                and not cfg.use_file_locks):
+                # per-op flock and inline read-back are sync-loop features
+                # (in C++ too); async engines fall back to Python for them
+                and (sync_path or not (cfg.do_read_inline
+                                       or cfg.do_direct_verify
+                                       or cfg.use_file_locks))):
             if self._run_native_block_loop(native, fd, gen, is_write,
                                            file_offset_base, stripe):
                 return
@@ -818,7 +822,10 @@ class LocalWorker(Worker):
                     block_var_seed=self._block_var_seed(),
                     limit_read_bps=cfg.limit_read_bps,
                     limit_write_bps=cfg.limit_write_bps,
-                    rl_state=self._native_rl_state)
+                    rl_state=self._native_rl_state,
+                    inline_readback=(cfg.do_read_inline
+                                     or cfg.do_direct_verify),
+                    flock_mode=self._flock_mode_code())
             except NativeVerifyError as err:
                 file_off = int(offsets[err.block_idx]) + err.word_idx * 8
                 raise WorkerException(
@@ -849,6 +856,10 @@ class LocalWorker(Worker):
         base = np.uint64(self.rank + self._num_iops_submitted)
         return (((base + np.arange(n, dtype=np.uint64)) % np.uint64(100))
                 < np.uint64(pct)).astype(np.uint8)
+
+    def _flock_mode_code(self) -> int:
+        """--flock mode for the engine: 0 none, 1 range, 2 full."""
+        return {"": 0, "range": 1, "full": 2}[self.cfg.use_file_locks]
 
     def _block_var_seed(self) -> int:
         """Variance-refill seed, varied per worker and per chunk."""
@@ -1275,7 +1286,10 @@ class LocalWorker(Worker):
                     if phase == BenchPhase.CREATEFILES else 0,
                     limit_read_bps=cfg.limit_read_bps,
                     limit_write_bps=cfg.limit_write_bps,
-                    rl_state=self._native_rl_state)
+                    rl_state=self._native_rl_state,
+                    inline_readback=(cfg.do_read_inline
+                                     or cfg.do_direct_verify),
+                    flock_mode=self._flock_mode_code())
             except NativeVerifyError as err:
                 # map the global block index back through the per-file
                 # [range_start, range_len) slices

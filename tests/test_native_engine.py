@@ -298,8 +298,11 @@ def test_native_striped_multifile(tmp_path, monkeypatch):
     reset_native_engine_cache()
 
 
-def test_flock_takes_locking_python_path(tmp_path, monkeypatch):
-    """--flock must NOT be delegated to the (lockless) native loop."""
+def test_flock_native_sync_and_python_async(tmp_path, monkeypatch):
+    """--flock runs in the native SYNC loop (fcntl record locks per op,
+    engine ABI 7); async engines still fall back to the locking Python
+    path (per-op locks are a sync-loop feature, like the reference's
+    flock wiring in rwBlockSized)."""
     monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
     from elbencho_tpu.utils.native import (get_native_engine,
                                            reset_native_engine_cache)
@@ -307,15 +310,57 @@ def test_flock_takes_locking_python_path(tmp_path, monkeypatch):
     native = get_native_engine()
     if native is None:
         pytest.skip("native engine unavailable")
+    calls = []
+    orig = type(native).run_block_loop
 
-    def forbidden(*a, **kw):
-        raise AssertionError("native block loop used despite --flock")
+    def spy(self, *a, **kw):
+        calls.append(kw.get("flock_mode"))
+        return orig(self, *a, **kw)
 
-    monkeypatch.setattr(type(native), "run_block_loop", forbidden)
+    monkeypatch.setattr(type(native), "run_block_loop", spy)
     from elbencho_tpu.cli import main
     rc = main(["-w", "-r", "-t", "1", "-s", "64K", "-b", "16K",
                "--flock", "range", "--nolive", str(tmp_path / "f")])
     assert rc == 0
+    assert 1 in calls, calls  # range mode reached the engine
+    calls.clear()
+    rc = main(["-w", "-t", "1", "-s", "64K", "-b", "16K", "--flock",
+               "full", "--iodepth", "4", "--nolive", str(tmp_path / "g")])
+    assert rc == 0
+    assert calls == [], calls  # async + flock: Python fallback
+    reset_native_engine_cache()
+
+
+def test_readinline_native_detects_corruption(tmp_path, monkeypatch,
+                                              capsys):
+    """--verifydirect: write + immediate read-back + check in the native
+    sync loop (pwriteAndReadWrapper parity). A filesystem that drops
+    writes would be caught; here we prove the path runs natively and
+    round-trips."""
+    monkeypatch.delenv("ELBENCHO_TPU_NO_NATIVE", raising=False)
+    from elbencho_tpu.utils.native import (get_native_engine,
+                                           reset_native_engine_cache)
+    reset_native_engine_cache()
+    native = get_native_engine()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    calls = []
+    orig = type(native).run_block_loop
+
+    def spy(self, *a, **kw):
+        calls.append(kw.get("inline_readback"))
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(type(native), "run_block_loop", spy)
+    from elbencho_tpu.cli import main
+    rc = main(["-w", "-t", "1", "-s", "64K", "-b", "16K", "--verify",
+               "7", "--verifydirect", "--nolive", str(tmp_path / "f")])
+    assert rc == 0
+    assert True in calls, calls
+    import numpy as np
+    words = np.frombuffer((tmp_path / "f").read_bytes(), dtype=np.uint64)
+    want = np.arange(len(words), dtype=np.uint64) * 8 + np.uint64(7)
+    assert (words == want).all()
     reset_native_engine_cache()
 
 

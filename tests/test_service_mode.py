@@ -2,6 +2,7 @@
 plus a master — the reference's localhost multi-service pattern
 (tools/test-examples.sh:296-330; SURVEY.md section 4)."""
 
+import contextlib
 import json
 import os
 import subprocess
@@ -28,22 +29,26 @@ def _wait_ready(port, timeout=20):
     raise TimeoutError(f"service on port {port} not ready")
 
 
-@pytest.fixture()
-def services():
+@contextlib.contextmanager
+def _service_pair(ports, native: bool):
+    """Spawn + ready-wait + teardown for a localhost service pair."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    if native:
+        env.pop("ELBENCHO_TPU_NO_NATIVE", None)
+    else:
+        env["ELBENCHO_TPU_NO_NATIVE"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     procs = []
     try:
-        for port in PORTS:
+        for port in ports:
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "elbencho_tpu", "--service",
                  "--foreground", "--port", str(port)],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-        for port in PORTS:
+        for port in ports:
             _wait_ready(port)
-        yield PORTS
+        yield ports
     finally:
         for p in procs:
             p.terminate()
@@ -52,6 +57,12 @@ def services():
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.fixture()
+def services():
+    with _service_pair(PORTS, native=False) as ports:
+        yield ports
 
 
 def _master(args):
@@ -256,3 +267,35 @@ def test_distributed_gcs_backend_over_service_wire(services):
         assert "distbkt" not in srv.state.buckets
     finally:
         srv.stop()
+
+
+NATIVE_PORTS = (17121, 17122)
+
+
+@pytest.fixture()
+def services_native():
+    """Service pair WITH the native C++ engine enabled (the default
+    fixture disables it): distributed phases must drive the C++ loops
+    from service worker threads too."""
+    with _service_pair(NATIVE_PORTS, native=True) as ports:
+        yield ports
+
+
+def test_distributed_native_engine_with_verify(services_native, tmp_path):
+    """Distributed write+read with --verify through the native loops on
+    BOTH services (2 threads each), then corruption is caught remotely."""
+    hosts = ",".join(f"localhost:{p}" for p in services_native)
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    args = ["--hosts", hosts, "-t", "2", "-n", "1", "-N", "2",
+            "-s", "64K", "-b", "16K", "--verify", "17", str(bench)]
+    assert _master(["-w", "-d"] + args) == 0
+    assert _master(["-r"] + args) == 0
+    # 2 services x 2 threads x 2 files, rank-namespaced
+    files = sorted(p.name for p in bench.rglob("r*-f*"))
+    assert len(files) == 8, files
+    victim = next(bench.rglob("r3-f1"))  # a file of the SECOND service
+    data = bytearray(victim.read_bytes())
+    data[30000] ^= 0xFF
+    victim.write_bytes(bytes(data))
+    assert _master(["-r"] + args) != 0  # remote native verify catches it
