@@ -28,6 +28,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
@@ -37,6 +38,7 @@
 #include <poll.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/file.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
 #include <fcntl.h>
@@ -197,7 +199,52 @@ inline int op_lock(int fd, int mode, bool is_read, uint64_t off,
     fl.l_whence = SEEK_SET;
     fl.l_start = (mode == 1) ? static_cast<off_t>(off) : 0;
     fl.l_len = (mode == 1) ? static_cast<off_t>(len) : 0;
-    return fcntl(fd, F_SETLKW, &fl) == 0 ? 0 : -errno;
+    while (fcntl(fd, F_SETLKW, &fl) != 0) {
+        if (errno != EINTR)  // retry stray signals like Python's lockf
+            return -errno;
+    }
+    return 0;
+}
+
+// one JSONL post-op record (--opslog; same schema as
+// toolkits/ops_logger.py and the reference's OpsLogger.cpp:62-100 —
+// block loops write completion records with an empty entry name)
+inline int ops_record(int fd, int use_lock, int rank, bool rd,
+                      uint64_t off, uint64_t len) {
+    timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    struct tm tmv;
+    localtime_r(&ts.tv_sec, &tmv);
+    char datebuf[24];
+    strftime(datebuf, sizeof(datebuf), "%Y%m%dT%H%M%S", &tmv);
+    char line[224];
+    const int n = snprintf(
+        line, sizeof(line),
+        "{\"date\":\"%s.%09ld\",\"worker_rank\":%d,"
+        "\"op_name\":\"%s\",\"entry_name\":\"\","
+        "\"offset\":%llu,\"length\":%llu,"
+        "\"is_finished\":true,\"is_error\":false}\n",
+        datebuf, static_cast<long>(ts.tv_nsec), rank,
+        rd ? "read" : "write", static_cast<unsigned long long>(off),
+        static_cast<unsigned long long>(len));
+    if (use_lock)
+        flock(fd, LOCK_EX);
+    int ret = 0;
+    ssize_t done = 0;
+    while (done < n) {  // full-line writes: a torn record corrupts JSONL
+        const ssize_t w = write(fd, line + done,
+                                static_cast<size_t>(n - done));
+        if (w < 0) {
+            if (errno == EINTR)
+                continue;
+            ret = -errno;  // surface ENOSPC etc. like the Python logger
+            break;
+        }
+        done += w;
+    }
+    if (use_lock)
+        flock(fd, LOCK_UN);
+    return ret;
 }
 
 // bundled modifier config threaded through all block loops; disabled
@@ -215,6 +262,15 @@ struct BlockMod {
     RateState* rl_write = nullptr;
     int inline_readback = 0;  // --readinline/--verifydirect (sync only)
     int flock_mode = 0;       // --flock: 0 none, 1 range, 2 full (sync)
+    int ops_fd = -1;          // --opslog trace fd (-1 = off)
+    int ops_lock = 0;
+    int worker_rank = 0;
+
+    inline int log_op(bool rd, uint64_t off, uint64_t len) const {
+        if (ops_fd < 0)
+            return 0;
+        return ops_record(ops_fd, ops_lock, worker_rank, rd, off, len);
+    }
 
     inline bool op_reads(uint64_t i, int phase_is_write) const {
         return op_is_read ? (op_is_read[i] != 0) : !phase_is_write;
@@ -297,6 +353,11 @@ int run_sync_loop(const int* fds, const uint32_t* fd_idx,
             return -io_errno;
         if (static_cast<uint64_t>(res) != len)
             return -EIO;  // short read/write is an error, like the reference
+        {
+            const int lg = mod.log_op(is_read_op, off, len);
+            if (lg != 0)
+                return lg;
+        }
         if (is_read_op) {
             const int vret = mod.post_read(buf, off, len, i);
             if (vret != 0)
@@ -419,7 +480,14 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
                     ret = -EIO;
                     break;
                 }
-                if (mod.op_reads(s->block_idx, is_write)) {
+                const bool was_read = mod.op_reads(s->block_idx, is_write);
+                // log BEFORE verify so the read that detects corruption
+                // appears in the trace (sync-loop and Python parity)
+                ret = mod.log_op(was_read, offsets[s->block_idx],
+                                 lengths[s->block_idx]);
+                if (ret != 0)
+                    break;
+                if (was_read) {
                     ret = mod.post_read(s->buf, offsets[s->block_idx],
                                         lengths[s->block_idx], s->block_idx);
                     if (ret != 0)
@@ -712,12 +780,19 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
                 UringSlot* s = reinterpret_cast<UringSlot*>(cqe.user_data);
                 ++head;
                 --in_flight;  // every reaped cqe leaves the ring, error or not
+                const bool was_read = mod.op_reads(s->block_idx, is_write);
                 if (cqe.res < 0) {
                     ret = cqe.res;
                 } else if (static_cast<uint64_t>(cqe.res)
                            != lengths[s->block_idx]) {
                     ret = -EIO;
-                } else if (mod.op_reads(s->block_idx, is_write)
+                } else if ((ret = mod.log_op(was_read,
+                                             offsets[s->block_idx],
+                                             lengths[s->block_idx]))
+                           != 0) {
+                    // opslog write failed (e.g. ENOSPC): fail the run
+                    // like the Python logger's os.write would
+                } else if (was_read
                            && (ret = mod.post_read(
                                    s->buf, offsets[s->block_idx],
                                    lengths[s->block_idx], s->block_idx))
@@ -908,8 +983,7 @@ int run_file_loop(const char* paths_blob, const uint32_t* path_offs,
                         return -err;
                     }
                 }
-                if ((rd || (mod.inline_readback && !rd))
-                        && mod.do_verify) {
+                if ((rd || mod.inline_readback) && mod.do_verify) {
                     const int vret = verify_check(
                         buf, off, len, mod.verify_salt, block_idx - 1,
                         mod.verify_info);
@@ -1049,7 +1123,8 @@ int ioengine_run_block_loop4(const int* fds, const uint32_t* fd_idx,
                              uint64_t limit_read_bps,
                              uint64_t limit_write_bps,
                              uint64_t* rl_state,
-                             int inline_readback, int flock_mode) {
+                             int inline_readback, int flock_mode,
+                             int ops_fd, int ops_lock, int worker_rank) {
     if (n == 0) {
         *out_bytes = 0;
         return 0;
@@ -1072,6 +1147,9 @@ int ioengine_run_block_loop4(const int* fds, const uint32_t* fd_idx,
     }
     mod.inline_readback = inline_readback;
     mod.flock_mode = flock_mode;
+    mod.ops_fd = ops_fd;
+    mod.ops_lock = ops_lock;
+    mod.worker_rank = worker_rank;
     const bool sync_engine = (engine == ENGINE_SYNC
                               || (engine == ENGINE_AUTO && iodepth <= 1));
     if ((inline_readback || flock_mode) && !sync_engine)
@@ -1103,7 +1181,7 @@ int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
                                     is_write, buf, buf_size, iodepth,
                                     out_lat_usec, out_bytes, interrupt_flag,
                                     engine, nullptr, 0, 0, 0, 0, nullptr,
-                                    0, 0, nullptr, 0, 0);
+                                    0, 0, nullptr, 0, 0, -1, 0, 0);
 }
 
 int ioengine_run_block_loop2(int fd, const uint64_t* offsets,
@@ -1396,7 +1474,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 7 (sync+aio+uring+fileloop+blockmods+ratelimit+flock)";
+    return "elbencho-tpu ioengine 8 (sync+aio+uring+fileloop+blockmods+ratelimit+flock+opslog)";
 }
 
 }  // extern "C"

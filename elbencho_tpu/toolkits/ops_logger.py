@@ -21,6 +21,11 @@ class OpsLogger:
         self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                            0o644)
 
+    @property
+    def fd(self) -> int:
+        """Raw fd for the native engine's in-loop block records."""
+        return self._fd
+
     def _write(self, record: dict) -> None:
         line = (json.dumps(record, separators=(",", ":")) + "\n").encode()
         if self.use_lock:

@@ -33,7 +33,7 @@ ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 7
+EXPECTED_ABI = 8
 
 _EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
 
@@ -143,6 +143,9 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # in/out rate windows [4]
             ctypes.c_int,                     # inline readback (sync only)
             ctypes.c_int,                     # flock mode 0|1=range|2=full
+            ctypes.c_int,                     # opslog fd (-1 = off)
+            ctypes.c_int,                     # opslog flock
+            ctypes.c_int,                     # worker rank (for records)
         ]
         lib.ioengine_uring_supported.restype = ctypes.c_int
         lib.ioengine_uring_supported.argtypes = []
@@ -449,7 +452,9 @@ class _NativeEngine:
                        limit_read_bps: int = 0,
                        limit_write_bps: int = 0,
                        rl_state=None, inline_readback: bool = False,
-                       flock_mode: int = 0) -> bool:
+                       flock_mode: int = 0, ops_fd: int = -1,
+                       ops_lock: bool = False,
+                       worker_rank: int = 0) -> bool:
         """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
         file of block i (reference: calcFileIdxAndOffsetStriped). offsets/
         lengths/fd_idx may be numpy uint64/uint32 arrays, passed zero-copy
@@ -488,7 +493,8 @@ class _NativeEngine:
             ENGINE_CODES[engine], flags_arr, verify_salt,
             1 if verify_salt else 0, block_var_pct, block_var_seed,
             verify_info, limit_read_bps, limit_write_bps, rl_state,
-            1 if inline_readback else 0, flock_mode)
+            1 if inline_readback else 0, flock_mode, ops_fd,
+            1 if ops_lock else 0, worker_rank)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),

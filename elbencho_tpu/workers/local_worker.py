@@ -405,6 +405,7 @@ class LocalWorker(Worker):
         dirModeIterateFiles is native there by construction)."""
         cfg = self.cfg
         return (self._native_loop_eligible(native)
+                and self._ops_log is None  # per-entry records stay Python
                 and phase in self._NATIVE_FILE_OPS
                 and cfg.io_engine in ("auto", "sync")
                 and cfg.io_depth <= 1
@@ -672,9 +673,9 @@ class LocalWorker(Worker):
         if cfg.io_engine != "auto":
             raise WorkerException(
                 f"--ioengine {cfg.io_engine} only supports the native "
-                f"block loop — incompatible with --verifydirect/"
-                f"--readinline/--opslog/--flock/--rwmixthrpct/"
-                f"--tpuids/non-'fast' --blockvaralgo")
+                f"block loop — incompatible with --rwmixthrpct/--tpuids/"
+                f"non-'fast' --blockvaralgo (and --verifydirect/"
+                f"--readinline/--flock need the sync engine)")
         num_bufs = len(self._io_bufs)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
         # the byte-ratio balancer only applies to the mixed WRITE phase
@@ -750,13 +751,13 @@ class LocalWorker(Worker):
         the native loop (csrc BlockMod — the reference keeps them in its
         hot loop too, LocalWorker.cpp:1741,2124,2242) and so do the
         per-thread rate limiters (C++ RateLimiter.h analogue); what still
-        drops to Python is opslog, TPU staging, the rwmix-threads
-        byte-ratio balancer, and non-default variance PRNGs. Loop-specific
+        drops to Python is TPU staging, the rwmix-threads byte-ratio
+        balancer, and non-default variance PRNGs (opslog block records
+        are written by the engine; dir-mode entry records stay Python). Loop-specific
         extras (flock, read-inline...) are checked at the call sites."""
         cfg = self.cfg
         return (native is not None
                 and self._tpu is None
-                and self._ops_log is None
                 and self.shared.rwmix_balancer is None
                 and (not cfg.block_variance_pct
                      or cfg.block_variance_algo == "fast"))
@@ -825,7 +826,10 @@ class LocalWorker(Worker):
                     rl_state=self._native_rl_state,
                     inline_readback=(cfg.do_read_inline
                                      or cfg.do_direct_verify),
-                    flock_mode=self._flock_mode_code())
+                    flock_mode=self._flock_mode_code(),
+                    ops_fd=(self._ops_log.fd if self._ops_log is not None
+                            else -1),
+                    ops_lock=cfg.ops_log_lock, worker_rank=self.rank)
             except NativeVerifyError as err:
                 file_off = int(offsets[err.block_idx]) + err.word_idx * 8
                 raise WorkerException(

@@ -738,3 +738,29 @@ def test_rate_limit_enforced_in_native_loop(tmp_path, monkeypatch):
                  str(target)]) == 0
     assert time_mod.monotonic() - t0 < elapsed * 0.75
     native_mod.reset_native_engine_cache()
+
+
+def test_opslog_written_by_native_block_loop(tmp_path, monkeypatch):
+    """--opslog block records come from the engine (ABI 8) with the same
+    JSONL schema as the Python OpsLogger; the loop stays native."""
+    import json as json_mod
+    native_mod, native = _native_or_skip(monkeypatch)
+    calls = _spy_block_loop(monkeypatch, native)
+    from elbencho_tpu.cli import main
+    target = tmp_path / "f"
+    opslog = tmp_path / "ops.jsonl"
+    assert main(["-w", "-t", "1", "-s", "64K", "-b", "16K", "--opslog",
+                 str(opslog), "--nolive", str(target)]) == 0
+    assert any(kw.get("ops_fd", -1) >= 0 for kw in calls), calls
+    lines = opslog.read_text().splitlines()
+    assert len(lines) == 4  # one completion record per block
+    rec = json_mod.loads(lines[2])
+    assert rec["op_name"] == "write" and rec["is_finished"] is True
+    assert rec["offset"] == 2 * 16384 and rec["length"] == 16384
+    assert rec["worker_rank"] == 0 and not rec["is_error"]
+    # same keys as the Python OpsLogger's records
+    from elbencho_tpu.toolkits.ops_logger import OpsLogger
+    py_rec = OpsLogger.__new__(OpsLogger)
+    py_rec.worker_rank = 0
+    assert set(rec) == set(py_rec._record("x", "", 0, 0, True, False))
+    native_mod.reset_native_engine_cache()
