@@ -227,8 +227,13 @@ inline int ops_record(int fd, int use_lock, int rank, bool rd,
         datebuf, static_cast<long>(ts.tv_nsec), rank,
         rd ? "read" : "write", static_cast<unsigned long long>(off),
         static_cast<unsigned long long>(len));
-    if (use_lock)
-        flock(fd, LOCK_EX);
+    if (use_lock) {
+        int lr;
+        while ((lr = flock(fd, LOCK_EX)) < 0 && errno == EINTR)
+            continue;
+        if (lr < 0)  // writing unlocked could interleave torn records —
+            return -errno;  // the exact corruption --opsloglock prevents
+    }
     int ret = 0;
     ssize_t done = 0;
     while (done < n) {  // full-line writes: a torn record corrupts JSONL
@@ -467,6 +472,11 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
             // completions after that sleep would book limiter time as
             // device latency
             const uint64_t t_now = now_usec();
+            // every reaped event is out of the kernel regardless of how
+            // its processing below goes; decrementing per-event instead
+            // would make an error break leave the teardown drain waiting
+            // for completions that were already delivered
+            in_flight -= got;
             AioSlot* free_slots[4];
             int n_free = 0;
             for (int e = 0; e < got; ++e) {
@@ -496,7 +506,6 @@ int run_aio_loop(const int* fds, const uint32_t* fd_idx,
                 out_lat_usec[s->block_idx] = t_now - s->submit_usec;
                 bytes_done += static_cast<uint64_t>(res);
                 ++completed;
-                --in_flight;
                 free_slots[n_free++] = s;
             }
             // pass 2: refill the freed slots (rate limit + fill + submit)

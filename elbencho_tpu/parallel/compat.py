@@ -1,0 +1,29 @@
+"""jax API compatibility shims shared by the sharded code paths."""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+
+@functools.lru_cache(maxsize=1)
+def _shard_map_fn_and_kwargs():
+    """(shard_map callable, name of its replication-check kwarg).
+
+    jax >= 0.8 promotes shard_map to ``jax.shard_map`` (the experimental
+    path warns and is slated for removal) and renames ``check_rep`` to
+    ``check_vma``; older releases only have the experimental symbol.
+    """
+    import jax
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as fn
+    params = inspect.signature(fn).parameters
+    check_kw = "check_vma" if "check_vma" in params else "check_rep"
+    return fn, check_kw
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_replication=True):
+    fn, check_kw = _shard_map_fn_and_kwargs()
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{check_kw: check_replication})

@@ -32,9 +32,9 @@ def make_ingest_step(mesh: Mesh):
       checksum/xor: global scalars (psum/reduce over the full mesh)
     """
     data_sharding = NamedSharding(mesh, P("host", "chip"))
-    from jax.experimental.shard_map import shard_map
 
     from ..models.workloads import scramble_fingerprint_core
+    from .compat import shard_map
 
     def _per_shard(data, key):
         # fold the mesh position into the key so every shard scrambles
@@ -58,7 +58,7 @@ def make_ingest_step(mesh: Mesh):
         out_specs=(P("host", "chip"), P(), P()),
         # the xor fold over the all_gather result is replicated by
         # construction, but not statically inferable
-        check_rep=False,
+        check_replication=False,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,),

@@ -642,8 +642,9 @@ class LocalWorker(Worker):
         positional I/O -> [verify] -> [TPU H2D] -> latency + counters.
 
         When the native C++ ioengine is available and the workload qualifies
-        (no TPU staging/opslog/rate limits), the whole loop is delegated to
-        it — verify, rwmix-pct and block variance run INSIDE the engine
+        (no TPU staging — see ``_native_loop_eligible``), the whole loop is
+        delegated to it: verify, rwmix-pct, block variance, rate limits,
+        flock, inline read-back and opslog records all run INSIDE the engine
         (BlockMod), and striped multi-file mode maps through
         ``stripe=(fds, file_size)`` (the structured form of the
         ``multi_file`` mapping).

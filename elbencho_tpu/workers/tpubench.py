@@ -77,7 +77,8 @@ def _run_ici(worker) -> None:
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    from ..parallel.compat import shard_map
 
     devices = jax.devices()
     n_dev = len(devices)
