@@ -16,9 +16,10 @@ self-relative ratio is the honest comparison.
 Prints ONE JSON line. Core keys: {"metric", "value", "unit",
 "vs_baseline"}; value is the MEDIAN of HBM_PASSES measured passes, with
 dispersion and context in the extra keys {"median_of", "min", "max",
-"host_read_mibs", "per_chip_hbm_mibs", "io_lat_usec_p50",
-"io_lat_usec_p99"}. If TPU accounting yields no TpuHbmMiBPerSec the run
-FAILS rather than substituting the host-only storage rate.
+"host_read_mibs", "inter_pass_idle_s", "per_chip_hbm_mibs",
+"io_lat_usec_p50", "io_lat_usec_p99"}. If TPU accounting yields no
+TpuHbmMiBPerSec the run FAILS rather than substituting the host-only
+storage rate.
 """
 
 from __future__ import annotations
@@ -28,23 +29,54 @@ import os
 import subprocess
 import sys
 import tempfile
+import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+import _axon_mitigation  # noqa: E402  (repo-root module)
+
+# harness self-test only (see _probe_tpu): run the whole pipeline on the
+# CPU backend with a sanitized env so a dead tunnel can't hang the probe
+_SELFTEST = os.environ.get("ELBENCHO_TPU_BENCH_ALLOW_NONTPU") == "1"
+
+
+def _subproc_env() -> dict:
+    return _axon_mitigation.sanitized_env(1) if _SELFTEST \
+        else dict(os.environ)
 
 FILE_SIZE = "256M"
 BLOCK_SIZE = "16M"
 IO_DEPTH = "4"     # per-thread transfer pipeline depth
 THREADS = "2"      # two workers overlap tunnel round-trips
 HBM_PASSES = 5     # report the median pass, with min/max dispersion
+# The axon tunnel rate-limits H2D traffic with a burst-credit window
+# (measured round 2: ~1.8-2.2 GiB/s for the first ~0.5-2 GiB, then a hard
+# ~200 MiB/s sustained floor, recovering over idle seconds-to-minutes; the
+# window size varies with shared-infra load). Back-to-back passes drain
+# each other's credit, so the median would measure the limiter's refill
+# state rather than the framework. Each measured pass therefore starts
+# after an idle gap, and a pass landing far below the best pass so far
+# (credit was still drained) doubles the next gap up to the cap. The
+# actual gaps used are reported in the JSON line; a throttled median
+# remains possible when the limiter needs longer than the cap to refill.
+INTER_PASS_IDLE_S = 20
+INTER_PASS_IDLE_CAP_S = 60
+# no tunnel (hence no limiter) in the CPU self-test: don't sleep for it
+if _SELFTEST:
+    INTER_PASS_IDLE_S = 0
+    INTER_PASS_IDLE_CAP_S = 0
 
 
-def _run_cli(args, jsonfile):
-    env = dict(os.environ)
+def _run_cli(args, jsonfile, timeout=240):
+    # a healthy pass takes well under a minute (jax import + cached jit +
+    # a 256 MiB transfer); the timeout only catches a hung tunnel, and it
+    # must be short enough that one dead pass can't eat the whole bench
+    env = _subproc_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
            "--jsonfile", jsonfile] + args
     res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                         timeout=600)
+                         timeout=timeout)
     if res.returncode != 0:
         raise RuntimeError(f"bench run failed: {res.stderr[-2000:]}")
     with open(jsonfile) as f:
@@ -58,13 +90,14 @@ def _probe_tpu(timeout_secs: int = 180) -> str:
     probe = subprocess.run(
         [sys.executable, "-c",
          "import jax; d = jax.devices(); print(d[0].platform)"],
-        capture_output=True, text=True, timeout=timeout_secs)
+        env=_subproc_env(), capture_output=True, text=True,
+        timeout=timeout_secs)
     if probe.returncode != 0:
         raise RuntimeError(
             f"TPU probe failed: {probe.stderr[-500:]}")
     platform = probe.stdout.strip().lower()
     if platform not in ("tpu", "axon"):  # axon = tunneled TPU plugin
-        if os.environ.get("ELBENCHO_TPU_BENCH_ALLOW_NONTPU") == "1":
+        if _SELFTEST:
             # harness self-test only: the metric name is rewritten so a
             # non-TPU number can never masquerade as the TPU result
             print(f"# WARNING: non-TPU platform {platform!r} allowed by "
@@ -102,11 +135,15 @@ def main() -> int:
                          if r["Phase"] == "READ")
         # warmup (jit compile) then measured passes: read -> HBM, pipelined
         _run_cli(["-r", "-t", "1", "-s", BLOCK_SIZE, "-b", BLOCK_SIZE,
-                  "--tpuids", "0", target], warm)
+                  "--tpuids", "0", target], warm, timeout=600)
         passes = []
         pass_errors = []
+        idle_s = INTER_PASS_IDLE_S
+        idles_used = []
         for pass_num in range(HBM_PASSES):
             open(j3, "w").close()  # fresh result file per pass
+            time.sleep(idle_s)  # let tunnel burst credit recover
+            idles_used.append(idle_s)
             try:
                 hbm = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
                                 "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
@@ -128,6 +165,9 @@ def main() -> int:
                     "TPU accounting is broken; refusing to substitute "
                     f"the host-only rate. Record: {json.dumps(hbm_rec)[:600]}")
             passes.append((mibs, hbm_rec))
+            best = max(p[0] for p in passes)
+            if mibs < best * 0.5:  # still credit-drained: back off further
+                idle_s = min(idle_s * 2, INTER_PASS_IDLE_CAP_S)
         if len(passes) < max(HBM_PASSES - 2, 1):
             raise RuntimeError(
                 f"only {len(passes)}/{HBM_PASSES} HBM passes succeeded; "
@@ -142,7 +182,6 @@ def main() -> int:
             chip: round(v["Bytes"] / 1048576 / wall_s, 1)
             for chip, v in med_rec.get("TpuPerChip", {}).items()
             if wall_s > 0}
-        sys.path.insert(0, REPO)
         from elbencho_tpu.stats.latency_histogram import LatencyHistogram
         histo = LatencyHistogram.from_dict(med_rec.get("IOLatHisto", {}))
         metric = ("seq read 16M blocks into TPU HBM "
@@ -158,6 +197,7 @@ def main() -> int:
             "min": round(passes[0][0], 1),
             "max": round(passes[-1][0], 1),
             "host_read_mibs": round(host_mibs, 1),
+            "inter_pass_idle_s": idles_used,
             "per_chip_hbm_mibs": per_chip,
             "io_lat_usec_p50": round(histo.percentile(50), 1),
             "io_lat_usec_p99": round(histo.percentile(99), 1),

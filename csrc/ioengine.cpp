@@ -573,6 +573,19 @@ inline int sys_io_uring_enter(int ring_fd, unsigned to_submit,
     return static_cast<int>(syscall(__NR_io_uring_enter, ring_fd, to_submit,
                                     min_complete, flags, arg, argsz));
 }
+inline int sys_io_uring_register(int ring_fd, unsigned opcode,
+                                 const void* arg, unsigned nr_args) {
+    return static_cast<int>(syscall(__NR_io_uring_register, ring_fd, opcode,
+                                    arg, nr_args));
+}
+
+// in case the image's linux/io_uring.h predates these (all kernel 5.1)
+#ifndef IORING_REGISTER_BUFFERS
+#define IORING_REGISTER_BUFFERS 0
+#endif
+#ifndef IORING_REGISTER_FILES
+#define IORING_REGISTER_FILES 2
+#endif
 
 #ifndef IORING_ENTER_EXT_ARG
 #define IORING_ENTER_EXT_ARG (1U << 3)
@@ -593,6 +606,7 @@ struct UringSlot {
     char* buf;
     uint64_t submit_usec;
     uint64_t block_idx;
+    uint16_t buf_index;  // registered-buffer slot for READ/WRITE_FIXED
 };
 
 // mmap'd ring state; unmap-all on destruction
@@ -698,8 +712,33 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
             break;
         }
         slots[allocated].buf = static_cast<char*>(p);
+        slots[allocated].buf_index = static_cast<uint16_t>(allocated);
         if (is_write)
             memcpy(slots[allocated].buf, src_buf, buf_size);
+    }
+
+    // register the slot buffers (pages stay pinned: no per-op
+    // get_user_pages) and the fd table (no per-op fget/fput). Both are
+    // pure fast-path optimizations — EPERM/ENOMEM (e.g. RLIMIT_MEMLOCK)
+    // just falls back to the unregistered opcodes.
+    bool fixed_buffers = false;
+    bool fixed_files = false;
+    uint32_t n_fds = 1;
+    if (ret == 0 && allocated == iodepth) {
+        iovec* iov = new iovec[iodepth];
+        for (int i = 0; i < iodepth; ++i) {
+            iov[i].iov_base = slots[i].buf;
+            iov[i].iov_len = buf_size;
+        }
+        fixed_buffers = sys_io_uring_register(
+            ring.ring_fd, IORING_REGISTER_BUFFERS, iov, iodepth) == 0;
+        delete[] iov;
+        if (fd_idx)
+            for (uint64_t i = 0; i < n; ++i)
+                if (fd_idx[i] >= n_fds)
+                    n_fds = fd_idx[i] + 1;
+        fixed_files = sys_io_uring_register(
+            ring.ring_fd, IORING_REGISTER_FILES, fds, n_fds) == 0;
     }
 
     uint64_t next_submit = 0;
@@ -729,8 +768,18 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
         const unsigned idx = tail & *ring.sq_mask;
         io_uring_sqe* sqe = &ring.sqes[idx];
         memset(sqe, 0, sizeof(*sqe));
-        sqe->opcode = rd ? IORING_OP_READ : IORING_OP_WRITE;
-        sqe->fd = fds[fd_idx ? fd_idx[next_submit] : 0];
+        if (fixed_buffers) {
+            sqe->opcode = rd ? IORING_OP_READ_FIXED : IORING_OP_WRITE_FIXED;
+            sqe->buf_index = s.buf_index;
+        } else {
+            sqe->opcode = rd ? IORING_OP_READ : IORING_OP_WRITE;
+        }
+        if (fixed_files) {
+            sqe->fd = static_cast<int32_t>(fd_idx ? fd_idx[next_submit] : 0);
+            sqe->flags |= IOSQE_FIXED_FILE;
+        } else {
+            sqe->fd = fds[fd_idx ? fd_idx[next_submit] : 0];
+        }
         sqe->addr = reinterpret_cast<uint64_t>(s.buf);
         sqe->len = static_cast<uint32_t>(lengths[next_submit]);
         sqe->off = offsets[next_submit];
@@ -1483,7 +1532,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 8 (sync+aio+uring+fileloop+blockmods+ratelimit+flock+opslog)";
+    return "elbencho-tpu ioengine 8 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog)";
 }
 
 }  // extern "C"
