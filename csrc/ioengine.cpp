@@ -579,13 +579,9 @@ inline int sys_io_uring_register(int ring_fd, unsigned opcode,
                                     arg, nr_args));
 }
 
-// in case the image's linux/io_uring.h predates these (all kernel 5.1)
-#ifndef IORING_REGISTER_BUFFERS
-#define IORING_REGISTER_BUFFERS 0
-#endif
-#ifndef IORING_REGISTER_FILES
-#define IORING_REGISTER_FILES 2
-#endif
+// IORING_REGISTER_BUFFERS/_FILES, READ/WRITE_FIXED and IOSQE_FIXED_FILE
+// are kernel-5.1 enums from linux/io_uring.h — as old as io_uring itself,
+// so any header that compiles this file has them
 
 #ifndef IORING_ENTER_EXT_ARG
 #define IORING_ENTER_EXT_ARG (1U << 3)

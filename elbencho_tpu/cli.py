@@ -124,7 +124,7 @@ def main(argv: "list[str] | None" = None) -> int:
                     "hosts were specified but none are usable "
                     "(empty hosts file or --numhosts 0?)")
             if cfg.bench_mode == BenchMode.POSIX and cfg.paths:
-                cfg._find_bench_path_type()
+                cfg.probe_local_paths()
         cfg.check()
     except (ConfigError, OSError) as err:
         print(f"ERROR: {err}", file=sys.stderr)

@@ -511,15 +511,40 @@ class BenchConfig(BenchConfigBase):
     # -- derivation (reference: initImplicitValues/checkArgs) ---------------
 
     def derive(self, probe_paths: bool = True) -> "BenchConfig":
+        if not self.derived_done:
+            # remember which size-ish values the user gave explicitly, so
+            # a late path probe (probe_local_paths) can recompute the
+            # defaults derived from them without clobbering user input;
+            # from_service_dict may have pre-set these from the master's
+            # wire declaration — the local bool() guess must not clobber
+            # that (the master's values arrive already default-filled)
+            if not hasattr(self, "_random_amount_explicit"):
+                self._random_amount_explicit = bool(self.random_amount)
+            if not hasattr(self, "_file_size_explicit"):
+                self._file_size_explicit = bool(self.file_size)
         self._parse_hosts()
         self.tpu_ids = parse_uint_list(self.tpu_ids_str)
         self._init_bench_mode()
         if probe_paths and self.bench_mode == BenchMode.POSIX and self.paths:
             self._find_bench_path_type()
+            self._detect_blockdev_size()
         self._calc_dataset_threads()
         self._apply_implicit_values()
         self.derived_done = True
         return self
+
+    def probe_local_paths(self) -> None:
+        """Late local-path probe for callers that derived with
+        probe_paths=False (the CLI defers probing until it knows the run
+        is local, not master mode): detect the path type and blockdev
+        size, then re-derive the size-dependent implicit values —
+        _apply_implicit_values recomputes every non-explicit default
+        against the freshly probed state. (The service side gets the same
+        treatment through its plain derive(), which runs after the
+        pinned-path overrides are applied.)"""
+        self._find_bench_path_type()
+        self._detect_blockdev_size()
+        self._apply_implicit_values()
 
     @staticmethod
     def _read_hosts(hosts_str: str, file_path: str) -> "list[str]":
@@ -660,6 +685,47 @@ class BenchConfig(BenchConfigBase):
                 f"{[t.name for t in types]}")
         self.bench_path_type = types.pop() if types else BenchPathType.DIR
 
+    def _detect_blockdev_size(self) -> None:
+        """Blockdev mode: detect the device size so -s is optional, and
+        refuse a -s larger than the device (reference:
+        prepareBenchPathFDsVec, ProgArgs.cpp:2306-2330). Runs before the
+        implicit-value derivation so random-amount defaults see the
+        detected size."""
+        if self.bench_path_type != BenchPathType.BLOCKDEV:
+            return
+        dev_size = None
+        for p in self.paths:
+            try:
+                fd = os.open(p, os.O_RDONLY)
+            except OSError as err:
+                raise ConfigError(
+                    f"unable to open block device {p}: {err.strerror}") \
+                    from err
+            try:
+                size = os.lseek(fd, 0, os.SEEK_END)
+            except OSError as err:
+                raise ConfigError(
+                    f"unable to check size of block device through lseek: "
+                    f"{p}: {err.strerror}") from err
+            finally:
+                os.close(fd)
+            if not size:
+                raise ConfigError(f"block device size seems to be 0: {p}")
+            dev_size = size if dev_size is None else min(dev_size, size)
+        if not self.file_size \
+                or not getattr(self, "_file_size_explicit", True):
+            # a size the user never gave (0, or filled by an earlier
+            # derivation's defaults) yields to the detected device size
+            from ..toolkits.logger import LOG_NORMAL, log
+            log(LOG_NORMAL,
+                f"NOTE: Setting file size to block dev size: {dev_size}")
+            self.file_size = dev_size
+        elif self.file_size > dev_size:
+            raise ConfigError(
+                f"given size to use is larger than detected block device "
+                f"size. Detected size: {dev_size}; "
+                f"Given size: {self.file_size}")
+
     def _calc_dataset_threads(self) -> None:
         """numDataSetThreads = threads * hosts if paths shared between
         services, else threads (reference: ProgArgs.cpp:1408-1409)."""
@@ -695,6 +761,11 @@ class BenchConfig(BenchConfigBase):
             # reductions below; check() re-applies for non-derive callers)
             self.block_size = self.file_size
         self._reduce_file_size_to_block_multiple()
+        if not getattr(self, "_random_amount_explicit", True):
+            # a value filled by an earlier derivation (possibly against a
+            # not-yet-probed path type, or on the master for different
+            # paths) is recomputed, never treated as user input
+            self.random_amount = 0
         if self.use_random_offsets and not self.random_amount:
             # default random amount = full dataset size
             if self.bench_path_type != BenchPathType.DIR:
@@ -984,6 +1055,13 @@ class BenchConfig(BenchConfigBase):
              for f in dataclasses.fields(self)}
         d["rank_offset"] = self.rank_offset + service_rank_offset
         d["ProtocolVersion"] = protocol_version or HTTP_PROTOCOL_VERSION
+        # which size values the USER gave (vs master-side derived
+        # defaults): the service's own probe must be allowed to recompute
+        # defaults for ITS paths, but never to clobber explicit input
+        d["RandomAmountExplicit"] = getattr(
+            self, "_random_amount_explicit", bool(self.random_amount))
+        d["FileSizeExplicit"] = getattr(
+            self, "_file_size_explicit", bool(self.file_size))
         # master never ships its own hosts list / service flags to services
         d["hosts_str"] = ""
         d["hosts_file_path"] = ""
@@ -1020,16 +1098,30 @@ class BenchConfig(BenchConfigBase):
         return d
 
     @classmethod
-    def from_service_dict(cls, d: dict) -> "BenchConfig":
+    def from_service_dict(cls, d: dict, derive: bool = True) \
+            -> "BenchConfig":
         """Rebuild effective config on the service side
-        (reference: setFromPropertyTreeForService, ProgArgs.cpp:3754)."""
+        (reference: setFromPropertyTreeForService, ProgArgs.cpp:3754).
+
+        derive=False defers derivation/validation so the caller can apply
+        service-side overrides (pinned --path / --tpuids) FIRST — deriving
+        against the master's paths would probe devices this service will
+        never touch. The caller must then run derive() + check() itself."""
         d = dict(d)
         d.pop("ProtocolVersion", None)
         cfg = cls(**{k: v for k, v in d.items()
                      if k in {f.name for f in dataclasses.fields(cls)}})
         cfg._service_side = True  # no default result files on services
-        cfg.derive()
-        cfg.check()
+        # master-declared explicitness beats the local bool(value) guess:
+        # a master-derived default must stay recomputable against the
+        # service's own (possibly pinned) paths
+        if "RandomAmountExplicit" in d:
+            cfg._random_amount_explicit = bool(d["RandomAmountExplicit"])
+        if "FileSizeExplicit" in d:
+            cfg._file_size_explicit = bool(d["FileSizeExplicit"])
+        if derive:
+            cfg.derive()
+            cfg.check()
         return cfg
 
     def config_labels(self) -> "dict[str, str]":

@@ -70,7 +70,10 @@ class ServiceState:
             raise ConfigError(
                 f"protocol version mismatch: master={version!r} "
                 f"service={HTTP_PROTOCOL_VERSION!r}")
-        cfg = BenchConfig.from_service_dict(cfg_dict)
+        # overrides are applied BEFORE derive(): deriving first would
+        # probe (open, size-check) the MASTER's paths on this host even
+        # when a pinned --path means they are never used here
+        cfg = BenchConfig.from_service_dict(cfg_dict, derive=False)
         cfg.run_as_service = True
         cfg.disable_live_stats = True
         # keep OUR listen port, not the master's --port: netbench derives
@@ -80,14 +83,13 @@ class ServiceState:
         # (reference: ProgArgs.cpp:1366-1382)
         if self.base_cfg.paths:
             cfg.paths = list(self.base_cfg.paths)
-            cfg._find_bench_path_type()
         if self.base_cfg.tpu_ids_str:
-            cfg.tpu_ids_str = self.base_cfg.tpu_ids_str
-            from ..toolkits.units import parse_uint_list
-            cfg.tpu_ids = parse_uint_list(cfg.tpu_ids_str)
+            cfg.tpu_ids_str = self.base_cfg.tpu_ids_str  # derive() parses
         if cfg.tree_file_path:
             cfg.tree_file_path = self._uploaded_file_path(
                 os.path.basename(cfg.tree_file_path))
+        cfg.derive()
+        cfg.check()
         self.cfg = cfg
         self.manager = WorkerManager(cfg)
         self.statistics = Statistics(cfg, self.manager)

@@ -205,3 +205,98 @@ def test_reference_example_config_file_verbatim(tmp_path):
     assert cfg.run_delete_dirs and cfg.run_delete_files
     assert cfg.run_create_dirs and cfg.run_create_files
     assert cfg.use_random_offsets is True
+
+
+def _fake_blockdev(monkeypatch):
+    """Make _find_bench_path_type see EVERY non-dir path as a block
+    device; lseek/SEEK_END on the real file then stands in for the device
+    size probe."""
+    monkeypatch.setattr(
+        "elbencho_tpu.config.args.stat_mod.S_ISBLK", lambda mode: True)
+
+
+def test_blockdev_size_autodetect(tmp_path, monkeypatch):
+    """-s is optional on block devices: the size comes from lseek SEEK_END
+    with a NOTE (reference: prepareBenchPathFDsVec, ProgArgs.cpp:2306-2330)."""
+    dev = tmp_path / "fakedev"
+    dev.write_bytes(b"\0" * (8 << 20))
+    _fake_blockdev(monkeypatch)
+    cfg, _ = parse_cli(["-r", "-b", "1M", str(dev)])
+    cfg.derive()
+    assert cfg.bench_path_type == BenchPathType.BLOCKDEV
+    assert cfg.file_size == 8 << 20
+    # random amount default derives from the detected size
+    cfg2, _ = parse_cli(["-r", "--rand", "-b", "1M", str(dev)])
+    cfg2.derive()
+    assert cfg2.random_amount == 8 << 20
+
+
+def test_blockdev_size_too_large_rejected(tmp_path, monkeypatch):
+    dev = tmp_path / "fakedev"
+    dev.write_bytes(b"\0" * (4 << 20))
+    _fake_blockdev(monkeypatch)
+    cfg, _ = parse_cli(["-r", "-b", "1M", "-s", "16M", str(dev)])
+    with pytest.raises(ConfigError, match="larger than detected"):
+        cfg.derive()
+
+
+def test_blockdev_explicit_size_within_device(tmp_path, monkeypatch):
+    dev = tmp_path / "fakedev"
+    dev.write_bytes(b"\0" * (8 << 20))
+    _fake_blockdev(monkeypatch)
+    cfg, _ = parse_cli(["-r", "-b", "1M", "-s", "4M", str(dev)])
+    cfg.derive()
+    assert cfg.file_size == 4 << 20
+
+
+def test_blockdev_multipath_random_amount_late_probe(tmp_path, monkeypatch):
+    """CLI-style late probe (derive(probe_paths=False) then
+    probe_local_paths): the random-amount default must be recomputed with
+    the real path type — file_size * num_paths for non-DIR — not stay at
+    the DIR-branch value derived before probing."""
+    d1 = tmp_path / "devA"
+    d2 = tmp_path / "devB"
+    for d in (d1, d2):
+        d.write_bytes(b"\0" * (4 << 20))
+    _fake_blockdev(monkeypatch)
+    cfg, _ = parse_cli(["-r", "--rand", "-b", "1M", "-s", "4M",
+                        str(d1), str(d2)])
+    cfg.derive(probe_paths=False)
+    cfg.probe_local_paths()
+    assert cfg.bench_path_type == BenchPathType.BLOCKDEV
+    assert cfg.random_amount == 2 * (4 << 20)
+    # explicit --randamount survives the late probe untouched
+    cfg2, _ = parse_cli(["-r", "--rand", "-b", "1M", "-s", "4M",
+                         "--randamount", "6M", str(d1), str(d2)])
+    cfg2.derive(probe_paths=False)
+    cfg2.probe_local_paths()
+    assert cfg2.random_amount == 6 << 20
+
+
+def test_service_wire_preserves_default_recompute(tmp_path, monkeypatch):
+    """A master-derived random-amount default (computed before any path
+    probe, so via the DIR branch) must be recomputed on the service
+    against the service's own paths — the wire marks it as non-explicit
+    (RandomAmountExplicit) so the service's derive() can redo it."""
+    d1 = tmp_path / "devA"
+    d2 = tmp_path / "devB"
+    for d in (d1, d2):
+        d.write_bytes(b"\0" * (4 << 20))
+    _fake_blockdev(monkeypatch)
+    cfg, _ = parse_cli(["-r", "--rand", "-b", "1M", "-s", "4M",
+                        "--hosts", "h1", str(d1), str(d2)])
+    cfg.derive(probe_paths=False)  # master mode: no local probe
+    assert cfg.random_amount == 4 << 20  # DIR-branch default (unprobed)
+    wire = cfg.to_service_dict()
+    assert wire["RandomAmountExplicit"] is False
+    svc = BenchConfig.from_service_dict(wire)
+    # service derived against the real (blockdev) paths: 2 devices
+    assert svc.random_amount == 2 * (4 << 20)
+    # explicit --randamount survives the wire untouched
+    cfg2, _ = parse_cli(["-r", "--rand", "-b", "1M", "-s", "4M",
+                         "--randamount", "6M", "--hosts", "h1",
+                         str(d1), str(d2)])
+    cfg2.derive(probe_paths=False)
+    wire2 = cfg2.to_service_dict()
+    assert wire2["RandomAmountExplicit"] is True
+    assert BenchConfig.from_service_dict(wire2).random_amount == 6 << 20
