@@ -526,8 +526,7 @@ class BenchConfig(BenchConfigBase):
         self.tpu_ids = parse_uint_list(self.tpu_ids_str)
         self._init_bench_mode()
         if probe_paths and self.bench_mode == BenchMode.POSIX and self.paths:
-            self._find_bench_path_type()
-            self._detect_blockdev_size()
+            self._probe_path_types_and_sizes()
         self._calc_dataset_threads()
         self._apply_implicit_values()
         self.derived_done = True
@@ -542,8 +541,7 @@ class BenchConfig(BenchConfigBase):
         against the freshly probed state. (The service side gets the same
         treatment through its plain derive(), which runs after the
         pinned-path overrides are applied.)"""
-        self._find_bench_path_type()
-        self._detect_blockdev_size()
+        self._probe_path_types_and_sizes()
         self._apply_implicit_values()
 
     @staticmethod
@@ -725,6 +723,54 @@ class BenchConfig(BenchConfigBase):
                 f"given size to use is larger than detected block device "
                 f"size. Detected size: {dev_size}; "
                 f"Given size: {self.file_size}")
+
+    def _probe_path_types_and_sizes(self) -> None:
+        """The local path probe: type detection plus blockdev/file size
+        detection, kept as ONE unit so the derive() probe and the late
+        probe_local_paths() can never diverge."""
+        self._find_bench_path_type()
+        self._detect_blockdev_size()
+        self._detect_file_size()
+
+    def _detect_file_size(self) -> None:
+        """File mode: auto-set the file size from an existing file so -s
+        is optional, refuse a read-only -s larger than the file, and
+        refuse a size of 0 (reference: prepareFileSize,
+        ProgArgs.cpp:2193-2227). Skipped while any path does not exist
+        yet (a create phase materializes them at -s)."""
+        if self.bench_path_type != BenchPathType.FILE:
+            return
+        explicit = self.file_size \
+            and getattr(self, "_file_size_explicit", True)
+        first = True
+        for p in self.paths:
+            try:
+                st = os.stat(p)
+            except OSError:
+                return  # to be created by the write phase; -s governs
+            if not explicit and first:
+                # a value filled by an earlier derivation's defaults is
+                # recomputed from the real file, never validated against
+                first = False
+                if not st.st_size and (self.run_read_files
+                                       or self.run_create_files):
+                    raise ConfigError(
+                        "file size must not be 0 when benchmark path is "
+                        f"a file: {p}")
+                from ..toolkits.logger import LOG_NORMAL, log
+                log(LOG_NORMAL,
+                    f"NOTE: Auto-setting file size. Size: {st.st_size}; "
+                    f"Path: {p}")
+                self.file_size = st.st_size
+            elif not self.run_create_files \
+                    and st.st_size < self.file_size \
+                    and stat_mod.S_ISREG(st.st_mode):
+                # ignore character devices like /dev/zero, as the
+                # reference does
+                raise ConfigError(
+                    f"given size to use is larger than detected size. "
+                    f"File: {p}; Detected size: {st.st_size}; "
+                    f"Given size: {self.file_size}")
 
     def _calc_dataset_threads(self) -> None:
         """numDataSetThreads = threads * hosts if paths shared between

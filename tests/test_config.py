@@ -101,7 +101,7 @@ def test_service_roundtrip():
 
 def test_random_amount_default(tmp_path):
     f = tmp_path / "x"
-    f.write_bytes(b"0" * 4096)
+    f.write_bytes(b"0" * (1 << 20))
     cfg, _ = parse_cli(["-r", "--rand", "-s", "1M", "-b", "4K", str(f)])
     cfg.derive()
     assert cfg.random_amount == 1 << 20
@@ -300,3 +300,29 @@ def test_service_wire_preserves_default_recompute(tmp_path, monkeypatch):
     wire2 = cfg2.to_service_dict()
     assert wire2["RandomAmountExplicit"] is True
     assert BenchConfig.from_service_dict(wire2).random_amount == 6 << 20
+
+
+def test_file_size_autodetect_existing_file(tmp_path):
+    """-s is optional when the bench path is an existing file: the size is
+    auto-set with a NOTE (reference: prepareFileSize, ProgArgs.cpp:2211)."""
+    f = tmp_path / "data.bin"
+    f.write_bytes(b"\0" * (4 << 20))
+    cfg, _ = parse_cli(["-r", "-b", "64K", str(f)])
+    cfg.derive()
+    assert cfg.file_size == 4 << 20
+    # read-only -s larger than the file is refused (ProgArgs.cpp:2221)
+    cfg2, _ = parse_cli(["-r", "-b", "64K", "-s", "8M", str(f)])
+    with pytest.raises(ConfigError, match="larger than detected"):
+        cfg2.derive()
+    # ...but a create phase may grow the file, so it's allowed there
+    cfg3, _ = parse_cli(["-w", "-b", "64K", "-s", "8M", str(f)])
+    cfg3.derive()
+    assert cfg3.file_size == 8 << 20
+
+
+def test_file_size_zero_rejected(tmp_path):
+    f = tmp_path / "empty.bin"
+    f.write_bytes(b"")
+    cfg, _ = parse_cli(["-r", "-b", "64K", str(f)])
+    with pytest.raises(ConfigError, match="must not be 0"):
+        cfg.derive()
