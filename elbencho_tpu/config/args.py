@@ -288,8 +288,9 @@ FLAG_DEFS = [
      "Run TPU transfer benchmark (no storage; the netbench analogue over "
      "the device fabric: host<->HBM DMA and ICI collectives)"),
     ("tpubenchpat", None, "tpu_bench_pattern", "str", "h2d", "tpu",
-     "TPU bench pattern: h2d|d2h|both|ici (ici = ring ppermute over all "
-     "chips, measuring inter-chip bandwidth)"),
+     "TPU bench pattern: h2d|d2h|both|ici|allgather|reducescatter|"
+     "alltoall|psum (ici = ring ppermute; the rest time one XLA "
+     "collective per step over all chips, NCCL-perf-test style)"),
     ("podhosts", None, "use_pod_hosts", "bool", False, "tpu",
      "Derive --hosts from this TPU pod slice's worker VMs "
      "(TPU_WORKER_HOSTNAMES env or GCE metadata; each worker must run "
@@ -736,40 +737,44 @@ class BenchConfig(BenchConfigBase):
         """File mode: auto-set the file size from an existing file so -s
         is optional, refuse a read-only -s larger than the file, and
         refuse a size of 0 (reference: prepareFileSize,
-        ProgArgs.cpp:2193-2227). Skipped while any path does not exist
-        yet (a create phase materializes them at -s)."""
+        ProgArgs.cpp:2193-2227). A path that does not exist yet behaves
+        like the reference's freshly O_CREAT-ed empty file: size 0, which
+        a read or create phase without -s must reject rather than run a
+        silent zero-byte benchmark."""
         if self.bench_path_type != BenchPathType.FILE:
             return
         explicit = self.file_size \
             and getattr(self, "_file_size_explicit", True)
-        first = True
+        detected = explicit
         for p in self.paths:
             try:
                 st = os.stat(p)
             except OSError:
-                return  # to be created by the write phase; -s governs
-            if not explicit and first:
-                # a value filled by an earlier derivation's defaults is
-                # recomputed from the real file, never validated against
-                first = False
-                if not st.st_size and (self.run_read_files
-                                       or self.run_create_files):
+                st = None  # created (empty) by the write phase
+            cur_size = st.st_size if st else 0
+            if not detected:
+                # first path wins, like the reference's sequential fd
+                # probe; a value filled by an earlier derivation's
+                # defaults is recomputed, never validated against
+                detected = True
+                if not cur_size and (self.run_read_files
+                                     or self.run_create_files):
                     raise ConfigError(
                         "file size must not be 0 when benchmark path is "
-                        f"a file: {p}")
+                        f"a file (give -s): {p}")
                 from ..toolkits.logger import LOG_NORMAL, log
                 log(LOG_NORMAL,
-                    f"NOTE: Auto-setting file size. Size: {st.st_size}; "
+                    f"NOTE: Auto-setting file size. Size: {cur_size}; "
                     f"Path: {p}")
-                self.file_size = st.st_size
-            elif not self.run_create_files \
-                    and st.st_size < self.file_size \
+                self.file_size = cur_size
+            elif not self.run_create_files and st is not None \
+                    and cur_size < self.file_size \
                     and stat_mod.S_ISREG(st.st_mode):
                 # ignore character devices like /dev/zero, as the
                 # reference does
                 raise ConfigError(
                     f"given size to use is larger than detected size. "
-                    f"File: {p}; Detected size: {st.st_size}; "
+                    f"File: {p}; Detected size: {cur_size}; "
                     f"Given size: {self.file_size}")
 
     def _calc_dataset_threads(self) -> None:

@@ -10,6 +10,12 @@ sockets, workers hammer the data paths a TPU ingest pipeline actually uses:
   ici   ring ppermute of a sharded array over every chip of the mesh —
         each step moves the full shard over the inter-chip interconnect
         (the XLA-collective replacement for NCCL-style p2p benchmarks)
+  allgather / reducescatter / alltoall / psum
+        the remaining collective families a sharded ingest pipeline
+        exercises (all_gather fan-in, reduce_scatter fan-out, all-to-all
+        reshards, psum trees), each as its own timed pattern so per-op
+        fabric latency is attributable per collective — the NCCL
+        perf-test suite analogue, on XLA collectives
 
 Workers transfer --size bytes total in --block chunks; per-op latency goes
 to the IOPS histogram; bytes count into both live ops and the per-chip HBM
@@ -31,12 +37,13 @@ def run_tpubench_phase(worker, phase: BenchPhase) -> None:
     if worker._tpu is None:
         raise WorkerException(
             "--tpubench requires --tpuids (chips to benchmark)")
-    if pattern == "ici":
-        _run_ici(worker)
+    if pattern in ("ici", "allgather", "reducescatter", "alltoall", "psum"):
+        _run_collective(worker, pattern)
         return
     if pattern not in ("h2d", "d2h", "both"):
         raise WorkerException(
-            f"unknown --tpubenchpat {pattern!r} (h2d|d2h|both|ici)")
+            f"unknown --tpubenchpat {pattern!r} (h2d|d2h|both|ici|"
+            f"allgather|reducescatter|alltoall|psum)")
     ctx = worker._tpu
     bs = cfg.block_size
     total = max(cfg.file_size, bs)
@@ -65,10 +72,14 @@ def run_tpubench_phase(worker, phase: BenchPhase) -> None:
     worker.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
 
 
-def _run_ici(worker) -> None:
-    """Ring ppermute over all available chips; only the first local worker
-    drives the mesh (one SPMD program per host, like the reference's
-    rank-0-only sync phase)."""
+def _run_collective(worker, pattern: str) -> None:
+    """One timed collective per step over all available chips; only the
+    first local worker drives the mesh (one SPMD program per host, like
+    the reference's rank-0-only sync phase).
+
+    Accounted bytes per step are the sharded array's total size
+    (the NCCL-perf-test "algorithm bytes" convention), so the patterns
+    are directly comparable; per-step latency goes to the IOPS histogram."""
     cfg = worker.cfg
     if worker.rank % max(1, cfg.num_threads) != 0:
         worker.got_phase_work = False
@@ -84,26 +95,51 @@ def _run_ici(worker) -> None:
     n_dev = len(devices)
     mesh = Mesh(np.array(devices), axis_names=("chip",))
     bs_words = max(cfg.block_size // 4, 128)
+    # all-to-all / reduce-scatter split the lane axis across chips
+    bs_words += (-bs_words) % n_dev
     total = max(cfg.file_size, cfg.block_size)
     # sharded array: one block per chip
     arr = jax.device_put(
         np.zeros((n_dev, bs_words), dtype=np.uint32),
         NamedSharding(mesh, P("chip", None)))
 
-    def _shift(x):
-        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-        return jax.lax.ppermute(x, axis_name="chip", perm=perm)
+    def _per_shard(x):
+        if pattern == "ici":  # ring p2p: every chip forwards its shard
+            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            return jax.lax.ppermute(x, axis_name="chip", perm=perm)
+        if pattern == "allgather":
+            r = jax.lax.all_gather(x, "chip").sum(dtype=jnp.uint32)
+        elif pattern == "reducescatter":
+            r = jax.lax.psum_scatter(
+                x, "chip", scatter_dimension=1, tiled=True) \
+                .sum(dtype=jnp.uint32)
+        elif pattern == "alltoall":
+            # tiled: the lane axis is cut into one slice per chip and the
+            # slices are exchanged (shape-preserving reshard)
+            r = jax.lax.all_to_all(
+                x, "chip", split_axis=1, concat_axis=1, tiled=True) \
+                .sum(dtype=jnp.uint32)
+        else:  # psum: full-array allreduce
+            r = jax.lax.psum(x, "chip").sum(dtype=jnp.uint32)
+        # fold the per-shard scalar so the output is replicated (clean
+        # P() out spec); negligible next to the array collective above
+        return jax.lax.psum(r, "chip").reshape(())
 
-    step = jax.jit(shard_map(_shift, mesh=mesh, in_specs=P("chip", None),
-                             out_specs=P("chip", None)))
-    step(arr)[0].block_until_ready()  # warm the compile outside timing
+    stateful = pattern == "ici"  # the ring permute carries its state
+    out_spec = P("chip", None) if stateful else P()
+    step = jax.jit(shard_map(
+        _per_shard, mesh=mesh, in_specs=P("chip", None),
+        out_specs=out_spec, check_replication=False))
+    jax.block_until_ready(step(arr))  # warm the compile outside timing
     bytes_per_step = n_dev * bs_words * 4
     done = 0
     while done < total:
         worker.check_interruption_request(force=True)
         t0 = time.perf_counter_ns()
-        arr = step(arr)
-        jax.block_until_ready(arr)
+        out = step(arr)
+        jax.block_until_ready(out)
+        if stateful:
+            arr = out
         lat_usec = (time.perf_counter_ns() - t0) // 1000
         worker.iops_latency_histo.add_latency(lat_usec)
         worker.live_ops.num_bytes_done += bytes_per_step

@@ -326,3 +326,12 @@ def test_file_size_zero_rejected(tmp_path):
     cfg, _ = parse_cli(["-r", "-b", "64K", str(f)])
     with pytest.raises(ConfigError, match="must not be 0"):
         cfg.derive()
+
+
+def test_write_new_file_without_size_rejected(tmp_path):
+    """A create phase on a not-yet-existing file without -s is an error
+    (reference: the freshly O_CREAT-ed file has size 0 and prepareFileSize
+    raises), not a silent zero-byte benchmark."""
+    cfg, _ = parse_cli(["-w", "-b", "64K", str(tmp_path / "newfile.bin")])
+    with pytest.raises(ConfigError, match="must not be 0"):
+        cfg.derive()

@@ -35,6 +35,20 @@ def test_tpubench_ici_pattern(tmp_path):
     assert rec["NumWorkers"] == 1
 
 
+def test_tpubench_collective_patterns(tmp_path):
+    """allgather/reducescatter/alltoall/psum each time one collective per
+    step over the 8 virtual CPU devices (NCCL-perf-test analogue)."""
+    for pat in ("allgather", "reducescatter", "alltoall", "psum"):
+        jsonfile = tmp_path / f"{pat}.json"
+        rc = main(["--tpubench", "--tpubenchpat", pat, "-s", "512K",
+                   "-b", "64K", "--nolive", "--jsonfile", str(jsonfile)])
+        assert rc == 0, pat
+        rec = json.loads(jsonfile.read_text().splitlines()[0])
+        assert rec["Phase"] == "TPUBENCH"
+        assert rec["BytesLast"] >= 512 * 1024, pat
+        assert rec["IOPSLast"] > 0, pat
+
+
 def test_tpubench_bad_pattern():
     rc = main(["--tpubench", "--tpubenchpat", "bogus", "-s", "64K",
                "--nolive"])
