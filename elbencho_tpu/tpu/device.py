@@ -179,10 +179,14 @@ class TpuWorkerContext:
             if n_words != self._num_words:
                 arr = arr[:n_words]
         host = np.asarray(arr)  # D2H transfer
-        raw = host.tobytes()
-        buf[:len(raw[:length])] = raw[:length]
+        # single copy into the I/O buffer (tobytes() + slice-assign would
+        # add two more full-block copies on this hot path)
+        dst = np.frombuffer(buf, dtype=np.uint8, count=length)
+        np.copyto(dst[:n_words * 4], host.view(np.uint8)[:length])
+        if length % 4:  # trailing sub-word bytes the u32 view can't carry
+            dst[n_words * 4:] = 0
         if verify_salt and length % 8:
-            buf[(length // 8) * 8:length] = bytes(length - (length // 8) * 8)
+            dst[(length // 8) * 8:] = 0
 
     def close(self) -> None:
         self.flush()

@@ -31,19 +31,24 @@ from ..phases import BenchPhase
 from .shared import WorkerException
 
 
+COLLECTIVE_PATTERNS = ("ici", "allgather", "reducescatter", "alltoall",
+                       "psum")
+TRANSFER_PATTERNS = ("h2d", "d2h", "both")
+
+
 def run_tpubench_phase(worker, phase: BenchPhase) -> None:
     cfg = worker.cfg
     pattern = cfg.tpu_bench_pattern
     if worker._tpu is None:
         raise WorkerException(
             "--tpubench requires --tpuids (chips to benchmark)")
-    if pattern in ("ici", "allgather", "reducescatter", "alltoall", "psum"):
+    if pattern in COLLECTIVE_PATTERNS:
         _run_collective(worker, pattern)
         return
-    if pattern not in ("h2d", "d2h", "both"):
+    if pattern not in TRANSFER_PATTERNS:
         raise WorkerException(
-            f"unknown --tpubenchpat {pattern!r} (h2d|d2h|both|ici|"
-            f"allgather|reducescatter|alltoall|psum)")
+            f"unknown --tpubenchpat {pattern!r} "
+            f"({'|'.join(TRANSFER_PATTERNS + COLLECTIVE_PATTERNS)})")
     ctx = worker._tpu
     bs = cfg.block_size
     total = max(cfg.file_size, bs)
