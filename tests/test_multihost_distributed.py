@@ -48,6 +48,21 @@ sync_global_devices("elbencho-tpu-test")
 out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
     jnp.ones((len(jax.local_devices()),)))
 assert float(out[0]) == 4.0, out
+
+# the REAL pod ingest step over the two-host mesh: shard placement,
+# per-chip scramble, psum/all_gather reductions across BOTH processes
+import numpy as np
+from elbencho_tpu.parallel.ingest import (host_shard_to_devices,
+                                          make_ingest_step)
+step, sharding = make_ingest_step(mesh)
+rows, cols = 4, 256  # divisible by the (2, 2) mesh
+batch = np.arange(rows * cols, dtype=np.uint32).reshape(rows, cols)
+placed = host_shard_to_devices(mesh, batch)
+assert placed.sharding.is_equivalent_to(sharding, placed.ndim)
+scrambled, csum, xr = step(placed, jax.random.PRNGKey(7))
+assert scrambled.shape == (rows, cols)
+# the reductions are replicated: every process must print the same pair
+print("INGEST_FPRINT", int(csum), int(xr))
 print("CHILD_OK", {pid})
 """
 
@@ -81,6 +96,11 @@ def test_two_process_distributed_mesh():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    fprints = []
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"process {pid} failed:\n{err[-2000:]}"
         assert f"CHILD_OK {pid}" in out
+        fprints += [ln for ln in out.splitlines()
+                    if ln.startswith("INGEST_FPRINT")]
+    # the global fingerprint reduction must agree across both processes
+    assert len(fprints) == 2 and fprints[0] == fprints[1], fprints
