@@ -282,6 +282,9 @@ FLAG_DEFS = [
      "(cuFile/GDS analogue on PjRt)"),
     ("tpuverify", None, "do_tpu_verify", "bool", False, "tpu",
      "Run integrity verification on-device (Pallas kernel) instead of host"),
+    ("tpuprofile", None, "tpu_profile_dir", "str", "", "tpu",
+     "Write a jax profiler trace (XLA device timeline for TensorBoard/"
+     "Perfetto) per TPU-touching phase into this directory"),
     ("tpuhbmpct", None, "tpu_hbm_limit_pct", "int", 90, "tpu",
      "Max percentage of per-chip HBM to use for staging buffers"),
     ("tpubench", None, "run_tpu_bench", "bool", False, "tpu",

@@ -9,6 +9,7 @@ time :150-159, service-ready wait :165.
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 import time
@@ -26,6 +27,8 @@ class Coordinator:
         self.manager = WorkerManager(cfg)
         self.statistics = Statistics(cfg, self.manager)
         self._interrupted = False
+        self._profile_seq = 0
+        self._profile_warned_hosts = False
         self._old_sigint = None
 
     # ------------------------------------------------------------------
@@ -125,14 +128,72 @@ class Coordinator:
         """Start phase -> live stats -> wait done -> print results
         (reference: runBenchmarkPhase, Coordinator.cpp:249)."""
         phase_start = time.monotonic()
-        self.manager.start_next_phase(phase)
-        self.statistics.live_stats_loop(phase, phase_start)
-        self.manager.wait_for_workers_done(phase_start)
+        profiling = self._start_tpu_profile(phase)
+        try:
+            self.manager.start_next_phase(phase)
+            self.statistics.live_stats_loop(phase, phase_start)
+            self.manager.wait_for_workers_done(phase_start)
+        finally:
+            if profiling:
+                self._stop_tpu_profile()
         self.statistics.print_phase_results(phase)
         if self._interrupted:
             # user Ctrl-C: print what we have for this phase, then abort the
             # remaining phases (reference: handleInterruptSignal semantics)
             raise KeyboardInterrupt
+
+    #: phases whose workers drive the TPU data path (H2D staging on
+    #: reads, HBM-originated fills on writes, the fabric bench itself) —
+    #: metadata phases (mkdir/stat/delete) never touch the device
+    _TPU_PROFILE_PHASES = (BenchPhase.CREATEFILES, BenchPhase.READFILES,
+                           BenchPhase.TPUBENCH)
+
+    def _start_tpu_profile(self, phase: BenchPhase) -> bool:
+        """--tpuprofile DIR: bracket each TPU-touching measured phase with
+        a jax profiler trace (XLA device timeline, viewable in
+        TensorBoard/Perfetto — the TPU-native per-op observability the
+        reference's --opslog gives for syscalls). One trace subdirectory
+        per phase run."""
+        cfg = self.cfg
+        if not cfg.tpu_profile_dir:
+            return False
+        if not (cfg.tpu_ids or cfg.run_tpu_bench):
+            return False
+        if phase not in self._TPU_PROFILE_PHASES:
+            return False
+        if cfg.hosts:
+            # master mode: the TPU work happens in the remote service
+            # processes; tracing this process would record an idle
+            # timeline. Warn once instead of writing meaningless traces.
+            if not self._profile_warned_hosts:
+                self._profile_warned_hosts = True
+                logger.log_error(
+                    "--tpuprofile is ignored in master mode (the TPU "
+                    "work runs in the remote service processes); run the "
+                    "benchmark locally on each host to capture traces")
+            return False
+        self._profile_seq += 1
+        trace_dir = os.path.join(
+            cfg.tpu_profile_dir,
+            f"{self._profile_seq:03d}_{phase.name.lower()}")
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+        except Exception as err:  # pragma: no cover - backend-dependent
+            logger.log_error(f"--tpuprofile: cannot start jax trace "
+                             f"({type(err).__name__}: {err})")
+            return False
+        logger.log(1, f"TPU profile trace: {trace_dir}")
+        return True
+
+    @staticmethod
+    def _stop_tpu_profile() -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as err:  # pragma: no cover - backend-dependent
+            logger.log_error(f"--tpuprofile: stop_trace failed "
+                             f"({type(err).__name__}: {err})")
 
     def _rotate_hosts(self) -> None:
         """--rotatehosts: shift the hosts list between phases, which

@@ -85,3 +85,16 @@ def test_rwmixthr_with_balancer(tmp_path, monkeypatch):
                "-n", "1", "-N", "2", "-s", "64K", "-b", "16K", "--nolive",
                str(tmp_path)])
     assert rc == 0
+
+
+def test_tpuprofile_writes_trace(tmp_path):
+    """--tpuprofile brackets TPU phases with a jax profiler trace; the
+    trace directory must contain the dumped timeline artifacts."""
+    import os
+    prof_dir = tmp_path / "prof"
+    rc = main(["--tpubench", "-s", "256K", "-b", "64K", "--nolive",
+               "--tpuprofile", str(prof_dir)])
+    assert rc == 0
+    dumped = [os.path.join(r, f) for r, _, fs in os.walk(prof_dir)
+              for f in fs]
+    assert dumped, "no profiler artifacts written"
