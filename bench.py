@@ -13,13 +13,21 @@ TPU HBM (1.0 = the TPU leg is fully hidden by pipelining). The reference
 publishes no GPU-path numbers (BASELINE.md: published == {}), so the
 self-relative ratio is the honest comparison.
 
-Prints ONE JSON line. Core keys: {"metric", "value", "unit",
+Prints ONE JSON line — ALWAYS, success or failure (round-2 verdict item
+1: two rounds of `parsed=null` artifacts because a dead tunnel aborted
+before any JSON was printed). Core keys: {"metric", "value", "unit",
 "vs_baseline"}; value is the MEDIAN of HBM_PASSES measured passes, with
 dispersion and context in the extra keys {"median_of", "min", "max",
 "host_read_mibs", "inter_pass_idle_s", "per_chip_hbm_mibs",
-"io_lat_usec_p50", "io_lat_usec_p99"}. If TPU accounting yields no
-TpuHbmMiBPerSec the run FAILS rather than substituting the host-only
-storage rate.
+"io_lat_usec_p50", "io_lat_usec_p99"}. On failure the same line carries
+{"value": null, "error": ..., "failed_stage": ..., "probe_timeline":
+[...]} with wall-clock timestamps so the artifact of record is a
+machine-readable account of WHY, and the exit code stays 0 so an
+rc-gating driver still captures the line. The TPU probe retries with
+backoff across ELBENCHO_TPU_BENCH_PROBE_WINDOW_S (default 35 min) so a
+transiently-down tunnel no longer voids the round. If TPU accounting
+yields no TpuHbmMiBPerSec the run FAILS rather than substituting the
+host-only storage rate.
 """
 
 from __future__ import annotations
@@ -44,11 +52,17 @@ def _subproc_env() -> dict:
     return _axon_mitigation.sanitized_env(1) if _SELFTEST \
         else dict(os.environ)
 
-FILE_SIZE = "256M"
-BLOCK_SIZE = "16M"
-IO_DEPTH = "4"     # per-thread transfer pipeline depth
-THREADS = "2"      # two workers overlap tunnel round-trips
-HBM_PASSES = 5     # report the median pass, with min/max dispersion
+# workload shape env-overridable ONLY for the harness self-test (fast CI
+# smoke of the whole pipeline); the driver runs the defaults
+def _knob(name, default):
+    return os.environ.get("ELBENCHO_TPU_BENCH_" + name, default) \
+        if _SELFTEST else default
+
+FILE_SIZE = _knob("FILE_SIZE", "256M")
+BLOCK_SIZE = _knob("BLOCK_SIZE", "16M")
+IO_DEPTH = _knob("IO_DEPTH", "4")   # per-thread transfer pipeline depth
+THREADS = _knob("THREADS", "2")     # two workers overlap tunnel round-trips
+HBM_PASSES = int(_knob("PASSES", "5"))  # report the median, w/ dispersion
 # The axon tunnel rate-limits H2D traffic with a burst-credit window
 # (measured round 2: ~1.8-2.2 GiB/s for the first ~0.5-2 GiB, then a hard
 # ~200 MiB/s sustained floor, recovering over idle seconds-to-minutes; the
@@ -89,10 +103,44 @@ def _run_cli(args, jsonfile, timeout=240):
         return [json.loads(ln) for ln in f if ln.strip()]
 
 
-def _probe_tpu(timeout_secs: int = 180) -> str:
-    """Fail fast (with a clear message) when the TPU backend is
-    unreachable — jax.devices() otherwise blocks forever on a dead
-    tunnel and the whole bench run times out without explanation."""
+# probe-retry budget: a transiently-down tunnel must not void the round
+# (round-2 verdict item 1). One attempt is a bounded subprocess; between
+# failed attempts the wait backs off 15s -> x2 -> cap 120s until the
+# window is spent. All knobs env-overridable so tests can fail fast.
+def _int_env(name: str, default: int) -> int:
+    # a malformed knob must degrade to the default, not crash before the
+    # never-null JSON line can be printed
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        print(f"# WARNING: ignoring malformed {name}="
+              f"{os.environ[name]!r}, using {default}", file=sys.stderr)
+        return default
+
+PROBE_WINDOW_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_WINDOW_S", 2100)
+PROBE_ATTEMPT_TIMEOUT_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_TIMEOUT_S", 180)
+
+METRIC_NAME = (f"seq read {BLOCK_SIZE} blocks into TPU HBM "
+               f"(1 chip, {THREADS} threads, iodepth {IO_DEPTH})")
+
+
+def _utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class BenchUnavailable(RuntimeError):
+    """Raised when the TPU never became reachable; carries the attempt
+    timeline for the machine-readable failure record."""
+
+    def __init__(self, msg: str, timeline: list):
+        super().__init__(msg)
+        self.timeline = timeline
+
+
+def _probe_tpu_once(timeout_secs: int) -> str:
+    """One bounded reachability check — jax.devices() otherwise blocks
+    forever on a dead tunnel and the whole bench run times out without
+    explanation."""
     probe = subprocess.run(
         [sys.executable, "-c",
          "import jax; d = jax.devices(); print(d[0].platform)"],
@@ -116,13 +164,89 @@ def _probe_tpu(timeout_secs: int = 180) -> str:
     return platform
 
 
+def _probe_tpu_with_retry() -> "tuple[str, list]":
+    """Retry the reachability probe with backoff until PROBE_WINDOW_S is
+    spent. Returns (platform, timeline); raises BenchUnavailable with the
+    full timeline when the window closes without a live TPU."""
+    timeline = []
+    t_start = time.monotonic()
+    backoff_s = 15
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.monotonic()
+        entry = {"attempt": attempt, "utc": _utc_now(),
+                 "at_s": round(t0 - t_start, 1)}
+        try:
+            platform = _probe_tpu_once(PROBE_ATTEMPT_TIMEOUT_S)
+            entry["elapsed_s"] = round(time.monotonic() - t0, 1)
+            entry["outcome"] = f"ok: platform={platform}"
+            timeline.append(entry)
+            return platform, timeline
+        except subprocess.TimeoutExpired:
+            entry["outcome"] = f"timeout after {PROBE_ATTEMPT_TIMEOUT_S}s"
+        except RuntimeError as err:
+            entry["outcome"] = f"error: {str(err)[-300:]}"
+        entry["elapsed_s"] = round(time.monotonic() - t0, 1)
+        timeline.append(entry)
+        print(f"# probe attempt {attempt} failed ({entry['outcome']}); "
+              f"{round(time.monotonic() - t_start)}s of {PROBE_WINDOW_S}s "
+              f"window spent", file=sys.stderr)
+        remaining = PROBE_WINDOW_S - (time.monotonic() - t_start)
+        if remaining <= 0:
+            raise BenchUnavailable(
+                f"TPU unreachable after {attempt} probe attempts across "
+                f"{round(time.monotonic() - t_start)}s "
+                f"(window {PROBE_WINDOW_S}s); last: {entry['outcome']}",
+                timeline)
+        time.sleep(min(backoff_s, max(remaining, 0)))
+        backoff_s = min(backoff_s * 2, 120)
+
+
+def _emit_failure(stage: str, err, timeline: list,
+                  platform: "str | None" = None) -> int:
+    """The never-null artifact: one machine-readable JSON line recording
+    why no MiB/s figure exists, with timestamps so the failure is
+    auditable. rc stays 0 so an rc-gating driver still parses stdout."""
+    metric = METRIC_NAME
+    if platform is not None and platform not in ("tpu", "axon"):
+        # same masquerade guard as the success path: a self-test failure
+        # must never be recorded under the real TPU metric name
+        metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": "MiB/s",
+        "vs_baseline": None,
+        "error": str(err)[-1500:],
+        "failed_stage": stage,
+        "utc": _utc_now(),
+        "probe_window_s": PROBE_WINDOW_S,
+        "probe_timeline": timeline,
+    }))
+    return 0
+
+
 def main() -> int:
     try:
-        platform = _probe_tpu()
-    except (RuntimeError, subprocess.TimeoutExpired) as err:
+        platform, probe_timeline = _probe_tpu_with_retry()
+    except BenchUnavailable as err:
         print(f"ERROR: TPU device unreachable, cannot run the HBM ingest "
               f"benchmark: {err}", file=sys.stderr)
-        return 1
+        return _emit_failure("tpu_probe", err, err.timeline)
+    except Exception as err:  # noqa: BLE001 - artifact must never be null
+        print(f"ERROR: TPU probe crashed: {err}", file=sys.stderr)
+        return _emit_failure("tpu_probe", err, [])
+    try:
+        return _run_bench(platform, probe_timeline)
+    except Exception as err:  # noqa: BLE001 - artifact must never be null
+        print(f"ERROR: bench failed after a successful TPU probe: {err}",
+              file=sys.stderr)
+        return _emit_failure("bench_run", err, probe_timeline,
+                             platform=platform)
+
+
+def _run_bench(platform: str, probe_timeline: list) -> int:
     tmpdir = tempfile.mkdtemp(prefix="elbencho_tpu_bench_")
     target = os.path.join(tmpdir, "benchfile")
     j1 = os.path.join(tmpdir, "w.json")
@@ -149,7 +273,6 @@ def main() -> int:
         for pass_num in range(HBM_PASSES):
             open(j3, "w").close()  # fresh result file per pass
             time.sleep(idle_s)  # let tunnel burst credit recover
-            idles_used.append(idle_s)
             try:
                 hbm = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
                                 "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
@@ -161,6 +284,9 @@ def main() -> int:
                 pass_errors.append(str(err))
                 continue
             hbm_rec = next(r for r in hbm if r["Phase"] == "READ")
+            # recorded only for passes that survive, so the reported list
+            # stays aligned with median_of (round-2 advisor finding)
+            idles_used.append(idle_s)
             mibs = hbm_rec.get("TpuHbmMiBPerSec") or 0.0
             if mibs <= 0:
                 # the headline metric IS the HBM-ingest rate; silently
@@ -193,8 +319,7 @@ def main() -> int:
             if wall_s > 0}
         from elbencho_tpu.stats.latency_histogram import LatencyHistogram
         histo = LatencyHistogram.from_dict(med_rec.get("IOLatHisto", {}))
-        metric = ("seq read 16M blocks into TPU HBM "
-                  "(1 chip, 2 threads, iodepth 4)")
+        metric = METRIC_NAME
         if platform not in ("tpu", "axon"):
             metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
         print(json.dumps({
@@ -210,6 +335,8 @@ def main() -> int:
             "per_chip_hbm_mibs": per_chip,
             "io_lat_usec_p50": round(histo.percentile(50), 1),
             "io_lat_usec_p99": round(histo.percentile(99), 1),
+            "probe_attempts": len(probe_timeline),
+            "utc": _utc_now(),
         }))
         return 0
     finally:

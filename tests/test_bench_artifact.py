@@ -1,0 +1,94 @@
+"""bench.py artifact contract: the driver-captured JSON line must NEVER
+be null-parsed (round-2 verdict item 1 — two rounds of `parsed=null`
+because a dead tunnel aborted before any output).
+
+Covers both sides of the contract:
+  - failure: TPU unreachable -> rc 0 + one JSON line with value=null,
+    a machine-readable error, and the probe attempt timeline;
+  - success: the CPU self-test pipeline end-to-end -> one JSON line with
+    a real MiB/s value and the documented extra keys.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+sys.path.insert(0, REPO)
+import _axon_mitigation  # noqa: E402
+
+
+def _last_json_line(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, f"no JSON line in stdout:\n{stdout[-2000:]}"
+    return json.loads(lines[-1])
+
+
+def _run_bench(env, timeout):
+    return subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True,
+        text=True, timeout=timeout)
+
+
+def test_unreachable_tpu_emits_machine_readable_failure_line():
+    """Dead backend: bench must retry within the (shrunken) probe window,
+    then print the never-null failure record and exit 0 so an rc-gating
+    driver still parses it."""
+    env = dict(os.environ)
+    # a platform jax cannot initialize -> every probe attempt fails fast
+    env["JAX_PLATFORMS"] = "no_such_platform"
+    env["PYTHONPATH"] = _axon_mitigation.strip_axon_paths(
+        env.get("PYTHONPATH", ""))
+    env["ELBENCHO_TPU_BENCH_PROBE_WINDOW_S"] = "1"
+    env["ELBENCHO_TPU_BENCH_PROBE_TIMEOUT_S"] = "60"
+    env.pop("ELBENCHO_TPU_BENCH_ALLOW_NONTPU", None)
+    res = _run_bench(env, timeout=180)
+    assert res.returncode == 0, res.stderr[-2000:]
+    rec = _last_json_line(res.stdout)
+    assert rec["value"] is None
+    assert rec["vs_baseline"] is None
+    assert rec["unit"] == "MiB/s"
+    assert rec["failed_stage"] == "tpu_probe"
+    assert "error" in rec and rec["error"]
+    assert rec["probe_window_s"] == 1
+    timeline = rec["probe_timeline"]
+    assert len(timeline) >= 1
+    for entry in timeline:
+        assert entry["attempt"] >= 1
+        assert "utc" in entry and "outcome" in entry
+        assert "elapsed_s" in entry
+    # metric key present so BENCH_rNN.json stays schema-stable
+    assert rec["metric"].startswith("seq read 16M blocks into TPU HBM")
+
+
+@pytest.mark.slow
+def test_selftest_pipeline_emits_success_line():
+    """Whole pipeline on the CPU backend with a tiny workload: write,
+    host-read baseline, warmup, measured HBM passes, median JSON line."""
+    env = _axon_mitigation.sanitized_env(1)
+    env["ELBENCHO_TPU_BENCH_ALLOW_NONTPU"] = "1"
+    env["ELBENCHO_TPU_BENCH_FILE_SIZE"] = "8M"
+    env["ELBENCHO_TPU_BENCH_BLOCK_SIZE"] = "4M"
+    env["ELBENCHO_TPU_BENCH_PASSES"] = "2"
+    env["ELBENCHO_TPU_BENCH_THREADS"] = "1"
+    res = _run_bench(env, timeout=420)
+    assert res.returncode == 0, res.stderr[-3000:]
+    rec = _last_json_line(res.stdout)
+    # a non-TPU platform may never masquerade as the TPU result
+    assert rec["metric"].startswith("HARNESS SELF-TEST on")
+    assert rec["value"] > 0
+    assert rec["unit"] == "MiB/s"
+    assert rec["vs_baseline"] > 0
+    assert rec["median_of"] == 2
+    assert rec["min"] <= rec["value"] <= rec["max"]
+    assert rec["host_read_mibs"] > 0
+    # idle list aligned with surviving passes (round-2 advisor finding)
+    assert len(rec["inter_pass_idle_s"]) == rec["median_of"]
+    assert rec["probe_attempts"] >= 1
+    assert rec["io_lat_usec_p99"] >= rec["io_lat_usec_p50"]
