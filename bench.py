@@ -121,7 +121,8 @@ PROBE_WINDOW_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_WINDOW_S", 2100)
 PROBE_ATTEMPT_TIMEOUT_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_TIMEOUT_S", 180)
 
 METRIC_NAME = (f"seq read {BLOCK_SIZE} blocks into TPU HBM "
-               f"(1 chip, {THREADS} threads, iodepth {IO_DEPTH})")
+               f"(1 chip, {THREADS} threads, iodepth {IO_DEPTH}, "
+               f"tpudirect)")
 
 
 def _utc_now() -> str:
@@ -263,9 +264,11 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                          "-b", BLOCK_SIZE, target], j2)
         host_mibs = next(r["MiBPerSecLast"] for r in host
                          if r["Phase"] == "READ")
-        # warmup (jit compile) then measured passes: read -> HBM, pipelined
+        # warmup (jit compile) then measured passes: read -> HBM via the
+        # zero-bounce --tpudirect path (cuFile analogue), pipelined
         _run_cli(["-r", "-t", "1", "-s", BLOCK_SIZE, "-b", BLOCK_SIZE,
-                  "--tpuids", "0", target], warm, timeout=600)
+                  "--tpuids", "0", "--tpudirect", target], warm,
+                 timeout=600)
         passes = []
         pass_errors = []
         idle_s = INTER_PASS_IDLE_S
@@ -276,7 +279,8 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             try:
                 hbm = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
                                 "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
-                                "--tpuids", "0", target], j3)
+                                "--tpuids", "0", "--tpudirect", target],
+                               j3)
             except (RuntimeError, subprocess.TimeoutExpired) as err:
                 # a transient tunnel hiccup must not void the whole bench;
                 # the median still needs a quorum of clean passes though
@@ -336,6 +340,10 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             "io_lat_usec_p50": round(histo.percentile(50), 1),
             "io_lat_usec_p99": round(histo.percentile(99), 1),
             "probe_attempts": len(probe_timeline),
+            # which H2D path actually ran (direct = zero-bounce dlpack;
+            # fallbacks mean the staged path silently served some blocks)
+            "tpu_direct_ops": med_rec.get("TpuH2dDirectOps", 0),
+            "tpu_direct_fallbacks": med_rec.get("TpuH2dDirectFallbacks", 0),
             "utc": _utc_now(),
         }))
         return 0

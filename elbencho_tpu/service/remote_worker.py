@@ -288,6 +288,10 @@ class RemoteWorker(Worker):
             result.get("IOLatHistoRWMixRead", {}))
         self.tpu_transfer_bytes = result.get("TpuHbmBytes", 0)
         self.tpu_transfer_usec = result.get("TpuHbmUSec", 0)
+        self.tpu_h2d_direct_ops = result.get("TpuH2dDirectOps", 0)
+        self.tpu_h2d_staged_ops = result.get("TpuH2dStagedOps", 0)
+        self.tpu_h2d_direct_fallbacks = result.get(
+            "TpuH2dDirectFallbacks", 0)
         self.got_phase_work = bool(self.elapsed_usec_vec)
 
     def _interrupt_remote(self, quit_service: bool) -> None:

@@ -54,6 +54,11 @@ class PhaseResults:
         self.tpu_bytes = 0
         self.tpu_usec = 0
         self.tpu_per_chip: "dict[int, tuple[int, int]]" = {}
+        # --tpudirect path audit: which H2D transfer path actually ran
+        # (cumulative per worker context; direct vs staged vs fallbacks)
+        self.tpu_h2d_direct = 0
+        self.tpu_h2d_staged = 0
+        self.tpu_h2d_fallbacks = 0
         self.num_workers = 0
 
 
@@ -358,6 +363,14 @@ class Statistics:
                 b, u = res.tpu_per_chip.get(chip, (0, 0))
                 res.tpu_per_chip[chip] = (b + w.tpu_transfer_bytes,
                                           u + w.tpu_transfer_usec)
+                res.tpu_h2d_direct += w._tpu.h2d_direct_ops
+                res.tpu_h2d_staged += w._tpu.h2d_staged_ops
+                res.tpu_h2d_fallbacks += w._tpu.h2d_direct_fallbacks
+            else:  # RemoteWorker: counters ingested from the service JSON
+                res.tpu_h2d_direct += getattr(w, "tpu_h2d_direct_ops", 0)
+                res.tpu_h2d_staged += getattr(w, "tpu_h2d_staged_ops", 0)
+                res.tpu_h2d_fallbacks += getattr(
+                    w, "tpu_h2d_direct_fallbacks", 0)
         stonewall_elapsed = [w.stonewall_elapsed_usec for w in workers
                              if w.stonewall_taken]
         res.first_done_usec = min(res.elapsed_usec_vec, default=0)
@@ -533,6 +546,9 @@ class Statistics:
                 res.tpu_bytes / last_s / (1 << 20), 2) if res.tpu_bytes else 0,
             "TpuPerChip": {str(k): {"Bytes": b, "USec": u}
                            for k, (b, u) in res.tpu_per_chip.items()},
+            "TpuH2dDirectOps": res.tpu_h2d_direct,
+            "TpuH2dStagedOps": res.tpu_h2d_staged,
+            "TpuH2dDirectFallbacks": res.tpu_h2d_fallbacks,
         }
         # unconditional so CSV rows keep a fixed column count
         rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
@@ -541,7 +557,7 @@ class Statistics:
         return rec
 
     #: fixed result columns of the CSV schema (docs/result-columns.md);
-    #: TpuPerChip is JSON-only (nested)
+    #: TpuPerChip and the TpuH2d* path-audit counters are JSON-only
     CSV_RESULT_COLUMNS = (
         "ISODate", "Label", "Phase", "EntryType", "NumWorkers",
         "ElapsedUSecFirst", "ElapsedUSecLast", "EntriesFirst", "EntriesLast",
@@ -600,6 +616,9 @@ class Statistics:
     def _write_csv(self, res: PhaseResults) -> None:
         rec = self._result_record(res)
         rec.pop("TpuPerChip")
+        rec.pop("TpuH2dDirectOps")
+        rec.pop("TpuH2dStagedOps")
+        rec.pop("TpuH2dDirectFallbacks")
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
         path = self.cfg.csv_file_path
@@ -656,11 +675,16 @@ class Statistics:
         shared = self.manager.shared
         elapsed_vec = []
         tpu_bytes = tpu_usec = 0
+        tpu_direct = tpu_staged = tpu_fallbacks = 0
         for w in self.manager.workers:
             if w.got_phase_work:
                 elapsed_vec.extend(w.elapsed_usec_vec)
             tpu_bytes += w.tpu_transfer_bytes
             tpu_usec += w.tpu_transfer_usec
+            if getattr(w, "_tpu", None) is not None:
+                tpu_direct += w._tpu.h2d_direct_ops
+                tpu_staged += w._tpu.h2d_staged_ops
+                tpu_fallbacks += w._tpu.h2d_direct_fallbacks
         iops_histo = LatencyHistogram()
         entries_histo = LatencyHistogram()
         iops_histo_rwmix = LatencyHistogram()
@@ -710,6 +734,9 @@ class Statistics:
             "CPUUtil": round(shared.cpu_util_last_done, 1),
             "TpuHbmBytes": tpu_bytes,
             "TpuHbmUSec": tpu_usec,
+            "TpuH2dDirectOps": tpu_direct,
+            "TpuH2dStagedOps": tpu_staged,
+            "TpuH2dDirectFallbacks": tpu_fallbacks,
         }
 
     def close(self) -> None:

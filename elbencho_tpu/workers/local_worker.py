@@ -71,6 +71,9 @@ class LocalWorker(Worker):
     def reset_stats(self) -> None:
         super().reset_stats()
         self._native_interrupt.value = 0
+        if self._tpu is not None:
+            # path-audit counters are per-phase, like tpu_transfer_bytes
+            self._tpu.reset_path_counters()
 
     # ------------------------------------------------------------------
     # preparation (reference: preparePhase, LocalWorker.cpp:424)

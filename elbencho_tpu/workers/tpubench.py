@@ -77,6 +77,33 @@ def run_tpubench_phase(worker, phase: BenchPhase) -> None:
     worker.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
 
 
+def _select_collective_devices(cfg, jax) -> list:
+    """Devices for the collective mesh. Single-process runs honor the
+    --tpuids subset (chip indices into jax.devices(), modulo, deduped);
+    multi-process SPMD requires every process to build the SAME global
+    mesh over every chip, so there --tpuids is ignored with a NOTE."""
+    from ..toolkits.logger import LOG_NORMAL, log
+    all_devices = list(jax.devices())
+    if not cfg.tpu_ids:
+        return all_devices
+    if jax.process_count() > 1:
+        log(LOG_NORMAL,
+            "NOTE: --tpuids is ignored for collective --tpubench patterns "
+            "in a multihost run: the SPMD mesh must span every chip of "
+            "the pod slice on every process")
+        return all_devices
+    selected = []
+    for chip_id in cfg.tpu_ids:
+        dev = all_devices[chip_id % len(all_devices)]
+        if dev not in selected:
+            selected.append(dev)
+    if len(selected) != len(all_devices):
+        log(LOG_NORMAL,
+            f"NOTE: collective mesh restricted to {len(selected)} of "
+            f"{len(all_devices)} chips (--tpuids)")
+    return selected
+
+
 def _run_collective(worker, pattern: str) -> None:
     """One timed collective per step over all available chips; only the
     first local worker drives the mesh (one SPMD program per host, like
@@ -95,13 +122,21 @@ def _run_collective(worker, pattern: str) -> None:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from ..parallel.compat import shard_map
+    from ..toolkits.logger import LOG_NORMAL, log
 
-    devices = jax.devices()
+    devices = _select_collective_devices(cfg, jax)
     n_dev = len(devices)
     mesh = Mesh(np.array(devices), axis_names=("chip",))
     bs_words = max(cfg.block_size // 4, 128)
     # all-to-all / reduce-scatter split the lane axis across chips
     bs_words += (-bs_words) % n_dev
+    if bs_words * 4 != cfg.block_size:
+        # auto-adjustments are always surfaced (repo convention, e.g. the
+        # file-size reduction notes in config/args.py)
+        log(LOG_NORMAL,
+            f"NOTE: collective block size adjusted to {bs_words * 4} "
+            f"bytes (word-aligned and divisible by {n_dev} chips); "
+            f"accounted bytes per step use the adjusted size")
     total = max(cfg.file_size, cfg.block_size)
     # sharded array: one block per chip
     arr = jax.device_put(

@@ -49,6 +49,52 @@ def test_tpubench_collective_patterns(tmp_path):
         assert rec["IOPSLast"] > 0, pat
 
 
+def test_collective_mesh_honors_tpuids_subset():
+    """Round-2 advisor finding: collective patterns used every visible
+    chip regardless of --tpuids. Single-process runs must honor the
+    subset (deduped, modulo device count)."""
+    import jax
+    from elbencho_tpu.config.args import BenchConfig
+    from elbencho_tpu.workers.tpubench import _select_collective_devices
+    cfg = BenchConfig()
+    cfg.tpu_ids = [0, 2, 2, 10]  # 10 % 8 == 2 -> dedupe
+    devices = _select_collective_devices(cfg, jax)
+    all_devices = jax.devices()
+    assert devices == [all_devices[0], all_devices[2]]
+    # no subset -> all chips
+    assert _select_collective_devices(BenchConfig(), jax) == \
+        list(all_devices)
+
+
+def test_collective_mesh_ignores_tpuids_multihost(capsys, monkeypatch):
+    """Multihost SPMD needs the same global mesh on every process, so
+    --tpuids is ignored there — with a NOTE, never silently."""
+    import jax
+    from elbencho_tpu.config.args import BenchConfig
+    from elbencho_tpu.workers import tpubench
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    cfg = BenchConfig()
+    cfg.tpu_ids = [0]
+    devices = tpubench._select_collective_devices(cfg, jax)
+    assert devices == list(jax.devices())
+    assert "--tpuids is ignored for collective" in capsys.readouterr().out
+
+
+def test_collective_block_padding_logs_note(tmp_path, capsys):
+    """Advisor finding: silent round-up of the collective block size.
+    64K/4 = 16384 words is divisible by 8 chips -> no note; a 100-byte
+    block (25 words -> padded to 32) must log the adjustment."""
+    rc = main(["--tpubench", "--tpubenchpat", "psum", "-s", "4K",
+               "-b", "100", "--nolive"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "collective block size adjusted" in out
+    rc = main(["--tpubench", "--tpubenchpat", "psum", "-s", "512K",
+               "-b", "64K", "--nolive"])
+    assert rc == 0
+    assert "collective block size adjusted" not in capsys.readouterr().out
+
+
 def test_tpubench_bad_pattern():
     rc = main(["--tpubench", "--tpubenchpat", "bogus", "-s", "64K",
                "--nolive"])
