@@ -448,6 +448,10 @@ FLAG_DEFS = [
     ("gcstoken", None, "gcs_token", "str", "", "s3",
      "OAuth2 access token (default: GOOGLE_OAUTH_ACCESS_TOKEN env, then "
      "the GCE/TPU-VM metadata server / workload identity)"),
+    ("gcsresumable", None, "gcs_resumable", "bool", False, "s3",
+     "Use GCS resumable upload sessions for multipart uploads instead of "
+     "compose (native large-single-object protocol; parts are sequential "
+     "per worker, so incompatible with --s3mpusharing)"),
     ("gcsanon", None, "gcs_anonymous", "bool", False, "s3",
      "Anonymous GCS access (public buckets, unauthenticated endpoints)"),
     ("objectbackend", None, "object_backend", "str", "", "s3",
@@ -944,6 +948,11 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError("--ioengine must be auto|sync|aio|uring")
         if self.object_backend not in ("", "s3", "gcs"):
             raise ConfigError("--objectbackend must be s3 or gcs")
+        if self.gcs_resumable and self.s3_mpu_sharing:
+            raise ConfigError(
+                "--gcsresumable uploads are sequential per worker and "
+                "cannot serve shared cross-worker multipart uploads "
+                "(--s3mpusharing); use the default compose mode instead")
         if self.use_file_locks not in ("", "range", "full"):
             raise ConfigError("--flock must be range or full")
         if self.io_engine == "sync" and self.io_depth > 1:

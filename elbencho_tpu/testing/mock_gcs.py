@@ -37,6 +37,12 @@ class MockGcsState:
         self.obj_meta: "dict[tuple[str, str], dict]" = {}
         self.seen_tokens: "list[str]" = []
         self.metadata_token_calls = 0
+        # resumable sessions: upload_id -> {bucket, name, data, done}
+        self.resumable: "dict[str, dict]" = {}
+        self.next_resumable_id = 0
+        # test knob: accept only this many bytes of the first chunk PUT
+        # of each session (forces the client's 308 resume loop)
+        self.resumable_truncate_first_chunk = 0
 
 
 def _make_handler(state: MockGcsState):
@@ -192,6 +198,19 @@ def _make_handler(state: MockGcsState):
                         self._error(404, f"bucket {bucket} not found")
                         return
                     name = query.get("name", "")
+                    if query.get("uploadType") == "resumable":
+                        state.next_resumable_id += 1
+                        sid = f"mock-resumable-{state.next_resumable_id}"
+                        state.resumable[sid] = {
+                            "bucket": bucket, "name": name,
+                            "data": bytearray(), "chunk_puts": 0}
+                        host = self.headers.get("Host", "localhost")
+                        self._reply(200, headers={
+                            "Location":
+                                f"http://{host}/upload/storage/v1/b/"
+                                f"{urllib.parse.quote(bucket, safe='')}"
+                                f"/o?upload_id={sid}"})
+                        return
                     state.objects[bucket][name] = body
                     self._json(200, self._obj_resource(bucket, name))
                     return
@@ -217,6 +236,64 @@ def _make_handler(state: MockGcsState):
                     self._json(200, self._obj_resource(bucket, dest))
                     return
                 self._error(404, f"no route {path}")
+
+        # -- PUT (resumable chunk uploads only) ----------------------------
+
+        def do_PUT(self):  # noqa: N802
+            self._record_token()
+            path, query = self._route()
+            body = self._body()
+            with state.lock:
+                sid = query.get("upload_id", "")
+                sess = state.resumable.get(sid)
+                if not path.startswith("/upload/storage/v1/b/") \
+                        or sess is None:
+                    self._error(404, f"no resumable session {sid!r}")
+                    return
+                rng = self.headers.get("Content-Range", "")
+                data = sess["data"]
+
+                def _finalize():
+                    # the session ends with the object's creation
+                    state.resumable.pop(sid, None)
+                    state.objects[sess["bucket"]][sess["name"]] = \
+                        bytes(data)
+                    self._json(200, self._obj_resource(
+                        sess["bucket"], sess["name"]))
+
+                def _incomplete():
+                    headers = {}
+                    if data:
+                        headers["Range"] = f"bytes=0-{len(data) - 1}"
+                    self._reply(308, headers=headers)
+
+                if rng.startswith("bytes */"):
+                    total = rng[len("bytes */"):]
+                    if total != "*" and len(data) == int(total):
+                        _finalize()
+                    else:
+                        _incomplete()  # status query / wrong total
+                    return
+                # "bytes S-E/T" chunk
+                try:
+                    span, _, total = rng[len("bytes "):].partition("/")
+                    start_s, _, _end_s = span.partition("-")
+                    start = int(start_s)
+                except ValueError:
+                    self._error(400, f"bad Content-Range {rng!r}")
+                    return
+                if start != len(data):
+                    _incomplete()  # out of sync: report committed prefix
+                    return
+                sess["chunk_puts"] += 1
+                if sess["chunk_puts"] == 1 \
+                        and state.resumable_truncate_first_chunk:
+                    body = body[:state.resumable_truncate_first_chunk]
+                data += body
+                if total != "*" and len(data) == int(total):
+                    _finalize()
+                else:
+                    _incomplete()
 
         # -- PATCH ---------------------------------------------------------
 
@@ -267,6 +344,14 @@ def _make_handler(state: MockGcsState):
             self._record_token()
             path, _query = self._route()
             with state.lock:
+                if path.startswith("/upload/storage/v1/b/"):
+                    sid = _query.get("upload_id", "")
+                    if state.resumable.pop(sid, None) is None:
+                        self._error(404, f"no resumable session {sid!r}")
+                        return
+                    # GCS answers 499 Client Closed Request for cancel
+                    self._reply(499)
+                    return
                 parts = path.split("/")
                 bucket = urllib.parse.unquote(parts[4]) \
                     if len(parts) > 4 else ""

@@ -151,7 +151,7 @@ class GcsClient:
     def __init__(self, endpoint: str = GCS_DEFAULT_ENDPOINT,
                  project: str = "", token_provider=None,
                  timeout: float = 60.0, num_retries: int = 0,
-                 interrupt_check=None):
+                 interrupt_check=None, resumable: bool = False):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "https://" + endpoint)
         self.scheme = parsed.scheme or "https"
@@ -162,6 +162,11 @@ class GcsClient:
         self.timeout = timeout
         self.num_retries = num_retries
         self.interrupt_check = interrupt_check
+        #: --gcsresumable: serve the MPU interface via resumable upload
+        #: sessions (the native GCS large-single-object idiom) instead of
+        #: component objects + compose
+        self.resumable = resumable
+        self._sessions: "dict[str, dict]" = {}
         self._conn: "http.client.HTTPConnection | None" = None
 
     # -- plumbing ------------------------------------------------------------
@@ -405,17 +410,109 @@ class GcsClient:
 
     def create_multipart_upload(self, bucket: str, key: str,
                                 extra_headers: "dict | None" = None) -> str:
-        """No server-side session: the upload id namespaces the component
-        objects of GCS's native parallel-upload idiom."""
+        """Compose mode (default): no server-side session — the upload id
+        namespaces the component objects of GCS's native parallel-upload
+        idiom. Resumable mode (--gcsresumable): initiates a resumable
+        upload session (uploadType=resumable; the Location header carries
+        the session URI) and the id keys the local session state."""
+        if self.resumable:
+            return self._resumable_create(bucket, key, extra_headers)
         del bucket, key, extra_headers  # no server round trip needed
         return "cmp" + uuid.uuid4().hex[:16]
 
     def upload_part(self, bucket: str, key: str, upload_id: str,
                     part_number: int, body: bytes,
                     extra_headers: "dict | None" = None) -> str:
+        if upload_id in self._sessions:
+            return self._resumable_put_chunk(upload_id, part_number, body)
         part_key = self._part_key(key, upload_id, part_number)
         self.put_object(bucket, part_key, body, extra_headers=extra_headers)
         return part_key  # the "etag" slot carries the component name
+
+    # -- resumable upload sessions (--gcsresumable) --------------------------
+    # Protocol: initiate (POST uploadType=resumable -> session URI), then
+    # sequential chunk PUTs with "Content-Range: bytes S-E/*" answered by
+    # 308 Resume Incomplete + a Range header acknowledging the committed
+    # prefix, finalize with an empty "bytes */TOTAL" PUT, cancel with
+    # DELETE on the session URI (status 499). The native GCS idiom for
+    # large single-stream objects; the reference's closest analogue is the
+    # sequential MPU path (LocalWorker.cpp:4905+).
+
+    @staticmethod
+    def _upload_obj_path(bucket: str) -> str:
+        return f"/upload/storage/v1/b/{urllib.parse.quote(bucket, safe='')}/o"
+
+    def _resumable_create(self, bucket: str, key: str,
+                          extra_headers: "dict | None") -> str:
+        status, headers, data = self.request(
+            "POST", self._upload_obj_path(bucket),
+            query={"uploadType": "resumable", "name": key},
+            body=json.dumps({"name": key}).encode(),
+            headers={"Content-Type": "application/json; charset=UTF-8",
+                     **(extra_headers or {})})
+        self._check(status, data, ok=(200,))
+        location = next((v for k, v in headers.items()
+                         if k.lower() == "location"), "")
+        if not location:
+            raise S3Error(500, "NoSessionUri",
+                          "resumable initiation returned no Location")
+        parsed = urllib.parse.urlparse(location)
+        upload_id = "rs" + uuid.uuid4().hex[:16]
+        self._sessions[upload_id] = {
+            "path": parsed.path,
+            "query": dict(urllib.parse.parse_qsl(parsed.query)),
+            "offset": 0,
+            "next_part": 1,
+        }
+        return upload_id
+
+    @staticmethod
+    def _committed_end(headers: dict) -> int:
+        """Bytes committed server-side, from the 308 Range header
+        ("Range: bytes=0-N" -> N+1); no header means nothing stored."""
+        rng = next((v for k, v in headers.items()
+                    if k.lower() == "range"), "")
+        if not rng.startswith("bytes=0-"):
+            return 0
+        try:
+            return int(rng[len("bytes=0-"):]) + 1
+        except ValueError:
+            return 0
+
+    def _resumable_put_chunk(self, upload_id: str, part_number: int,
+                             body: bytes) -> str:
+        sess = self._sessions[upload_id]
+        if part_number != sess["next_part"]:
+            raise S3Error(
+                400, "OutOfOrderChunk",
+                f"resumable uploads are sequential per worker: got part "
+                f"{part_number}, expected {sess['next_part']} "
+                f"(--gcsresumable cannot serve shared cross-worker MPUs)")
+        data = bytes(body)
+        first_byte = sess["offset"]
+        while data:
+            start = sess["offset"]
+            end = start + len(data) - 1
+            status, headers, resp = self.request(
+                "PUT", sess["path"], query=sess["query"], body=data,
+                headers={"Content-Range": f"bytes {start}-{end}/*"})
+            if status not in (308, 200, 201):
+                self._check(status, resp, ok=(308, 200, 201))
+            if status in (200, 201):  # server finalized early
+                sess["offset"] = end + 1
+                break
+            committed = self._committed_end(headers)
+            if committed <= start:
+                raise S3Error(
+                    500, "NoChunkProgress",
+                    f"308 acknowledged {committed} bytes, already had "
+                    f"{start} committed — resumable session stalled")
+            # partial accept: resend the unacknowledged tail (this is the
+            # 308-driven resume loop of the protocol)
+            data = data[committed - start:]
+            sess["offset"] = committed
+        sess["next_part"] += 1
+        return f"bytes-{first_byte}-{sess['offset'] - 1}"
 
     def _compose(self, bucket: str, sources: "list[str]",
                  dest: str) -> None:
@@ -431,10 +528,19 @@ class GcsClient:
     def complete_multipart_upload(self, bucket: str, key: str,
                                   upload_id: str, parts,
                                   checksum_algo: str = "") -> None:
-        """Fold the ordered components into the destination: up to 32 per
-        compose request, intermediates re-composed iteratively, then all
-        temporaries deleted."""
+        """Compose mode: fold the ordered components into the destination
+        (up to 32 per compose request, intermediates re-composed
+        iteratively, then all temporaries deleted). Resumable mode: an
+        empty finalize PUT declaring the total ("bytes */TOTAL")."""
         del checksum_algo  # GCS validates via per-object crc32c instead
+        sess = self._sessions.pop(upload_id, None)
+        if sess is not None:
+            total = sess["offset"]
+            status, _, data = self.request(
+                "PUT", sess["path"], query=sess["query"], body=b"",
+                headers={"Content-Range": f"bytes */{total}"})
+            self._check(status, data, ok=(200, 201))
+            return None
         sources = [self._part_key(key, upload_id, p[0])
                    for p in sorted(parts)]
         temps = list(sources)
@@ -462,6 +568,15 @@ class GcsClient:
 
     def abort_multipart_upload(self, bucket: str, key: str,
                                upload_id: str) -> None:
+        sess = self._sessions.pop(upload_id, None)
+        if sess is not None:
+            # cancel the session: DELETE on the session URI; GCS answers
+            # 499 Client Closed Request for a cancelled session
+            status, _, data = self.request(
+                "DELETE", sess["path"], query=sess["query"])
+            if status not in (200, 204, 499):
+                self._check(status, data, ok=(200, 204, 499))
+            return
         prefix = f"{key}.{upload_id}."
         token = ""
         while True:

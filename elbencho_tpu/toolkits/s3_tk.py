@@ -759,7 +759,8 @@ def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
         return GcsClient(
             endpoints[rank % len(endpoints)], project=cfg.gcs_project,
             token_provider=GcsTokenProvider.for_config(cfg),
-            num_retries=cfg.s3_num_retries, interrupt_check=interrupt_check)
+            num_retries=cfg.s3_num_retries, interrupt_check=interrupt_check,
+            resumable=getattr(cfg, "gcs_resumable", False))
     endpoints = [e.strip() for e in cfg.s3_endpoints_str.split(",")
                  if e.strip()]
     if not endpoints:

@@ -2,6 +2,7 @@
 (random-object reads, MPU completion phase, credential store, retries)."""
 
 import json
+import posixpath
 
 import pytest
 
@@ -53,6 +54,169 @@ def test_hdfs_verify(local_fs_as_hdfs):
                "-N", "1", "-s", "16K", "-b", "4K", "--nolive",
                f"hdfs://{base}"])
     assert rc == 0
+
+
+# -- HDFS: the real HadoopFileSystem branch against a shaped fake ------------
+# (round-2 verdict item 7: authority parsing, default host/port, connect
+# failure wrapping and base-path stripping had never executed under test —
+# set_filesystem_factory bypasses them all. A real mini-cluster still can't
+# run in this image: no JVM/libhdfs; that gap is documented in STATUS.md.)
+
+@pytest.fixture()
+def fake_hadoop():
+    pytest.importorskip("pyarrow")
+    import threading
+    from types import SimpleNamespace
+    from pyarrow import fs as pafs
+    from elbencho_tpu.workers import hdfs_worker
+
+    class FakeHadoopFS:
+        """pyarrow.fs.HadoopFileSystem-shaped in-memory filesystem:
+        same constructor signature, same method surface the HDFS worker
+        uses, shared store across instances (one namenode)."""
+
+        instances: "list[tuple[str, int]]" = []
+        files: "dict[str, bytes]" = {}
+        dirs: "set[str]" = set()
+        _lock = threading.Lock()
+
+        def __init__(self, host, port=8020):
+            if host == "unreachable.example":
+                raise OSError("HadoopFileSystem: connect refused")
+            type(self).instances.append((host, int(port)))
+
+        def create_dir(self, path, recursive=True):
+            with self._lock:
+                if not recursive and posixpath.dirname(path) not in self.dirs:
+                    raise OSError(f"parent missing: {path}")
+                self.dirs.add(path)
+
+        def delete_dir(self, path):
+            with self._lock:
+                if path not in self.dirs:
+                    raise OSError(f"no such dir: {path}")
+                self.dirs.discard(path)
+                for f in [f for f in self.files if f.startswith(path + "/")]:
+                    del self.files[f]
+
+        def delete_file(self, path):
+            with self._lock:
+                if path not in self.files:
+                    raise FileNotFoundError(path)
+                del self.files[path]
+
+        def get_file_info(self, target):
+            if isinstance(target, pafs.FileSelector):
+                base = target.base_dir
+                with self._lock:
+                    names = {f for f in self.files
+                             if f.startswith(base + "/")}
+                    names |= {d for d in self.dirs
+                              if d.startswith(base + "/")}
+                return [SimpleNamespace(path=n, type=pafs.FileType.File)
+                        for n in names]
+            with self._lock:
+                if target in self.files:
+                    return SimpleNamespace(path=target,
+                                           type=pafs.FileType.File,
+                                           size=len(self.files[target]))
+                if target in self.dirs:
+                    return SimpleNamespace(path=target,
+                                           type=pafs.FileType.Directory)
+            return SimpleNamespace(path=target, type=pafs.FileType.NotFound)
+
+        def open_output_stream(self, path):
+            fs = self
+
+            class _Out:
+                def __init__(self):
+                    self._chunks = []
+
+                def write(self, data):
+                    self._chunks.append(bytes(data))
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *exc):
+                    with fs._lock:
+                        fs.files[path] = b"".join(self._chunks)
+
+            return _Out()
+
+        def open_input_file(self, path):
+            with self._lock:
+                data = self.files.get(path)
+            if data is None:
+                raise FileNotFoundError(path)
+
+            class _In:
+                def read_at(self, length, offset):
+                    return data[offset:offset + length]
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *exc):
+                    pass
+
+            return _In()
+
+    hdfs_worker.set_hadoop_class(FakeHadoopFS)
+    yield FakeHadoopFS
+    hdfs_worker.set_hadoop_class(None)
+    FakeHadoopFS.instances.clear()
+    FakeHadoopFS.files.clear()
+    FakeHadoopFS.dirs.clear()
+
+
+def test_hadoop_branch_full_cycle(fake_hadoop):
+    """Write/read/stat/delete through the REAL HadoopFileSystem branch:
+    authority parsed from the hdfs:// URI, base path stripped of the
+    authority, every phase executed against the namenode connection."""
+    rc = main(["-w", "-d", "-r", "--stat", "-F", "-D", "-t", "2",
+               "-n", "1", "-N", "2", "-s", "16K", "-b", "4K", "--nolive",
+               "hdfs://nn1.example:9000/bench"])
+    assert rc == 0
+    assert ("nn1.example", 9000) in fake_hadoop.instances
+    assert not fake_hadoop.files    # delete phases cleaned up
+    assert not fake_hadoop.dirs
+
+
+def test_hadoop_branch_strips_authority_from_paths(fake_hadoop):
+    rc = main(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+               "-b", "4K", "--nolive", "hdfs://nn1.example:9000/bench"])
+    assert rc == 0
+    # every created path lives under /bench — the authority never leaks
+    # into filesystem paths (previously untested _base_path branch)
+    assert fake_hadoop.files and fake_hadoop.dirs
+    assert all(p.startswith("/bench/") for p in fake_hadoop.files)
+    assert all(p.startswith("/bench/") for p in fake_hadoop.dirs)
+
+
+def test_hadoop_branch_default_host_and_port(fake_hadoop):
+    """hdfs://host/base -> port 8020; hdfs:///base -> libhdfs 'default'
+    (fs.defaultFS discovery), like the reference's hdfsConnect("default",
+    0) (LocalWorker.cpp:599)."""
+    rc = main(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+               "-b", "4K", "--nolive", "hdfs://nn2.example/bench"])
+    assert rc == 0
+    assert ("nn2.example", 8020) in fake_hadoop.instances
+    rc = main(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+               "-b", "4K", "--nolive", "hdfs:///bench"])
+    assert rc == 0
+    assert ("default", 8020) in fake_hadoop.instances
+
+
+def test_hadoop_connect_failure_is_worker_error(fake_hadoop, capsys):
+    """Connect failures must surface as a clear worker error, not a
+    traceback (the reference aborts with a connect error,
+    LocalWorker.cpp:600)."""
+    rc = main(["-w", "-d", "-t", "1", "-n", "1", "-N", "1", "-s", "4K",
+               "-b", "4K", "--nolive", "hdfs://unreachable.example/b"])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "cannot connect to HDFS" in err
 
 
 # -- S3 extras ----------------------------------------------------------------
