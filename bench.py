@@ -13,27 +13,35 @@ TPU HBM (1.0 = the TPU leg is fully hidden by pipelining). The reference
 publishes no GPU-path numbers (BASELINE.md: published == {}), so the
 self-relative ratio is the honest comparison.
 
-Prints ONE JSON line — ALWAYS, success or failure (round-2 verdict item
-1: two rounds of `parsed=null` artifacts because a dead tunnel aborted
-before any JSON was printed). Core keys: {"metric", "value", "unit",
-"vs_baseline"}; value is the MEDIAN of HBM_PASSES measured passes, with
-dispersion and context in the extra keys {"median_of", "min", "max",
-"host_read_mibs", "inter_pass_idle_s", "per_chip_hbm_mibs",
-"io_lat_usec_p50", "io_lat_usec_p99"}. On failure the same line carries
-{"value": null, "error": ..., "failed_stage": ..., "probe_timeline":
-[...]} with wall-clock timestamps so the artifact of record is a
-machine-readable account of WHY, and the exit code stays 0 so an
-rc-gating driver still captures the line. The TPU probe retries with
-backoff across ELBENCHO_TPU_BENCH_PROBE_WINDOW_S (default 35 min) so a
-transiently-down tunnel no longer voids the round. If TPU accounting
-yields no TpuHbmMiBPerSec the run FAILS rather than substituting the
-host-only storage rate.
+Prints ONE JSON line — ALWAYS, success or failure. Three rounds of
+`parsed=null` artifacts taught three lessons, all encoded here:
+  round 1-2: a dead tunnel aborted before any output -> probe retries with
+    backoff and the failure record carries the probe timeline;
+  round 3: the probe window (2100s) outlived the driver's ~1800s patience,
+    so the never-null line was never reached -> the WHOLE run now runs
+    under TOTAL_BUDGET_S (default 1500s): the probe window shrinks to fit,
+    measured passes stop when the deadline nears (partial medians are
+    published with "passes_truncated_by_deadline"), and a SIGTERM/SIGINT
+    handler emits the record IMMEDIATELY if the driver kills us anyway.
+Additionally the last successful TPU result is cached on disk
+(.bench_last_success.json) and replayed inside failure records under
+"stale_last_success" — clearly labeled evidence with its UTC timestamp,
+never a substitute value.
+
+Core keys: {"metric", "value", "unit", "vs_baseline"}; value is the MEDIAN
+of HBM_PASSES measured passes, with dispersion and context in the extra
+keys. On failure the same line carries {"value": null, "error": ...,
+"failed_stage": ..., "probe_timeline": [...]}. Exit code stays 0 so an
+rc-gating driver still captures the line. If TPU accounting yields no
+TpuHbmMiBPerSec the run FAILS rather than substituting the host-only
+storage rate.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -87,22 +95,6 @@ if _SELFTEST:
     INTER_PASS_IDLE_CAP_S = 0
 
 
-def _run_cli(args, jsonfile, timeout=240):
-    # a healthy pass takes well under a minute (jax import + cached jit +
-    # a 256 MiB transfer); the timeout only catches a hung tunnel, and it
-    # must be short enough that one dead pass can't eat the whole bench
-    env = _subproc_env()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
-           "--jsonfile", jsonfile] + args
-    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                         timeout=timeout)
-    if res.returncode != 0:
-        raise RuntimeError(f"bench run failed: {res.stderr[-2000:]}")
-    with open(jsonfile) as f:
-        return [json.loads(ln) for ln in f if ln.strip()]
-
-
 # probe-retry budget: a transiently-down tunnel must not void the round
 # (round-2 verdict item 1). One attempt is a bounded subprocess; between
 # failed attempts the wait backs off 15s -> x2 -> cap 120s until the
@@ -117,16 +109,182 @@ def _int_env(name: str, default: int) -> int:
               f"{os.environ[name]!r}, using {default}", file=sys.stderr)
         return default
 
-PROBE_WINDOW_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_WINDOW_S", 2100)
+# the driver kills bench.py at ~1800s (round 3: rc=124 with the probe
+# window still open). EVERYTHING — probe + warmup + passes — must fit
+# inside TOTAL_BUDGET_S, with DEADLINE_RESERVE_S left to assemble and
+# print the JSON line.
+TOTAL_BUDGET_S = _int_env("ELBENCHO_TPU_BENCH_TOTAL_BUDGET_S", 1500)
+DEADLINE_RESERVE_S = 45
+PROBE_WINDOW_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_WINDOW_S", 1200)
 PROBE_ATTEMPT_TIMEOUT_S = _int_env("ELBENCHO_TPU_BENCH_PROBE_TIMEOUT_S", 180)
+
+_T_START = time.monotonic()
+
+
+def _remaining_s() -> float:
+    return TOTAL_BUDGET_S - (time.monotonic() - _T_START)
 
 METRIC_NAME = (f"seq read {BLOCK_SIZE} blocks into TPU HBM "
                f"(1 chip, {THREADS} threads, iodepth {IO_DEPTH}, "
                f"tpudirect)")
 
+# last successful TPU capture, replayed as labeled evidence in failure
+# records (never as the value). Lives next to bench.py so it survives
+# across driver rounds when committed.
+LAST_SUCCESS_PATH = os.environ.get(
+    "ELBENCHO_TPU_BENCH_CACHE", os.path.join(REPO, ".bench_last_success.json"))
+
 
 def _utc_now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _load_last_success() -> "dict | None":
+    try:
+        with open(LAST_SUCCESS_PATH) as f:
+            rec = json.load(f)
+        # only ever replay a real-TPU success under the stale label
+        if rec.get("value") and not rec.get("metric", "").startswith(
+                "HARNESS SELF-TEST"):
+            return rec
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _store_last_success(rec: dict) -> None:
+    # the cache holds real-TPU evidence only: a self-test run must never
+    # write it, even if the sanitized env still resolved a tpu backend
+    # (its tiny workload shape would then replay as "TPU evidence")
+    if _SELFTEST or rec.get("metric", "").startswith("HARNESS SELF-TEST"):
+        return
+    try:
+        tmp = LAST_SUCCESS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, LAST_SUCCESS_PATH)
+    except OSError as err:
+        print(f"# WARNING: could not cache success record: {err}",
+              file=sys.stderr)
+
+
+# --- never-null emission machinery -----------------------------------
+# _STATE is the single source of truth about where the run is, shared
+# between the normal control flow and the signal handler.
+_STATE = {
+    "stage": "startup",
+    "timeline": [],
+    "platform": None,
+    "partial_pass_mibs": [],
+    "effective_window_s": None,
+    "tmpdir": None,
+    "emitted": False,
+}
+
+
+def _emit_record(rec: dict) -> None:
+    """Print the one JSON line exactly once. Signals are masked across
+    the emitted-flag check + print so a SIGTERM landing between them
+    cannot produce zero lines (handler sees emitted=True and returns)
+    or a torn line (handler can't interrupt the write)."""
+    try:
+        old_mask = signal.pthread_sigmask(
+            signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT})
+    except (ValueError, OSError):  # non-main thread: emit unguarded
+        old_mask = None
+    try:
+        if _STATE["emitted"]:
+            return
+        _STATE["emitted"] = True
+        print(json.dumps(rec), flush=True)
+    finally:
+        if old_mask is not None:
+            signal.pthread_sigmask(signal.SIG_SETMASK, old_mask)
+
+
+def _emit_failure(stage: str, err) -> int:
+    """The never-null artifact: one machine-readable JSON line recording
+    why no MiB/s figure exists, with timestamps so the failure is
+    auditable. rc stays 0 so an rc-gating driver still parses stdout."""
+    platform = _STATE["platform"]
+    metric = METRIC_NAME
+    if platform is not None and platform not in ("tpu", "axon"):
+        # same masquerade guard as the success path: a self-test failure
+        # must never be recorded under the real TPU metric name
+        metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
+    rec = {
+        "metric": metric,
+        "value": None,
+        "unit": "MiB/s",
+        "vs_baseline": None,
+        "error": str(err)[-1500:],
+        "failed_stage": stage,
+        "utc": _utc_now(),
+        "budget_s": TOTAL_BUDGET_S,
+        "elapsed_s": round(time.monotonic() - _T_START, 1),
+        "probe_window_s": PROBE_WINDOW_S,
+        "probe_timeline": _STATE["timeline"],
+    }
+    if _STATE["effective_window_s"] is not None:
+        # the window that actually applied after budget clamping — the
+        # configured value alone would misstate the audit record
+        rec["probe_window_effective_s"] = _STATE["effective_window_s"]
+    if _STATE["partial_pass_mibs"]:
+        rec["partial_pass_mibs"] = [
+            round(v, 1) for v in _STATE["partial_pass_mibs"]]
+    stale = _load_last_success()
+    if stale is not None:
+        # evidence from a previous session, clearly labeled — NEVER the
+        # value of this run (round-3 verdict item 1c)
+        rec["stale_last_success"] = {
+            "value": stale.get("value"), "unit": stale.get("unit"),
+            "utc": stale.get("utc"), "metric": stale.get("metric"),
+            "note": "cached result of the last successful TPU capture; "
+                    "NOT measured in this run"}
+    _emit_record(rec)
+    return 0
+
+
+def _signal_handler(signum, frame):  # noqa: ARG001
+    """The driver is killing us: emit the artifact RIGHT NOW. Round 3
+    died with the JSON line unprinted because emission waited for the
+    probe window to close."""
+    _emit_failure(
+        _STATE["stage"],
+        f"killed by signal {signal.Signals(signum).name} after "
+        f"{round(time.monotonic() - _T_START)}s (driver timeout?)")
+    sys.stdout.flush()
+    tmpdir = _STATE["tmpdir"]
+    if tmpdir:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    os._exit(0)
+
+
+def _install_signal_handlers() -> None:
+    # called from main(), NOT at import: importing bench as a library
+    # (tests do) must not hijack the host process's signal disposition
+    signal.signal(signal.SIGTERM, _signal_handler)
+    signal.signal(signal.SIGINT, _signal_handler)
+
+
+def _run_cli(args, jsonfile, timeout=240):
+    # a healthy pass takes well under a minute (jax import + cached jit +
+    # a 256 MiB transfer); the timeout only catches a hung tunnel, and it
+    # must be short enough that one dead pass can't eat the whole bench.
+    # Never let a subprocess outlive the global deadline either.
+    timeout = max(10, min(timeout, _remaining_s() - DEADLINE_RESERVE_S))
+    env = _subproc_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
+           "--jsonfile", jsonfile] + args
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=timeout)
+    if res.returncode != 0:
+        raise RuntimeError(f"bench run failed: {res.stderr[-2000:]}")
+    with open(jsonfile) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
 
 
 class BenchUnavailable(RuntimeError):
@@ -166,11 +324,17 @@ def _probe_tpu_once(timeout_secs: int) -> str:
 
 
 def _probe_tpu_with_retry() -> "tuple[str, list]":
-    """Retry the reachability probe with backoff until PROBE_WINDOW_S is
-    spent. Returns (platform, timeline); raises BenchUnavailable with the
-    full timeline when the window closes without a live TPU."""
-    timeline = []
+    """Retry the reachability probe with backoff until the probe window
+    OR the global budget is spent — whichever is tighter. Returns
+    (platform, timeline); raises BenchUnavailable with the full timeline
+    when the window closes without a live TPU."""
+    timeline = _STATE["timeline"]
     t_start = time.monotonic()
+    # the probe may not consume the slice of budget the measured passes
+    # need: leave at least 240s of budget after the window closes
+    window_s = min(PROBE_WINDOW_S,
+                   max(_remaining_s() - DEADLINE_RESERVE_S - 240, 30))
+    _STATE["effective_window_s"] = round(window_s)
     backoff_s = 15
     attempt = 0
     while True:
@@ -178,77 +342,61 @@ def _probe_tpu_with_retry() -> "tuple[str, list]":
         t0 = time.monotonic()
         entry = {"attempt": attempt, "utc": _utc_now(),
                  "at_s": round(t0 - t_start, 1)}
+        attempt_timeout = int(max(
+            10, min(PROBE_ATTEMPT_TIMEOUT_S,
+                    _remaining_s() - DEADLINE_RESERVE_S)))
         try:
-            platform = _probe_tpu_once(PROBE_ATTEMPT_TIMEOUT_S)
+            platform = _probe_tpu_once(attempt_timeout)
             entry["elapsed_s"] = round(time.monotonic() - t0, 1)
             entry["outcome"] = f"ok: platform={platform}"
             timeline.append(entry)
             return platform, timeline
         except subprocess.TimeoutExpired:
-            entry["outcome"] = f"timeout after {PROBE_ATTEMPT_TIMEOUT_S}s"
+            # report the budget-clamped timeout that actually applied
+            entry["outcome"] = f"timeout after {attempt_timeout}s"
         except RuntimeError as err:
             entry["outcome"] = f"error: {str(err)[-300:]}"
         entry["elapsed_s"] = round(time.monotonic() - t0, 1)
         timeline.append(entry)
         print(f"# probe attempt {attempt} failed ({entry['outcome']}); "
-              f"{round(time.monotonic() - t_start)}s of {PROBE_WINDOW_S}s "
+              f"{round(time.monotonic() - t_start)}s of {round(window_s)}s "
               f"window spent", file=sys.stderr)
-        remaining = PROBE_WINDOW_S - (time.monotonic() - t_start)
+        remaining = window_s - (time.monotonic() - t_start)
         if remaining <= 0:
             raise BenchUnavailable(
                 f"TPU unreachable after {attempt} probe attempts across "
                 f"{round(time.monotonic() - t_start)}s "
-                f"(window {PROBE_WINDOW_S}s); last: {entry['outcome']}",
+                f"(window {round(window_s)}s); last: {entry['outcome']}",
                 timeline)
         time.sleep(min(backoff_s, max(remaining, 0)))
         backoff_s = min(backoff_s * 2, 120)
 
 
-def _emit_failure(stage: str, err, timeline: list,
-                  platform: "str | None" = None) -> int:
-    """The never-null artifact: one machine-readable JSON line recording
-    why no MiB/s figure exists, with timestamps so the failure is
-    auditable. rc stays 0 so an rc-gating driver still parses stdout."""
-    metric = METRIC_NAME
-    if platform is not None and platform not in ("tpu", "axon"):
-        # same masquerade guard as the success path: a self-test failure
-        # must never be recorded under the real TPU metric name
-        metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
-    print(json.dumps({
-        "metric": metric,
-        "value": None,
-        "unit": "MiB/s",
-        "vs_baseline": None,
-        "error": str(err)[-1500:],
-        "failed_stage": stage,
-        "utc": _utc_now(),
-        "probe_window_s": PROBE_WINDOW_S,
-        "probe_timeline": timeline,
-    }))
-    return 0
-
-
 def main() -> int:
+    _install_signal_handlers()
+    _STATE["stage"] = "tpu_probe"
     try:
         platform, probe_timeline = _probe_tpu_with_retry()
+        _STATE["platform"] = platform
     except BenchUnavailable as err:
         print(f"ERROR: TPU device unreachable, cannot run the HBM ingest "
               f"benchmark: {err}", file=sys.stderr)
-        return _emit_failure("tpu_probe", err, err.timeline)
+        return _emit_failure("tpu_probe", err)
     except Exception as err:  # noqa: BLE001 - artifact must never be null
         print(f"ERROR: TPU probe crashed: {err}", file=sys.stderr)
-        return _emit_failure("tpu_probe", err, [])
+        return _emit_failure("tpu_probe", err)
     try:
         return _run_bench(platform, probe_timeline)
     except Exception as err:  # noqa: BLE001 - artifact must never be null
         print(f"ERROR: bench failed after a successful TPU probe: {err}",
               file=sys.stderr)
-        return _emit_failure("bench_run", err, probe_timeline,
-                             platform=platform)
+        return _emit_failure("bench_run", err)
 
 
 def _run_bench(platform: str, probe_timeline: list) -> int:
+    _STATE["stage"] = "bench_setup"
     tmpdir = tempfile.mkdtemp(prefix="elbencho_tpu_bench_")
+    _STATE["tmpdir"] = tmpdir  # signal handler cleans it (os._exit skips finally)
     target = os.path.join(tmpdir, "benchfile")
     j1 = os.path.join(tmpdir, "w.json")
     j2 = os.path.join(tmpdir, "host.json")
@@ -260,20 +408,32 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                   target], j1)
         # pass 1: host-only read baseline (same thread count as the HBM
         # pass so the ratio isolates the TPU leg, not reader scaling)
+        _STATE["stage"] = "host_baseline"
         host = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
                          "-b", BLOCK_SIZE, target], j2)
         host_mibs = next(r["MiBPerSecLast"] for r in host
                          if r["Phase"] == "READ")
         # warmup (jit compile) then measured passes: read -> HBM via the
         # zero-bounce --tpudirect path (cuFile analogue), pipelined
+        _STATE["stage"] = "jit_warmup"
         _run_cli(["-r", "-t", "1", "-s", BLOCK_SIZE, "-b", BLOCK_SIZE,
                   "--tpuids", "0", "--tpudirect", target], warm,
                  timeout=600)
+        _STATE["stage"] = "hbm_passes"
         passes = []
         pass_errors = []
         idle_s = INTER_PASS_IDLE_S
         idles_used = []
+        truncated = False
         for pass_num in range(HBM_PASSES):
+            # a pass not startable within the budget is a pass skipped;
+            # publishing a partial median beats dying with no artifact
+            if _remaining_s() < idle_s + DEADLINE_RESERVE_S + 60:
+                truncated = True
+                print(f"# deadline near ({round(_remaining_s())}s left): "
+                      f"stopping after {len(passes)} passes",
+                      file=sys.stderr)
+                break
             open(j3, "w").close()  # fresh result file per pass
             time.sleep(idle_s)  # let tunnel burst credit recover
             try:
@@ -301,15 +461,20 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                     "TPU accounting is broken; refusing to substitute "
                     f"the host-only rate. Record: {json.dumps(hbm_rec)[:600]}")
             passes.append((mibs, hbm_rec))
+            _STATE["partial_pass_mibs"].append(mibs)
             best = max(p[0] for p in passes)
             if not _SELFTEST and (mibs < best * 0.5
                                   or mibs < THROTTLE_SUSPECT_MIBS):
                 # still credit-drained: back off further
                 idle_s = min(max(idle_s, INTER_PASS_IDLE_S) * 2,
                              INTER_PASS_IDLE_CAP_S)
-        if len(passes) < max(HBM_PASSES - 2, 1):
+        # quorum: normally HBM_PASSES-2; when the deadline truncated the
+        # loop, any clean pass beats an empty artifact (labeled below)
+        quorum = 1 if truncated else max(HBM_PASSES - 2, 1)
+        if len(passes) < quorum:
             raise RuntimeError(
-                f"only {len(passes)}/{HBM_PASSES} HBM passes succeeded; "
+                f"only {len(passes)}/{HBM_PASSES} HBM passes succeeded"
+                f"{' (deadline-truncated)' if truncated else ''}; "
                 f"errors: {' | '.join(e[-300:] for e in pass_errors)}")
         passes.sort(key=lambda p: p[0])
         med_mibs, med_rec = passes[len(passes) // 2]
@@ -326,7 +491,7 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
         metric = METRIC_NAME
         if platform not in ("tpu", "axon"):
             metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
-        print(json.dumps({
+        rec = {
             "metric": metric,
             "value": round(med_mibs, 1),
             "unit": "MiB/s",
@@ -345,7 +510,11 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             "tpu_direct_ops": med_rec.get("TpuH2dDirectOps", 0),
             "tpu_direct_fallbacks": med_rec.get("TpuH2dDirectFallbacks", 0),
             "utc": _utc_now(),
-        }))
+        }
+        if truncated:
+            rec["passes_truncated_by_deadline"] = True
+        _store_last_success(rec)
+        _emit_record(rec)
         return 0
     finally:
         for p in (target, j1, j2, j3, warm):
