@@ -953,6 +953,15 @@ class BenchConfig(BenchConfigBase):
                 "--gcsresumable uploads are sequential per worker and "
                 "cannot serve shared cross-worker multipart uploads "
                 "(--s3mpusharing); use the default compose mode instead")
+        if self.gcs_resumable and self.io_depth > 1:
+            # the async pipeline gives each executor thread its own
+            # client: part uploads would miss the session-owning client's
+            # state, silently fall through to the compose path, and the
+            # finalize would commit a zero-byte object (data loss)
+            raise ConfigError(
+                "--gcsresumable uploads are sequential per worker and "
+                "cannot be pipelined (--iodepth > 1); use the default "
+                "compose mode for parallel part uploads")
         if self.use_file_locks not in ("", "range", "full"):
             raise ConfigError("--flock must be range or full")
         if self.io_engine == "sync" and self.io_depth > 1:

@@ -685,6 +685,11 @@ class Statistics:
                 tpu_direct += w._tpu.h2d_direct_ops
                 tpu_staged += w._tpu.h2d_staged_ops
                 tpu_fallbacks += w._tpu.h2d_direct_fallbacks
+            else:  # RemoteWorker: counters ingested from the service JSON
+                tpu_direct += getattr(w, "tpu_h2d_direct_ops", 0)
+                tpu_staged += getattr(w, "tpu_h2d_staged_ops", 0)
+                tpu_fallbacks += getattr(
+                    w, "tpu_h2d_direct_fallbacks", 0)
         iops_histo = LatencyHistogram()
         entries_histo = LatencyHistogram()
         iops_histo_rwmix = LatencyHistogram()

@@ -43,6 +43,10 @@ class MockGcsState:
         # test knob: accept only this many bytes of the first chunk PUT
         # of each session (forces the client's 308 resume loop)
         self.resumable_truncate_first_chunk = 0
+        # drop the next N chunk PUT bodies entirely (308 with no Range
+        # progress — the transient-backend-loss case the protocol expects
+        # clients to resend through)
+        self.resumable_drop_chunks = 0
 
 
 def _make_handler(state: MockGcsState):
@@ -286,6 +290,10 @@ def _make_handler(state: MockGcsState):
                     _incomplete()  # out of sync: report committed prefix
                     return
                 sess["chunk_puts"] += 1
+                if state.resumable_drop_chunks > 0:
+                    state.resumable_drop_chunks -= 1
+                    _incomplete()  # chunk "lost": acknowledge no progress
+                    return
                 if sess["chunk_puts"] == 1 \
                         and state.resumable_truncate_first_chunk:
                     body = body[:state.resumable_truncate_first_chunk]

@@ -490,6 +490,11 @@ class GcsClient:
                 f"(--gcsresumable cannot serve shared cross-worker MPUs)")
         data = bytes(body)
         first_byte = sess["offset"]
+        # GCS may answer 308 without having persisted anything after a
+        # transient backend error; the protocol expects the client to
+        # resend the same chunk, so a zero-progress 308 only becomes
+        # fatal after the retry budget is spent
+        no_progress_left = self.num_retries + 1
         while data:
             start = sess["offset"]
             end = start + len(data) - 1
@@ -503,10 +508,16 @@ class GcsClient:
                 break
             committed = self._committed_end(headers)
             if committed <= start:
-                raise S3Error(
-                    500, "NoChunkProgress",
-                    f"308 acknowledged {committed} bytes, already had "
-                    f"{start} committed — resumable session stalled")
+                no_progress_left -= 1
+                if no_progress_left <= 0:
+                    raise S3Error(
+                        500, "NoChunkProgress",
+                        f"308 acknowledged {committed} bytes, already had "
+                        f"{start} committed, and {self.num_retries + 1} "
+                        f"resends made no progress — resumable session "
+                        f"stalled")
+                continue  # resend the same chunk
+            no_progress_left = self.num_retries + 1
             # partial accept: resend the unacknowledged tail (this is the
             # 308-driven resume loop of the protocol)
             data = data[committed - start:]

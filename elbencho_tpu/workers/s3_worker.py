@@ -70,12 +70,27 @@ class _S3Pipeline:
         barrier = threading.Barrier(self.depth)
 
         def warm():
-            self._thread_client()
+            try:
+                self._thread_client()
+            except BaseException:
+                # release siblings immediately: without the abort they sit
+                # at barrier.wait for the full timeout before the
+                # construction error can surface via fut.result()
+                barrier.abort()
+                raise
             barrier.wait(timeout=60)
 
         futs = [self._pool.submit(warm) for _ in range(self.depth)]
+        errors = []
         for fut in futs:
-            fut.result()  # construction errors surface at prepare time
+            try:
+                fut.result()  # construction errors surface at prepare time
+            except threading.BrokenBarrierError as err:
+                errors.append(err)  # sibling released by abort(), not root cause
+            except Exception as err:  # noqa: BLE001
+                errors.insert(0, err)  # real construction error first
+        if errors:
+            raise errors[0]
 
     def _thread_client(self):
         client = getattr(self._tls, "client", None)

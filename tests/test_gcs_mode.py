@@ -286,6 +286,52 @@ def test_resumable_rejects_mpu_sharing():
         cfg.check()
 
 
+def test_resumable_rejects_iodepth():
+    """--gcsresumable + --iodepth > 1: the async pipeline's per-thread
+    clients would miss the session-owning client's state, silently fall
+    through to the compose path, and the finalize would commit a
+    ZERO-BYTE object (round-3 advisor, high)."""
+    from elbencho_tpu.config.args import BenchConfig, ConfigError
+    cfg = BenchConfig(gcs_resumable=True, io_depth=2,
+                      run_create_files=True, file_size=1, block_size=1,
+                      paths=["gs://x"]).derive(probe_paths=False)
+    with pytest.raises(ConfigError, match="iodepth"):
+        cfg.check()
+
+
+def test_resumable_zero_progress_308_retried(mock_gcs):
+    """A 308 with no Range progress (chunk lost to a transient backend
+    error) must be resent within the retry budget, not hard-fail the
+    upload (round-3 advisor, low)."""
+    c = GcsClient(mock_gcs.endpoint, resumable=True, num_retries=2)
+    c.create_bucket("rsb5")
+    upload_id = c.create_multipart_upload("rsb5", "drop.bin")
+    mock_gcs.state.resumable_drop_chunks = 2
+    try:
+        c.upload_part("rsb5", "drop.bin", upload_id, 1, b"q" * 512)
+    finally:
+        mock_gcs.state.resumable_drop_chunks = 0
+    c.complete_multipart_upload("rsb5", "drop.bin", upload_id, [(1, "")])
+    assert mock_gcs.state.objects["rsb5"]["drop.bin"] == b"q" * 512
+    c.close()
+
+
+def test_resumable_zero_progress_308_exhausts_budget(mock_gcs):
+    """With no retry budget, persistent zero-progress 308s still fail
+    loudly instead of looping forever."""
+    c = GcsClient(mock_gcs.endpoint, resumable=True, num_retries=0)
+    c.create_bucket("rsb6")
+    upload_id = c.create_multipart_upload("rsb6", "stall.bin")
+    mock_gcs.state.resumable_drop_chunks = 99
+    try:
+        with pytest.raises(S3Error, match="NoChunkProgress"):
+            c.upload_part("rsb6", "stall.bin", upload_id, 1, b"q" * 512)
+    finally:
+        mock_gcs.state.resumable_drop_chunks = 0
+    c.abort_multipart_upload("rsb6", "stall.bin", upload_id)
+    c.close()
+
+
 def test_gcs_verify_integrity(mock_gcs):
     rc = run_cli(mock_gcs, ["-w", "-d", "-r", "--verify", "13", "-t", "1",
                             "-n", "1", "-N", "2", "-s", "16K", "-b", "16K",

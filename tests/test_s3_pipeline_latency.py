@@ -125,3 +125,24 @@ def test_drain_harvests_all_and_reraises(pipeline):
     with pytest.raises(OSError, match="boom"):
         pipe.drain()
     pipe._pool.shutdown()
+
+
+def test_failed_client_construction_surfaces_fast(monkeypatch):
+    """One thread's client construction failing must abort the warm-up
+    barrier so siblings release immediately — not stall prepare for the
+    barrier's 60s timeout (round-3 advisor, low)."""
+    calls = []
+
+    def flaky_make(cfg, rank, interrupt_check=None):
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("endpoint resolution failed")
+        return object()
+
+    monkeypatch.setattr(
+        "elbencho_tpu.toolkits.s3_tk.make_client_for_rank", flaky_make)
+    t0 = time.monotonic()
+    with pytest.raises(OSError, match="endpoint resolution failed"):
+        _S3Pipeline(_stub_worker(), 4)
+    assert time.monotonic() - t0 < 10, \
+        "construction error took the full barrier timeout to surface"
