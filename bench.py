@@ -179,8 +179,28 @@ _STATE = {
     "partial_pass_mibs": [],
     "effective_window_s": None,
     "tmpdir": None,
+    "active_proc": None,
     "emitted": False,
 }
+
+
+def _tracked_run(cmd, env, timeout):
+    """subprocess.run equivalent that records the child in _STATE so the
+    signal handler can kill it: os._exit would otherwise orphan an
+    in-flight probe/bench child, which keeps the TPU tunnel and temp
+    files busy until its own timeout long after bench.py exited."""
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    _STATE["active_proc"] = proc
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise
+    finally:
+        _STATE["active_proc"] = None
+    return subprocess.CompletedProcess(cmd, proc.returncode, out, err)
 
 
 def _emit_record(rec: dict) -> None:
@@ -255,6 +275,14 @@ def _signal_handler(signum, frame):  # noqa: ARG001
         f"killed by signal {signal.Signals(signum).name} after "
         f"{round(time.monotonic() - _T_START)}s (driver timeout?)")
     sys.stdout.flush()
+    proc = _STATE["active_proc"]
+    if proc is not None and proc.poll() is None:
+        # os._exit skips communicate(): kill the child here or it keeps
+        # running (holding the tunnel / temp files) up to its own timeout
+        try:
+            proc.kill()
+        except OSError:
+            pass
     tmpdir = _STATE["tmpdir"]
     if tmpdir:
         import shutil
@@ -274,13 +302,19 @@ def _run_cli(args, jsonfile, timeout=240):
     # a 256 MiB transfer); the timeout only catches a hung tunnel, and it
     # must be short enough that one dead pass can't eat the whole bench.
     # Never let a subprocess outlive the global deadline either.
-    timeout = max(10, min(timeout, _remaining_s() - DEADLINE_RESERVE_S))
+    budget_left = _remaining_s() - DEADLINE_RESERVE_S
+    if budget_left <= 0:
+        # fail fast with the artifact instead of overshooting the global
+        # budget by the max(10, ...) floor on yet another subprocess
+        raise RuntimeError(
+            f"global budget exhausted ({round(_remaining_s())}s left, "
+            f"{DEADLINE_RESERVE_S}s reserved): not launching another run")
+    timeout = max(10, min(timeout, budget_left))
     env = _subproc_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
            "--jsonfile", jsonfile] + args
-    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                         timeout=timeout)
+    res = _tracked_run(cmd, env, timeout)
     if res.returncode != 0:
         raise RuntimeError(f"bench run failed: {res.stderr[-2000:]}")
     with open(jsonfile) as f:
@@ -300,11 +334,10 @@ def _probe_tpu_once(timeout_secs: int) -> str:
     """One bounded reachability check — jax.devices() otherwise blocks
     forever on a dead tunnel and the whole bench run times out without
     explanation."""
-    probe = subprocess.run(
+    probe = _tracked_run(
         [sys.executable, "-c",
          "import jax; d = jax.devices(); print(d[0].platform)"],
-        env=_subproc_env(), capture_output=True, text=True,
-        timeout=timeout_secs)
+        _subproc_env(), timeout_secs)
     if probe.returncode != 0:
         raise RuntimeError(
             f"TPU probe failed: {probe.stderr[-500:]}")
@@ -513,8 +546,12 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
         }
         if truncated:
             rec["passes_truncated_by_deadline"] = True
-        _store_last_success(rec)
+        # emit FIRST: a SIGTERM landing between these two calls must lose
+        # at worst the cache update, never the measured record (a handler
+        # firing after the cache write would otherwise replay this run's
+        # own result labeled "NOT measured in this run")
         _emit_record(rec)
+        _store_last_success(rec)
         return 0
     finally:
         for p in (target, j1, j2, j3, warm):

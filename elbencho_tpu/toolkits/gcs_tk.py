@@ -514,8 +514,14 @@ class GcsClient:
                         500, "NoChunkProgress",
                         f"308 acknowledged {committed} bytes, already had "
                         f"{start} committed, and {self.num_retries + 1} "
-                        f"resends made no progress — resumable session "
+                        f"attempts (initial send + {self.num_retries} "
+                        f"resends) made no progress — resumable session "
                         f"stalled")
+                # a zero-progress 308 means the backend is struggling:
+                # back off like the request-level retry path instead of
+                # hammering it with back-to-back resends
+                resend_num = self.num_retries + 1 - no_progress_left
+                time.sleep(0.2 * resend_num)
                 continue  # resend the same chunk
             no_progress_left = self.num_retries + 1
             # partial accept: resend the unacknowledged tail (this is the
