@@ -50,6 +50,7 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 import _axon_mitigation  # noqa: E402  (repo-root module)
+from elbencho_tpu.toolkits.tpu_probe import TPU_PLATFORMS  # noqa: E402
 
 # harness self-test only (see _probe_tpu): run the whole pipeline on the
 # CPU backend with a sanitized env so a dead tunnel can't hang the probe
@@ -184,14 +185,35 @@ _STATE = {
 }
 
 
+def _mask_signals():
+    """Block SIGTERM/SIGINT; returns the old mask (None if unmaskable).
+    Used across spawn+register windows: a signal landing between Popen
+    returning and the _STATE registration would orphan the child — the
+    exact leak the tracking exists to close."""
+    try:
+        return signal.pthread_sigmask(
+            signal.SIG_BLOCK, {signal.SIGTERM, signal.SIGINT})
+    except (ValueError, OSError):  # non-main thread
+        return None
+
+
+def _unmask_signals(old_mask) -> None:
+    if old_mask is not None:
+        signal.pthread_sigmask(signal.SIG_SETMASK, old_mask)
+
+
 def _tracked_run(cmd, env, timeout):
     """subprocess.run equivalent that records the child in _STATE so the
     signal handler can kill it: os._exit would otherwise orphan an
     in-flight probe/bench child, which keeps the TPU tunnel and temp
     files busy until its own timeout long after bench.py exited."""
-    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
-    _STATE["active_proc"] = proc
+    old_mask = _mask_signals()
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        _STATE["active_proc"] = proc
+    finally:
+        _unmask_signals(old_mask)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -229,7 +251,7 @@ def _emit_failure(stage: str, err) -> int:
     auditable. rc stays 0 so an rc-gating driver still parses stdout."""
     platform = _STATE["platform"]
     metric = METRIC_NAME
-    if platform is not None and platform not in ("tpu", "axon"):
+    if platform is not None and platform not in TPU_PLATFORMS:
         # same masquerade guard as the success path: a self-test failure
         # must never be recorded under the real TPU metric name
         metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
@@ -333,25 +355,37 @@ class BenchUnavailable(RuntimeError):
 def _probe_tpu_once(timeout_secs: int) -> str:
     """One bounded reachability check — jax.devices() otherwise blocks
     forever on a dead tunnel and the whole bench run times out without
-    explanation."""
-    probe = _tracked_run(
-        [sys.executable, "-c",
-         "import jax; d = jax.devices(); print(d[0].platform)"],
-        _subproc_env(), timeout_secs)
-    if probe.returncode != 0:
-        raise RuntimeError(
-            f"TPU probe failed: {probe.stderr[-500:]}")
-    platform = probe.stdout.strip().lower()
-    if platform not in ("tpu", "axon"):  # axon = tunneled TPU plugin
-        if _SELFTEST:
-            # harness self-test only: the metric name is rewritten so a
-            # non-TPU number can never masquerade as the TPU result
-            print(f"# WARNING: non-TPU platform {platform!r} allowed by "
-                  f"ELBENCHO_TPU_BENCH_ALLOW_NONTPU", file=sys.stderr)
-            return platform
-        raise RuntimeError(
-            f"default jax backend is {platform!r}, not a TPU — refusing "
-            f"to publish HBM-ingest numbers measured on a CPU fallback")
+    explanation. Delegates to the shared tools/tpu-probe core so the
+    operator CLI, the watcher and this bench all agree on what 'up'
+    means; the child is registered in _STATE for the signal handler."""
+    from elbencho_tpu.toolkits.tpu_probe import probe_once
+
+    # signals stay masked from before the spawn until on_spawn has
+    # registered the child, closing the Popen-returns/registration gap
+    # where a SIGTERM would orphan the probe child
+    old_mask = _mask_signals()
+
+    def _track(proc):
+        _STATE["active_proc"] = proc
+        _unmask_signals(old_mask)
+
+    try:
+        res = probe_once(timeout_secs, env=_subproc_env(),
+                         require_tpu=not _SELFTEST, on_spawn=_track)
+    finally:
+        _STATE["active_proc"] = None
+        _unmask_signals(old_mask)  # no-op if on_spawn already restored it
+    if res.get("outcome") == "timeout":
+        raise subprocess.TimeoutExpired(cmd="tpu-probe", timeout=timeout_secs)
+    if not res.up:
+        raise RuntimeError(f"TPU probe failed: {res.get('error', '?')[-500:]}")
+    platform = res.platform
+    if platform not in TPU_PLATFORMS and _SELFTEST:
+        # harness self-test only: the metric name is rewritten so a
+        # non-TPU number can never masquerade as the TPU result
+        print(f"# WARNING: non-TPU platform {platform!r} allowed by "
+              f"ELBENCHO_TPU_BENCH_ALLOW_NONTPU", file=sys.stderr)
+        return platform
     print(f"# TPU probe ok: platform={platform}", file=sys.stderr)
     return platform
 
@@ -522,7 +556,7 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
         from elbencho_tpu.stats.latency_histogram import LatencyHistogram
         histo = LatencyHistogram.from_dict(med_rec.get("IOLatHisto", {}))
         metric = METRIC_NAME
-        if platform not in ("tpu", "axon"):
+        if platform not in TPU_PLATFORMS:
             metric = f"HARNESS SELF-TEST on {platform}, NOT TPU: " + metric
         rec = {
             "metric": metric,

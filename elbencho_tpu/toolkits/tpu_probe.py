@@ -1,0 +1,190 @@
+"""Bounded TPU reachability probe — the importable core of `tools/tpu-probe`.
+
+The axon tunnel on this box makes `jax.devices()` block FOREVER when the
+tunnel is down (backend init walks every platform), so reachability must
+always be checked in a bounded subprocess, never in-process. This module
+is the single implementation of that check, shared by:
+
+- `tools/tpu-probe` (operator CLI: one-shot JSON status, `--wait` mode,
+  `--exec` hook to convert any tunnel-up window into a fresh capture)
+- `bench.py` (driver benchmark: probe-with-retry before measuring)
+- `tools/tpu-watch` semantics are `tpu-probe --wait --exec "python bench.py"`
+
+Reference analogue: elbencho has no tunnel, but its service-mode master
+polls every service for readiness before a run (RemoteWorker.cpp
+checkServiceVersions); this is the same "don't start until the device
+plane answers" discipline applied to the PJRt backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+#: platforms that count as the real tunneled TPU on this box
+TPU_PLATFORMS = ("tpu", "axon")
+
+_PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "print(d[0].platform, len(d))"
+)
+
+
+def utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class ProbeResult(dict):
+    """Plain dict with attribute sugar; JSON-serializable as-is."""
+
+    @property
+    def up(self) -> bool:
+        return bool(self.get("up"))
+
+    @property
+    def platform(self) -> "str | None":
+        return self.get("platform")
+
+
+def probe_once(timeout_s: float = 120.0, env: "dict | None" = None,
+               require_tpu: bool = True,
+               on_spawn=None) -> ProbeResult:
+    """One bounded reachability check.
+
+    Returns a ProbeResult with keys: up, platform, device_count,
+    elapsed_s, utc and (on failure) outcome ("timeout"/"error") + error.
+    ``require_tpu`` demands a TPU_PLATFORMS backend; with False any live
+    backend (e.g. the CPU self-test env) counts as up.
+    ``on_spawn`` is called with the Popen object right after spawn so a
+    caller's signal handler can kill the child (bench.py does this).
+    """
+    t0 = time.monotonic()
+    rec = ProbeResult(up=False, platform=None, device_count=None,
+                      utc=utc_now())
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SNIPPET],
+        env=dict(os.environ) if env is None else env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    if on_spawn is not None:
+        on_spawn(proc)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        rec["outcome"] = "timeout"
+        rec["error"] = f"probe subprocess exceeded {timeout_s:.0f}s"
+        rec["elapsed_s"] = round(time.monotonic() - t0, 1)
+        return rec
+    rec["elapsed_s"] = round(time.monotonic() - t0, 1)
+    if proc.returncode != 0:
+        rec["outcome"] = "error"
+        rec["error"] = err.strip()[-500:]
+        return rec
+    try:
+        platform, count = out.split()
+        platform = platform.strip().lower()
+        count = int(count)
+    except ValueError:
+        rec["outcome"] = "error"
+        rec["error"] = f"unparseable probe output: {out[:200]!r}"
+        return rec
+    rec["platform"] = platform
+    rec["device_count"] = count
+    if require_tpu and platform not in TPU_PLATFORMS:
+        rec["outcome"] = "wrong_platform"
+        rec["error"] = (f"default backend is {platform!r}, not a TPU "
+                        f"({'/'.join(TPU_PLATFORMS)})")
+        return rec
+    rec["up"] = True
+    rec["outcome"] = "ok"
+    return rec
+
+
+def wait_until_up(window_s: float, interval_s: float = 60.0,
+                  attempt_timeout_s: float = 120.0,
+                  env: "dict | None" = None, require_tpu: bool = True,
+                  log=None) -> ProbeResult:
+    """Poll until the backend answers or ``window_s`` is spent.
+
+    Returns the final ProbeResult augmented with "attempts" (full
+    timeline) and "waited_s". The attempt cadence is one probe per
+    ``interval_s`` measured from probe START, so a fast failure does not
+    turn the wait into a busy loop and a slow timeout does not stretch
+    the cadence beyond interval + attempt_timeout.
+    """
+    t_start = time.monotonic()
+    attempts = []
+    while True:
+        t_probe = time.monotonic()
+        res = probe_once(attempt_timeout_s, env=env, require_tpu=require_tpu)
+        attempts.append({k: res.get(k) for k in
+                         ("utc", "outcome", "elapsed_s", "platform", "error")
+                         if res.get(k) is not None})
+        if log is not None:
+            log(f"probe {len(attempts)}: {res.get('outcome')} "
+                f"({res.get('elapsed_s')}s)")
+        if res.up:
+            break
+        remaining = window_s - (time.monotonic() - t_start)
+        if remaining <= 0:
+            break
+        sleep_s = interval_s - (time.monotonic() - t_probe)
+        if sleep_s > 0:
+            time.sleep(min(sleep_s, max(remaining, 0)))
+    res["attempts"] = attempts
+    res["waited_s"] = round(time.monotonic() - t_start, 1)
+    return res
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    """CLI entry for tools/tpu-probe. Exit 0 when up, 1 when not, 2 on
+    bad usage. Always prints one JSON status object (unless --quiet)."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="tpu-probe",
+        description="Bounded TPU-tunnel reachability probe with optional "
+                    "wait-until-up mode and on-up command hook.")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-attempt probe timeout in seconds (default 120)")
+    ap.add_argument("--wait", action="store_true",
+                    help="poll until the TPU answers or --window is spent")
+    ap.add_argument("--window", type=float, default=3600.0,
+                    help="total wait window for --wait, seconds (default 3600)")
+    ap.add_argument("--interval", type=float, default=60.0,
+                    help="probe cadence for --wait, seconds (default 60)")
+    ap.add_argument("--exec", dest="exec_cmd", default=None,
+                    help="shell command to run once the TPU is up (its rc "
+                         "becomes the exit code); typical use: "
+                         "--wait --exec 'python bench.py'")
+    ap.add_argument("--any-backend", action="store_true",
+                    help="accept any live jax backend, not just TPU "
+                         "(harness self-test)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the JSON status line")
+    args = ap.parse_args(argv)
+
+    def log(msg):
+        print(f"# {msg}", file=sys.stderr)
+
+    if args.wait:
+        res = wait_until_up(args.window, interval_s=args.interval,
+                            attempt_timeout_s=args.timeout,
+                            require_tpu=not args.any_backend, log=log)
+    else:
+        res = probe_once(args.timeout, require_tpu=not args.any_backend)
+    if not args.quiet:
+        print(json.dumps(res), flush=True)
+    if not res.up:
+        return 1
+    if args.exec_cmd:
+        log(f"TPU up — running: {args.exec_cmd}")
+        return subprocess.call(args.exec_cmd, shell=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""tools/tpu-probe: bounded reachability probe + wait/exec watcher.
+
+The probe is the shared core used by bench.py and the auto-recapture
+watcher (`tpu-probe --wait --exec "python bench.py"`), so these tests
+drive the real subprocess path on the CPU backend (sanitized env — the
+axon plugin would hang a dead-tunnel probe for the full timeout).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import _axon_mitigation
+from elbencho_tpu.toolkits import tpu_probe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "tpu-probe")
+
+
+def _cpu_env():
+    env = _axon_mitigation.sanitized_env(1)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_probe_once_cpu_backend_counts_when_tpu_not_required():
+    res = tpu_probe.probe_once(timeout_s=120, env=_cpu_env(),
+                               require_tpu=False)
+    assert res.up
+    assert res["outcome"] == "ok"
+    assert res.platform == "cpu"
+    assert res["device_count"] == 1
+    assert res["elapsed_s"] >= 0
+
+
+def test_probe_once_rejects_cpu_backend_by_default():
+    res = tpu_probe.probe_once(timeout_s=120, env=_cpu_env())
+    assert not res.up
+    assert res["outcome"] == "wrong_platform"
+    assert "not a TPU" in res["error"]
+    assert res.platform == "cpu"  # platform still reported for the audit
+
+
+def test_probe_once_reports_error_outcome_on_crash():
+    env = _cpu_env()
+    env["JAX_PLATFORMS"] = "nonexistent-backend"
+    res = tpu_probe.probe_once(timeout_s=120, env=env, require_tpu=False)
+    assert not res.up
+    assert res["outcome"] == "error"
+    assert res["error"]
+
+
+def test_probe_once_on_spawn_hook_sees_live_child():
+    seen = []
+    res = tpu_probe.probe_once(timeout_s=120, env=_cpu_env(),
+                               require_tpu=False,
+                               on_spawn=lambda p: seen.append(p))
+    assert res.up
+    assert len(seen) == 1
+    assert seen[0].poll() == 0  # child reaped by communicate()
+
+
+def test_wait_until_up_times_out_with_attempt_timeline():
+    res = tpu_probe.wait_until_up(
+        window_s=0.1, interval_s=0.05, attempt_timeout_s=120,
+        env=_cpu_env(), require_tpu=True)
+    assert not res.up
+    assert res["waited_s"] >= 0
+    assert len(res["attempts"]) >= 1
+    assert all(a["outcome"] == "wrong_platform" for a in res["attempts"])
+
+
+def test_wait_until_up_returns_first_success():
+    logs = []
+    res = tpu_probe.wait_until_up(
+        window_s=30, interval_s=0.05, attempt_timeout_s=120,
+        env=_cpu_env(), require_tpu=False, log=logs.append)
+    assert res.up
+    assert len(res["attempts"]) == 1
+    assert logs  # log hook exercised
+
+
+def test_cli_one_shot_json_and_exit_codes():
+    # rc 1 + JSON on a non-TPU backend; rc 0 with --any-backend
+    res = subprocess.run([sys.executable, TOOL], env=_cpu_env(),
+                         capture_output=True, text=True, timeout=180)
+    assert res.returncode == 1
+    rec = json.loads(res.stdout)
+    assert rec["up"] is False and rec["outcome"] == "wrong_platform"
+
+    res = subprocess.run([sys.executable, TOOL, "--any-backend"],
+                         env=_cpu_env(), capture_output=True, text=True,
+                         timeout=180)
+    assert res.returncode == 0
+    rec = json.loads(res.stdout)
+    assert rec["up"] is True and rec["platform"] == "cpu"
+
+
+def test_cli_exec_runs_only_when_up_and_propagates_rc(tmp_path):
+    marker = tmp_path / "ran"
+    cmd = f"touch {marker} && exit 7"
+    # not up -> exec must NOT run, rc 1
+    res = subprocess.run(
+        [sys.executable, TOOL, "--exec", cmd], env=_cpu_env(),
+        capture_output=True, text=True, timeout=180)
+    assert res.returncode == 1
+    assert not marker.exists()
+    # up (any backend) -> exec runs, its rc propagates
+    res = subprocess.run(
+        [sys.executable, TOOL, "--any-backend", "--exec", cmd],
+        env=_cpu_env(), capture_output=True, text=True, timeout=180)
+    assert res.returncode == 7
+    assert marker.exists()
+
+
+def test_bench_probe_uses_shared_core(monkeypatch):
+    """bench.py._probe_tpu_once must delegate to the shared probe and
+    translate its outcomes into the bench exception contract."""
+    import bench
+    calls = {}
+
+    def fake_probe_once(timeout_s, env=None, require_tpu=True,
+                        on_spawn=None):
+        calls["require_tpu"] = require_tpu
+        return tpu_probe.ProbeResult(up=False, outcome="timeout",
+                                     error="x")
+
+    monkeypatch.setattr(tpu_probe, "probe_once", fake_probe_once)
+    try:
+        bench._probe_tpu_once(5)
+    except subprocess.TimeoutExpired:
+        pass
+    else:
+        raise AssertionError("timeout outcome must raise TimeoutExpired")
+    assert calls["require_tpu"] is True
