@@ -292,6 +292,11 @@ class RemoteWorker(Worker):
         self.tpu_h2d_staged_ops = result.get("TpuH2dStagedOps", 0)
         self.tpu_h2d_direct_fallbacks = result.get(
             "TpuH2dDirectFallbacks", 0)
+        # chip ids arrive as JSON string keys; normalize back to int so
+        # the master's merge can't split one chip into "0" and 0 buckets
+        self.tpu_per_chip = {
+            int(chip): (v.get("Bytes", 0), v.get("USec", 0))
+            for chip, v in result.get("TpuPerChip", {}).items()}
         self.got_phase_work = bool(self.elapsed_usec_vec)
 
     def _interrupt_remote(self, quit_service: bool) -> None:

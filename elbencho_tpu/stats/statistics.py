@@ -371,6 +371,10 @@ class Statistics:
                 res.tpu_h2d_staged += getattr(w, "tpu_h2d_staged_ops", 0)
                 res.tpu_h2d_fallbacks += getattr(
                     w, "tpu_h2d_direct_fallbacks", 0)
+                for chip, (b2, u2) in getattr(w, "tpu_per_chip",
+                                              {}).items():
+                    b, u = res.tpu_per_chip.get(chip, (0, 0))
+                    res.tpu_per_chip[chip] = (b + b2, u + u2)
         stonewall_elapsed = [w.stonewall_elapsed_usec for w in workers
                              if w.stonewall_taken]
         res.first_done_usec = min(res.elapsed_usec_vec, default=0)
@@ -676,12 +680,17 @@ class Statistics:
         elapsed_vec = []
         tpu_bytes = tpu_usec = 0
         tpu_direct = tpu_staged = tpu_fallbacks = 0
+        tpu_per_chip = {}
         for w in self.manager.workers:
             if w.got_phase_work:
                 elapsed_vec.extend(w.elapsed_usec_vec)
             tpu_bytes += w.tpu_transfer_bytes
             tpu_usec += w.tpu_transfer_usec
             if getattr(w, "_tpu", None) is not None:
+                chip = w._tpu.chip_id
+                b, u = tpu_per_chip.get(chip, (0, 0))
+                tpu_per_chip[chip] = (b + w.tpu_transfer_bytes,
+                                      u + w.tpu_transfer_usec)
                 tpu_direct += w._tpu.h2d_direct_ops
                 tpu_staged += w._tpu.h2d_staged_ops
                 tpu_fallbacks += w._tpu.h2d_direct_fallbacks
@@ -690,6 +699,10 @@ class Statistics:
                 tpu_staged += getattr(w, "tpu_h2d_staged_ops", 0)
                 tpu_fallbacks += getattr(
                     w, "tpu_h2d_direct_fallbacks", 0)
+                for chip, (b2, u2) in getattr(w, "tpu_per_chip",
+                                              {}).items():
+                    b, u = tpu_per_chip.get(chip, (0, 0))
+                    tpu_per_chip[chip] = (b + b2, u + u2)
         iops_histo = LatencyHistogram()
         entries_histo = LatencyHistogram()
         iops_histo_rwmix = LatencyHistogram()
@@ -739,6 +752,10 @@ class Statistics:
             "CPUUtil": round(shared.cpu_util_last_done, 1),
             "TpuHbmBytes": tpu_bytes,
             "TpuHbmUSec": tpu_usec,
+            # per-chip breakdown travels the wire so the master's merged
+            # record can attribute bytes to chips across services
+            "TpuPerChip": {str(k): {"Bytes": b, "USec": u}
+                           for k, (b, u) in tpu_per_chip.items()},
             "TpuH2dDirectOps": tpu_direct,
             "TpuH2dStagedOps": tpu_staged,
             "TpuH2dDirectFallbacks": tpu_fallbacks,

@@ -1,0 +1,91 @@
+"""Localhost service-process lifecycle for tests and the multichip dryrun.
+
+One implementation of the spawn / /status-ready-wait / terminate-then-kill
+sequence that every consumer of a local service pair needs (the reference's
+localhost multi-service pattern, tools/test-examples.sh:296-330): the
+service-mode pytest suite, the netbench tests, and pass 4 of
+``__graft_entry__.dryrun_multichip`` (master -> HTTP -> services -> chips).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_ports(n: int) -> "list[int]":
+    """n ephemeral localhost ports via bind-then-close, so concurrent
+    runs don't collide on fixed port constants."""
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_ready(port: int, timeout: float = 120.0) -> None:
+    """Poll /status until the service answers 200 or the window closes."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except OSError:
+            pass
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"service on port {port} not ready "
+                               f"after {timeout:.0f}s")
+        time.sleep(0.2)
+
+
+@contextlib.contextmanager
+def service_procs(ports: "list[int]", env: "dict | None" = None,
+                  extra_args: "list[str] | None" = None):
+    """Spawn one --service --foreground process per port, wait for all to
+    answer /status, yield the Popen list, and tear down (terminate, then
+    kill after 10s) any still running on exit.
+
+    ``env`` defaults to os.environ plus the repo on PYTHONPATH. A caller
+    that expects the services to exit on their own (e.g. after --quit
+    over the wire) can wait() them inside the block; teardown skips
+    already-exited processes.
+    """
+    if env is None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    try:
+        for port in ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "elbencho_tpu", "--service",
+                 "--foreground", "--port", str(port)]
+                + list(extra_args or []),
+                env=env, cwd=REPO_DIR,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        for port in ports:
+            wait_ready(port)
+        yield procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()

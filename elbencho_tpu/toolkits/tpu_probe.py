@@ -3,12 +3,16 @@
 The axon tunnel on this box makes `jax.devices()` block FOREVER when the
 tunnel is down (backend init walks every platform), so reachability must
 always be checked in a bounded subprocess, never in-process. This module
-is the single implementation of that check, shared by:
+is the single implementation of that CHECK (one bounded probe and what
+counts as "up"), shared by:
 
 - `tools/tpu-probe` (operator CLI: one-shot JSON status, `--wait` mode,
   `--exec` hook to convert any tunnel-up window into a fresh capture)
-- `bench.py` (driver benchmark: probe-with-retry before measuring)
-- `tools/tpu-watch` semantics are `tpu-probe --wait --exec "python bench.py"`
+- `bench.py` (driver benchmark), which wraps probe_once in its OWN retry
+  loop rather than wait_until_up: its cadence is deliberately different
+  (exponential backoff clamped to the bench's global token budget, and a
+  timeline format embedded in the never-null failure record)
+- the watcher pattern `tpu-probe --wait --exec "python bench.py"`
 
 Reference analogue: elbencho has no tunnel, but its service-mode master
 polls every service for readiness before a run (RemoteWorker.cpp

@@ -3,12 +3,10 @@ service on localhost (reference: netbench mode, LocalWorker.cpp:626-8064)."""
 
 import json
 import os
-import subprocess
-import sys
-import time
-import urllib.request
 
 import pytest
+
+from elbencho_tpu.testing.service_harness import service_procs
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PORTS = (17311, 17312)
@@ -23,32 +21,8 @@ def services(request):
     else:
         env.pop("ELBENCHO_TPU_NO_NATIVE", None)
     env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen(
-        [sys.executable, "-m", "elbencho_tpu", "--service", "--foreground",
-         "--port", str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for port in PORTS]
-    deadline = time.monotonic() + 20
-    try:
-        for port in PORTS:
-            while True:
-                try:
-                    urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}/status", timeout=2)
-                    break
-                except OSError:
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(f"service {port} not up")
-                    time.sleep(0.2)
+    with service_procs(PORTS, env=env):
         yield PORTS
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
 
 
 def test_netbench_two_hosts(services, tmp_path):

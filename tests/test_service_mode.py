@@ -5,7 +5,6 @@ plus a master — the reference's localhost multi-service pattern
 import contextlib
 import json
 import os
-import subprocess
 import sys
 import time
 import urllib.request
@@ -16,22 +15,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PORTS = (17111, 17112)
 
 
-def _wait_ready(port, timeout=20):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/status", timeout=2) as r:
-                if r.status == 200:
-                    return
-        except OSError:
-            time.sleep(0.2)
-    raise TimeoutError(f"service on port {port} not ready")
+from elbencho_tpu.testing.service_harness import service_procs  # noqa: E402
 
 
 @contextlib.contextmanager
 def _service_pair(ports, native: bool):
-    """Spawn + ready-wait + teardown for a localhost service pair."""
+    """Spawn + ready-wait + teardown for a localhost service pair
+    (shared lifecycle: elbencho_tpu/testing/service_harness.py)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if native:
@@ -39,24 +29,8 @@ def _service_pair(ports, native: bool):
     else:
         env["ELBENCHO_TPU_NO_NATIVE"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
-    procs = []
-    try:
-        for port in ports:
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "elbencho_tpu", "--service",
-                 "--foreground", "--port", str(port)],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-        for port in ports:
-            _wait_ready(port)
+    with service_procs(ports, env=env):
         yield ports
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
 
 
 @pytest.fixture()
