@@ -288,10 +288,11 @@ class RemoteWorker(Worker):
             result.get("IOLatHistoRWMixRead", {}))
         self.tpu_transfer_bytes = result.get("TpuHbmBytes", 0)
         self.tpu_transfer_usec = result.get("TpuHbmUSec", 0)
-        self.tpu_h2d_direct_ops = result.get("TpuH2dDirectOps", 0)
-        self.tpu_h2d_staged_ops = result.get("TpuH2dStagedOps", 0)
-        self.tpu_h2d_direct_fallbacks = result.get(
-            "TpuH2dDirectFallbacks", 0)
+        # H2D/D2H path-audit counters, schema-driven so a counter added
+        # to PATH_AUDIT_COUNTERS is ingested without touching this file
+        from ..tpu.device import PATH_AUDIT_COUNTERS
+        for _attr, key, ingest_attr in PATH_AUDIT_COUNTERS:
+            setattr(self, ingest_attr, result.get(key, 0))
         # chip ids arrive as JSON string keys; normalize back to int so
         # the master's merge can't split one chip into "0" and 0 buckets
         self.tpu_per_chip = {

@@ -19,6 +19,7 @@ import sys
 import time
 
 from ..phases import BenchMode, BenchPhase, phase_entry_type, phase_name
+from ..tpu.device import PATH_AUDIT_COUNTERS, sum_path_audit_counters
 from .latency_histogram import LatencyHistogram
 
 
@@ -54,11 +55,10 @@ class PhaseResults:
         self.tpu_bytes = 0
         self.tpu_usec = 0
         self.tpu_per_chip: "dict[int, tuple[int, int]]" = {}
-        # --tpudirect path audit: which H2D transfer path actually ran
-        # (cumulative per worker context; direct vs staged vs fallbacks)
-        self.tpu_h2d_direct = 0
-        self.tpu_h2d_staged = 0
-        self.tpu_h2d_fallbacks = 0
+        # --tpudirect H2D/D2H path audit, keyed by wire/JSON name
+        # (schema: tpu.device.PATH_AUDIT_COUNTERS)
+        self.tpu_path_counters: "dict[str, int]" = {
+            key: 0 for _attr, key, _ingest in PATH_AUDIT_COUNTERS}
         self.num_workers = 0
 
 
@@ -363,18 +363,12 @@ class Statistics:
                 b, u = res.tpu_per_chip.get(chip, (0, 0))
                 res.tpu_per_chip[chip] = (b + w.tpu_transfer_bytes,
                                           u + w.tpu_transfer_usec)
-                res.tpu_h2d_direct += w._tpu.h2d_direct_ops
-                res.tpu_h2d_staged += w._tpu.h2d_staged_ops
-                res.tpu_h2d_fallbacks += w._tpu.h2d_direct_fallbacks
-            else:  # RemoteWorker: counters ingested from the service JSON
-                res.tpu_h2d_direct += getattr(w, "tpu_h2d_direct_ops", 0)
-                res.tpu_h2d_staged += getattr(w, "tpu_h2d_staged_ops", 0)
-                res.tpu_h2d_fallbacks += getattr(
-                    w, "tpu_h2d_direct_fallbacks", 0)
+            else:  # RemoteWorker: per-chip map ingested from service JSON
                 for chip, (b2, u2) in getattr(w, "tpu_per_chip",
                                               {}).items():
                     b, u = res.tpu_per_chip.get(chip, (0, 0))
                     res.tpu_per_chip[chip] = (b + b2, u + u2)
+        res.tpu_path_counters = sum_path_audit_counters(workers)
         stonewall_elapsed = [w.stonewall_elapsed_usec for w in workers
                              if w.stonewall_taken]
         res.first_done_usec = min(res.elapsed_usec_vec, default=0)
@@ -550,9 +544,8 @@ class Statistics:
                 res.tpu_bytes / last_s / (1 << 20), 2) if res.tpu_bytes else 0,
             "TpuPerChip": {str(k): {"Bytes": b, "USec": u}
                            for k, (b, u) in res.tpu_per_chip.items()},
-            "TpuH2dDirectOps": res.tpu_h2d_direct,
-            "TpuH2dStagedOps": res.tpu_h2d_staged,
-            "TpuH2dDirectFallbacks": res.tpu_h2d_fallbacks,
+            # H2D/D2H path audit, keyed by PATH_AUDIT_COUNTERS
+            **res.tpu_path_counters,
         }
         # unconditional so CSV rows keep a fixed column count
         rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
@@ -561,7 +554,7 @@ class Statistics:
         return rec
 
     #: fixed result columns of the CSV schema (docs/result-columns.md);
-    #: TpuPerChip and the TpuH2d* path-audit counters are JSON-only
+    #: TpuPerChip and the TpuH2d*/TpuD2h* path-audit counters are JSON-only
     CSV_RESULT_COLUMNS = (
         "ISODate", "Label", "Phase", "EntryType", "NumWorkers",
         "ElapsedUSecFirst", "ElapsedUSecLast", "EntriesFirst", "EntriesLast",
@@ -620,9 +613,8 @@ class Statistics:
     def _write_csv(self, res: PhaseResults) -> None:
         rec = self._result_record(res)
         rec.pop("TpuPerChip")
-        rec.pop("TpuH2dDirectOps")
-        rec.pop("TpuH2dStagedOps")
-        rec.pop("TpuH2dDirectFallbacks")
+        for _attr, key, _ingest in PATH_AUDIT_COUNTERS:  # JSON-only keys
+            rec.pop(key)
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
         path = self.cfg.csv_file_path
@@ -679,7 +671,6 @@ class Statistics:
         shared = self.manager.shared
         elapsed_vec = []
         tpu_bytes = tpu_usec = 0
-        tpu_direct = tpu_staged = tpu_fallbacks = 0
         tpu_per_chip = {}
         for w in self.manager.workers:
             if w.got_phase_work:
@@ -691,14 +682,7 @@ class Statistics:
                 b, u = tpu_per_chip.get(chip, (0, 0))
                 tpu_per_chip[chip] = (b + w.tpu_transfer_bytes,
                                       u + w.tpu_transfer_usec)
-                tpu_direct += w._tpu.h2d_direct_ops
-                tpu_staged += w._tpu.h2d_staged_ops
-                tpu_fallbacks += w._tpu.h2d_direct_fallbacks
-            else:  # RemoteWorker: counters ingested from the service JSON
-                tpu_direct += getattr(w, "tpu_h2d_direct_ops", 0)
-                tpu_staged += getattr(w, "tpu_h2d_staged_ops", 0)
-                tpu_fallbacks += getattr(
-                    w, "tpu_h2d_direct_fallbacks", 0)
+            else:  # RemoteWorker: per-chip map ingested from service JSON
                 for chip, (b2, u2) in getattr(w, "tpu_per_chip",
                                               {}).items():
                     b, u = tpu_per_chip.get(chip, (0, 0))
@@ -756,9 +740,8 @@ class Statistics:
             # record can attribute bytes to chips across services
             "TpuPerChip": {str(k): {"Bytes": b, "USec": u}
                            for k, (b, u) in tpu_per_chip.items()},
-            "TpuH2dDirectOps": tpu_direct,
-            "TpuH2dStagedOps": tpu_staged,
-            "TpuH2dDirectFallbacks": tpu_fallbacks,
+            # H2D/D2H path audit, keyed by PATH_AUDIT_COUNTERS
+            **sum_path_audit_counters(self.manager.workers),
         }
 
     def close(self) -> None:

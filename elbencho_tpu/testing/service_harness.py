@@ -35,6 +35,14 @@ def free_ports(n: int) -> "list[int]":
     return ports
 
 
+def default_env() -> dict:
+    """os.environ plus the repo on PYTHONPATH — the baseline service
+    subprocess environment; callers layer their own knobs on top."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 def wait_ready(port: int, timeout: float = 120.0) -> None:
     """Poll /status until the service answers 200 or the window closes."""
     deadline = time.monotonic() + timeout
@@ -65,8 +73,7 @@ def service_procs(ports: "list[int]", env: "dict | None" = None,
     already-exited processes.
     """
     if env is None:
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+        env = default_env()
     procs = []
     try:
         for port in ports:

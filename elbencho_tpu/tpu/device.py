@@ -33,6 +33,40 @@ import numpy as np
 _jax_lock = threading.Lock()
 _jax_mod = None
 
+#: H2D/D2H path-audit counter map, the single schema for how counters
+#: flow from TpuWorkerContext to result records: (context attribute,
+#: wire/JSON key, RemoteWorker ingest attribute). Statistics sums by it,
+#: the service payload serializes by it, RemoteWorker ingests by it —
+#: adding a counter here plumbs it end to end.
+PATH_AUDIT_COUNTERS = (
+    ("h2d_direct_ops", "TpuH2dDirectOps", "tpu_h2d_direct_ops"),
+    ("h2d_staged_ops", "TpuH2dStagedOps", "tpu_h2d_staged_ops"),
+    ("h2d_direct_fallbacks", "TpuH2dDirectFallbacks",
+     "tpu_h2d_direct_fallbacks"),
+    ("d2h_direct_ops", "TpuD2hDirectOps", "tpu_d2h_direct_ops"),
+    ("d2h_staged_ops", "TpuD2hStagedOps", "tpu_d2h_staged_ops"),
+    ("d2h_direct_fallbacks", "TpuD2hDirectFallbacks",
+     "tpu_d2h_direct_fallbacks"),
+    ("d2h_prefetch_hits", "TpuD2hPrefetchHits", "tpu_d2h_prefetch_hits"),
+    ("d2h_prefetch_misses", "TpuD2hPrefetchMisses",
+     "tpu_d2h_prefetch_misses"),
+)
+
+
+def sum_path_audit_counters(workers) -> dict:
+    """Total the path-audit counters over a worker list, reading local
+    workers' TpuWorkerContext directly and RemoteWorkers' ingested
+    attributes (keyed by wire/JSON name, ready to merge into records)."""
+    totals = {key: 0 for _, key, _ in PATH_AUDIT_COUNTERS}
+    for w in workers:
+        ctx = getattr(w, "_tpu", None)
+        for attr, key, ingest_attr in PATH_AUDIT_COUNTERS:
+            if ctx is not None:
+                totals[key] += getattr(ctx, attr)
+            else:
+                totals[key] += getattr(w, ingest_attr, 0)
+    return totals
+
 
 def _get_jax():
     """Lazy jax import so CPU-only workloads never pay for it."""
@@ -95,7 +129,11 @@ class TpuWorkerContext:
                 f"--tpuhbmpct {hbm_limit_pct} fits fewer than 3 blocks)")
         self._pool_blocks = min(self._FILL_POOL_BLOCKS,
                                 max(budget_blocks - 2, 1))
-        max_depth = max(budget_blocks - self._pool_blocks - 1, 1)
+        # both rings can be live on ONE context in the same phase (rwmix
+        # interleaves reads -> H2D in-flight ring with writes -> D2H
+        # speculative ring), so the depth clamp budgets for two rings of
+        # pipeline_depth blocks each, not one
+        max_depth = max((budget_blocks - self._pool_blocks - 1) // 2, 1)
         self.pipeline_depth = min(self.pipeline_depth, max_depth)
         self._key = jax.random.PRNGKey(chip_id)
         self._num_words = max(block_size // 4, 1)
@@ -116,6 +154,28 @@ class TpuWorkerContext:
         self.h2d_staged_ops = 0
         self.h2d_direct_fallbacks = 0
         self._direct_warned = False
+        # the H2D import and D2H export are INDEPENDENT capabilities of
+        # --tpudirect (e.g. on the virtual mesh the export works on every
+        # device while the import only aliases onto device 0), so each
+        # has its own works/failed latch; self.direct stays the user's
+        # intent and is never mutated
+        self._h2d_direct_ok = True
+        # symmetric D2H audit (write path / --tpubench d2h): direct =
+        # zero-copy dlpack export of the device block, staged = np.asarray
+        # D2H; prefetch = async D2H issued ahead of consumption
+        self.d2h_direct_ops = 0
+        self.d2h_staged_ops = 0
+        self.d2h_direct_fallbacks = 0
+        self.d2h_prefetch_hits = 0
+        self.d2h_prefetch_misses = 0
+        self._d2h_direct_ok = True
+        self._d2h_warned = False
+        # speculative verify-pattern pipeline: (offset, length, salt) ->
+        # device block with its D2H already issued. Bounded by
+        # pipeline_depth; sequential write streams hit, random streams
+        # miss and speculation self-disables after a miss streak.
+        self._d2h_spec: dict = {}
+        self._d2h_spec_miss_streak = 0
 
     # -- read path: host buffer -> HBM --------------------------------------
 
@@ -148,7 +208,7 @@ class TpuWorkerContext:
         jax = _get_jax()
         n_words = length // 4
         np_view = np.frombuffer(buf[:n_words * 4], dtype=np.uint32)
-        if self.direct:
+        if self.direct and self._h2d_direct_ok:
             arr = self._direct_import(np_view)
         else:
             arr = jax.device_put(np_view, self.device)
@@ -191,21 +251,25 @@ class TpuWorkerContext:
                     f"{self.chip_id} ({err}); falling back to the staged "
                     f"transfer path for this run")
             # the I/O buffers are fixed for the worker's lifetime, so one
-            # failed export means they all fail: disable direct so the
-            # hot loop doesn't pay a raise per block (and the one-time
-            # note above stays truthful)
-            self.direct = False
+            # failed import means they all fail: latch the H2D side off
+            # so the hot loop doesn't pay a raise per block (the D2H
+            # export is an independent capability and keeps its own latch)
+            self._h2d_direct_ok = False
             self.h2d_direct_fallbacks += 1
             self.h2d_staged_ops += 1
             return jax.device_put(np_view, self.device)
 
     def reset_path_counters(self) -> None:
-        """Zero the H2D path-audit counters (called from the worker's
+        """Zero the H2D/D2H path-audit counters (called from the worker's
         per-phase reset_stats so each phase record reports its own ops,
-        consistent with the phase-reset TpuHbmBytes)."""
-        self.h2d_direct_ops = 0
-        self.h2d_staged_ops = 0
-        self.h2d_direct_fallbacks = 0
+        consistent with the phase-reset TpuHbmBytes). Speculation state
+        resets with them: a random-offset phase must not leave prefetch
+        disabled for a later sequential phase, and stale speculated
+        blocks must not charge a miss to the next phase's record."""
+        for attr, _key, _ingest in PATH_AUDIT_COUNTERS:
+            setattr(self, attr, 0)
+        self._d2h_spec.clear()
+        self._d2h_spec_miss_streak = 0
 
     def flush(self) -> None:
         """Drain all pipelined transfers (phase-end completion wait)."""
@@ -218,8 +282,9 @@ class TpuWorkerContext:
             from ..ops.fill import random_block_u32
             for i in range(self._pool_blocks):
                 key = jax.random.fold_in(self._key, i)
-                self._fill_pool.append(
-                    random_block_u32(key, self._num_words))
+                arr = random_block_u32(key, self._num_words)
+                _d2h_async(arr)  # host copies stream while later blocks fill
+                self._fill_pool.append(arr)
 
     def warmup_fill(self) -> None:
         """Build the HBM fill pool ahead of the first measured phase so the
@@ -230,17 +295,43 @@ class TpuWorkerContext:
 
     # -- write path: HBM -> host buffer --------------------------------------
 
+    #: consecutive speculation misses before the verify-pattern prefetch
+    #: pipeline concludes the offset stream is not sequential and stops
+    #: wasting device compute + HBM on mispredicted blocks
+    _D2H_SPEC_MISS_LIMIT = 8
+
     def device_to_host(self, buf: memoryview, length: int,
                        verify_salt: int = 0, file_offset: int = 0) -> None:
         """Write-source block originates in HBM (on-device PRNG fill, or the
         on-device verify pattern when --verify is active) and is DMA'd to
         the host I/O buffer (replaces curandGenerate + cudaMemcpy D2H,
-        LocalWorker.cpp:1427-1537 / :2437)."""
+        LocalWorker.cpp:1427-1537; the reference's GPU path is symmetric,
+        cudaMemcpyAsync D2H :2437-2490 — this is the symmetric TPU leg).
+
+        Pipelined like the H2D ring, with the roles flipped:
+
+        - pool path (plain writes): every pool block's host copy is
+          issued asynchronously at fill time, so steady-state calls only
+          pay the copy into the I/O buffer, never a blocking D2H.
+        - verify path (--verify): block content depends on file_offset,
+          so the ring speculates — after serving offset o it precomputes
+          the patterns for o+len .. o+depth*len on device and issues
+          their D2H transfers; a sequential write stream then always
+          consumes an already-in-flight block (d2h_prefetch_hits), while
+          a random stream misses (d2h_prefetch_misses) and speculation
+          self-disables after a miss streak. Depth rides --iodepth
+          (pipeline_depth), reusing the H2D ring's HBM budget allowance —
+          a phase is either reading (H2D ring live) or writing (D2H
+          ring live), never both on the same context.
+        - the final hop into the caller's I/O buffer uses a zero-copy
+          dlpack export of the device block when --tpudirect is active
+          (host-backed backends; real TPUs fall back LOUDLY to the
+          staged np.asarray, whose async copy the ring already started).
+        """
         n_words = max(length // 4, 1)
         if verify_salt:
-            from ..ops.fill import verify_pattern_block_u32
-            params = _split_u64_params(file_offset, verify_salt)
-            arr = verify_pattern_block_u32(params, n_words)
+            arr = self._verify_block_pipelined(length, n_words,
+                                               verify_salt, file_offset)
         else:
             # cycle the pre-filled HBM pool (curand-at-alloc parity)
             self._ensure_fill_pool()
@@ -248,7 +339,7 @@ class TpuWorkerContext:
             arr = self._fill_pool[self._fill_idx]
             if n_words != self._num_words:
                 arr = arr[:n_words]
-        host = np.asarray(arr)  # D2H transfer
+        host = self._d2h_export(arr)
         # single copy into the I/O buffer (tobytes() + slice-assign would
         # add two more full-block copies on this hot path)
         dst = np.frombuffer(buf, dtype=np.uint8, count=length)
@@ -258,10 +349,85 @@ class TpuWorkerContext:
         if verify_salt and length % 8:
             dst[(length // 8) * 8:] = 0
 
+    def _verify_block_pipelined(self, length: int, n_words: int,
+                                verify_salt: int, file_offset: int):
+        """Serve the verify-pattern block for file_offset, preferably from
+        the speculative ring, and re-arm speculation for the sequential
+        continuation of the stream."""
+        from ..ops.fill import verify_pattern_block_u32
+        arr = self._d2h_spec.pop((file_offset, length, verify_salt), None)
+        if arr is not None:
+            self.d2h_prefetch_hits += 1
+            self._d2h_spec_miss_streak = 0
+        else:
+            if self._d2h_spec:
+                # mispredicted stream: the speculated blocks are stale
+                # (their offsets will never be asked for in order)
+                self.d2h_prefetch_misses += 1
+                self._d2h_spec_miss_streak += 1
+                self._d2h_spec.clear()
+            arr = verify_pattern_block_u32(
+                _split_u64_params(file_offset, verify_salt), n_words)
+            _d2h_async(arr)
+        # evaluated AFTER miss accounting so the ring cannot re-arm on
+        # the very call whose miss reached the limit
+        if self._d2h_spec_miss_streak < self._D2H_SPEC_MISS_LIMIT:
+            # speculate the sequential continuation up to ring depth
+            for k in range(1, self.pipeline_depth + 1):
+                if len(self._d2h_spec) >= self.pipeline_depth:
+                    break
+                nxt = (file_offset + k * length, length, verify_salt)
+                if nxt in self._d2h_spec:
+                    continue
+                spec_arr = verify_pattern_block_u32(
+                    _split_u64_params(nxt[0], verify_salt), n_words)
+                _d2h_async(spec_arr)
+                self._d2h_spec[nxt] = spec_arr
+        return arr
+
+    def _d2h_export(self, arr) -> np.ndarray:
+        """Host ndarray of a device block. Direct (--tpudirect): zero-copy
+        dlpack export — the device buffer IS the host memory on
+        host-backed backends, so the only copy left is the one into the
+        I/O buffer (cudaMemcpy-D2H-into-registered-buffer analogue). On
+        devices whose memory the host can't address (real TPU HBM) the
+        export fails once, falls back LOUDLY to the staged np.asarray
+        path (whose transfer the async ring already started), and stays
+        disabled so the hot loop doesn't pay a raise per block."""
+        if self.direct and self._d2h_direct_ok:
+            try:
+                host = np.from_dlpack(arr)
+                self.d2h_direct_ops += 1
+                return host
+            except Exception as err:  # noqa: BLE001 - any export failure
+                self._d2h_direct_ok = False
+                self.d2h_direct_fallbacks += 1
+                if not self._d2h_warned:
+                    self._d2h_warned = True
+                    from ..toolkits.logger import log, LOG_NORMAL
+                    log(LOG_NORMAL,
+                        f"NOTE: --tpudirect D2H dlpack export failed for "
+                        f"chip {self.chip_id} ({err}); falling back to "
+                        f"the staged transfer path for this run")
+        self.d2h_staged_ops += 1
+        return np.asarray(arr)
+
     def close(self) -> None:
         self.flush()
         self._last_ingested = None
         self._fill_pool = []
+        self._d2h_spec = {}
+
+
+def _d2h_async(arr) -> None:
+    """Start the device->host copy of arr without blocking (jax caches
+    the host copy on the array; a later np.asarray completes instantly
+    once the DMA lands). Best-effort: backends without the method just
+    stay synchronous."""
+    try:
+        arr.copy_to_host_async()
+    except Exception:  # pragma: no cover - non-jax.Array or old backend
+        pass
 
 
 def _split_u64_params(file_offset: int, salt: int):

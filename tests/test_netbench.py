@@ -6,23 +6,21 @@ import os
 
 import pytest
 
-from elbencho_tpu.testing.service_harness import service_procs
+from elbencho_tpu.testing.service_harness import default_env, free_ports, service_procs
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PORTS = (17311, 17312)
 
 
 @pytest.fixture(params=["native", "python"])
 def services(request):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = default_env()
     if request.param == "python":
         env["ELBENCHO_TPU_NO_NATIVE"] = "1"
     else:
         env.pop("ELBENCHO_TPU_NO_NATIVE", None)
     env["JAX_PLATFORMS"] = "cpu"
-    with service_procs(PORTS, env=env):
-        yield PORTS
+    ports = free_ports(2)
+    with service_procs(ports, env=env):
+        yield ports
 
 
 def test_netbench_two_hosts(services, tmp_path):

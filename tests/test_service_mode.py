@@ -12,18 +12,16 @@ import urllib.request
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PORTS = (17111, 17112)
 
-
-from elbencho_tpu.testing.service_harness import service_procs  # noqa: E402
+from elbencho_tpu.testing.service_harness import (  # noqa: E402
+    default_env, free_ports, service_procs)
 
 
 @contextlib.contextmanager
 def _service_pair(ports, native: bool):
     """Spawn + ready-wait + teardown for a localhost service pair
     (shared lifecycle: elbencho_tpu/testing/service_harness.py)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = default_env()
     if native:
         env.pop("ELBENCHO_TPU_NO_NATIVE", None)
     else:
@@ -35,7 +33,7 @@ def _service_pair(ports, native: bool):
 
 @pytest.fixture()
 def services():
-    with _service_pair(PORTS, native=False) as ports:
+    with _service_pair(free_ports(2), native=False) as ports:
         yield ports
 
 
@@ -243,15 +241,12 @@ def test_distributed_gcs_backend_over_service_wire(services):
         srv.stop()
 
 
-NATIVE_PORTS = (17121, 17122)
-
-
 @pytest.fixture()
 def services_native():
     """Service pair WITH the native C++ engine enabled (the default
     fixture disables it): distributed phases must drive the C++ loops
     from service worker threads too."""
-    with _service_pair(NATIVE_PORTS, native=True) as ports:
+    with _service_pair(free_ports(2), native=True) as ports:
         yield ports
 
 

@@ -122,12 +122,16 @@ def test_tpudirect_falls_back_loudly_on_unexportable_buffer(capsys):
     ctx = TpuWorkerContext(chip_id=0, block_size=bs, direct=True)
     ctx.host_to_device(mv, bs)
     ctx.host_to_device(mv, bs)
-    # first block: failed export, counted fallback; direct then disabled
-    # for the run (fixed buffers -> every export would fail identically)
+    # first block: failed export, counted fallback; the H2D side then
+    # latches off for the run (fixed buffers -> every export would fail
+    # identically) while user intent and the independent D2H export
+    # capability stay intact
     assert ctx.h2d_direct_fallbacks == 1
     assert ctx.h2d_staged_ops == 2
     assert ctx.h2d_direct_ops == 0
-    assert ctx.direct is False
+    assert ctx.direct is True  # user intent, never mutated
+    assert ctx._h2d_direct_ok is False
+    assert ctx._d2h_direct_ok is True  # D2H export unaffected
     out = capsys.readouterr().out
     assert out.count("--tpudirect dlpack export failed") == 1
     ctx.close()
@@ -182,10 +186,12 @@ def test_hbm_budget_clamps_pipeline_depth():
     assert ctx.hbm_budget_bytes == budget
     assert ctx.pipeline_depth == 4  # tiny blocks: no clamping
 
-    # block size chosen so only ~2 blocks fit beyond pool+sink
+    # block size chosen so only ~2 blocks fit beyond pool+sink; the
+    # clamp budgets for BOTH transfer rings (H2D in-flight + D2H
+    # speculative) since rwmix phases run them simultaneously
     big = budget // 7
     ctx2 = TpuWorkerContext(chip_id=0, block_size=big, pipeline_depth=64)
-    assert ctx2.pipeline_depth == max(budget // big - 4 - 1, 1)
+    assert ctx2.pipeline_depth == max((budget // big - 4 - 1) // 2, 1)
 
     with pytest.raises(RuntimeError, match="HBM staging budget"):
         TpuWorkerContext(chip_id=0, block_size=budget + 1)
@@ -218,9 +224,10 @@ def test_service_wire_carries_tpudirect_audit(tmp_path):
     import sys as _sys
     _sys.path.insert(0, "/root/repo")
     from tests.test_service_mode import _service_pair
+    from elbencho_tpu.testing.service_harness import free_ports
     from elbencho_tpu.cli import main
     jsonfile = tmp_path / "out.json"
-    with _service_pair((17161,), native=False) as ports:
+    with _service_pair(free_ports(1), native=False) as ports:
         host = f"127.0.0.1:{ports[0]}"
         rc = main(["-w", "-r", "-t", "1", "-s", "128K", "-b", "64K",
                    "--tpuids", "0", "--tpudirect", "--hosts", host,
@@ -242,6 +249,53 @@ def test_device_fill_pool_cycles():
     ctx.device_to_host(buf2, 4096)
     assert bytes(buf1) != bytes(4096)  # actually filled
     assert bytes(buf1) != bytes(buf2)  # pool rotation gives variety
+    # pool path is staged by default; the export split is audited
+    assert ctx.d2h_staged_ops == 2
+    assert ctx.d2h_direct_ops == 0
+
+
+def test_d2h_direct_export_on_host_backed_device():
+    """--tpudirect D2H: zero-copy dlpack export serves the write source
+    on host-backed devices (the symmetric leg of the H2D direct path)."""
+    ctx = TpuWorkerContext(chip_id=0, block_size=4096, direct=True)
+    buf = memoryview(bytearray(4096))
+    ctx.device_to_host(buf, 4096, verify_salt=7, file_offset=0)
+    assert bytes(buf) == _host_pattern(0, 4096, 7)  # content still right
+    assert ctx.d2h_direct_ops == 1
+    assert ctx.d2h_staged_ops == 0
+    assert ctx.d2h_direct_fallbacks == 0
+
+
+def test_d2h_verify_prefetch_hits_on_sequential_stream():
+    """Sequential verify-pattern writes ride the speculative D2H ring:
+    after the first block every request is served from an
+    already-in-flight prefetch (reference: the symmetric pipelined
+    cudaMemcpyAsync D2H, LocalWorker.cpp:2437-2490)."""
+    ctx = TpuWorkerContext(chip_id=0, block_size=4096, pipeline_depth=4)
+    buf = memoryview(bytearray(4096))
+    for i in range(6):
+        ctx.device_to_host(buf, 4096, verify_salt=11,
+                           file_offset=i * 4096)
+        assert bytes(buf) == _host_pattern(i * 4096, 4096, 11)
+    assert ctx.d2h_prefetch_hits == 5  # all but the stream head
+    assert ctx.d2h_prefetch_misses == 0
+
+
+def test_d2h_verify_prefetch_self_disables_on_random_stream():
+    """A random offset stream must not keep paying speculative device
+    compute forever: misses accumulate and the ring shuts off after the
+    miss-streak limit (content stays correct throughout)."""
+    ctx = TpuWorkerContext(chip_id=0, block_size=4096, pipeline_depth=2)
+    buf = memoryview(bytearray(4096))
+    limit = TpuWorkerContext._D2H_SPEC_MISS_LIMIT
+    # offsets jump by 3 blocks: every speculated continuation is wrong
+    for i in range(limit + 4):
+        off = i * 3 * 4096
+        ctx.device_to_host(buf, 4096, verify_salt=5, file_offset=off)
+        assert bytes(buf) == _host_pattern(off, 4096, 5)
+    assert ctx.d2h_prefetch_hits == 0
+    assert ctx.d2h_prefetch_misses == limit
+    assert not ctx._d2h_spec  # speculation off: nothing left in flight
 
 
 def test_split_u64_params():
