@@ -2,7 +2,8 @@
 # the C++ build; here the Python package needs no build and the native
 # engine lives in csrc/)
 
-.PHONY: all native native-tsan test test-fast bench docs clean deb rpm docker
+.PHONY: all native native-tsan native-asan check test test-fast \
+	test-examples fuzz bench docs clean deb rpm docker
 
 all: native
 
@@ -28,6 +29,29 @@ native-asan:
 	@echo "asan build done; run tests with:" \
 		"LD_PRELOAD=\$$(gcc -print-file-name=libasan.so)" \
 		"ASAN_OPTIONS=detect_leaks=0 pytest ..."
+
+# the single green command (SURVEY.md section 5.2 sanitizer/robustness
+# gate): pytest + seeded fuzz sweeps + asan/tsan engine builds each
+# re-running the native test file + the end-to-end example suite.
+# Exits nonzero on the first failing stage; ends by restoring the
+# normal (unsanitized) engine build.
+check: native
+	python -m pytest tests/ -q
+	tools/fuzz-sweep
+	$(MAKE) native-asan
+	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
+		ASAN_OPTIONS=detect_leaks=0 \
+		python -m pytest tests/test_native_engine.py -q
+	$(MAKE) native-tsan
+	LD_PRELOAD=$$(gcc -print-file-name=libtsan.so) \
+		python -m pytest tests/test_native_engine.py -q
+	$(MAKE) native
+	tools/test-examples $${BASEDIR:-/tmp}
+	@echo "make check: ALL GREEN"
+
+# fuzz sweeps alone (fixed default seed; see tools/fuzz-sweep --help)
+fuzz:
+	tools/fuzz-sweep
 
 test: native
 	python -m pytest tests/ -q
