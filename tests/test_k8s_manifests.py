@@ -1,0 +1,126 @@
+"""Deployment manifests (docs/k8s/) are schema-validated: structurally
+sound k8s objects whose commands/ports/volumes are mutually consistent
+and consistent with the CLI's defaults (reference counterpart:
+docs/k8s/multi-node-elbencho.yaml:1-84)."""
+
+import os
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+K8S_DIR = os.path.join(REPO, "docs", "k8s")
+
+MANIFESTS = [
+    "tpu-pod-slice-elbencho-tpu.yaml",
+    "multi-node-elbencho-tpu.yaml",
+    "nfs-pv-pvc.yaml",
+]
+
+
+def _load(name):
+    with open(os.path.join(K8S_DIR, name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+@pytest.mark.parametrize("name", MANIFESTS)
+def test_manifest_objects_are_wellformed(name):
+    docs = _load(name)
+    assert docs, f"{name}: no objects"
+    for doc in docs:
+        assert doc.get("apiVersion"), doc
+        assert doc.get("kind"), doc
+        assert doc.get("metadata", {}).get("name"), doc
+        assert "spec" in doc, doc
+
+
+def _pod_spec(doc):
+    return doc["spec"]["template"]["spec"]
+
+
+def _containers(doc):
+    return _pod_spec(doc)["containers"]
+
+
+def test_tpu_pod_slice_topology():
+    docs = {(d["kind"], d["metadata"]["name"]): d
+            for d in _load("tpu-pod-slice-elbencho-tpu.yaml")}
+    svc = docs[("Service", "elbencho-tpu-workers")]
+    worker = docs[("Job", "elbencho-tpu-worker")]
+    master = docs[("Job", "elbencho-tpu-master")]
+
+    # headless service selects the worker pods on the service port
+    # (k8s wants the literal string "None" for headless)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["selector"] == \
+        worker["spec"]["template"]["metadata"]["labels"]
+    svc_port = svc["spec"]["ports"][0]["port"]
+
+    # one service pod per TPU VM worker: indexed job, chips requested,
+    # slice pinned via nodeSelector
+    assert worker["spec"]["completionMode"] == "Indexed"
+    assert worker["spec"]["parallelism"] == worker["spec"]["completions"]
+    node_sel = _pod_spec(worker)["nodeSelector"]
+    assert any("gke-tpu" in k for k in node_sel)
+    [wc] = _containers(worker)
+    assert wc["resources"]["requests"]["google.com/tpu"]
+    assert wc["command"][:3] == ["python", "-m", "elbencho_tpu"]
+    assert "--service" in wc["command"]
+    port_idx = wc["command"].index("--port") + 1
+    assert int(wc["command"][port_idx]) == svc_port
+    assert wc["ports"][0]["containerPort"] == svc_port
+
+    # master drives the TPU data path against the slice via --podhosts
+    [mc] = _containers(master)
+    assert mc["command"][:3] == ["python", "-m", "elbencho_tpu"]
+    assert "--podhosts" in mc["command"]
+    assert "--tpuids" in mc["command"]
+
+    # every mount references a defined volume, both jobs
+    for doc in (worker, master):
+        vols = {v["name"] for v in _pod_spec(doc)["volumes"]}
+        for c in _containers(doc):
+            for m in c.get("volumeMounts", []):
+                assert m["name"] in vols, (doc["metadata"]["name"], m)
+
+
+def test_multi_node_deployment_matches_reference_pattern():
+    [dep] = _load("multi-node-elbencho-tpu.yaml")
+    assert dep["kind"] == "Deployment"
+    assert dep["spec"]["replicas"] >= 2
+    # anti-affinity spreads services across nodes
+    aff = _pod_spec(dep)["affinity"]["podAntiAffinity"]
+    [pref] = aff["preferredDuringSchedulingIgnoredDuringExecution"]
+    assert pref["podAffinityTerm"]["topologyKey"] == \
+        "kubernetes.io/hostname"
+    [c] = _containers(dep)
+    assert "--service" in c["command"]
+    # the pod template carries the selector labels
+    assert dep["spec"]["selector"]["matchLabels"].items() <= \
+        dep["spec"]["template"]["metadata"]["labels"].items()
+
+
+def test_nfs_pv_pvc_bind():
+    docs = {d["kind"]: d for d in _load("nfs-pv-pvc.yaml")}
+    pv, pvc = docs["PersistentVolume"], docs["PersistentVolumeClaim"]
+    assert pvc["spec"]["volumeName"] == pv["metadata"]["name"]
+    assert pvc["spec"]["storageClassName"] == ""
+    assert pv["spec"]["accessModes"] == pvc["spec"]["accessModes"]
+    assert pv["spec"]["capacity"]["storage"] == \
+        pvc["spec"]["resources"]["requests"]["storage"]
+    assert pv["spec"]["nfs"]["server"] and pv["spec"]["nfs"]["path"]
+
+
+def test_service_port_matches_cli_default():
+    """The manifests hardcode the service port; it must stay in sync
+    with the CLI's --port default so a master with no explicit port
+    reaches the pods."""
+    from elbencho_tpu.config.args import BenchConfig
+    default_port = BenchConfig().service_port
+    docs = _load("tpu-pod-slice-elbencho-tpu.yaml")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    assert svc["spec"]["ports"][0]["port"] == default_port
+    [dep] = _load("multi-node-elbencho-tpu.yaml")
+    [c] = _containers(dep)
+    port_idx = c["command"].index("--port") + 1
+    assert int(c["command"][port_idx]) == default_port
