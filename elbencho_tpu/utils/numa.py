@@ -1,15 +1,133 @@
-"""NUMA zone binding (reference: source/toolkits/NumaTk.h via libnuma).
+"""NUMA zone binding (reference: source/toolkits/NumaTk.h:22-320 via
+libnuma — bindToNumaZones / setMemPolicy).
 
-Pure-Python equivalent: bind the calling thread's CPU affinity to the CPUs
-of the given NUMA node (from sysfs), which is what the reference's
-``--zones`` round-robin binding achieves for worker threads.
+Two halves, matching the reference's split:
+
+- CPU affinity: bind the calling thread to the CPUs of a NUMA node
+  (sysfs cpulist + sched_setaffinity) — the ``--zones`` round-robin
+  worker binding.
+- MEMORY policy: libnuma isn't a baked-in dependency, so the
+  set_mempolicy/mbind/get_mempolicy syscalls are invoked directly via
+  ctypes. ``bind_to_numa_zone`` applies MPOL_BIND for the thread (all
+  its future page faults allocate on the zone), and ``mbind_buffer``
+  pins an already-mmap'd I/O buffer to the zone (MPOL_MF_MOVE migrates
+  any pages that faulted elsewhere first) — the staging buffers a
+  worker DMAs through should live next to the core driving them.
 """
 
 from __future__ import annotations
 
+import ctypes
 import os
+import platform
 
 from ..toolkits import logger
+
+# mode constants (linux/mempolicy.h)
+MPOL_DEFAULT = 0
+MPOL_PREFERRED = 1
+MPOL_BIND = 2
+MPOL_INTERLEAVE = 3
+# mbind flags
+MPOL_MF_MOVE = 1 << 1
+# get_mempolicy flags
+MPOL_F_NODE = 1 << 0
+MPOL_F_ADDR = 1 << 1
+
+#: syscall numbers differ per arch (no libc wrappers outside libnuma)
+_SYSCALLS = {
+    "x86_64": {"mbind": 237, "set_mempolicy": 238, "get_mempolicy": 239},
+    "aarch64": {"mbind": 235, "set_mempolicy": 237, "get_mempolicy": 236},
+}
+
+_MAXNODE = 64  # one u64 nodemask covers every machine this targets
+
+
+def _syscall_table() -> "dict[str, int] | None":
+    return _SYSCALLS.get(platform.machine())
+
+
+def _libc():
+    return ctypes.CDLL(None, use_errno=True)
+
+
+def _nodemask(zone: int) -> ctypes.c_uint64:
+    return ctypes.c_uint64(1 << zone)
+
+
+def set_thread_mempolicy_bind(zone: int) -> bool:
+    """MPOL_BIND the calling thread's allocations to one node
+    (reference: NumaTk setMemPolicy / numa_set_membind)."""
+    table = _syscall_table()
+    if table is None:
+        return False
+    mask = _nodemask(zone)
+    res = _libc().syscall(table["set_mempolicy"], MPOL_BIND,
+                          ctypes.byref(mask), _MAXNODE)
+    if res != 0:
+        logger.log_error(
+            f"set_mempolicy(MPOL_BIND, node {zone}) failed: "
+            f"{os.strerror(ctypes.get_errno())}")
+        return False
+    return True
+
+
+def reset_thread_mempolicy() -> bool:
+    """Back to MPOL_DEFAULT (tests; and phase teardown symmetry)."""
+    table = _syscall_table()
+    if table is None:
+        return False
+    return _libc().syscall(table["set_mempolicy"], MPOL_DEFAULT,
+                           None, _MAXNODE) == 0
+
+
+def get_thread_mempolicy() -> "tuple[int, int] | None":
+    """(mode, nodemask) of the calling thread, or None when
+    unsupported — lets tests assert the policy actually landed."""
+    table = _syscall_table()
+    if table is None:
+        return None
+    mode = ctypes.c_int(0)
+    mask = ctypes.c_uint64(0)
+    res = _libc().syscall(table["get_mempolicy"], ctypes.byref(mode),
+                          ctypes.byref(mask), _MAXNODE, None, 0)
+    if res != 0:
+        return None
+    return mode.value, mask.value
+
+
+def mbind_buffer(addr: int, length: int, zone: int) -> bool:
+    """MPOL_BIND one mmap'd region to a node, migrating already-faulted
+    pages (reference: NumaTk.h mbind of the GPU staging buffers). addr
+    must be page-aligned — true for mmap allocations."""
+    table = _syscall_table()
+    if table is None:
+        return False
+    mask = _nodemask(zone)
+    res = _libc().syscall(table["mbind"], ctypes.c_void_p(addr),
+                          ctypes.c_ulong(length), MPOL_BIND,
+                          ctypes.byref(mask), _MAXNODE, MPOL_MF_MOVE)
+    if res != 0:
+        logger.log_error(
+            f"mbind(node {zone}, {length} bytes) failed: "
+            f"{os.strerror(ctypes.get_errno())}")
+        return False
+    return True
+
+
+def get_buffer_policy(addr: int) -> "tuple[int, int] | None":
+    """(mode, nodemask) governing an address (MPOL_F_ADDR), or None."""
+    table = _syscall_table()
+    if table is None:
+        return None
+    mode = ctypes.c_int(0)
+    mask = ctypes.c_uint64(0)
+    res = _libc().syscall(table["get_mempolicy"], ctypes.byref(mode),
+                          ctypes.byref(mask), _MAXNODE,
+                          ctypes.c_void_p(addr), MPOL_F_ADDR)
+    if res != 0:
+        return None
+    return mode.value, mask.value
 
 
 def _node_cpus(zone: int) -> "set[int]":
@@ -29,7 +147,10 @@ def _node_cpus(zone: int) -> "set[int]":
     return cpus
 
 
-def bind_to_numa_zone(zone: int) -> bool:
+def bind_to_numa_zone(zone: int, bind_memory: bool = True) -> bool:
+    """Bind the calling thread's CPU affinity AND (by default) its memory
+    policy to one NUMA zone — the reference binds both
+    (NumaTk.h:22-320: numa_run_on_node + set_mempolicy)."""
     cpus = _node_cpus(zone)
     if not cpus:
         logger.log_error(f"NUMA zone {zone} not found or empty; "
@@ -37,10 +158,14 @@ def bind_to_numa_zone(zone: int) -> bool:
         return False
     try:
         os.sched_setaffinity(0, cpus)
-        return True
     except OSError as err:
         logger.log_error(f"NUMA binding to zone {zone} failed: {err}")
         return False
+    if bind_memory:
+        # a failed memory bind degrades to CPU-only binding with the
+        # error logged (same behavior as the reference's soft fallback)
+        set_thread_mempolicy_bind(zone)
+    return True
 
 
 def numa_is_available() -> bool:

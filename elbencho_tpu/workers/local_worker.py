@@ -58,6 +58,7 @@ class LocalWorker(Worker):
         self._rate_limiter_read: "RateLimiter | None" = None
         self._rate_limiter_write: "RateLimiter | None" = None
         self._tpu = None           # TpuWorkerContext when --tpuids given
+        self._numa_zone = None     # set when --zones bound this worker
         self._ops_log = None
         self._num_iops_submitted = 0  # rwmix modulo counter
         self._prepared = False
@@ -98,7 +99,8 @@ class LocalWorker(Worker):
                 chip_id=chip, block_size=cfg.block_size,
                 direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify,
                 pipeline_depth=max(cfg.io_depth, 1),
-                hbm_limit_pct=cfg.tpu_hbm_limit_pct)
+                hbm_limit_pct=cfg.tpu_hbm_limit_pct,
+                batch_blocks=max(cfg.tpu_batch_blocks, 1))
             needs_fill = (cfg.run_create_files
                           or (cfg.run_tpu_bench
                               and cfg.tpu_bench_pattern in ("d2h", "both")))
@@ -195,7 +197,11 @@ class LocalWorker(Worker):
             from ..toolkits.units import parse_uint_list
             zones = parse_uint_list(cfg.numa_zones_str)
             if zones:
-                bind_to_numa_zone(zones[self.rank % len(zones)])
+                zone = zones[self.rank % len(zones)]
+                # binds CPU affinity AND thread memory policy; the zone
+                # is kept so _alloc_io_buffer can mbind the buffers too
+                if bind_to_numa_zone(zone):
+                    self._numa_zone = zone
 
     def _alloc_io_buffer(self) -> None:
         """Page-aligned I/O buffers via anonymous mmap, one per iodepth slot
@@ -207,6 +213,15 @@ class LocalWorker(Worker):
         fill = create_rand_algo("fast", seed=self.rank + 1)
         for _ in range(max(self.cfg.io_depth, 1)):
             m = mmap.mmap(-1, size)
+            if self._numa_zone is not None:
+                # pin the staging buffer's pages to the worker's zone
+                # (reference: NumaTk.h mbind of the staging buffers);
+                # MPOL_MF_MOVE migrates any page the mmap pre-fill
+                # below would otherwise fault on a foreign node
+                import ctypes
+                from ..utils.numa import mbind_buffer
+                addr = ctypes.addressof(ctypes.c_char.from_buffer(m))
+                mbind_buffer(addr, size, self._numa_zone)
             mv = memoryview(m)
             mv[:] = fill.fill_buffer(size)
             self._io_buf_mmaps.append(m)
