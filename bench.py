@@ -543,6 +543,34 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                 f"only {len(passes)}/{HBM_PASSES} HBM passes succeeded"
                 f"{' (deadline-truncated)' if truncated else ''}; "
                 f"errors: {' | '.join(e[-300:] for e in pass_errors)}")
+        # A/B rider: one extra pass with --tpubatch (transfer coalescing,
+        # the tunnel dispatch-amortization knob) so any tunnel-up window
+        # also yields the live batched-vs-unbatched comparison. Never at
+        # the expense of the primary median; failures are non-fatal.
+        tpubatch_ab = None
+        if passes and not truncated and \
+                _remaining_s() > DEADLINE_RESERVE_S + 150:
+            _STATE["stage"] = "tpubatch_ab"
+            try:
+                time.sleep(idle_s)
+                open(j3, "w").close()
+                ab = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
+                               "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
+                               "--tpubatch", IO_DEPTH, "--tpuids", "0",
+                               "--tpudirect", target], j3)
+                ab_rec = next(r for r in ab if r["Phase"] == "READ")
+                ab_mibs = ab_rec.get("TpuHbmMiBPerSec") or 0.0
+                best_plain = max(p[0] for p in passes)
+                tpubatch_ab = {
+                    "batch_blocks": int(IO_DEPTH),
+                    "mibs": round(ab_mibs, 1),
+                    "vs_best_unbatched": round(
+                        ab_mibs / max(best_plain, 1e-9), 3),
+                }
+            except (RuntimeError, subprocess.TimeoutExpired,
+                    StopIteration) as err:
+                tpubatch_ab = {"error": str(err)[-300:]}
+            _STATE["stage"] = "hbm_passes"
         passes.sort(key=lambda p: p[0])
         med_mibs, med_rec = passes[len(passes) // 2]
         # per-chip ingest over PHASE WALL TIME: per-worker transfer-busy
@@ -578,6 +606,10 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             "tpu_direct_fallbacks": med_rec.get("TpuH2dDirectFallbacks", 0),
             "utc": _utc_now(),
         }
+        if tpubatch_ab is not None:
+            # transfer-coalescing A/B (--tpubatch): labeled context, never
+            # the headline value
+            rec["tpubatch_ab"] = tpubatch_ab
         if truncated:
             rec["passes_truncated_by_deadline"] = True
         # emit FIRST: a SIGTERM landing between these two calls must lose

@@ -280,6 +280,11 @@ FLAG_DEFS = [
     ("tpudirect", None, "use_tpu_direct", "bool", False, "tpu",
      "Direct host->HBM DMA path, skipping the bounce buffer where possible "
      "(cuFile/GDS analogue on PjRt)"),
+    ("tpubatch", None, "tpu_batch_blocks", "int", 1, "tpu",
+     "Coalesce this many blocks into one host->HBM DMA (amortizes "
+     "per-transfer dispatch overhead, e.g. on tunneled chips; costs one "
+     "host-side copy per block and defers the DMA to every Nth block; "
+     "ignored with --tpuverify)"),
     ("tpuverify", None, "do_tpu_verify", "bool", False, "tpu",
      "Run integrity verification on-device (Pallas kernel) instead of host"),
     ("tpuprofile", None, "tpu_profile_dir", "str", "", "tpu",

@@ -104,7 +104,7 @@ class TpuWorkerContext:
 
     def __init__(self, chip_id: int, block_size: int, direct: bool = False,
                  verify_on_device: bool = False, pipeline_depth: int = 1,
-                 hbm_limit_pct: int = 90):
+                 hbm_limit_pct: int = 90, batch_blocks: int = 1):
         jax = _get_jax()
         devices = jax.devices()
         if not devices:
@@ -127,14 +127,56 @@ class TpuWorkerContext:
                 f"block size {block_size} exceeds the HBM staging budget "
                 f"of chip {chip_id} ({self.hbm_budget_bytes} bytes at "
                 f"--tpuhbmpct {hbm_limit_pct} fits fewer than 3 blocks)")
+        # --tpubatch: coalesce N blocks into one DMA, amortizing the
+        # per-transfer dispatch overhead (the dominant cost on tunneled
+        # chips: ~71 ms/op measured round 2 vs ~5 ms for the extra
+        # host-side copy a 16M block costs). Disabled under on-device
+        # verify, which needs per-block arrays.
+        self.batch_blocks = max(batch_blocks, 1)
+        if verify_on_device and self.batch_blocks > 1:
+            from ..toolkits.logger import LOG_NORMAL, log
+            log(LOG_NORMAL, "NOTE: --tpubatch is ignored with "
+                            "--tpuverify (per-block on-device checks)")
+            self.batch_blocks = 1
         self._pool_blocks = min(self._FILL_POOL_BLOCKS,
                                 max(budget_blocks - 2, 1))
+        # a single aggregated span must itself fit the budget (alongside
+        # the sink block and the D2H ring's share): clamp batch_blocks
+        # BEFORE it sizes the ring math below, or one --tpubatch DMA
+        # could exceed --tpuhbmpct outright
+        spare_blocks = max(budget_blocks - self._pool_blocks - 1, 2)
+        if self.batch_blocks > spare_blocks // 2:
+            clamped = max(spare_blocks // 2, 1)
+            from ..toolkits.logger import LOG_NORMAL, log
+            log(LOG_NORMAL,
+                f"NOTE: --tpubatch {self.batch_blocks} exceeds the HBM "
+                f"staging budget; clamped to {clamped}")
+            self.batch_blocks = clamped
         # both rings can be live on ONE context in the same phase (rwmix
         # interleaves reads -> H2D in-flight ring with writes -> D2H
         # speculative ring), so the depth clamp budgets for two rings of
-        # pipeline_depth blocks each, not one
-        max_depth = max((budget_blocks - self._pool_blocks - 1) // 2, 1)
+        # pipeline_depth slots each — and with batching every H2D slot
+        # holds batch_blocks blocks of HBM
+        max_depth = max((budget_blocks - self._pool_blocks - 1)
+                        // (2 * self.batch_blocks), 1)
         self.pipeline_depth = min(self.pipeline_depth, max_depth)
+        self._h2d_agg = None
+        self._h2d_agg_fill = 0  # words staged in the active agg buffer
+        if self.batch_blocks > 1:
+            import mmap as _mmap
+            # page-aligned host aggregation buffers (64B-aligned for the
+            # dlpack export of the --tpudirect path). One buffer per
+            # ring slot: a buffer stays aliased by its in-flight direct
+            # import until the ring drains it, so the next batch must
+            # stage into a different buffer (same rotation discipline
+            # as the worker's iodepth I/O buffers).
+            self._h2d_agg_mmaps = [
+                _mmap.mmap(-1, self.batch_blocks * max(block_size, 4))
+                for _ in range(max(self.pipeline_depth, 1))]
+            self._h2d_agg_ring = [np.frombuffer(m, dtype=np.uint32)
+                                  for m in self._h2d_agg_mmaps]
+            self._h2d_agg_idx = 0
+            self._h2d_agg = self._h2d_agg_ring[0]
         self._key = jax.random.PRNGKey(chip_id)
         self._num_words = max(block_size // 4, 1)
         # write-source pool: filled ONCE on first use, like the reference's
@@ -205,9 +247,31 @@ class TpuWorkerContext:
           rewritten before its transfer completed (CuFileHandleData
           register-once discipline, reference CuFileHandleData.h:18-73).
         """
-        jax = _get_jax()
         n_words = length // 4
         np_view = np.frombuffer(buf[:n_words * 4], dtype=np.uint32)
+        if self.batch_blocks > 1:
+            # --tpubatch: stage into the aggregation buffer; the DMA
+            # fires once per batch_blocks blocks (or at flush), so the
+            # per-transfer dispatch cost is paid once per batch. The
+            # copy releases the caller's I/O buffer immediately, which
+            # also means the dlpack stability contract moves to the
+            # aggregation buffer (drained before reuse via the ring).
+            start = self._h2d_agg_fill
+            self._h2d_agg[start:start + n_words] = np_view
+            self._h2d_agg_fill = start + n_words
+            if self._h2d_agg_fill + self._num_words > len(self._h2d_agg):
+                self._flush_h2d_batch()
+            return
+        self._transfer_h2d(np_view)
+        if verify_salt and self.verify_on_device:
+            from ..ops.verify import verify_block_on_device
+            verify_block_on_device(self._last_ingested, file_offset,
+                                   length, verify_salt)
+
+    def _transfer_h2d(self, np_view: np.ndarray) -> None:
+        """One DMA into the in-flight ring (a block, or a --tpubatch
+        aggregation span), with the drain-to-depth discipline."""
+        jax = _get_jax()
         if self.direct and self._h2d_direct_ok:
             arr = self._direct_import(np_view)
         else:
@@ -220,9 +284,17 @@ class TpuWorkerContext:
         while len(self._inflight) >= self.pipeline_depth:
             self._inflight.popleft().block_until_ready()
         self._last_ingested = arr  # keep resident (benchmark sink)
-        if verify_salt and self.verify_on_device:
-            from ..ops.verify import verify_block_on_device
-            verify_block_on_device(arr, file_offset, length, verify_salt)
+
+    def _flush_h2d_batch(self) -> None:
+        if self._h2d_agg_fill:
+            self._transfer_h2d(self._h2d_agg[:self._h2d_agg_fill])
+            # rotate to the next aggregation buffer: the one just
+            # transferred may stay aliased by a direct import until the
+            # ring drains it (by then the rotation has cycled past it)
+            self._h2d_agg_idx = (self._h2d_agg_idx + 1) \
+                % len(self._h2d_agg_ring)
+            self._h2d_agg = self._h2d_agg_ring[self._h2d_agg_idx]
+            self._h2d_agg_fill = 0
 
     def _direct_import(self, np_view: np.ndarray):
         """Zero-bounce dlpack import of the I/O buffer (--tpudirect).
@@ -272,7 +344,10 @@ class TpuWorkerContext:
         self._d2h_spec_miss_streak = 0
 
     def flush(self) -> None:
-        """Drain all pipelined transfers (phase-end completion wait)."""
+        """Drain all pipelined transfers (phase-end completion wait),
+        including a partially-filled --tpubatch aggregation span."""
+        if self._h2d_agg_fill:
+            self._flush_h2d_batch()
         while self._inflight:
             self._inflight.popleft().block_until_ready()
 
@@ -417,6 +492,9 @@ class TpuWorkerContext:
         self._last_ingested = None
         self._fill_pool = []
         self._d2h_spec = {}
+        if self._h2d_agg is not None:
+            self._h2d_agg = None
+            self._h2d_agg_ring = []
 
 
 def _d2h_async(arr) -> None:

@@ -26,7 +26,7 @@ def _tool(name, args):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run(
         [sys.executable, os.path.join(TOOLS, name)] + args,
-        capture_output=True, text=True, env=env, timeout=60)
+        capture_output=True, text=True, env=env, timeout=180)
 
 
 def test_treescan_roundtrip(tmp_path):
@@ -87,6 +87,146 @@ def test_chart_tool(tmp_path):
     res = _tool("elbencho-tpu-chart", [str(csvfile)])
     assert res.returncode == 0, res.stderr
     assert "#" in res.stdout  # bars rendered
+
+
+def _sweep_csv(tmp_path):
+    """Two-point block-size sweep CSV for the chart tests."""
+    csvfile = tmp_path / "res.csv"
+    target = tmp_path / "f"
+    for block in ("4K", "8K"):
+        assert main(["-w", "-r", "-t", "1", "-s", "16K", "-b", block,
+                     "--csvfile", str(csvfile), "--nolive",
+                     str(target)]) == 0
+    return csvfile
+
+
+def test_chart_tool_listings_and_series(tmp_path):
+    """-c/-o listings and explicit -x/-y/-Y series selection
+    (reference surface: tools/elbencho-chart:42-58)."""
+    csvfile = _sweep_csv(tmp_path)
+    res = _tool("elbencho-tpu-chart", ["-c", str(csvfile)])
+    assert res.returncode == 0
+    assert "MiBPerSecLast" in res.stdout and "block_size" in res.stdout
+    res = _tool("elbencho-tpu-chart", ["-o", str(csvfile)])
+    assert res.returncode == 0
+    assert res.stdout.split() == ["WRITE", "READ"]
+    res = _tool("elbencho-tpu-chart",
+                ["-x", "block_size", "-y", "MiBPerSecLast:READ",
+                 str(csvfile)])
+    assert res.returncode == 0
+    assert "MiBPerSecLast [READ]" in res.stdout
+    # unknown column / op are clean errors
+    res = _tool("elbencho-tpu-chart", ["-y", "NoSuchCol", str(csvfile)])
+    assert res.returncode != 0 and "not in csv" in res.stderr
+    res = _tool("elbencho-tpu-chart",
+                ["-y", "MiBPerSecLast:NOSUCHOP", str(csvfile)])
+    assert res.returncode != 0 and "not in csv" in res.stderr
+
+
+def test_chart_tool_dual_axis_line_png(tmp_path):
+    """A sweep CSV charts as a dual-axis line image: MiB/s on the left
+    axis, IOPS on the right (round-4 verdict item 8)."""
+    csvfile = _sweep_csv(tmp_path)
+    png = tmp_path / "chart.png"
+    res = _tool("elbencho-tpu-chart",
+                ["-x", "block_size", "-y", "MiBPerSecLast:READ",
+                 "-Y", "IOPSLast:READ", "--imgfile", str(png),
+                 "--title", "t", str(csvfile)])
+    assert res.returncode == 0, res.stderr
+    assert png.exists() and png.stat().st_size > 1000
+    assert png.read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_chart_tool_auto_selection(tmp_path):
+    """No -x/-y: one MiBPerSecLast series per op, x = the varying config
+    column (the sweep variable)."""
+    csvfile = _sweep_csv(tmp_path)
+    res = _tool("elbencho-tpu-chart", [str(csvfile)])
+    assert res.returncode == 0, res.stderr
+    assert "MiBPerSecLast [WRITE]" in res.stdout
+    assert "MiBPerSecLast [READ]" in res.stdout
+    assert "block_size" in res.stdout  # auto-picked sweep variable
+
+
+def test_dgen_and_sweep_with_baseline(tmp_path):
+    """dgen generates the named datasets; the sweep consumes them with
+    --use-existing; --write-baseline/--baseline implement the committed
+    regression flow (reference: contrib/storage_sweep/)."""
+    root = tmp_path / "root"
+    root.mkdir()
+    # dry run prints commands, writes nothing
+    res = _tool("elbencho-tpu-dgen",
+                ["-r", "losf", "-n", "--dataset-size", "64K", str(root)])
+    assert res.returncode == 0
+    assert "sweep_1K" in res.stdout and not list(root.iterdir())
+    # generate one dataset, then a single-point read-only sweep over it
+    res = _tool("elbencho-tpu-dgen",
+                ["-f", "1K", "--dataset-size", "16K", "-t", "1",
+                 str(root)])
+    assert res.returncode == 0, res.stderr
+    assert (root / "sweep_1K" / "r0").is_dir()
+    # missing datasets in --use-existing mode are a clean actionable error
+    res = _tool("elbencho-tpu-sweep",
+                [str(root), "--range", "losf", "--use-existing",
+                 "--dataset-size", "16K", "-t", "1",
+                 "--csv", str(tmp_path / "partial.csv")])
+    assert res.returncode == 2
+    assert "elbencho-tpu-dgen -f 2K" in res.stderr
+    # full write+read sweep (tiny range via dataset-size) + baseline
+    work = tmp_path / "work"
+    work.mkdir()
+    csvfile = tmp_path / "sweep.csv"
+    base = tmp_path / "base.json"
+    args = [str(work), "--range", "losf", "--dataset-size", "4K",
+            "-t", "1", "--csv", str(csvfile)]
+    res = _tool("elbencho-tpu-sweep",
+                args + ["--write-baseline", str(base)])
+    assert res.returncode == 0, res.stderr
+    rec = json.loads(base.read_text())
+    assert len(rec["points"]) == 11  # 1K..1M
+    assert all("read_mibs" in p and "write_mibs" in p
+               for p in rec["points"].values())
+    # same run regresses clean against its own baseline (tolerance
+    # widened: these 4K points are sub-ms and wildly noisy — the
+    # inflated-baseline leg below proves detection)
+    csv2 = tmp_path / "sweep2.csv"
+    res = _tool("elbencho-tpu-sweep",
+                [str(work), "--range", "losf", "--dataset-size", "4K",
+                 "-t", "1", "--csv", str(csv2), "--tolerance", "99",
+                 "--baseline", str(base)])
+    assert res.returncode == 0, res.stderr
+    assert "no regressions" in res.stdout
+    # ...and an inflated baseline is caught
+    for p in rec["points"].values():
+        p["read_mibs"] *= 1000
+    base.write_text(json.dumps(rec))
+    csv3 = tmp_path / "sweep3.csv"
+    res = _tool("elbencho-tpu-sweep",
+                [str(work), "--range", "losf", "--dataset-size", "4K",
+                 "-t", "1", "--csv", str(csv3),
+                 "--baseline", str(base)])
+    assert res.returncode == 3
+    assert "REGRESSED" in res.stdout
+
+
+def test_committed_losf_baseline_is_valid():
+    """The committed baseline artifact (docs/sweeps/) parses and has the
+    full losf range with nonzero read throughput per point."""
+    path = os.path.join(REPO, "docs", "sweeps",
+                        "losf_vm_2026-07-29.json")
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["range"] == "losf" and len(rec["points"]) == 11
+    assert all(p["read_mibs"] > 0 for p in rec["points"].values())
+
+
+def test_fuzz_sweep_quick_posix(tmp_path):
+    """The checked-in fuzz harness (make check gate): a seeded quick
+    posix sweep runs clean — no uncaught tracebacks."""
+    res = _tool("fuzz-sweep", ["--suite", "posix", "--combos", "5",
+                               "--seed", "7"])
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "clean" in res.stdout
 
 
 def test_flock_modes(tmp_path):
