@@ -43,7 +43,7 @@ chmod 755 "$PKGROOT/usr/bin/elbencho-tpu"
 
 for tool in elbencho-tpu-chart elbencho-tpu-summarize-json \
         elbencho-tpu-scan-path elbencho-tpu-sweep elbencho-tpu-dgen \
-        elbencho-tpu-cleanup-mpu; do
+        elbencho-tpu-blockdev-rand elbencho-tpu-cleanup-mpu; do
     # the tools' repo-relative sys.path bootstrap resolves to /usr when
     # installed — harmless, dist-packages provides the real package
     cp "$REPO/tools/$tool" "$PKGROOT/usr/bin/$tool"

@@ -220,6 +220,21 @@ def test_committed_losf_baseline_is_valid():
     assert all(p["read_mibs"] > 0 for p in rec["points"].values())
 
 
+def test_blockdev_rand_wrapper_arg_validation():
+    """Usage/arg errors are clean (reference: tools/blockdev-rand.sh).
+    The happy path needs a real block device: tools/test-examples runs
+    the wrapper twice against a loop device (rwmix + pure read) in its
+    loopdev section when /dev access exists."""
+    res = _tool("elbencho-tpu-blockdev-rand", [])
+    assert res.returncode == 2 and "Usage" in res.stderr
+    res = _tool("elbencho-tpu-blockdev-rand",
+                ["nosuchdev", "4", "1", "100", "4K", "2"])
+    assert res.returncode == 2 and "device not found" in res.stderr
+    res = _tool("elbencho-tpu-blockdev-rand",
+                ["loop0", "4", "1", "142", "4K", "2"])
+    assert res.returncode == 2 and "READPERCENT" in res.stderr
+
+
 def test_fuzz_sweep_quick_posix(tmp_path):
     """The checked-in fuzz harness (make check gate): a seeded quick
     posix sweep runs clean — no uncaught tracebacks."""
