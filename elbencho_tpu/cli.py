@@ -66,13 +66,18 @@ def _print_dry_run(cfg) -> None:
 
 def _run_tree_scan(cfg) -> int:
     """--treescan DIR --treefile OUT: build a treefile from a real tree
-    (reference: --treescan + tools/elbencho-scan-path)."""
+    (reference: --treescan + tools/elbencho-scan-path). An s3:// or
+    gs:// scan path lists the BUCKET into the treefile instead
+    (reference: ProgArgs::scanCustomTree S3 branch, ProgArgs.cpp:2799 +
+    S3Tk::scanCustomTree)."""
     import os
     from .toolkits.file_tk import scan_tree, write_treefile
     if not cfg.tree_file_path:
         print("ERROR: --treescan requires --treefile OUT for the result",
               file=sys.stderr)
         return 1
+    if cfg.tree_scan_path.startswith(("s3://", "gs://")):
+        return _run_bucket_tree_scan(cfg)
     if not os.path.isdir(cfg.tree_scan_path):
         print(f"ERROR: --treescan path is not a directory: "
               f"{cfg.tree_scan_path}", file=sys.stderr)
@@ -83,6 +88,72 @@ def _run_tree_scan(cfg) -> int:
     print(f"Scanned {cfg.tree_scan_path}: {dirs.num_paths} dirs, "
           f"{files.num_paths} files, {format_bytes(total)}B total -> "
           f"{cfg.tree_file_path}")
+    return 0
+
+
+def _run_bucket_tree_scan(cfg) -> int:
+    """--treescan s3://bucket[/prefix] (or gs://): paginated object
+    listing written as treefile "f <size> <name>" lines, so an existing
+    bucket becomes a custom-tree workload (reference:
+    S3Tk::scanCustomTree, S3Tk.cpp:330-430)."""
+    from .toolkits.path_store import PathStore, PathStoreElem
+    from .toolkits.file_tk import write_treefile
+    from .toolkits.s3_tk import S3Error, make_client_for_rank
+
+    scheme, _, rest = cfg.tree_scan_path.partition("://")
+    bucket, _, prefix = rest.partition("/")
+    if not bucket:
+        print(f"ERROR: --treescan {scheme}:// path needs a bucket",
+              file=sys.stderr)
+        return 1
+    # the scan path is not a bench path, so it never participated in
+    # config derivation's backend selection: the scheme picks the
+    # client here, and a conflicting pre-derived backend (e.g. gs://
+    # scan with --s3endpoints) is the same ambiguity bench paths
+    # reject explicitly
+    want_backend = "gcs" if scheme == "gs" else "s3"
+    have_backend = cfg.object_backend or ""
+    if have_backend and have_backend != want_backend:
+        print(f"ERROR: --treescan {scheme}:// conflicts with the "
+              f"{have_backend!r} object backend configured by the other "
+              f"flags; pick one explicitly with --objectbackend",
+              file=sys.stderr)
+        return 1
+    if want_backend == "gcs":
+        cfg.object_backend = "gcs"
+    try:
+        client = make_client_for_rank(cfg, 0)
+    except ValueError as err:  # e.g. no --s3endpoints configured
+        print(f"ERROR: {err}", file=sys.stderr)
+        return 1
+    files = PathStore()
+    # keys go into the store directly — formatting them through treefile
+    # text lines would corrupt names with newlines/leading whitespace
+    # before the base64 decision is even made
+    needs_b64 = False
+    token = ""
+    try:
+        while True:
+            entries, token = client.list_objects_entries(
+                bucket, prefix=prefix, continuation_token=token)
+            for key, size in entries:
+                files.elems.append(PathStoreElem(
+                    key, total_len=size, range_start=0, range_len=size))
+                if key != key.strip() or "\n" in key or "\r" in key:
+                    needs_b64 = True
+            if not token:
+                break
+    except S3Error as err:
+        print(f"ERROR: bucket treescan failed: {err}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    write_treefile(cfg.tree_file_path, PathStore(), files,
+                   use_base64=needs_b64)
+    total = sum(e.total_len for e in files.elems)
+    print(f"Scanned {scheme}://{bucket}"
+          f"{'/' + prefix if prefix else ''}: {files.num_paths} objects, "
+          f"{format_bytes(total)}B total -> {cfg.tree_file_path}")
     return 0
 
 

@@ -388,6 +388,16 @@ class GcsClient:
                      max_keys: int = 1000,
                      continuation_token: str = ""
                      ) -> "tuple[list[str], str]":
+        entries, next_token = self.list_objects_entries(
+            bucket, prefix, max_keys, continuation_token)
+        return [k for k, _size in entries], next_token
+
+    def list_objects_entries(self, bucket: str, prefix: str = "",
+                             max_keys: int = 1000,
+                             continuation_token: str = ""
+                             ) -> "tuple[list[tuple[str, int]], str]":
+        """Sized listing page for the bucket treescan (same surface as
+        S3Client.list_objects_entries)."""
         query = {"maxResults": str(max_keys)}
         if prefix:
             query["prefix"] = prefix
@@ -397,8 +407,9 @@ class GcsClient:
             "GET", self._bucket_path(bucket) + "/o", query=query)
         self._check(status, data, ok=(200,))
         doc = json.loads(data)
-        keys = [item["name"] for item in doc.get("items", [])]
-        return keys, doc.get("nextPageToken", "")
+        entries = [(item["name"], int(item.get("size", 0)))
+                   for item in doc.get("items", [])]
+        return entries, doc.get("nextPageToken", "")
 
     # -- multipart analogue: component objects + compose ---------------------
 

@@ -508,6 +508,17 @@ class S3Client:
                      continuation_token: str = ""
                      ) -> "tuple[list[str], str]":
         """ListObjectsV2 page -> (keys, next_continuation_token)."""
+        entries, next_token = self.list_objects_entries(
+            bucket, prefix, max_keys, continuation_token)
+        return [k for k, _size in entries], next_token
+
+    def list_objects_entries(self, bucket: str, prefix: str = "",
+                             max_keys: int = 1000,
+                             continuation_token: str = ""
+                             ) -> "tuple[list[tuple[str, int]], str]":
+        """ListObjectsV2 page -> ([(key, size)], next_continuation_token).
+        The sized variant feeds the bucket treescan's "f <size> <name>"
+        treefile lines (reference: S3Tk::scanCustomTree, S3Tk.cpp:330+)."""
         query = {"list-type": "2", "max-keys": str(max_keys)}
         if prefix:
             query["prefix"] = prefix
@@ -517,10 +528,14 @@ class S3Client:
         self._check(status, data, ok=(200,))
         root = ET.fromstring(data)
         ns = _xml_ns(root)
-        keys = [el.findtext(f"{ns}Key") for el in root.findall(
-            f"{ns}Contents")]
+        entries = []
+        for el in root.findall(f"{ns}Contents"):
+            key = el.findtext(f"{ns}Key")
+            if key:
+                entries.append(
+                    (key, int(el.findtext(f"{ns}Size", default="0") or 0)))
         next_token = root.findtext(f"{ns}NextContinuationToken", default="")
-        return [k for k in keys if k], next_token
+        return entries, next_token
 
     # -- multipart ------------------------------------------------------------
 
