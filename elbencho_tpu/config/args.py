@@ -326,7 +326,8 @@ FLAG_DEFS = [
     ("sharesize", None, "file_share_size", "size", 0, "multi",
      "Custom tree: files >= this size are shared between workers"),
     ("treescan", None, "tree_scan_path", "str", "", "multi",
-     "Scan this directory tree and write a treefile (with --treefile OUT)"),
+     "Scan this directory tree — or an s3://bucket[/prefix] / gs:// "
+     "bucket — and write a treefile (with --treefile OUT)"),
     ("statinline", None, "do_stat_inline", "bool", False, "misc",
      "Stat each file inline during write/read phases"),
 
