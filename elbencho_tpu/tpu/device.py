@@ -342,6 +342,10 @@ class TpuWorkerContext:
             setattr(self, attr, 0)
         self._d2h_spec.clear()
         self._d2h_spec_miss_streak = 0
+        # a phase that ended without reaching flush() (worker error /
+        # interrupt) must not leak its staged-but-untransferred batch
+        # blocks into the next phase's first span
+        self._h2d_agg_fill = 0
 
     def flush(self) -> None:
         """Drain all pipelined transfers (phase-end completion wait),
