@@ -181,6 +181,7 @@ _STATE = {
     "effective_window_s": None,
     "tmpdir": None,
     "active_proc": None,
+    "pending_success": None,
     "emitted": False,
 }
 
@@ -248,7 +249,21 @@ def _emit_record(rec: dict) -> None:
 def _emit_failure(stage: str, err) -> int:
     """The never-null artifact: one machine-readable JSON line recording
     why no MiB/s figure exists, with timestamps so the failure is
-    auditable. rc stays 0 so an rc-gating driver still parses stdout."""
+    auditable. rc stays 0 so an rc-gating driver still parses stdout.
+
+    If a COMPLETED measurement is stashed (the failure landed during
+    the optional A/B rider or later), that record is emitted as the
+    success it is — annotated, never discarded. This is the single
+    choke point, so the guarantee holds for signals and uncaught
+    exceptions alike."""
+    pending = _STATE["pending_success"]
+    if pending is not None:
+        pending["late_failure"] = (
+            f"at stage {stage}: {str(err)[-300:]} "
+            f"(measurement itself was complete)")
+        _emit_record(pending)
+        _store_last_success(pending)
+        return 0
     platform = _STATE["platform"]
     metric = METRIC_NAME
     if platform is not None and platform not in TPU_PLATFORMS:
@@ -291,7 +306,9 @@ def _emit_failure(stage: str, err) -> int:
 def _signal_handler(signum, frame):  # noqa: ARG001
     """The driver is killing us: emit the artifact RIGHT NOW. Round 3
     died with the JSON line unprinted because emission waited for the
-    probe window to close."""
+    probe window to close. A COMPLETED measurement whose record was
+    assembled but not yet printed (a kill during the optional A/B
+    rider) is emitted as the success it is, not as a failure."""
     _emit_failure(
         _STATE["stage"],
         f"killed by signal {signal.Signals(signum).name} after "
@@ -543,34 +560,6 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                 f"only {len(passes)}/{HBM_PASSES} HBM passes succeeded"
                 f"{' (deadline-truncated)' if truncated else ''}; "
                 f"errors: {' | '.join(e[-300:] for e in pass_errors)}")
-        # A/B rider: one extra pass with --tpubatch (transfer coalescing,
-        # the tunnel dispatch-amortization knob) so any tunnel-up window
-        # also yields the live batched-vs-unbatched comparison. Never at
-        # the expense of the primary median; failures are non-fatal.
-        tpubatch_ab = None
-        if passes and not truncated and \
-                _remaining_s() > DEADLINE_RESERVE_S + 150:
-            _STATE["stage"] = "tpubatch_ab"
-            try:
-                time.sleep(idle_s)
-                open(j3, "w").close()
-                ab = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
-                               "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
-                               "--tpubatch", IO_DEPTH, "--tpuids", "0",
-                               "--tpudirect", target], j3)
-                ab_rec = next(r for r in ab if r["Phase"] == "READ")
-                ab_mibs = ab_rec.get("TpuHbmMiBPerSec") or 0.0
-                best_plain = max(p[0] for p in passes)
-                tpubatch_ab = {
-                    "batch_blocks": int(IO_DEPTH),
-                    "mibs": round(ab_mibs, 1),
-                    "vs_best_unbatched": round(
-                        ab_mibs / max(best_plain, 1e-9), 3),
-                }
-            except (RuntimeError, subprocess.TimeoutExpired,
-                    StopIteration) as err:
-                tpubatch_ab = {"error": str(err)[-300:]}
-            _STATE["stage"] = "hbm_passes"
         passes.sort(key=lambda p: p[0])
         med_mibs, med_rec = passes[len(passes) // 2]
         # per-chip ingest over PHASE WALL TIME: per-worker transfer-busy
@@ -606,18 +595,50 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             "tpu_direct_fallbacks": med_rec.get("TpuH2dDirectFallbacks", 0),
             "utc": _utc_now(),
         }
-        if tpubatch_ab is not None:
-            # transfer-coalescing A/B (--tpubatch): labeled context, never
-            # the headline value
-            rec["tpubatch_ab"] = tpubatch_ab
         if truncated:
             rec["passes_truncated_by_deadline"] = True
+        # the measurement is COMPLETE here: stash it so a driver kill
+        # during the optional A/B rider below makes the signal handler
+        # emit THIS record instead of a value-null failure — the rider
+        # is bonus context, never worth discarding the measurement for
+        _STATE["pending_success"] = rec
+
+        # A/B rider: one extra pass with --tpubatch (transfer coalescing,
+        # the tunnel dispatch-amortization knob) so any tunnel-up window
+        # also yields the live batched-vs-unbatched comparison. Never at
+        # the expense of the primary median; failures are non-fatal.
+        if not truncated and _remaining_s() > DEADLINE_RESERVE_S + 150:
+            _STATE["stage"] = "tpubatch_ab"
+            try:
+                time.sleep(idle_s)
+                open(j3, "w").close()
+                ab = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
+                               "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
+                               "--tpubatch", IO_DEPTH, "--tpuids", "0",
+                               "--tpudirect", target], j3)
+                ab_rec = next(r for r in ab if r["Phase"] == "READ")
+                ab_mibs = ab_rec.get("TpuHbmMiBPerSec") or 0.0
+                best_plain = max(p[0] for p in passes)
+                # labeled A/B context, never the headline value
+                rec["tpubatch_ab"] = {
+                    "batch_blocks": int(IO_DEPTH),
+                    "mibs": round(ab_mibs, 1),
+                    "vs_best_unbatched": round(
+                        ab_mibs / max(best_plain, 1e-9), 3),
+                }
+            except (RuntimeError, subprocess.TimeoutExpired,
+                    StopIteration) as err:
+                rec["tpubatch_ab"] = {"error": str(err)[-300:]}
+
         # emit FIRST: a SIGTERM landing between these two calls must lose
         # at worst the cache update, never the measured record (a handler
         # firing after the cache write would otherwise replay this run's
         # own result labeled "NOT measured in this run")
         _emit_record(rec)
         _store_last_success(rec)
+        # emitted and cached: a late signal must not re-annotate the
+        # record or rewrite the cache with a phantom mid-run kill
+        _STATE["pending_success"] = None
         return 0
     finally:
         for p in (target, j1, j2, j3, warm):

@@ -171,3 +171,53 @@ def test_selftest_pipeline_emits_success_line():
     assert len(rec["inter_pass_idle_s"]) == rec["median_of"]
     assert rec["probe_attempts"] >= 1
     assert rec["io_lat_usec_p99"] >= rec["io_lat_usec_p50"]
+
+
+def test_sigterm_during_ab_rider_emits_completed_measurement(
+        monkeypatch, tmp_path, capsys):
+    """A driver kill during the optional --tpubatch A/B rider must emit
+    the COMPLETED measurement (stashed in _STATE before the rider), not
+    a value-null failure record."""
+    import signal as _signal
+
+    import bench
+
+    monkeypatch.setattr(bench, "LAST_SUCCESS_PATH",
+                        str(tmp_path / "cache.json"))
+    rec = {"metric": "HARNESS SELF-TEST on cpu, NOT TPU: x",
+           "value": 123.4, "unit": "MiB/s", "vs_baseline": 0.5}
+    monkeypatch.setitem(bench._STATE, "pending_success", dict(rec))
+    monkeypatch.setitem(bench._STATE, "stage", "tpubatch_ab")
+    monkeypatch.setitem(bench._STATE, "emitted", False)
+    monkeypatch.setitem(bench._STATE, "tmpdir", None)
+    monkeypatch.setitem(bench._STATE, "active_proc", None)
+    monkeypatch.setattr(
+        bench.os, "_exit",
+        lambda code: (_ for _ in ()).throw(SystemExit(code)))
+    with pytest.raises(SystemExit) as exc:
+        bench._signal_handler(int(_signal.SIGTERM), None)
+    assert exc.value.code == 0
+    out = _last_json_line(capsys.readouterr().out)
+    assert out["value"] == 123.4  # the measurement, not a failure
+    assert "tpubatch_ab" in out["late_failure"]
+    assert "measurement itself was complete" in out["late_failure"]
+
+
+def test_rider_exception_also_emits_completed_measurement(
+        monkeypatch, tmp_path, capsys):
+    """Uncaught exceptions after the measurement completed take the same
+    single choke point: _emit_failure must surface the stashed success,
+    not a value-null failure record."""
+    import bench
+
+    monkeypatch.setattr(bench, "LAST_SUCCESS_PATH",
+                        str(tmp_path / "cache.json"))
+    rec = {"metric": "HARNESS SELF-TEST on cpu, NOT TPU: x",
+           "value": 77.0, "unit": "MiB/s", "vs_baseline": 0.4}
+    monkeypatch.setitem(bench._STATE, "pending_success", dict(rec))
+    monkeypatch.setitem(bench._STATE, "emitted", False)
+    rc = bench._emit_failure("tpubatch_ab", KeyError("Phase"))
+    assert rc == 0
+    out = _last_json_line(capsys.readouterr().out)
+    assert out["value"] == 77.0
+    assert "at stage tpubatch_ab" in out["late_failure"]
