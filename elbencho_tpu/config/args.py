@@ -1252,8 +1252,13 @@ REF_FLAG_ALIASES = {
 
 def build_arg_parser():
     import argparse
+    # allow_abbrev=False: with 180+ flags, silent prefix matching is a
+    # data-semantics hazard — e.g. "--s3nompu" would resolve to
+    # --s3nompucompl (deliberately-unfinalized MPUs) while reading like
+    # "single PUT, no multipart" (--s3single). The reference's
+    # boost::program_options CLI matches flags exactly too.
     parser = argparse.ArgumentParser(
-        prog="elbencho-tpu", add_help=False,
+        prog="elbencho-tpu", add_help=False, allow_abbrev=False,
         description="TPU-native distributed storage benchmark "
                     "(files, block devices, object storage; HBM data path)")
     parser.add_argument("paths", nargs="*", help="Benchmark paths "
