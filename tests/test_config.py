@@ -176,6 +176,23 @@ def test_flags_parity_accounted():
     assert res.returncode == 0, res.stderr
 
 
+def test_shipped_example_config_file(tmp_path):
+    """Our own docs/example_configuration/random-write.conf must parse
+    and derive to the workload its header documents."""
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfgfile = os.path.join(repo, "docs", "example_configuration",
+                           "random-write.conf")
+    cfg, _ = parse_cli(["-c", cfgfile, str(tmp_path / "bench")])
+    assert cfg.run_create_files and cfg.run_create_dirs
+    assert cfg.run_delete_files and cfg.run_delete_dirs
+    assert cfg.use_random_offsets
+    assert cfg.num_threads == 2 and cfg.io_depth == 4
+    assert cfg.block_size == 1 << 20 and cfg.file_size == 1 << 30
+    assert cfg.num_dirs == 1 and cfg.num_files == 10
+    assert cfg.use_direct_io and cfg.time_limit_secs == 10
+
+
 def test_reference_example_config_file_verbatim(tmp_path):
     """The reference ships docs/example_configuration/random-write.elbencho
     (flag=value ini style, '# ' comments, 1/0 bools) — our --configfile
