@@ -343,8 +343,11 @@ FLAG_DEFS = [
      "Prefix for object names in bucket"),
     ("s3randobj", None, "s3_rand_obj_select", "bool", False, "s3",
      "Read at random offsets of random objects"),
-    ("s3single", None, "s3_no_mpu", "bool", False, "s3",
-     "Single-part upload even for large objects"),
+    ("s3nompu", None, "s3_no_mpu", "bool", False, "s3",
+     "Single-part upload even for large objects (no multipart)"),
+    ("s3single", None, "use_s3_client_singleton", "bool", False, "s3",
+     "Share one S3/GCS client object among all workers of this process "
+     "(reference: S3 client singleton; per-worker clients otherwise)"),
     ("s3listobj", None, "run_list_objects_num", "int", 0, "s3",
      "Run bucket listing phase for this many objects"),
     ("s3listobjpar", None, "run_list_objects_parallel", "bool", False, "s3",
@@ -663,6 +666,15 @@ class BenchConfig(BenchConfigBase):
             if not self.object_backend:
                 self.object_backend = "gcs" \
                     if (has_gs or self.gcs_endpoint_str) else "s3"
+            if self.use_s3_client_singleton:
+                from ..toolkits.logger import log
+                # the flag changed meaning in round 5 (it briefly meant
+                # single-part upload here): surface the semantics so old
+                # scripts notice
+                log(0, "NOTE: --s3single shares ONE client object among "
+                       "all workers (reference client-singleton "
+                       "semantics); for single-part uploads without "
+                       "multipart use --s3nompu")
             self.paths = [p.removeprefix("s3://").removeprefix("gs://")
                           for p in self.paths]
             return

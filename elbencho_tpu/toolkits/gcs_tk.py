@@ -29,6 +29,7 @@ backend-agnostic.
 from __future__ import annotations
 
 import http.client
+import threading
 import json
 import os
 import time
@@ -167,21 +168,43 @@ class GcsClient:
         #: component objects + compose
         self.resumable = resumable
         self._sessions: "dict[str, dict]" = {}
-        self._conn: "http.client.HTTPConnection | None" = None
+        # per-thread connections, same discipline as S3Client: a shared
+        # singleton client (--s3single) stays safe because every worker
+        # thread drives its own connection
+        self._conn_local = threading.local()
+        self._all_conns: "list[http.client.HTTPConnection]" = []
+        self._conns_lock = threading.Lock()
 
     # -- plumbing ------------------------------------------------------------
 
     def _connection(self) -> http.client.HTTPConnection:
-        if self._conn is None:
+        conn = getattr(self._conn_local, "conn", None)
+        if conn is None:
             cls = (http.client.HTTPSConnection if self.scheme == "https"
                    else http.client.HTTPConnection)
-            self._conn = cls(self.host, self.port, timeout=self.timeout)
-        return self._conn
+            conn = cls(self.host, self.port, timeout=self.timeout)
+            self._conn_local.conn = conn
+            with self._conns_lock:
+                self._all_conns.append(conn)
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._conn_local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._conn_local.conn = None
+            with self._conns_lock:
+                try:
+                    self._all_conns.remove(conn)
+                except ValueError:
+                    pass
 
     def close(self) -> None:
-        if self._conn is not None:
-            self._conn.close()
-            self._conn = None
+        with self._conns_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
+            conn.close()
+        self._conn_local = threading.local()
 
     @staticmethod
     def _obj_path(bucket: str, key: str) -> str:
@@ -239,7 +262,7 @@ class GcsClient:
                 resp.read()  # drain for keep-alive
             return resp.status, dict(resp.getheaders()), data
         except (http.client.HTTPException, OSError):
-            self.close()  # drop broken keep-alive connection
+            self._drop_connection()  # broken keep-alive: this thread's
             raise
 
     @staticmethod
@@ -347,7 +370,7 @@ class GcsClient:
                     self.interrupt_check()
             return resp.status, total
         except (http.client.HTTPException, OSError):
-            self.close()
+            self._drop_connection()
             raise
 
     def head_object(self, bucket: str, key: str,

@@ -19,6 +19,7 @@ import datetime
 import hashlib
 import hmac
 import http.client
+import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
 
@@ -211,7 +212,16 @@ class S3Client:
         # payload (the dominant client-side CPU cost of uploads)
         self.unsigned_payload = unsigned_payload
         self._log_fh = None
-        self._conn: "http.client.HTTPConnection | None" = None
+        self._log_lock = threading.Lock()  # shared-client (--s3single)
+        # connections are PER THREAD (threading.local): one client
+        # object can then be shared by every worker of a process
+        # (--s3single, the reference's S3 client-singleton mode) with
+        # each worker thread still driving its own connection — and the
+        # default one-client-per-worker case is unchanged (one thread,
+        # one connection). All conns are tracked for close().
+        self._conn_local = threading.local()
+        self._all_conns: "list[http.client.HTTPConnection]" = []
+        self._conns_lock = threading.Lock()
 
     def _log_request(self, method: str, bucket: str, key: str,
                      status: int, num_bytes: int) -> None:
@@ -219,28 +229,48 @@ class S3Client:
         --s3log/--s3logprefix SDK logging)."""
         if not self.log_level:
             return
-        if self._log_fh is None:
-            date = datetime.date.today().isoformat()
-            self._log_fh = open(f"{self.log_prefix}{date}.log", "a")
-        now = datetime.datetime.now().isoformat(timespec="milliseconds")
-        self._log_fh.write(
-            f"{now} {method} {self.host}:{self.port} /{bucket}/{key} "
-            f"-> {status} ({num_bytes}B)\n")
-        self._log_fh.flush()
+        with self._log_lock:  # the client may be shared (--s3single)
+            if self._log_fh is None:
+                date = datetime.date.today().isoformat()
+                self._log_fh = open(f"{self.log_prefix}{date}.log", "a")
+            now = datetime.datetime.now().isoformat(timespec="milliseconds")
+            self._log_fh.write(
+                f"{now} {method} {self.host}:{self.port} /{bucket}/{key} "
+                f"-> {status} ({num_bytes}B)\n")
+            self._log_fh.flush()
 
     # -- low-level request --------------------------------------------------
 
     def _connection(self) -> http.client.HTTPConnection:
-        if self._conn is None:
+        conn = getattr(self._conn_local, "conn", None)
+        if conn is None:
             cls = (http.client.HTTPSConnection if self.scheme == "https"
                    else http.client.HTTPConnection)
-            self._conn = cls(self.host, self.port, timeout=self.timeout)
-        return self._conn
+            conn = cls(self.host, self.port, timeout=self.timeout)
+            self._conn_local.conn = conn
+            with self._conns_lock:
+                self._all_conns.append(conn)
+        return conn
+
+    def _drop_connection(self) -> None:
+        """Close and forget the calling thread's connection (retry path
+        re-opens on next use)."""
+        conn = getattr(self._conn_local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._conn_local.conn = None
+            with self._conns_lock:
+                try:
+                    self._all_conns.remove(conn)
+                except ValueError:
+                    pass
 
     def close(self) -> None:
-        if self._conn is not None:
-            self._conn.close()
-            self._conn = None
+        with self._conns_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
+            conn.close()
+        self._conn_local = threading.local()
         if self._log_fh is not None:
             self._log_fh.close()
             self._log_fh = None
@@ -365,7 +395,7 @@ class S3Client:
                 resp.read()  # drain for keep-alive
             return resp.status, dict(resp.getheaders()), data
         except (http.client.HTTPException, OSError):
-            self.close()  # drop broken keep-alive connection
+            self._drop_connection()  # broken keep-alive: this thread's only
             raise
 
     def _check(self, status: int, data: bytes, ok=(200, 204)) -> None:
@@ -467,7 +497,7 @@ class S3Client:
             self._log_request("GET", bucket, key, resp.status, total)
             return resp.status, total
         except (http.client.HTTPException, OSError):
-            self.close()
+            self._drop_connection()
             raise
 
     def head_object(self, bucket: str, key: str,

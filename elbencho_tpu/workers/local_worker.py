@@ -173,7 +173,11 @@ class LocalWorker(Worker):
         if self._ops_log is not None:
             self._ops_log.close()
         if getattr(self, "_s3_client", None) is not None:
-            self._s3_client.close()
+            # --s3single: the client is the process-wide singleton other
+            # workers may still be using — only a per-worker client is
+            # closed here (the singleton's sockets close on GC/rebuild)
+            if not getattr(self.cfg, "use_s3_client_singleton", False):
+                self._s3_client.close()
             self._s3_client = None
         if getattr(self, "_netbench_conns", None):
             from .netbench import cleanup_netbench

@@ -27,10 +27,27 @@ MAX_LIST_PAGE = 1000
 def _client(worker):
     if getattr(worker, "_s3_client", None) is None:
         from ..toolkits.s3_tk import make_client_for_rank
-        worker._s3_client = make_client_for_rank(
-            worker.cfg, worker.rank,
-            interrupt_check=lambda: worker.check_interruption_request(
-                force=True))
+        if getattr(worker.cfg, "use_s3_client_singleton", False):
+            # --s3single: ONE client object for every worker of this
+            # process (reference: S3 client singleton, ProgArgs.h:368).
+            # Safe because connections inside the client are per thread;
+            # interruption checks use the thread-safe shared-flag test.
+            # Worker teardown must NOT close a shared client (see
+            # LocalWorker._close_s3_client).
+            shared = worker.shared
+            with shared.cond:
+                client = getattr(shared, "s3_client_singleton", None)
+                if client is None:
+                    client = make_client_for_rank(
+                        worker.cfg, 0,
+                        interrupt_check=worker.check_interruption_flag_only)
+                    shared.s3_client_singleton = client
+            worker._s3_client = client
+        else:
+            worker._s3_client = make_client_for_rank(
+                worker.cfg, worker.rank,
+                interrupt_check=lambda: worker.check_interruption_request(
+                    force=True))
     return worker._s3_client
 
 
@@ -95,6 +112,13 @@ class _S3Pipeline:
     def _thread_client(self):
         client = getattr(self._tls, "client", None)
         if client is None:
+            if getattr(self.worker.cfg, "use_s3_client_singleton", False):
+                # --s3single governs the async pipeline too: every
+                # executor thread uses the process-wide client (safe:
+                # connections inside it are per thread). Not added to
+                # self._clients — pipeline teardown must not close it.
+                self._tls.client = _client(self.worker)
+                return self._tls.client
             from ..toolkits.s3_tk import make_client_for_rank
             # rank-based endpoint/credential selection stays per WORKER so
             # round-robin semantics don't depend on executor thread count;

@@ -160,7 +160,7 @@ def test_s3_verify_integrity(mock_s3):
 def test_s3_single_put_large_object_not_truncated(mock_s3):
     """--s3single with file_size > block_size must upload the full object
     (assembled block-by-block) and read it back."""
-    rc = run_cli(mock_s3, ["-w", "-d", "-r", "--s3single", "-t", "1",
+    rc = run_cli(mock_s3, ["-w", "-d", "-r", "--s3nompu", "-t", "1",
                            "-n", "1", "-N", "1", "-s", "64K", "-b", "16K",
                            "s3://single-big"])
     assert rc == 0
@@ -393,3 +393,52 @@ def test_s3_async_error_surfaces(mock_s3):
                            "-N", "1", "-s", "64K", "-b", "16K",
                            "s3://missing-async-bucket"])
     assert rc != 0
+
+
+def test_s3_client_singleton_shared_across_workers(mock_s3, tmp_path):
+    """--s3single: all workers of a process share ONE client object
+    (reference: ARG_S3CLIENTSINGLETON, ProgArgs.h:368 s3ClientSingleton),
+    each worker thread driving its own connection inside it."""
+    # functional: a multi-threaded run through the singleton stays green
+    rc = run_cli(mock_s3, ["-w", "-d", "-r", "-F", "-D", "--s3single",
+                           "-t", "3", "-n", "1", "-N", "2", "-s", "16K",
+                           "-b", "8K", "s3://singleton-bkt"])
+    assert rc == 0
+    # structural: _client returns the same object for different workers
+    from types import SimpleNamespace
+    import threading as _threading
+    from elbencho_tpu.config.args import BenchConfig
+    from elbencho_tpu.workers.s3_worker import _client
+
+    cfg = BenchConfig(use_s3_client_singleton=True,
+                      s3_endpoints_str=mock_s3.endpoint,
+                      s3_access_key="k", s3_secret_key="s",
+                      paths=["s3://x"])
+    shared = SimpleNamespace(cond=_threading.Condition())
+    workers = [SimpleNamespace(cfg=cfg, shared=shared, rank=r,
+                               check_interruption_flag_only=lambda: None,
+                               _s3_client=None) for r in range(3)]
+    clients = [_client(w) for w in workers]
+    assert clients[0] is clients[1] is clients[2]
+    # connections are per thread inside the shared client: concurrent
+    # requests from distinct threads must each succeed
+    clients[0].create_bucket("tbkt")
+    errs = []
+
+    def hammer(i):
+        try:
+            clients[0].put_object("tbkt", f"o{i}", b"x" * 128)
+            assert clients[0].get_object("tbkt", f"o{i}") == b"x" * 128
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [_threading.Thread(target=hammer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert len(clients[0]._all_conns) >= 2  # per-thread connections
+    clients[0].close()
+    assert not clients[0]._all_conns
