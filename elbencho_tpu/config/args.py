@@ -285,6 +285,14 @@ FLAG_DEFS = [
      "per-transfer dispatch overhead, e.g. on tunneled chips; costs one "
      "host-side copy per block and defers the DMA to every Nth block; "
      "ignored with --tpuverify)"),
+    ("tpudepth", None, "tpu_depth", "int", 0, "tpu",
+     "In-flight TPU transfer ring depth (submission/completion window of "
+     "the HBM pipeline; overrides the default of riding --iodepth, like "
+     "the reference's cuFile iodepth semantics)"),
+    ("tpubudget", None, "tpu_dispatch_budget_usec", "int", 0, "tpu",
+     "Fail the run when the measured per-block host-side dispatch "
+     "overhead of the TPU transfer pipeline exceeds this many "
+     "microseconds (0 = no budget)"),
     ("tpuverify", None, "do_tpu_verify", "bool", False, "tpu",
      "Run integrity verification on-device (Pallas kernel) instead of host"),
     ("tpuprofile", None, "tpu_profile_dir", "str", "", "tpu",
@@ -1020,6 +1028,16 @@ class BenchConfig(BenchConfigBase):
                 "blockdev)")
         if self.tpu_ids_str and self.bench_mode == BenchMode.NETBENCH:
             raise ConfigError("--tpuids not supported in netbench mode")
+        if self.tpu_depth < 0:
+            raise ConfigError("--tpudepth must be >= 0 (0 = use --iodepth)")
+        if self.tpu_dispatch_budget_usec < 0:
+            raise ConfigError("--tpubudget must be >= 0 (0 = no budget)")
+        if (self.tpu_depth or self.tpu_dispatch_budget_usec) \
+                and not self.tpu_ids_str and not self.tpu_ids \
+                and not self.run_tpu_bench:
+            raise ConfigError(
+                "--tpudepth/--tpubudget tune the TPU transfer pipeline — "
+                "they need --tpuids (or --tpubench)")
         if self.run_s3_mpu_complete_phase and not self.s3_mpu_sharing:
             raise ConfigError(
                 "--s3mpucomplphase requires --s3mpusharing (only shared "

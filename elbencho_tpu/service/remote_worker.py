@@ -288,6 +288,7 @@ class RemoteWorker(Worker):
             result.get("IOLatHistoRWMixRead", {}))
         self.tpu_transfer_bytes = result.get("TpuHbmBytes", 0)
         self.tpu_transfer_usec = result.get("TpuHbmUSec", 0)
+        self.tpu_dispatch_usec = result.get("TpuHbmDispatchUSec", 0)
         # H2D/D2H path-audit counters, schema-driven so a counter added
         # to PATH_AUDIT_COUNTERS is ingested without touching this file
         from ..tpu.device import PATH_AUDIT_COUNTERS

@@ -53,7 +53,8 @@ class PhaseResults:
         self.cpu_last_done = 0.0
         self.elapsed_usec_vec: "list[int]" = []
         self.tpu_bytes = 0
-        self.tpu_usec = 0
+        self.tpu_usec = 0           # DMA wall time (submit -> ready)
+        self.tpu_dispatch_usec = 0  # host-side submit cost of the pipeline
         self.tpu_per_chip: "dict[int, tuple[int, int]]" = {}
         # --tpudirect H2D/D2H path audit, keyed by wire/JSON name
         # (schema: tpu.device.PATH_AUDIT_COUNTERS)
@@ -358,6 +359,7 @@ class Statistics:
             res.iops_histo_rwmix.merge(w.iops_latency_histo_rwmix)
             res.tpu_bytes += w.tpu_transfer_bytes
             res.tpu_usec += w.tpu_transfer_usec
+            res.tpu_dispatch_usec += w.tpu_dispatch_usec
             if getattr(w, "_tpu", None) is not None:
                 chip = w._tpu.chip_id
                 b, u = res.tpu_per_chip.get(chip, (0, 0))
@@ -452,6 +454,19 @@ class Statistics:
                 rows.append(self._row(
                     "", f"  chip {chip} {unit}/s", "-",
                     f"{b / last_s / div:,.0f}"))
+            # dispatch-vs-DMA split (TransferPipeline accounting): the
+            # host-side submit overhead --tpubudget bounds vs the DMA
+            # wall time the pipeline overlaps
+            tpu_ops = sum(res.tpu_path_counters.get(k, 0) for k in (
+                "TpuH2dDirectOps", "TpuH2dStagedOps",
+                "TpuD2hDirectOps", "TpuD2hStagedOps"))
+            if tpu_ops and (res.tpu_dispatch_usec or res.tpu_usec):
+                rows.append(self._row(
+                    "", "HBM dispatch us/op", "-",
+                    f"{res.tpu_dispatch_usec / tpu_ops:,.1f}"))
+                rows.append(self._row(
+                    "", "HBM DMA us/op", "-",
+                    f"{res.tpu_usec / tpu_ops:,.1f}"))
         if cfg.show_cpu_util:
             rows.append(self._row("", "CPU util %",
                                   f"{res.cpu_stonewall:.0f}",
@@ -542,6 +557,11 @@ class Statistics:
             "TpuHbmBytes": res.tpu_bytes,
             "TpuHbmMiBPerSec": round(
                 res.tpu_bytes / last_s / (1 << 20), 2) if res.tpu_bytes else 0,
+            # dispatch-vs-DMA split of the transfer pipeline: host-side
+            # submit cost vs per-transfer DMA wall time (overlapping
+            # windows — divide bytes by PHASE time for bandwidth)
+            "TpuDispatchUSec": res.tpu_dispatch_usec,
+            "TpuTransferUSec": res.tpu_usec,
             "TpuPerChip": {str(k): {"Bytes": b, "USec": u}
                            for k, (b, u) in res.tpu_per_chip.items()},
             # H2D/D2H path audit, keyed by PATH_AUDIT_COUNTERS
@@ -563,6 +583,7 @@ class Statistics:
         "CPUUtilStoneWall", "CPUUtil", "IOLatUSecMin", "IOLatUSecAvg",
         "IOLatUSecMax", "IOLatUSecP99", "EntLatUSecMin", "EntLatUSecAvg",
         "EntLatUSecMax", "TpuHbmBytes", "TpuHbmMiBPerSec",
+        "TpuDispatchUSec", "TpuTransferUSec",
         "RWMixReadIOPSLast", "RWMixReadMiBPerSecLast")
 
     @classmethod
@@ -670,13 +691,14 @@ class Statistics:
         Statistics.cpp:2784)."""
         shared = self.manager.shared
         elapsed_vec = []
-        tpu_bytes = tpu_usec = 0
+        tpu_bytes = tpu_usec = tpu_dispatch_usec = 0
         tpu_per_chip = {}
         for w in self.manager.workers:
             if w.got_phase_work:
                 elapsed_vec.extend(w.elapsed_usec_vec)
             tpu_bytes += w.tpu_transfer_bytes
             tpu_usec += w.tpu_transfer_usec
+            tpu_dispatch_usec += w.tpu_dispatch_usec
             if getattr(w, "_tpu", None) is not None:
                 chip = w._tpu.chip_id
                 b, u = tpu_per_chip.get(chip, (0, 0))
@@ -736,6 +758,10 @@ class Statistics:
             "CPUUtil": round(shared.cpu_util_last_done, 1),
             "TpuHbmBytes": tpu_bytes,
             "TpuHbmUSec": tpu_usec,
+            # host-side submit cost of the transfer pipeline, shipped
+            # separately so the master's dispatch-vs-DMA split survives
+            # distribution (RemoteWorker ingests it as tpu_dispatch_usec)
+            "TpuHbmDispatchUSec": tpu_dispatch_usec,
             # per-chip breakdown travels the wire so the master's merged
             # record can attribute bytes to chips across services
             "TpuPerChip": {str(k): {"Bytes": b, "USec": u}

@@ -14,6 +14,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 import urllib.request
 
@@ -71,21 +72,34 @@ def service_procs(ports: "list[int]", env: "dict | None" = None,
     that expects the services to exit on their own (e.g. after --quit
     over the wire) can wait() them inside the block; teardown skips
     already-exited processes.
+
+    Service output goes to one temp log file per process, never a pipe:
+    a long-lived service pair (fuzz suite, multichip dryrun) can emit
+    more than the ~64KiB pipe buffer, and an undrained pipe would then
+    block the service mid-write and deadlock the run. On failure each
+    log's tail is printed to stderr; the files are removed on success.
     """
     if env is None:
         env = default_env()
     procs = []
+    logs = []  # (port, path, fh) per service process
+    ok = False
     try:
         for port in ports:
+            fd, path = tempfile.mkstemp(prefix=f"elbencho-svc-{port}-",
+                                        suffix=".log")
+            fh = os.fdopen(fd, "wb")
+            logs.append((port, path, fh))
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "elbencho_tpu", "--service",
                  "--foreground", "--port", str(port)]
                 + list(extra_args or []),
                 env=env, cwd=REPO_DIR,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+                stdout=fh, stderr=subprocess.STDOUT))
         for port in ports:
             wait_ready(port)
         yield procs
+        ok = True
     finally:
         for p in procs:
             if p.poll() is None:
@@ -96,3 +110,27 @@ def service_procs(ports: "list[int]", env: "dict | None" = None,
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+        for port, path, fh in logs:
+            with contextlib.suppress(OSError):
+                fh.close()
+            if not ok:
+                _print_log_tail(port, path)
+            with contextlib.suppress(OSError):
+                os.unlink(path)
+
+
+def _print_log_tail(port: int, path: str, max_bytes: int = 8192) -> None:
+    """Last chunk of a failed service's log to stderr, so the harness
+    failure carries the service-side context the pipe used to hold."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(size - max_bytes, 0))
+            tail = fh.read().decode("utf-8", errors="replace")
+    except OSError:
+        return
+    if tail.strip():
+        print(f"--- service on port {port}: log tail ---\n{tail}"
+              f"--- end service log (port {port}) ---",
+              file=sys.stderr)

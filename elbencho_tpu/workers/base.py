@@ -77,7 +77,9 @@ class Worker:
         self.phase_finished = False
         self._ops_since_check = 0
         self.tpu_transfer_bytes = 0   # HBM ingest accounting (TPU data path)
-        self.tpu_transfer_usec = 0
+        self.tpu_transfer_usec = 0    # DMA wall time (submit -> ready)
+        self.tpu_dispatch_usec = 0    # host-side submit cost (the overhead
+                                      # --tpubudget bounds)
 
     def oplog(self, op_name: str, entry_name: str = "", offset: int = 0,
               length: int = 0):
@@ -111,6 +113,7 @@ class Worker:
         self._ops_since_check = 0
         self.tpu_transfer_bytes = 0
         self.tpu_transfer_usec = 0
+        self.tpu_dispatch_usec = 0
 
     def create_stonewall_stats_if_triggered(self) -> None:
         """Snapshot current counters when the first worker finished

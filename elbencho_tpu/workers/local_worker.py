@@ -98,14 +98,31 @@ class LocalWorker(Worker):
             self._tpu = TpuWorkerContext(
                 chip_id=chip, block_size=cfg.block_size,
                 direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify,
-                pipeline_depth=max(cfg.io_depth, 1),
+                # --tpudepth overrides the iodepth ride-along (the
+                # reference's cuFile iodepth analogue)
+                pipeline_depth=max(cfg.tpu_depth or cfg.io_depth, 1),
                 hbm_limit_pct=cfg.tpu_hbm_limit_pct,
-                batch_blocks=max(cfg.tpu_batch_blocks, 1))
+                batch_blocks=max(cfg.tpu_batch_blocks, 1),
+                dispatch_budget_usec=cfg.tpu_dispatch_budget_usec)
             needs_fill = (cfg.run_create_files
                           or (cfg.run_tpu_bench
                               and cfg.tpu_bench_pattern in ("d2h", "both")))
             if needs_fill and not cfg.integrity_check_salt:
                 self._tpu.warmup_fill()  # jit outside the timed phase
+            needs_ingest = (cfg.run_read_files
+                            or (cfg.run_tpu_bench
+                                and cfg.tpu_bench_pattern in ("h2d",
+                                                              "both")))
+            if needs_ingest and not cfg.use_tpu_direct:
+                # copy-step jit + donation probe outside the timed phase
+                # (and outside the --tpubudget accounting). Skipped in
+                # direct mode: its primary path never stages, and the
+                # warmup would pin pipeline_depth full-size HBM staging
+                # blocks in _slot_prev for the whole run — headroom
+                # --tpuhbmpct exists to protect. (The direct->staged
+                # fallback then jit-compiles lazily; that run is already
+                # off its fast path and says so loudly.)
+                self._tpu.warmup_transfer()
         if cfg.bench_path_type != BenchPathType.DIR \
                 and cfg.bench_mode == BenchMode.POSIX:
             self._prepare_path_fds()
@@ -764,9 +781,15 @@ class LocalWorker(Worker):
             ops.num_iops_done += 1
             self._num_iops_submitted += 1
         if self._tpu is not None:
-            t0 = time.perf_counter_ns()
             self._tpu.flush()  # drain pipelined transfers before phase end
-            self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+            self._sync_tpu_usec()
+
+    def _sync_tpu_usec(self) -> None:
+        """Mirror the context's split timing counters into this worker's
+        phase stats (dispatch = host-side submit cost, transfer = DMA
+        wall time; both accumulated per-phase by TransferPipeline)."""
+        self.tpu_dispatch_usec = self._tpu.dispatch_usec
+        self.tpu_transfer_usec = self._tpu.transfer_usec
 
     def _native_loop_eligible(self, native) -> bool:
         """Conditions every native delegation shares: no per-op Python
@@ -919,11 +942,10 @@ class LocalWorker(Worker):
             # D2H pre-write, reference LocalWorker.cpp:2437-2490). With
             # --verify the pattern itself is generated on-device so the
             # read-back check still holds.
-            t0 = time.perf_counter_ns()
             self._tpu.device_to_host(buf, length,
                                      verify_salt=cfg.integrity_check_salt,
                                      file_offset=offset)
-            self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+            self._sync_tpu_usec()
             self.tpu_transfer_bytes += length
             return
         if cfg.integrity_check_salt:
@@ -974,12 +996,11 @@ class LocalWorker(Worker):
         if self._tpu is not None:
             # host->HBM DMA of the read block (replaces cudaMemcpy H2D post-
             # read / cuFile read, reference LocalWorker.cpp:2633-2749)
-            t0 = time.perf_counter_ns()
             self._tpu.host_to_device(buf, length,
                                      verify_salt=cfg.integrity_check_salt
                                      if cfg.do_tpu_verify else 0,
                                      file_offset=offset)
-            self.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+            self._sync_tpu_usec()
             self.tpu_transfer_bytes += length
             if cfg.do_tpu_verify and cfg.integrity_check_salt:
                 return  # verified on-device by the Pallas kernel
@@ -1035,10 +1056,8 @@ class LocalWorker(Worker):
                 self.live_ops.num_iops_done += 1
                 self._num_iops_submitted += 1
             if self._tpu is not None:
-                t0 = time.perf_counter_ns()
                 self._tpu.flush()
-                self.tpu_transfer_usec += \
-                    (time.perf_counter_ns() - t0) // 1000
+                self._sync_tpu_usec()
         finally:
             mapped.close()
 

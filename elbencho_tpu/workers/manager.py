@@ -175,6 +175,18 @@ class WorkerManager:
                 pass
         self._shared_fds = []
         self.cfg.bench_path_fds = []
+        # --s3single: the shared client is owned by no worker (each one
+        # deliberately skips it in cleanup), so the manager closes it once
+        # after ALL workers are done — otherwise its tracked connections
+        # and the --s3log file handle leak per-run in a long-lived
+        # --service process, which rebuilds a manager per /preparephase
+        client = getattr(self.shared, "s3_client_singleton", None)
+        if client is not None:
+            self.shared.s3_client_singleton = None
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - teardown is best effort
+                pass
 
     # -- per-phase work accounting (reference: getPhaseNumEntriesAndBytes) --
 

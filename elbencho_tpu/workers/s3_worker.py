@@ -228,9 +228,8 @@ def dispatch_s3_phase(worker, phase: BenchPhase) -> None:
             f"S3 phase {phase.name} is not implemented yet")
     handler(worker, phase)
     if worker._tpu is not None:
-        t0 = time.perf_counter_ns()
-        worker._tpu.flush()
-        worker.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+        worker._tpu.flush()  # drain pipelined staging; --tpubudget checks
+        worker._sync_tpu_usec()
 
 
 # ---------------------------------------------------------------------------

@@ -69,12 +69,16 @@ def run_tpubench_phase(worker, phase: BenchPhase) -> None:
         worker.live_ops.num_bytes_done += moved
         worker.live_ops.num_iops_done += 1
         worker.tpu_transfer_bytes += moved
-        worker.tpu_transfer_usec += lat_usec
         worker._num_iops_submitted += 1
         done += length
-    t0 = time.perf_counter_ns()
-    ctx.flush()
-    worker.tpu_transfer_usec += (time.perf_counter_ns() - t0) // 1000
+        # split accounting from the pipeline: host-side dispatch cost vs
+        # DMA wall time (the per-op histogram above times the full call);
+        # synced per op so an interrupt mid-window keeps partial stats
+        worker.tpu_dispatch_usec = ctx.dispatch_usec
+        worker.tpu_transfer_usec = ctx.transfer_usec
+    ctx.flush()  # drain the in-flight window; --tpubudget checks here
+    worker.tpu_dispatch_usec = ctx.dispatch_usec
+    worker.tpu_transfer_usec = ctx.transfer_usec
 
 
 def _select_collective_devices(cfg, jax) -> list:

@@ -65,6 +65,10 @@ def test_unreachable_tpu_emits_machine_readable_failure_line():
         assert "elapsed_s" in entry
     # metric key present so BENCH_rNN.json stays schema-stable
     assert rec["metric"].startswith("seq read 16M blocks into TPU HBM")
+    # the pipelined-vs-sync A/B slot is machine-written even on failure
+    # (null = not measured this run), so charting tools need no
+    # key-existence special case
+    assert "pipeline_ab" in rec and rec["pipeline_ab"] is None
 
 
 def test_sigterm_mid_probe_emits_artifact_immediately():
@@ -102,7 +106,9 @@ def test_failure_record_replays_cached_last_success(tmp_path):
     cache = tmp_path / "cache.json"
     cache.write_text(json.dumps({
         "metric": "seq read 16M blocks into TPU HBM (1 chip, ...)",
-        "value": 1009.1, "unit": "MiB/s", "utc": "2026-07-29T00:00:00Z"}))
+        "value": 1009.1, "unit": "MiB/s", "utc": "2026-07-29T00:00:00Z",
+        "pipeline_ab": {"sync_mibs": 400.0, "pipelined_mibs": 1009.1,
+                        "pipelined_vs_sync": 2.523}}))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "no_such_platform"
     env["PYTHONPATH"] = _axon_mitigation.strip_axon_paths(
@@ -119,6 +125,11 @@ def test_failure_record_replays_cached_last_success(tmp_path):
     assert stale["value"] == 1009.1
     assert stale["utc"] == "2026-07-29T00:00:00Z"
     assert "NOT measured in this run" in stale["note"]
+    # the cached capture's pipelined-vs-sync A/B replays as the same kind
+    # of labeled stale evidence (acceptance: the A/B is machine-written
+    # even when the probe falls back to stale_last_success)
+    assert stale["pipeline_ab"]["pipelined_vs_sync"] == 2.523
+    assert rec["pipeline_ab"] is None  # this run measured nothing
 
 
 def test_selftest_cache_never_pollutes_tpu_evidence(tmp_path):
@@ -171,6 +182,16 @@ def test_selftest_pipeline_emits_success_line():
     assert len(rec["inter_pass_idle_s"]) == rec["median_of"]
     assert rec["probe_attempts"] >= 1
     assert rec["io_lat_usec_p99"] >= rec["io_lat_usec_p50"]
+    # dispatch-vs-DMA split of the median pass rides along
+    assert rec["tpu_dispatch_usec"] >= 0
+    assert rec["tpu_transfer_usec"] >= 0
+    # pipelined-vs-sync A/B rider: one --tpudepth 1 pass quantifies what
+    # the in-flight window buys (sync pass proven sync via its hwm)
+    ab = rec["pipeline_ab"]
+    assert ab["sync_mibs"] > 0
+    assert ab["pipelined_mibs"] >= rec["min"]
+    assert ab["pipelined_vs_sync"] > 0
+    assert ab["sync_inflight_hwm"] == 1
 
 
 def test_sigterm_during_ab_rider_emits_completed_measurement(

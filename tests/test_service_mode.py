@@ -268,3 +268,108 @@ def test_distributed_native_engine_with_verify(services_native, tmp_path):
     data[30000] ^= 0xFF
     victim.write_bytes(bytes(data))
     assert _master(["-r"] + args) != 0  # remote native verify catches it
+
+
+def test_service_harness_logs_to_file_not_pipe(monkeypatch, tmp_path):
+    """Round-5 advisor: service stdout used to go to an undrained pipe
+    whose ~64KiB buffer could fill and deadlock long fuzz/multichip runs.
+    The harness must hand the service a FILE, and surface its tail on
+    failure."""
+    import subprocess as _subprocess
+
+    from elbencho_tpu.testing import service_harness
+
+    captured = {}
+
+    class _FakeProc:
+        def poll(self):
+            return 0
+
+        def wait(self, timeout=None):
+            return 0
+
+        def terminate(self):
+            pass
+
+    def fake_popen(cmd, env=None, cwd=None, stdout=None, stderr=None):
+        captured["stdout"] = stdout
+        captured["stderr"] = stderr
+        stdout.write(b"boom: service-side failure detail\n")
+        stdout.flush()
+        captured["log_path"] = stdout.name
+        return _FakeProc()
+
+    monkeypatch.setattr(service_harness.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(service_harness, "wait_ready",
+                        lambda port, timeout=120.0: None)
+
+    with service_harness.service_procs([1]):
+        # a real file object, not subprocess.PIPE
+        assert hasattr(captured["stdout"], "fileno")
+        assert captured["stdout"] is not _subprocess.PIPE
+        assert captured["stderr"] is _subprocess.STDOUT
+        assert os.path.exists(captured["log_path"])
+    # success path: temp log removed
+    assert not os.path.exists(captured["log_path"])
+
+
+def test_service_harness_surfaces_log_tail_on_failure(monkeypatch, capsys):
+    """On failure inside the block, each service's log tail is printed to
+    stderr (the context the pipe used to swallow) and then removed."""
+    from elbencho_tpu.testing import service_harness
+
+    paths = []
+
+    class _FakeProc:
+        def poll(self):
+            return 0
+
+        def wait(self, timeout=None):
+            return 0
+
+        def terminate(self):
+            pass
+
+    def fake_popen(cmd, env=None, cwd=None, stdout=None, stderr=None):
+        stdout.write(b"boom: service-side failure detail\n")
+        stdout.flush()
+        paths.append(stdout.name)
+        return _FakeProc()
+
+    monkeypatch.setattr(service_harness.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(service_harness, "wait_ready",
+                        lambda port, timeout=120.0: None)
+
+    with pytest.raises(RuntimeError, match="master-side"):
+        with service_harness.service_procs([1, 2]):
+            raise RuntimeError("master-side")
+    err = capsys.readouterr().err
+    assert "boom: service-side failure detail" in err
+    assert "port 1" in err and "port 2" in err
+    assert not any(os.path.exists(p) for p in paths)
+
+
+def test_manager_closes_s3_singleton_at_teardown():
+    """Round-5 advisor: nothing owned the --s3single shared client (each
+    worker's cleanup deliberately skips it), leaking its connections and
+    the --s3log handle per-run in long-lived --service processes. The
+    manager closes it once after all workers are done."""
+    from elbencho_tpu.config.args import BenchConfig
+    from elbencho_tpu.workers.manager import WorkerManager
+
+    cfg = BenchConfig(num_threads=0)
+    mgr = WorkerManager(cfg)
+
+    closed = []
+
+    class _FakeClient:
+        def close(self):
+            closed.append(True)
+
+    mgr.shared.s3_client_singleton = _FakeClient()
+    mgr.join_all_threads()
+    assert closed == [True]
+    assert mgr.shared.s3_client_singleton is None
+    # idempotent: a second teardown has nothing left to close
+    mgr.join_all_threads()
+    assert closed == [True]

@@ -389,3 +389,77 @@ def test_netbench_requires_hosts_config_error(capsys):
 def test_treescan_requires_treefile(tmp_path, capsys):
     rc = main(["--treescan", str(tmp_path), "--nolive"])
     assert rc == 1
+
+
+def _bench_capture_file(tmp_path):
+    """Two bench.py capture lines: one measured (with the pipelined-vs-
+    sync A/B rider), one probe failure replaying a stale A/B."""
+    cap = tmp_path / "capture.json"
+    measured = {
+        "metric": "seq read ...", "value": 900.0, "unit": "MiB/s",
+        "utc": "2026-08-01T00:00:00Z",
+        "tpu_dispatch_usec": 1200, "tpu_transfer_usec": 34000,
+        "tpu_pipe_inflight_hwm": 4,
+        "pipeline_ab": {"sync_mibs": 400.0, "pipelined_mibs": 900.0,
+                        "pipelined_vs_sync": 2.25, "sync_dispatch_usec": 800,
+                        "sync_inflight_hwm": 1}}
+    failed = {
+        "metric": "seq read ...", "value": None, "unit": "MiB/s",
+        "utc": "2026-08-02T00:00:00Z", "pipeline_ab": None,
+        "stale_last_success": {
+            "value": 890.0, "utc": "2026-08-01T00:00:00Z",
+            "pipeline_ab": {"sync_mibs": 410.0, "pipelined_mibs": 890.0,
+                            "pipelined_vs_sync": 2.171},
+            "note": "NOT measured in this run"}}
+    cap.write_text(json.dumps(measured) + "\n" + json.dumps(failed) + "\n")
+    return cap
+
+
+def test_summarize_json_dispatch_split_columns(tmp_path):
+    """Phase records report the per-op dispatch-vs-DMA split as columns
+    (the --tpubudget observable, chartable per sweep point)."""
+    jsonfile = tmp_path / "res.json"
+    assert main(["--tpubench", "-s", "512K", "-b", "128K", "--iodepth",
+                 "4", "--jsonfile", str(jsonfile), "--nolive"]) == 0
+    res = _tool("elbencho-tpu-summarize-json", [str(jsonfile), "--csv"])
+    assert res.returncode == 0, res.stderr
+    header = res.stdout.splitlines()[0].split(",")
+    data = res.stdout.splitlines()[1].split(",")
+    assert "HBMdisp us/op" in header and "HBMdma us/op" in header
+    assert float(data[header.index("HBMdisp us/op")]) > 0
+
+
+def test_summarize_json_bench_capture_ab(tmp_path):
+    """bench.py capture lines summarize as the pipelined-vs-sync A/B
+    table — including the stale replay of a failed capture."""
+    cap = _bench_capture_file(tmp_path)
+    res = _tool("elbencho-tpu-summarize-json", [str(cap)])
+    assert res.returncode == 0, res.stderr
+    assert "pipelined/sync" in res.stdout
+    assert "2.25" in res.stdout and "measured" in res.stdout
+    assert "2.171" in res.stdout and "stale_last_success" in res.stdout
+
+
+def test_chart_tool_rejects_phase_records_cleanly(tmp_path):
+    """Ordinary --jsonfile phase records are not chartable — the tool
+    must say so instead of misrouting them into the bench-capture path
+    and complaining about a missing A/B."""
+    jsonfile = tmp_path / "res.json"
+    assert main(["--tpubench", "-s", "256K", "-b", "128K", "--jsonfile",
+                 str(jsonfile), "--nolive"]) == 0
+    res = _tool("elbencho-tpu-chart", [str(jsonfile)])
+    assert res.returncode != 0
+    assert "phase-record output" in res.stderr
+
+
+def test_chart_tool_bench_capture_ab(tmp_path):
+    """`elbencho-tpu-chart capture.json` charts the A/B automatically:
+    SYNC and PIPELINED series, no flags needed."""
+    cap = _bench_capture_file(tmp_path)
+    res = _tool("elbencho-tpu-chart", [str(cap)])
+    assert res.returncode == 0, res.stderr
+    assert "MiBPerSecLast [SYNC]" in res.stdout
+    assert "MiBPerSecLast [PIPELINED]" in res.stdout
+    assert "900.0" in res.stdout and "400.0" in res.stdout
+    # the stale replay is labeled as such on its x tick
+    assert "(stale)" in res.stdout
