@@ -238,6 +238,20 @@ FLAG_DEFS = [
      "Show per-service elapsed times in results"),
     ("svcping", None, "show_svc_ping", "bool", False, "dist",
      "Show per-service control-plane round-trip latency in live stats"),
+    ("svcretries", None, "svc_num_retries", "int", 3, "dist",
+     "Transient-error retries per control-plane request to a service "
+     "(connection failures, malformed replies, 5xx/429; jittered "
+     "exponential backoff; 0 = fail on first error)"),
+    ("svcretrybudget", None, "svc_retry_budget_secs", "int", 30, "dist",
+     "Max total seconds of control-plane retry backoff per phase per "
+     "service host before the host counts as failed"),
+    ("svcstalledsecs", None, "svc_stalled_secs", "int", 0, "dist",
+     "Declare a service stalled when its live counters stop advancing "
+     "(or it stops answering /status) for this many seconds (0 = off)"),
+    ("svctolerant", None, "svc_tolerant_hosts", "int", 0, "dist",
+     "Max service hosts that may be lost mid-run; lost hosts are "
+     "dropped and results are marked DEGRADED (0 = fail fast, the "
+     "default)"),
     ("rotatehosts", None, "rotate_hosts_num", "int", 0, "dist",
      "Rotate hosts list by this many positions between phases"),
     ("datasetthreads", None, "num_dataset_threads_override", "int", 0, "dist",
@@ -1078,6 +1092,23 @@ class BenchConfig(BenchConfigBase):
                                   self.s3_acl_grants)
             except ValueError as err:
                 raise ConfigError(str(err)) from err
+        if self.svc_num_retries < 0:
+            raise ConfigError("--svcretries must be >= 0")
+        if self.svc_retry_budget_secs < 0:
+            raise ConfigError("--svcretrybudget must be >= 0")
+        if self.svc_stalled_secs < 0:
+            raise ConfigError("--svcstalledsecs must be >= 0")
+        if self.svc_tolerant_hosts < 0:
+            raise ConfigError("--svctolerant must be >= 0")
+        if self.svc_tolerant_hosts and self.hosts \
+                and self.svc_tolerant_hosts >= len(self.hosts):
+            raise ConfigError(
+                "--svctolerant must leave at least one surviving host "
+                "(got tolerance for all given --hosts)")
+        if self.svc_tolerant_hosts and self.run_netbench:
+            raise ConfigError(
+                "--svctolerant is incompatible with --netbench (the "
+                "client/server topology cannot lose hosts mid-run)")
         if self.run_netbench:
             if not self.hosts and not self.netbench_total_hosts:
                 raise ConfigError(
@@ -1174,6 +1205,11 @@ class BenchConfig(BenchConfigBase):
         d["hosts_str"] = ""
         d["hosts_file_path"] = ""
         d["run_as_service"] = False
+        # control-plane fault tolerance is the MASTER's job; a service
+        # makes no outbound control calls (and e.g. --svctolerant would
+        # trip host-count validation against the stripped hosts list)
+        d["svc_tolerant_hosts"] = 0
+        d["svc_stalled_secs"] = 0
         # result files are written by the master only (the reference never
         # serializes resFilePath* to services)
         d["res_file_path"] = d["csv_file_path"] = d["json_file_path"] = ""

@@ -1,0 +1,132 @@
+"""Control-plane fault tolerance: transient-error classifier + retry policy.
+
+The S3 data plane already classifies and retries transient failures
+(`toolkits/s3_tk.py` `_RETRY_STATUSES` + interruptible linear backoff); this
+module gives the master->service HTTP control plane the same idiom so one
+flaky `/status` poll can no longer abort a whole multi-host run ("RPC
+Considered Harmful", PAPERS.md: naive request/reply fabrics become the
+reliability bottleneck of distributed accelerator workloads).
+
+Semantics (docs/fault-tolerance.md):
+
+- **Idempotent** requests (`/status`, `/benchresult`, `/protocolversion`,
+  `/preparefile` — re-upload overwrites) retry freely on any transient
+  error: connection failures, malformed/truncated replies, 5xx/429.
+- **Non-idempotent** requests (`/preparephase`, `/startphase`) retry only
+  on *connect-level* failures, where the request provably never reached
+  the service.
+- Every retry sleeps a jittered exponential backoff and draws from a
+  per-phase time budget (`--svcretrybudget`) so a dying host converges to
+  an error instead of retrying forever.
+"""
+
+from __future__ import annotations
+
+import http.client
+import random
+from dataclasses import dataclass
+
+#: HTTP statuses the control plane treats as transient, mirroring
+#: s3_tk.S3Client._RETRY_STATUSES (+504 for intermediary timeouts)
+TRANSIENT_HTTP_STATUSES = (500, 502, 503, 504, 429)
+
+#: exception types a control-plane exchange may raise transiently: every
+#: socket-level failure is an OSError (incl. ConnectionError/timeout);
+#: http.client.HTTPException covers half-closed sockets returning a
+#: malformed status line (BadStatusLine), truncated bodies
+#: (IncompleteRead), and over-long/garbage header replies
+TRANSIENT_EXCEPTIONS = (OSError, http.client.HTTPException)
+
+
+class ConnectFailedError(ConnectionError):
+    """TCP connect to the service failed — the request was never sent, so
+    retrying is safe even for non-idempotent requests."""
+
+
+class GarbageReplyError(http.client.HTTPException):
+    """A 200 reply whose body was not the expected JSON (fault injection:
+    bit rot / truncation behind a proxy). Safe to retry idempotently."""
+
+
+def is_transient_error(err: BaseException) -> bool:
+    """Shared classifier: would a retry plausibly succeed?"""
+    return isinstance(err, TRANSIENT_EXCEPTIONS)
+
+
+def is_connect_level_error(err: BaseException) -> bool:
+    """True when the failure happened before the request was sent (or the
+    peer provably refused it), making a retry safe for non-idempotent
+    requests too."""
+    return isinstance(err, (ConnectFailedError, ConnectionRefusedError))
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Per-request retry shape (--svcretries / --svcretrybudget)."""
+
+    num_retries: int = 3         # retries per request on top of attempt 1
+    budget_secs: float = 30.0    # per-phase backoff-sleep budget per host
+    base_delay_secs: float = 0.05
+    max_delay_secs: float = 2.0
+
+    @classmethod
+    def from_config(cls, cfg) -> "RetryPolicy":
+        return cls(num_retries=max(cfg.svc_num_retries, 0),
+                   budget_secs=max(cfg.svc_retry_budget_secs, 0))
+
+    def backoff_delay(self, attempt: int, rng: random.Random) -> float:
+        """Jittered exponential backoff: 2^attempt growth, 0.5x-1.5x
+        jitter so a fleet of masters doesn't thundering-herd a recovering
+        service."""
+        base = min(self.base_delay_secs * (2 ** attempt),
+                   self.max_delay_secs)
+        return base * (0.5 + rng.random())
+
+
+class RetryBudget:
+    """Per-phase backoff-time account. Retries across ALL requests of one
+    phase draw from it, so many individually-cheap retries against a dead
+    host still converge to an error within --svcretrybudget seconds."""
+
+    def __init__(self, budget_secs: float):
+        self.budget_secs = budget_secs
+        self.spent_secs = 0.0
+
+    def reset(self) -> None:
+        self.spent_secs = 0.0
+
+    def try_spend(self, delay_secs: float) -> bool:
+        if self.spent_secs + delay_secs > self.budget_secs:
+            return False
+        self.spent_secs += delay_secs
+        return True
+
+
+# ---------------------------------------------------------------------------
+# control-plane audit counters (per-host; master side)
+# ---------------------------------------------------------------------------
+
+#: (RemoteWorker attribute, wire/JSON key, merge mode) — the control-plane
+#: analogue of tpu.device.PATH_AUDIT_COUNTERS. "max" entries merge across
+#: hosts like the existing TpuPipeInflightHwm MAX-merge: a high-water mark
+#: summed over hosts would report an age/streak no single host ever saw.
+#: JSON-only result keys (docs/result-columns.md).
+CONTROL_AUDIT_COUNTERS = (
+    ("svc_retries", "SvcRetries", "sum"),
+    ("svc_consec_retries_hwm", "SvcConsecRetriesHwm", "max"),
+    ("svc_heartbeat_age_hwm_usec", "SvcHeartbeatAgeHwmUsec", "max"),
+)
+
+
+def merge_control_audit_counters(workers) -> dict:
+    """Merge the per-host control-plane counters over a worker list
+    (local workers contribute 0), keyed by wire/JSON name."""
+    totals = {key: 0 for _attr, key, _mode in CONTROL_AUDIT_COUNTERS}
+    for w in workers:
+        for attr, key, mode in CONTROL_AUDIT_COUNTERS:
+            val = getattr(w, attr, 0)
+            if mode == "max":
+                totals[key] = max(totals[key], val)
+            else:
+                totals[key] += val
+    return totals

@@ -8,6 +8,13 @@ adaptive cadence accumulating remote live ops into its own counters
 mergeable histograms (finishPhase :172-280), sends /interruptphase on
 error/quit. Bench-UUID hijack detection: a /status reply with an unexpected
 BenchID aborts the run (RemoteWorker.cpp:199-202).
+
+Fault tolerance (service/fault_tolerance.py + docs/fault-tolerance.md):
+transient control-plane failures retry with jittered backoff
+(--svcretries/--svcretrybudget), a stalled-progress watchdog bounds how
+long a silent host can hold a phase (--svcstalledsecs), and with
+--svctolerant N the run completes degraded when up to N hosts are lost
+mid-run instead of aborting.
 """
 
 from __future__ import annotations
@@ -15,6 +22,8 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import random
+import threading
 import time
 import urllib.parse
 
@@ -23,12 +32,21 @@ from ..phases import BenchPhase
 from ..stats.latency_histogram import LatencyHistogram
 from ..toolkits import logger
 from ..workers.base import Worker
-from ..workers.shared import (WorkerInterruptedException,
-                              WorkerRemoteException)
+from ..workers.shared import (WorkerHijackedException,
+                              WorkerInterruptedException,
+                              WorkerRemoteException,
+                              WorkerStalledException)
 from . import protocol as proto
+from .fault_tolerance import (ConnectFailedError, GarbageReplyError,
+                              RetryBudget, RetryPolicy,
+                              TRANSIENT_EXCEPTIONS, TRANSIENT_HTTP_STATUSES,
+                              is_connect_level_error, is_transient_error)
 
 DEFAULT_PORT = 1611
 CONNECT_TIMEOUT_SECS = 10
+# best-effort /interruptphase sends (teardown path): short and retry-free
+# so a dead host can't stall the shutdown of the survivors
+INTERRUPT_TIMEOUT_SECS = 3
 # adaptive /status cadence: start fast for short phases, back off to the
 # configured --svcupint (reference: 25ms -> 500ms, RemoteWorker.cpp:447+)
 POLL_MIN_SECS = 0.025
@@ -43,15 +61,42 @@ def split_host_port(host: str, default_port: int = DEFAULT_PORT
 
 
 class ServiceClient:
-    """Minimal HTTP/JSON client for one service host."""
+    """HTTP/JSON client for one service host with transient-failure
+    retries (shared idiom with the S3 data plane's retry strategy,
+    s3_tk.S3Client.request)."""
 
-    def __init__(self, host: str, default_port: int, pw_hash: str = ""):
+    def __init__(self, host: str, default_port: int, pw_hash: str = "",
+                 retry_policy: "RetryPolicy | None" = None,
+                 interrupt_check=None):
         self.hostname, self.port = split_host_port(host, default_port)
         self.pw_hash = pw_hash
+        self.retry_policy = retry_policy or RetryPolicy(num_retries=0,
+                                                        budget_secs=0.0)
+        self.retry_budget = RetryBudget(self.retry_policy.budget_secs)
+        self.interrupt_check = interrupt_check
+        # deterministic per-host jitter stream (reproducible chaos runs)
+        self._rng = random.Random(f"{self.hostname}:{self.port}")
+        # control-plane audit counters (fault_tolerance.py schema)
+        self.total_retries = 0
+        self.consec_retries = 0
+        self.consec_retries_hwm = 0
+
+    def reset_phase_accounting(self) -> None:
+        """New phase: fresh retry budget + per-phase counters."""
+        self.retry_budget.reset()
+        self.total_retries = 0
+        self.consec_retries = 0
+        self.consec_retries_hwm = 0
+
+    def _host_label(self) -> str:
+        return f"{self.hostname}:{self.port}"
 
     def _request(self, method: str, path: str, params: "dict | None" = None,
                  body: "bytes | None" = None,
                  timeout: float = CONNECT_TIMEOUT_SECS):
+        """One raw exchange. A failure to even reach the service raises
+        ConnectFailedError so the retry layer knows the request was never
+        sent (safe to retry non-idempotent requests)."""
         params = dict(params or {})
         if self.pw_hash:
             params[proto.KEY_AUTHORIZATION] = self.pw_hash
@@ -60,6 +105,12 @@ class ServiceClient:
         conn = http.client.HTTPConnection(self.hostname, self.port,
                                           timeout=timeout)
         try:
+            try:
+                conn.connect()
+            except OSError as err:
+                raise ConnectFailedError(
+                    f"connect to {self._host_label()} failed: {err}") \
+                    from err
             conn.request(method, path, body=body)
             resp = conn.getresponse()
             data = resp.read()
@@ -67,23 +118,114 @@ class ServiceClient:
         finally:
             conn.close()
 
+    # -- retrying core ------------------------------------------------------
+
+    def _exchange_retry(self, method: str, path: str,
+                        params: "dict | None" = None,
+                        body: "bytes | None" = None,
+                        timeout: float = CONNECT_TIMEOUT_SECS,
+                        idempotent: bool = True,
+                        deadline: "float | None" = None,
+                        parse_json: bool = True):
+        """(status, payload) with transient-error retries.
+
+        Idempotent requests retry on any transient failure including
+        retryable HTTP statuses and garbage 200-replies; non-idempotent
+        ones only on connect-level failures. Each retry sleeps a jittered
+        exponential backoff drawn from the per-phase budget; an optional
+        deadline (the stall watchdog) caps the whole exchange. On
+        exhaustion the last transient status is returned for the caller's
+        contextual error message, while transport errors raise
+        WorkerRemoteException with host context.
+        """
+        policy = self.retry_policy
+        attempt = 0
+        while True:
+            if self.interrupt_check is not None:
+                self.interrupt_check()
+            err: "BaseException | None" = None
+            status, payload = 0, {}
+            try:
+                status, data = self._request(method, path, params, body,
+                                             timeout=timeout)
+                if parse_json:
+                    try:
+                        payload = json.loads(data) if data else {}
+                    except json.JSONDecodeError:
+                        payload = {"raw": data.decode(errors="replace")}
+                        if status == 200:
+                            # a mangled OK reply is indistinguishable from
+                            # line noise — retryable, never trustable
+                            err = GarbageReplyError(
+                                f"undecodable JSON reply from "
+                                f"{self._host_label()}")
+                else:
+                    payload = data
+            except TRANSIENT_EXCEPTIONS as req_err:
+                err = req_err
+            if err is None and status in TRANSIENT_HTTP_STATUSES \
+                    and idempotent:
+                err = http.client.HTTPException(
+                    f"transient HTTP {status} from {self._host_label()}")
+                # keep last payload/status: returned on retry exhaustion
+            if err is None:
+                self.consec_retries = 0
+                return status, payload
+            retryable = is_transient_error(err) and (
+                idempotent or is_connect_level_error(err))
+            delay = policy.backoff_delay(attempt, self._rng)
+            if (not retryable) or attempt >= policy.num_retries \
+                    or (deadline is not None
+                        and time.monotonic() + delay >= deadline) \
+                    or not self.retry_budget.try_spend(delay):
+                if status in TRANSIENT_HTTP_STATUSES:
+                    # the service DID answer; hand the status back so the
+                    # caller raises its own contextual error
+                    return status, payload
+                raise WorkerRemoteException(
+                    f"service {self._host_label()}: {method} {path} "
+                    f"failed: {type(err).__name__}: {err}") from err
+            attempt += 1
+            self.total_retries += 1
+            self.consec_retries += 1
+            self.consec_retries_hwm = max(self.consec_retries_hwm,
+                                          self.consec_retries)
+            logger.log(logger.LOG_VERBOSE,
+                       f"retrying {method} {path} on "
+                       f"{self._host_label()} in {delay * 1000:.0f}ms "
+                       f"(attempt {attempt}/{policy.num_retries}: "
+                       f"{type(err).__name__}: {err})")
+            time.sleep(delay)
+
+    # -- public request surface --------------------------------------------
+
     def get_json(self, path: str, params: "dict | None" = None,
-                 timeout: float = CONNECT_TIMEOUT_SECS) -> "tuple[int, dict]":
-        status, data = self._request("GET", path, params, timeout=timeout)
-        try:
-            return status, (json.loads(data) if data else {})
-        except json.JSONDecodeError:
-            return status, {"raw": data.decode(errors="replace")}
+                 timeout: float = CONNECT_TIMEOUT_SECS,
+                 idempotent: bool = True,
+                 deadline: "float | None" = None) -> "tuple[int, dict]":
+        return self._exchange_retry("GET", path, params, timeout=timeout,
+                                    idempotent=idempotent,
+                                    deadline=deadline)
 
     def post_json(self, path: str, obj, params: "dict | None" = None,
-                  timeout: float = 60.0) -> "tuple[int, dict]":
+                  timeout: float = 60.0,
+                  idempotent: bool = False) -> "tuple[int, dict]":
         body = json.dumps(obj).encode()
-        status, data = self._request("POST", path, params, body=body,
-                                     timeout=timeout)
-        try:
-            return status, (json.loads(data) if data else {})
-        except json.JSONDecodeError:
-            return status, {"raw": data.decode(errors="replace")}
+        return self._exchange_retry("POST", path, params, body,
+                                    timeout=timeout, idempotent=idempotent)
+
+    def get_raw(self, path: str, params: "dict | None" = None,
+                timeout: float = CONNECT_TIMEOUT_SECS
+                ) -> "tuple[int, bytes]":
+        return self._exchange_retry("GET", path, params, timeout=timeout,
+                                    idempotent=True, parse_json=False)
+
+    def post_raw(self, path: str, params: "dict | None", body: bytes,
+                 timeout: float = 60.0, idempotent: bool = True
+                 ) -> "tuple[int, bytes]":
+        return self._exchange_retry("POST", path, params, body,
+                                    timeout=timeout, idempotent=idempotent,
+                                    parse_json=False)
 
 
 class RemoteWorker(Worker):
@@ -93,14 +235,36 @@ class RemoteWorker(Worker):
         self.host = host
         self.host_idx = host_idx
         self.last_ping_usec = 0  # --svcping: last /status RTT
+        self.degraded = False    # --svctolerant: host lost mid-run
+        # control-plane audit counters (CONTROL_AUDIT_COUNTERS schema)
+        self.svc_retries = 0
+        self.svc_consec_retries_hwm = 0
+        self.svc_heartbeat_age_hwm_usec = 0
         pw_hash = ""
         if self.cfg.svc_password_file:
             pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
-        self.client = ServiceClient(host, self.cfg.service_port, pw_hash)
+        self.client = ServiceClient(
+            host, self.cfg.service_port, pw_hash,
+            retry_policy=RetryPolicy.from_config(self.cfg),
+            interrupt_check=self.check_interruption_flag_only)
         self.num_remote_threads = self.cfg.num_threads
         self._expected_bench_id = ""
 
     # ------------------------------------------------------------------
+
+    def reset_stats(self) -> None:
+        super().reset_stats()
+        self.client.reset_phase_accounting()
+        self.svc_retries = 0
+        self.svc_consec_retries_hwm = 0
+        self.svc_heartbeat_age_hwm_usec = 0
+        if self.degraded:
+            # a lost host stays excluded from all later phase results
+            self.got_phase_work = False
+
+    def _sync_control_counters(self) -> None:
+        self.svc_retries = self.client.total_retries
+        self.svc_consec_retries_hwm = self.client.consec_retries_hwm
 
     def run(self) -> None:
         self._check_protocol_version()
@@ -119,35 +283,51 @@ class RemoteWorker(Worker):
                 self._start_remote_phase(phase, last_uuid)
                 self._poll_until_done(phase)
                 self._finish_phase_remote()
+                self._sync_control_counters()
                 self.shared.inc_num_workers_done()
             except WorkerInterruptedException:
                 self._interrupt_remote(quit_service=False)
+                self._sync_control_counters()
                 self.shared.inc_num_workers_done()
+            except WorkerHijackedException as err:
+                # bench-UUID hijack stays a hard abort: two masters on one
+                # service corrupt BOTH runs, no degraded completion
+                logger.log_error(f"Remote worker for {self.host} failed: "
+                                 f"{err}")
+                self._interrupt_remote(quit_service=False)
+                self.shared.inc_num_workers_done_with_error(err)
             except Exception as err:  # noqa: BLE001
                 logger.log_error(f"Remote worker for {self.host} failed: "
                                  f"{err}")
                 self._interrupt_remote(quit_service=False)
+                self._sync_control_counters()
+                if self.shared.try_degrade_worker(self, err):
+                    logger.log_error(
+                        f"service {self.host} lost mid-run; completing "
+                        f"phase with survivors (--svctolerant, results "
+                        f"marked degraded)")
+                    return  # host dropped for the rest of the run
                 self.shared.inc_num_workers_done_with_error(err)
 
     # ------------------------------------------------------------------
 
     def _check_protocol_version(self) -> None:
-        status, data = self.client._request("GET",
-                                            proto.PATH_PROTOCOL_VERSION)
-        remote = data.decode().strip().strip('"')
+        status, data = self.client.get_raw(proto.PATH_PROTOCOL_VERSION)
+        remote = data.decode(errors="replace").strip().strip('"')
         if status != 200 or remote != HTTP_PROTOCOL_VERSION:
             raise WorkerRemoteException(
                 f"service {self.host} protocol version mismatch: "
                 f"{remote!r} != {HTTP_PROTOCOL_VERSION!r}")
 
     def _prepare_remote_files(self) -> None:
-        """Upload treefile to the service (reference: :288-345)."""
+        """Upload treefile to the service (reference: :288-345).
+        Idempotent: re-uploading simply overwrites the stored file."""
         if not self.cfg.tree_file_path:
             return
         with open(self.cfg.tree_file_path, "rb") as f:
             body = f.read()
-        status, data = self.client._request(
-            "POST", proto.PATH_PREPARE_FILE, {
+        status, data = self.client.post_raw(
+            proto.PATH_PREPARE_FILE, {
                 proto.KEY_FILE_NAME:
                     os.path.basename(self.cfg.tree_file_path)}, body)
         if status != 200:
@@ -157,11 +337,13 @@ class RemoteWorker(Worker):
     def _prepare_phase_remote(self) -> None:
         """POST the full effective config with this host's rank offset
         (reference: preparePhase :354-407; rank offset = hostIdx * threads,
-        ProgArgs.cpp:3921)."""
+        ProgArgs.cpp:3921). Non-idempotent (rebuilds the remote worker
+        pool): retried on connect-level failures only."""
         cfg_dict = self.cfg.to_service_dict(
             service_rank_offset=self.host_idx * self.cfg.num_threads)
         status, reply = self.client.post_json(proto.PATH_PREPARE_PHASE,
-                                              cfg_dict, timeout=300.0)
+                                              cfg_dict, timeout=300.0,
+                                              idempotent=False)
         self._replay_error_history(reply)
         if status != 200:
             raise WorkerRemoteException(
@@ -173,7 +355,7 @@ class RemoteWorker(Worker):
         self._expected_bench_id = bench_id
         status, reply = self.client.get_json(proto.PATH_START_PHASE, {
             proto.KEY_PHASE_CODE: int(phase),
-            proto.KEY_BENCH_ID: bench_id})
+            proto.KEY_BENCH_ID: bench_id}, idempotent=False)
         if status != 200:
             raise WorkerRemoteException(
                 f"phase start on {self.host} failed: "
@@ -182,23 +364,59 @@ class RemoteWorker(Worker):
     def _poll_until_done(self, phase: BenchPhase) -> None:
         """Poll /status, mirroring remote live totals into this worker's
         counters so the master's live stats aggregate naturally
-        (reference: waitForBenchPhaseCompletion :447-560)."""
+        (reference: waitForBenchPhaseCompletion :447-560).
+
+        Stall watchdog (--svcstalledsecs): when the service's live
+        counters stop advancing — or the service stops answering — for
+        longer than the window, the host is declared stalled instead of
+        holding the phase barrier forever."""
         interval = POLL_MIN_SECS
         max_interval = max(self.cfg.svc_update_interval_ms, 25) / 1000.0
+        stalled_secs = max(self.cfg.svc_stalled_secs, 0)
+        # bound the per-poll read block so a hung socket can't blow
+        # through the stall window before the watchdog gets to look
+        poll_timeout = min(CONNECT_TIMEOUT_SECS, stalled_secs) \
+            if stalled_secs else CONNECT_TIMEOUT_SECS
+        # two separate baselines: last_success (last answered /status)
+        # drives the unreachable trip and the retry deadline, so a
+        # legitimately idle host — e.g. a post-stonewall straggler whose
+        # counters sit still — keeps its full retry window; last_progress
+        # (last counter advance) drives only the static-counter trip
+        last_progress = last_success = time.monotonic()
+        last_counters = None
         while True:
             self.check_interruption_request(force=True)
+            deadline = (last_success + stalled_secs) if stalled_secs \
+                else None
             t0 = time.monotonic()
-            status, stats = self.client.get_json(proto.PATH_STATUS)
+            try:
+                status, stats = self.client.get_json(
+                    proto.PATH_STATUS, timeout=poll_timeout,
+                    deadline=deadline)
+            except WorkerRemoteException as err:
+                if stalled_secs \
+                        and time.monotonic() - last_success >= stalled_secs:
+                    raise WorkerStalledException(
+                        f"service {self.host} stalled: no reachable "
+                        f"status for {stalled_secs}s "
+                        f"(--svcstalledsecs)") from err
+                raise
+            now = time.monotonic()
             # --svcping: the /status round-trip IS the service ping
             # (reference fullscreen shows per-service latency, --svcping)
-            self.last_ping_usec = int((time.monotonic() - t0) * 1e6)
+            self.last_ping_usec = int((now - t0) * 1e6)
+            # heartbeat age: gap between successive successful polls
+            self.svc_heartbeat_age_hwm_usec = max(
+                self.svc_heartbeat_age_hwm_usec,
+                int((now - last_success) * 1e6))
+            last_success = now
             if status != 200:
                 raise WorkerRemoteException(
                     f"status poll on {self.host} failed ({status})")
             got_id = stats.get(proto.KEY_BENCH_ID, "")
             if got_id and self._expected_bench_id \
                     and got_id != self._expected_bench_id:
-                raise WorkerRemoteException(
+                raise WorkerHijackedException(
                     f"service {self.host} was hijacked by another master "
                     f"(bench UUID mismatch)")  # reference: :199-202
             self.live_ops.num_entries_done = \
@@ -214,6 +432,20 @@ class RemoteWorker(Worker):
             done = stats.get(proto.KEY_NUM_WORKERS_DONE, 0)
             if done >= self.num_remote_threads:
                 return
+            counters = (self.live_ops.num_entries_done,
+                        self.live_ops.num_bytes_done,
+                        self.live_ops.num_iops_done, done)
+            if counters != last_counters:
+                last_counters = counters
+                last_progress = now
+            elif stalled_secs and not self.shared.stonewall_triggered \
+                    and now - last_progress >= stalled_secs:
+                # counters froze while the service still answers; with a
+                # stonewall in effect straggler counters may legitimately
+                # idle, so the static-counter trip is gated on it
+                raise WorkerStalledException(
+                    f"service {self.host} stalled: live counters static "
+                    f"for {stalled_secs}s (--svcstalledsecs)")
             time.sleep(interval)
             interval = min(interval * 2, max_interval)
 
@@ -302,11 +534,18 @@ class RemoteWorker(Worker):
         self.got_phase_work = bool(self.elapsed_usec_vec)
 
     def _interrupt_remote(self, quit_service: bool) -> None:
+        """Best effort, deliberately BELOW the retry layer: the service may
+        already be gone, and burning --svcretries x timeout here serializes
+        into teardown (error handler + TERMINATE both interrupt), stalling
+        the whole run on a dead host. TRANSIENT_EXCEPTIONS is the shared
+        classifier: a half-closed socket's malformed status line
+        (HTTPException) must not escape and mask the original failure."""
         params = {proto.KEY_INTERRUPT_QUIT: "1"} if quit_service else {}
         try:
-            self.client.get_json(proto.PATH_INTERRUPT_PHASE, params)
-        except OSError:
-            pass  # service may already be gone
+            self.client._request("GET", proto.PATH_INTERRUPT_PHASE, params,
+                                 timeout=INTERRUPT_TIMEOUT_SECS)
+        except TRANSIENT_EXCEPTIONS:
+            pass  # service may already be gone (best effort)
 
 
 # ---------------------------------------------------------------------------
@@ -315,21 +554,43 @@ class RemoteWorker(Worker):
 
 def wait_for_services_ready(hosts: "list[str]", default_port: int,
                             wait_secs: int) -> None:
+    """Probe all hosts CONCURRENTLY against the shared --svcwait deadline
+    (a slow first host used to eat the whole budget of the hosts after
+    it) and report every unreachable host at once."""
     deadline = time.monotonic() + max(wait_secs, 0)
-    for host in hosts:
+    unreachable: "dict[str, str]" = {}
+    lock = threading.Lock()
+
+    def probe(host: str) -> None:
         client = ServiceClient(host, default_port)
+        last_err = "no reply"
         while True:
             try:
                 status, _ = client.get_json(proto.PATH_STATUS, timeout=3)
                 if status in (200, 401):
-                    break
-            except OSError:
-                pass
+                    return
+                last_err = f"HTTP {status}"
+            except WorkerRemoteException as err:
+                last_err = str(err)
             if time.monotonic() >= deadline:
-                raise WorkerRemoteException(
-                    f"service {host} not reachable "
-                    f"(--svcwait to extend the wait)")
+                with lock:
+                    unreachable[host] = last_err
+                return
             time.sleep(1)
+
+    threads = [threading.Thread(target=probe, args=(h,), daemon=True,
+                                name=f"svc-probe-{h}") for h in hosts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        # margin over the shared deadline: a probe returns right after its
+        # own deadline check, so this only guards against pathological hangs
+        t.join(timeout=max(deadline - time.monotonic(), 0) + 10)
+    if unreachable:
+        details = "; ".join(f"{h}: {e}" for h, e in unreachable.items())
+        raise WorkerRemoteException(
+            f"service(s) not reachable (--svcwait to extend the wait): "
+            f"{details}")
 
 
 def send_interrupt_to_hosts(hosts: "list[str]", default_port: int,
@@ -342,5 +603,7 @@ def send_interrupt_to_hosts(hosts: "list[str]", default_port: int,
         try:
             client.get_json(proto.PATH_INTERRUPT_PHASE, params)
             logger.log(0, f"sent {'quit' if quit else 'interrupt'} to {host}")
-        except OSError as err:
+        except (WorkerRemoteException, *TRANSIENT_EXCEPTIONS) as err:
+            # OSError alone used to let a half-closed socket's malformed
+            # status line (HTTPException) escape and mask the real failure
             logger.log_error(f"could not reach {host}: {err}")

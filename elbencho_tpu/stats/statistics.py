@@ -61,6 +61,10 @@ class PhaseResults:
         self.tpu_path_counters: "dict[str, int]" = {
             key: 0 for _attr, key, _ingest in PATH_AUDIT_COUNTERS}
         self.num_workers = 0
+        # --svctolerant: hosts lost mid-run (results exclude them)
+        self.degraded_hosts: "list[str]" = []
+        # control-plane audit (fault_tolerance.CONTROL_AUDIT_COUNTERS)
+        self.control_counters: "dict[str, int]" = {}
 
 
 class Statistics:
@@ -106,6 +110,7 @@ class Statistics:
             time.sleep(0.02)  # fine-grained poll so short phases don't stall
             if phase_start is not None:
                 self.manager.check_phase_time_limit(phase_start)
+            self.manager.check_fail_fast_interrupt()
             if time.monotonic() < next_render:
                 continue
             next_render = time.monotonic() + interval
@@ -371,6 +376,10 @@ class Statistics:
                     b, u = res.tpu_per_chip.get(chip, (0, 0))
                     res.tpu_per_chip[chip] = (b + b2, u + u2)
         res.tpu_path_counters = sum_path_audit_counters(workers)
+        from ..service.fault_tolerance import merge_control_audit_counters
+        res.control_counters = merge_control_audit_counters(
+            self.manager.workers)
+        res.degraded_hosts = list(self.manager.shared.degraded_hosts)
         stonewall_elapsed = [w.stonewall_elapsed_usec for w in workers
                              if w.stonewall_taken]
         res.first_done_usec = min(res.elapsed_usec_vec, default=0)
@@ -502,6 +511,13 @@ class Statistics:
                                  f"{_fmt_elapsed_usec(max(w.elapsed_usec_vec))}")
             if parts:
                 rows.append(f"{'':12}Service elapsed  : {', '.join(parts)}")
+        if res.degraded_hosts:
+            # loud, unmissable: these numbers exclude lost hosts and must
+            # never be read as a clean run (--svctolerant)
+            rows.append(
+                f"{'':12}{'DEGRADED hosts :':<20}"
+                f"{', '.join(res.degraded_hosts)} "
+                f"(lost mid-run; results cover survivors only)")
         if not cfg.ignore_0usec_errors and res.num_workers \
                 and res.first_done_usec == 0:
             # reference semantics (Statistics.cpp:2186): warn when the
@@ -566,6 +582,11 @@ class Statistics:
                            for k, (b, u) in res.tpu_per_chip.items()},
             # H2D/D2H path audit, keyed by PATH_AUDIT_COUNTERS
             **res.tpu_path_counters,
+            # --svctolerant: hosts lost mid-run (count in CSV; the host
+            # list + control-plane audit counters are JSON-only)
+            "NumHostsDegraded": len(res.degraded_hosts),
+            "DegradedHosts": list(res.degraded_hosts),
+            **res.control_counters,
         }
         # unconditional so CSV rows keep a fixed column count
         rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
@@ -583,7 +604,7 @@ class Statistics:
         "CPUUtilStoneWall", "CPUUtil", "IOLatUSecMin", "IOLatUSecAvg",
         "IOLatUSecMax", "IOLatUSecP99", "EntLatUSecMin", "EntLatUSecAvg",
         "EntLatUSecMax", "TpuHbmBytes", "TpuHbmMiBPerSec",
-        "TpuDispatchUSec", "TpuTransferUSec",
+        "TpuDispatchUSec", "TpuTransferUSec", "NumHostsDegraded",
         "RWMixReadIOPSLast", "RWMixReadMiBPerSecLast")
 
     @classmethod
@@ -632,9 +653,13 @@ class Statistics:
             return f.readline().rstrip("\n").count(",") == expected
 
     def _write_csv(self, res: PhaseResults) -> None:
+        from ..service.fault_tolerance import CONTROL_AUDIT_COUNTERS
         rec = self._result_record(res)
         rec.pop("TpuPerChip")
         for _attr, key, _ingest in PATH_AUDIT_COUNTERS:  # JSON-only keys
+            rec.pop(key)
+        rec.pop("DegradedHosts")  # list is JSON-only; the count stays CSV
+        for _attr, key, _mode in CONTROL_AUDIT_COUNTERS:  # JSON-only keys
             rec.pop(key)
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()

@@ -1,0 +1,225 @@
+"""Fault-injection TCP/HTTP proxy for control-plane chaos testing.
+
+Interposes between the master and a service instance (master --hosts
+points at the proxy's port) and injects, per a seeded schedule, the
+failure modes a flaky fleet produces: connection drops, response delays,
+5xx replies, truncated bodies, and garbage JSON — so the retry/watchdog/
+degradation paths in `service/remote_worker.py` can be driven end-to-end
+through the REAL master code path (tests/test_fault_tolerance.py).
+
+The master's ServiceClient opens one HTTP connection per request, so a
+proxy connection corresponds 1:1 to a control-plane request; the proxy
+parses the request head, which lets fault rules target specific endpoints
+(e.g. fault only idempotent `/status` polls).
+
+Loopback only, short timeouts — tier-1-safe by design.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+from dataclasses import dataclass, field
+
+#: fault kinds a rule may inject
+FAULTS = ("drop", "error500", "garbage", "truncate", "delay", "hang")
+
+_CANNED_500 = (b"HTTP/1.1 500 Internal Server Error\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Content-Length: 35\r\n\r\n"
+               b'{"Error": "injected fault: error"}\n')
+_GARBAGE_200 = (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: 24\r\n\r\n"
+                b'{"NumWorkers### garbage!')
+
+
+@dataclass
+class FaultRule:
+    """One injection rule; rules are evaluated in order, first match wins.
+
+    ``path`` substring-matches the request path ("" = any). A request
+    matches the rule when its per-rule match counter exceeds
+    ``skip_first`` and then either hits ``every_nth`` (1 = every match)
+    or the seeded coin with probability ``prob`` comes up. ``max_faults``
+    caps total injections of the rule (0 = unlimited).
+    """
+
+    fault: str
+    path: str = ""
+    every_nth: int = 0
+    prob: float = 0.0
+    skip_first: int = 0
+    max_faults: int = 0
+    delay_secs: float = 0.25
+    _matches: int = field(default=0, repr=False)
+    _injected: int = field(default=0, repr=False)
+
+    def __post_init__(self):
+        if self.fault not in FAULTS:
+            raise ValueError(f"unknown fault {self.fault!r} "
+                             f"(expected one of {FAULTS})")
+
+
+class FaultSchedule:
+    """Deterministic (seeded) rule evaluation, shared by all proxy
+    connections of one test run."""
+
+    def __init__(self, rules: "list[FaultRule]", seed: int = 0):
+        self.rules = list(rules)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def fault_for(self, method: str, path: str) -> "FaultRule | None":
+        with self._lock:
+            for rule in self.rules:
+                if rule.path and rule.path not in path:
+                    continue
+                rule._matches += 1
+                if rule._matches <= rule.skip_first:
+                    continue
+                if rule.max_faults and rule._injected >= rule.max_faults:
+                    continue
+                hit = (rule.every_nth
+                       and (rule._matches - rule.skip_first)
+                       % rule.every_nth == 0) \
+                    or (rule.prob and self._rng.random() < rule.prob)
+                if hit:
+                    rule._injected += 1
+                    return rule
+        return None
+
+
+def _recv_http_message(sock: socket.socket, timeout: float = 10.0) -> bytes:
+    """One full HTTP message (head + Content-Length body) off a socket.
+    Returns b"" when the peer closed before sending a head."""
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return b""
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    content_len = 0
+    for line in head.split(b"\r\n")[1:]:
+        name, _, value = line.partition(b":")
+        if name.strip().lower() == b"content-length":
+            content_len = int(value.strip())
+    while len(rest) < content_len:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest
+
+
+class FaultProxy:
+    """One proxy instance in front of one service port. Context manager:
+
+        with FaultProxy(svc_port, FaultSchedule([...])) as proxy:
+            run_master(hosts=f"127.0.0.1:{proxy.port}")
+            assert proxy.injected
+
+    ``injected`` records (conn_idx, fault, path) per injection.
+    """
+
+    def __init__(self, target_port: int, schedule: FaultSchedule,
+                 target_host: str = "127.0.0.1"):
+        self.target_host = target_host
+        self.target_port = target_port
+        self.schedule = schedule
+        self.injected: "list[tuple[int, str, str]]" = []
+        self.num_connections = 0
+        self.port = 0
+        self._listener: "socket.socket | None" = None
+        self._stop = threading.Event()
+        self._threads: "list[threading.Thread]" = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "FaultProxy":
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"fault-proxy-{self.port}")
+        self._threads.append(t)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.close()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "FaultProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- connection handling ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            idx = self.num_connections
+            self.num_connections += 1
+            t = threading.Thread(target=self._handle, args=(conn, idx),
+                                 daemon=True,
+                                 name=f"fault-proxy-conn-{idx}")
+            self._threads.append(t)
+            t.start()
+
+    def _handle(self, client: socket.socket, idx: int) -> None:
+        upstream = None
+        try:
+            request = _recv_http_message(client)
+            if not request:
+                return
+            first_line = request.split(b"\r\n", 1)[0].decode(
+                errors="replace")
+            parts = first_line.split()
+            method = parts[0] if parts else ""
+            path = parts[1] if len(parts) > 1 else ""
+            rule = self.schedule.fault_for(method, path)
+            if rule is not None:
+                self.injected.append((idx, rule.fault, path))
+                if rule.fault == "drop":
+                    return  # close without a reply: RST/EOF at the master
+                if rule.fault == "hang":
+                    # accept the request, never answer (SIGSTOP-alike);
+                    # released when the proxy stops
+                    self._stop.wait(timeout=60)
+                    return
+                if rule.fault == "error500":
+                    client.sendall(_CANNED_500)
+                    return
+                if rule.fault == "garbage":
+                    client.sendall(_GARBAGE_200)
+                    return
+                if rule.fault == "delay":
+                    self._stop.wait(timeout=rule.delay_secs)
+            upstream = socket.create_connection(
+                (self.target_host, self.target_port), timeout=10)
+            upstream.sendall(request)
+            response = _recv_http_message(upstream)
+            if rule is not None and rule.fault == "truncate":
+                client.sendall(response[:max(len(response) // 2, 1)])
+                return
+            client.sendall(response)
+        except OSError:
+            pass  # a torn-down test peer is not a proxy error
+        finally:
+            if upstream is not None:
+                upstream.close()
+            client.close()

@@ -27,6 +27,7 @@ class WorkerManager:
         self.workers: list = []
         self.threads: "list[threading.Thread]" = []
         self._shared_fds: "list[int]" = []
+        self._error_interrupt_sent = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -123,8 +124,21 @@ class WorkerManager:
 
     def start_next_phase(self, phase: BenchPhase) -> str:
         for worker in self.workers:
-            worker.reset_stats()
+            worker.reset_stats()  # keeps degraded hosts excluded
+        self._error_interrupt_sent = False
         return self.shared.start_phase(phase)
+
+    def check_fail_fast_interrupt(self) -> None:
+        """True fail-fast: the moment one worker errors out, interrupt the
+        survivors instead of letting them run the phase to completion
+        before the error surfaces (an --infloop phase would otherwise
+        hide a dead host until the time limit). Called from the
+        live-stats poll loop and the done-wait loop, like the time-limit
+        check. Degraded hosts (--svctolerant) do NOT count as errors."""
+        if self.shared.num_workers_done_with_error \
+                and not self._error_interrupt_sent:
+            self._error_interrupt_sent = True
+            self.interrupt_and_notify_workers()
 
     def check_phase_time_limit(self, phase_start: float) -> None:
         """--timelimit enforcement; called from the live-stats poll loop and
@@ -143,11 +157,15 @@ class WorkerManager:
         shared = self.shared
         with shared.cond:
             while True:
+                # degraded hosts (--svctolerant) dropped out of the run;
+                # the barrier completes with the survivors
                 total = shared.num_workers_done \
-                    + shared.num_workers_done_with_error
+                    + shared.num_workers_done_with_error \
+                    + shared.num_workers_degraded
                 if total >= len(self.workers):
                     break
                 self.check_phase_time_limit(phase_start)
+                self.check_fail_fast_interrupt()
                 shared.cond.wait(WAIT_WAKEUP_SECS)
             shared.cpu_util_last_done = shared.cpu_util.update()
             if shared.num_workers_done_with_error:
@@ -156,7 +174,8 @@ class WorkerManager:
     def all_workers_done(self) -> bool:
         shared = self.shared
         return (shared.num_workers_done
-                + shared.num_workers_done_with_error) >= len(self.workers)
+                + shared.num_workers_done_with_error
+                + shared.num_workers_degraded) >= len(self.workers)
 
     def interrupt_and_notify_workers(self) -> None:
         if self.shared.rwmix_balancer is not None:

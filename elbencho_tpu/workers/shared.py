@@ -30,6 +30,18 @@ class WorkerRemoteException(WorkerException):
     """Error reported by a remote service instance."""
 
 
+class WorkerStalledException(WorkerRemoteException):
+    """The --svcstalledsecs watchdog declared a remote host stalled: its
+    live counters stopped advancing (or it stopped answering /status)
+    for longer than the configured window."""
+
+
+class WorkerHijackedException(WorkerRemoteException):
+    """A /status reply carried an unexpected bench UUID: another master
+    took over the service. Always a hard abort — never degraded
+    (reference: RemoteWorker.cpp:199-202)."""
+
+
 class WorkersSharedData:
     def __init__(self, config):
         self.config = config
@@ -40,6 +52,10 @@ class WorkersSharedData:
         self.phase_start_wall: float = 0.0
         self.num_workers_done = 0
         self.num_workers_done_with_error = 0
+        # --svctolerant: hosts lost mid-run and dropped from the barrier;
+        # persists across phases (a lost host stays lost for the run)
+        self.num_workers_degraded = 0
+        self.degraded_hosts: "list[str]" = []
         self.stonewall_triggered = False
         self.interrupt_requested = False
         self.phase_time_expired = False
@@ -100,6 +116,35 @@ class WorkersSharedData:
                 self.first_error = err
             self.num_workers_done_with_error += 1
             self.cond.notify_all()
+
+    def try_degrade_worker(self, worker, err: Exception) -> bool:
+        """--svctolerant N: drop a failed remote host from the done-barrier
+        accounting instead of failing the run, as long as at most N hosts
+        have been lost. Returns True when the worker was degraded (its
+        thread must exit); False keeps today's fail-fast behavior.
+
+        Deliberately NOT a stonewall trigger and NOT an error count: a
+        degraded phase completes with the survivors, and the results are
+        marked via degraded_hosts so a degraded number can never
+        masquerade as a clean one (stats/statistics.py)."""
+        tolerant = getattr(self.config, "svc_tolerant_hosts", 0)
+        host = getattr(worker, "host", None)
+        if tolerant <= 0 or host is None:
+            return False
+        with self.cond:
+            # accounting is per WORKER, not per host string: with a
+            # duplicated --hosts entry each worker must still draw from
+            # the tolerance cap and bump the barrier count, or the
+            # done-barrier never completes
+            if not worker.degraded:
+                if self.num_workers_degraded >= tolerant:
+                    return False
+                self.degraded_hosts.append(host)
+                self.num_workers_degraded += 1
+                worker.degraded = True
+            worker.got_phase_work = False
+            self.cond.notify_all()
+        return True
 
     # -- interruption -------------------------------------------------------
 

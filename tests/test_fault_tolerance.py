@@ -1,0 +1,386 @@
+"""Chaos suite: control-plane fault tolerance through the REAL master path.
+
+Drives retry (--svcretries), stall watchdog (--svcstalledsecs), and
+degraded-run completion (--svctolerant) end-to-end: real service
+processes, real master (cli.main), faults injected by
+elbencho_tpu.testing.fault_proxy or by stopping/killing service
+processes. Loopback only, short timeouts (tier-1-safe); the `chaos`
+marker lets `-m 'not chaos'` skip the whole suite.
+"""
+
+import contextlib
+import json
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from elbencho_tpu.service.fault_tolerance import (
+    RetryBudget, RetryPolicy, is_connect_level_error, is_transient_error,
+    merge_control_audit_counters)
+from elbencho_tpu.testing.fault_proxy import (FaultProxy, FaultRule,
+                                              FaultSchedule)
+from elbencho_tpu.testing.service_harness import (default_env, free_ports,
+                                                  service_procs)
+
+pytestmark = pytest.mark.chaos
+
+
+@contextlib.contextmanager
+def _services(n=2):
+    env = default_env()
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    ports = free_ports(n)
+    with service_procs(ports, env=env) as procs:
+        yield ports, procs
+
+
+def _master(args):
+    from elbencho_tpu.cli import main
+    return main(args + ["--nolive"])
+
+
+def _json_recs(path):
+    return [json.loads(ln) for ln in path.read_text().splitlines()]
+
+
+def _when_phase_active(port, action, timeout=30.0):
+    """Background thread: poll a service's /status until the WRITE phase
+    is live (bytes flowing), then run action(). Deterministic mid-phase
+    fault injection without timing races."""
+    from elbencho_tpu.phases import BenchPhase
+
+    def watch():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status", timeout=2) as r:
+                    st = json.loads(r.read())
+                if st.get("PhaseCode") == int(BenchPhase.CREATEFILES) \
+                        and st.get("NumBytesDone", 0) > 0:
+                    action()
+                    return
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# unit layer: classifier / policy / schedule determinism
+# ---------------------------------------------------------------------------
+
+def test_transient_classifier():
+    import http.client
+    assert is_transient_error(ConnectionResetError("peer"))
+    assert is_transient_error(TimeoutError("slow"))
+    assert is_transient_error(http.client.BadStatusLine("garbage"))
+    assert is_transient_error(http.client.IncompleteRead(b"x"))
+    assert not is_transient_error(ValueError("logic bug"))
+    assert is_connect_level_error(ConnectionRefusedError("down"))
+    assert not is_connect_level_error(ConnectionResetError("mid-flight"))
+
+
+def test_backoff_is_jittered_exponential_and_capped():
+    import random
+    policy = RetryPolicy(num_retries=8, base_delay_secs=0.05,
+                         max_delay_secs=2.0)
+    rng = random.Random(42)
+    delays = [policy.backoff_delay(a, rng) for a in range(8)]
+    for attempt, d in enumerate(delays):
+        base = min(0.05 * (2 ** attempt), 2.0)
+        assert base * 0.5 <= d <= base * 1.5
+    assert max(delays) <= 3.0  # cap * max jitter
+    # deterministic for a given seed (reproducible chaos runs)
+    rng2 = random.Random(42)
+    assert delays == [policy.backoff_delay(a, rng2) for a in range(8)]
+
+
+def test_retry_budget_converges():
+    budget = RetryBudget(1.0)
+    assert budget.try_spend(0.6)
+    assert not budget.try_spend(0.6)  # would exceed
+    assert budget.try_spend(0.4)
+    budget.reset()
+    assert budget.try_spend(1.0)
+
+
+def test_fault_schedule_is_deterministic_and_path_scoped():
+    def make():
+        return FaultSchedule([
+            FaultRule(fault="error500", path="/status", every_nth=2),
+            FaultRule(fault="drop", prob=0.5, max_faults=2),
+        ], seed=7)
+
+    def run(sched):
+        out = []
+        for i in range(12):
+            path = "/status" if i % 2 else "/benchresult"
+            rule = sched.fault_for("GET", path)
+            out.append(rule.fault if rule else None)
+        return out
+
+    a, b = run(make()), run(make())
+    assert a == b  # seeded => reproducible
+    assert "error500" in a
+    assert a.count("drop") <= 2  # max_faults honored
+    # the path-scoped rule never fired on /benchresult
+    s = make()
+    for _ in range(10):
+        r = s.fault_for("GET", "/benchresult")
+        assert r is None or r.fault != "error500"
+
+
+def test_degrade_accounting_is_per_worker_not_per_host():
+    """With a duplicated --hosts entry, each worker must draw from the
+    --svctolerant cap individually — a host-string-keyed cap would let a
+    second worker exit without bumping the barrier count (hang)."""
+    import types
+
+    from elbencho_tpu.workers.shared import WorkersSharedData
+    cfg = types.SimpleNamespace(svc_tolerant_hosts=1, rwmix_thr_read_pct=0)
+    shared = WorkersSharedData(cfg)
+    w1, w2 = (types.SimpleNamespace(host="10.0.0.1:1611", degraded=False,
+                                    got_phase_work=True) for _ in range(2))
+    assert shared.try_degrade_worker(w1, RuntimeError("boom"))
+    assert shared.num_workers_degraded == 1
+    assert shared.degraded_hosts == ["10.0.0.1:1611"]
+    # second worker on the SAME host string exceeds the cap: fail fast
+    assert not shared.try_degrade_worker(w2, RuntimeError("boom"))
+    assert shared.num_workers_degraded == 1
+    # re-degrading an already-dropped worker is idempotent
+    assert shared.try_degrade_worker(w1, RuntimeError("again"))
+    assert shared.num_workers_degraded == 1
+
+
+def test_control_audit_counter_merge_modes():
+    class W:  # noqa: D401 - minimal worker stand-in
+        def __init__(self, r, c, h):
+            self.svc_retries = r
+            self.svc_consec_retries_hwm = c
+            self.svc_heartbeat_age_hwm_usec = h
+
+    merged = merge_control_audit_counters(
+        [W(2, 3, 1000), W(5, 1, 8000), object()])  # local worker -> 0s
+    assert merged["SvcRetries"] == 7               # sum
+    assert merged["SvcConsecRetriesHwm"] == 3      # max
+    assert merged["SvcHeartbeatAgeHwmUsec"] == 8000  # max
+
+
+# ---------------------------------------------------------------------------
+# acceptance (a): injected transient /status faults => run completes,
+# retries logged in the result record
+# ---------------------------------------------------------------------------
+
+def test_run_survives_transient_status_faults(tmp_path):
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jsonfile = tmp_path / "res.json"
+    with _services(2) as (ports, _procs):
+        schedule1 = FaultSchedule([
+            FaultRule(fault="error500", path="/status", every_nth=3,
+                      skip_first=2),
+            FaultRule(fault="drop", path="/status", every_nth=5,
+                      skip_first=2),
+        ])
+        schedule2 = FaultSchedule([
+            FaultRule(fault="garbage", path="/status", every_nth=4,
+                      skip_first=2),
+            FaultRule(fault="truncate", path="/benchresult", max_faults=1,
+                      every_nth=1),
+        ])
+        with FaultProxy(ports[0], schedule1) as p1, \
+                FaultProxy(ports[1], schedule2) as p2:
+            hosts = f"127.0.0.1:{p1.port},127.0.0.1:{p2.port}"
+            rc = _master(["-w", "-d", "-t", "2", "-n", "1", "-N", "4",
+                          "-s", "16K", "-b", "16K", "--hosts", hosts,
+                          "--svcretries", "6", "--svcretrybudget", "60",
+                          "--jsonfile", str(jsonfile), str(bench)])
+            assert rc == 0
+            injected = p1.injected + p2.injected
+    assert injected, "proxies never injected a fault — schedule too lax"
+    recs = _json_recs(jsonfile)
+    write_rec = next(r for r in recs if r["Phase"] == "WRITE")
+    # full result despite the faults: 2 hosts x 2 threads x 4 files
+    assert write_rec["EntriesLast"] == 16
+    # retries surfaced as audit counters, and the run is NOT degraded
+    assert sum(r.get("SvcRetries", 0) for r in recs) >= 1
+    assert all(r["NumHostsDegraded"] == 0 for r in recs)
+    assert all(r["DegradedHosts"] == [] for r in recs)
+
+
+def test_prepare_phase_does_not_retry_after_send(tmp_path):
+    """Non-idempotent /preparephase must NOT be retried when the request
+    already reached the service (only connect-level failures retry):
+    a drop AFTER the proxy read the request aborts the run."""
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    with _services(1) as (ports, _procs):
+        schedule = FaultSchedule([
+            FaultRule(fault="drop", path="/preparephase", every_nth=1),
+        ])
+        with FaultProxy(ports[0], schedule) as proxy:
+            rc = _master(["-w", "-d", "-t", "1", "-n", "1", "-N", "1",
+                          "-s", "4K", "-b", "4K",
+                          "--hosts", f"127.0.0.1:{proxy.port}",
+                          "--svcretries", "5", str(bench)])
+            assert rc != 0
+            drops = [f for f in proxy.injected if f[1] == "drop"]
+            assert len(drops) == 1, \
+                "post-send drop on /preparephase must not be retried"
+
+
+# ---------------------------------------------------------------------------
+# acceptance (b): a hung service trips the stall watchdog
+# ---------------------------------------------------------------------------
+
+def test_stall_watchdog_trips_on_hung_service(tmp_path, capsys):
+    stalled_secs = 2
+    with _services(2) as (ports, procs):
+        victim = procs[1]
+        watcher = _when_phase_active(
+            ports[1], lambda: victim.send_signal(signal.SIGSTOP))
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        t0 = time.monotonic()
+        try:
+            rc = _master(["-w", "-s", "64K", "-b", "4K", "--infloop",
+                          "--timelimit", "30", "--hosts", hosts,
+                          "--svcstalledsecs", str(stalled_secs),
+                          "--svcretries", "2",
+                          str(tmp_path / "data.bin")])
+            elapsed = time.monotonic() - t0
+        finally:
+            watcher.join(timeout=5)
+            victim.send_signal(signal.SIGCONT)  # let teardown terminate it
+        assert rc != 0
+        # tripped by the watchdog, not by the 30s time limit backstop
+        assert elapsed < 25, f"watchdog too slow: {elapsed:.1f}s"
+        assert "stalled" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# acceptance (c): --svctolerant completes degraded; default still fails fast
+# ---------------------------------------------------------------------------
+
+def _run_with_midphase_kill(tmp_path, extra_args, jsonfile):
+    """-w --infloop file-mode run over 2 services; the second service is
+    SIGKILLed as soon as the write phase is live. Returns the master's
+    rc and the hosts list."""
+    with _services(2) as (ports, procs):
+        victim = procs[1]
+        watcher = _when_phase_active(ports[1], victim.kill)
+        try:
+            rc = _master(["-w", "-s", "64K", "-b", "4K", "--infloop",
+                          "--timelimit", "3",
+                          "--hosts", ",".join(f"127.0.0.1:{p}"
+                                              for p in ports),
+                          "--svcretries", "1", "--svcretrybudget", "2",
+                          "--jsonfile", str(jsonfile)]
+                         + extra_args + [str(tmp_path / "data.bin")])
+        finally:
+            watcher.join(timeout=5)
+    return rc, [f"127.0.0.1:{p}" for p in ports]
+
+
+def test_tolerant_run_completes_degraded_with_marker(tmp_path):
+    jsonfile = tmp_path / "res.json"
+    rc, hosts = _run_with_midphase_kill(
+        tmp_path, ["--svctolerant", "1"], jsonfile)
+    assert rc == 0, "lost host within --svctolerant must not fail the run"
+    recs = _json_recs(jsonfile)
+    assert recs, "degraded run must still write result records"
+    write_rec = next(r for r in recs if r["Phase"] == "WRITE")
+    # the lost host is named, counted, and survivors-only results remain
+    assert write_rec["DegradedHosts"] == [hosts[1]]
+    assert write_rec["NumHostsDegraded"] == 1
+    assert write_rec["NumWorkers"] <= 1  # survivors only
+
+
+def test_same_fault_fails_fast_with_default_tolerance(tmp_path):
+    jsonfile = tmp_path / "res2.json"
+    rc, _hosts = _run_with_midphase_kill(tmp_path, [], jsonfile)
+    assert rc != 0, "--svctolerant 0 (default) must keep fail-fast"
+
+
+def test_degraded_text_output_carries_banner(tmp_path, capsys):
+    jsonfile = tmp_path / "res3.json"
+    rc, hosts = _run_with_midphase_kill(
+        tmp_path, ["--svctolerant", "1"], jsonfile)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out
+    assert hosts[1] in out
+
+
+# ---------------------------------------------------------------------------
+# satellites: host-context wrapping + concurrent ready-probe
+# ---------------------------------------------------------------------------
+
+def test_connect_failure_carries_host_context():
+    """A bare OSError from the control plane must surface as
+    WorkerRemoteException naming the service host."""
+    from elbencho_tpu.service.fault_tolerance import RetryPolicy
+    from elbencho_tpu.service.remote_worker import ServiceClient
+    from elbencho_tpu.workers.shared import WorkerRemoteException
+    port = free_ports(1)[0]  # nothing listens here
+    client = ServiceClient(f"127.0.0.1:{port}", port,
+                           retry_policy=RetryPolicy(num_retries=0))
+    with pytest.raises(WorkerRemoteException, match=f"127.0.0.1:{port}"):
+        client.get_json("/protocolversion")
+
+
+def test_wait_for_services_ready_probes_concurrently_and_reports_all():
+    """One slow host must no longer eat the whole --svcwait budget of the
+    hosts after it, and ALL unreachable hosts are reported at once."""
+    from elbencho_tpu.service.remote_worker import wait_for_services_ready
+    from elbencho_tpu.workers.shared import WorkerRemoteException
+    ports = free_ports(3)  # nothing listens on any of them
+    hosts = [f"127.0.0.1:{p}" for p in ports]
+    t0 = time.monotonic()
+    with pytest.raises(WorkerRemoteException) as excinfo:
+        wait_for_services_ready(hosts, ports[0], wait_secs=2)
+    elapsed = time.monotonic() - t0
+    # sequential probing would need ~len(hosts) * wait_secs
+    assert elapsed < 2 * 2, f"probe not concurrent ({elapsed:.1f}s)"
+    for host in hosts:
+        assert host in str(excinfo.value)
+
+
+def test_interrupt_helpers_swallow_malformed_status_lines():
+    """send_interrupt_to_hosts must survive a peer that answers with a
+    malformed status line (http.client.HTTPException, previously escaping
+    the bare `except OSError`)."""
+    import socket
+
+    from elbencho_tpu.service.remote_worker import send_interrupt_to_hosts
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    done = threading.Event()
+
+    def bad_peer():
+        srv.settimeout(5)
+        try:
+            conn, _ = srv.accept()
+        except socket.timeout:
+            return
+        conn.recv(1024)
+        conn.sendall(b"NOT-HTTP garbage\r\n\r\n")  # malformed status line
+        conn.close()
+        done.set()
+
+    t = threading.Thread(target=bad_peer, daemon=True)
+    t.start()
+    try:
+        # must not raise
+        send_interrupt_to_hosts([f"127.0.0.1:{port}"], port)
+        assert done.wait(timeout=5)
+    finally:
+        srv.close()

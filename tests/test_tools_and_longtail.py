@@ -463,3 +463,76 @@ def test_chart_tool_bench_capture_ab(tmp_path):
     assert "900.0" in res.stdout and "400.0" in res.stdout
     # the stale replay is labeled as such on its x tick
     assert "(stale)" in res.stdout
+
+
+def test_summarize_json_degraded_banner(tmp_path):
+    """A --svctolerant degraded record must never tabulate silently next
+    to clean ones: stderr banner + a Degr column (docs/fault-tolerance.md)."""
+    jsonfile = tmp_path / "res.json"
+    clean = {"Phase": "WRITE", "EntriesLast": 8, "NumHostsDegraded": 0,
+             "DegradedHosts": []}
+    degraded = {"Phase": "READ", "EntriesLast": 4, "NumHostsDegraded": 1,
+                "DegradedHosts": ["10.0.0.2:1611"]}
+    jsonfile.write_text(json.dumps(clean) + "\n" + json.dumps(degraded)
+                        + "\n")
+    res = _tool("elbencho-tpu-summarize-json", [str(jsonfile)])
+    assert res.returncode == 0, res.stderr
+    assert "DEGRADED" in res.stderr and "10.0.0.2:1611" in res.stderr
+    header, _sep, clean_row, degr_row = res.stdout.splitlines()[:4]
+    assert "Degr" in header
+    assert "DEGRADED" in degr_row and "DEGRADED" not in clean_row
+    # an all-clean file keeps the old schema: no banner, no Degr column
+    jsonfile.write_text(json.dumps(clean) + "\n")
+    res = _tool("elbencho-tpu-summarize-json", [str(jsonfile)])
+    assert res.returncode == 0 and "DEGRADED" not in res.stderr
+    assert "Degr" not in res.stdout.splitlines()[0]
+
+
+# ---------------------------------------------------------------------------
+# toolkits/signals.py: fault-trace registration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _fresh_fault_handlers(monkeypatch):
+    """Reset signals.py module state and restore faulthandler afterwards."""
+    import faulthandler
+
+    from elbencho_tpu.toolkits import signals
+    monkeypatch.setattr(signals, "_trace_file", None)
+    yield signals
+    faulthandler.disable()
+    if signals._trace_file is not None:
+        signals._trace_file.close()
+        signals._trace_file = None
+
+
+def test_fault_trace_registration_returns_per_user_path(
+        tmp_path, monkeypatch, _fresh_fault_handlers):
+    import faulthandler
+    import getpass
+    signals = _fresh_fault_handlers
+    monkeypatch.setattr(signals, "FAULT_TRACE_PATH_TEMPLATE",
+                        str(tmp_path / "trace_{user}.txt"))
+    path = signals.register_fault_handlers()
+    assert path == str(tmp_path / f"trace_{getpass.getuser()}.txt")
+    assert os.path.exists(path)
+    assert faulthandler.is_enabled()
+    # idempotent: a second call keeps the existing sink, same path
+    assert signals.register_fault_handlers() == path
+
+
+def test_fault_trace_falls_back_to_stderr_when_unwritable(
+        tmp_path, monkeypatch, _fresh_fault_handlers):
+    """An unwritable trace path must not kill startup: faulthandler still
+    arms (stderr sink) and the intended path is still returned so the
+    startup log points somewhere."""
+    import faulthandler
+    signals = _fresh_fault_handlers
+    monkeypatch.setattr(signals, "FAULT_TRACE_PATH_TEMPLATE",
+                        str(tmp_path / "no" / "such" / "dir" / "{user}.txt"))
+    path = signals.register_fault_handlers()
+    assert path.startswith(str(tmp_path))
+    assert not os.path.exists(path)
+    assert faulthandler.is_enabled()  # stderr fallback
+    assert signals._trace_file is None  # no half-open sink left behind
