@@ -908,6 +908,62 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
 }
 
 // ---------------------------------------------------------------------------
+// streaming producer mode (fused storage<->HBM loop): instead of running a
+// whole block loop to completion, the engine exposes an io_uring
+// submission/completion ring over the worker's REGISTERED staging slots.
+// Python submits one read/write per slot, reaps completed slots (GIL
+// released for the whole blocking wait — ctypes drops it around the call),
+// and hands each completed slot straight to the TPU transfer pipeline
+// (TpuWorkerContext.host_to_device / device_to_host), so disk DMA in the
+// kernel overlaps HBM DMA dispatch in Python. This is the cuFileRead
+// overlap shape of the reference's GPUDirect path (LocalWorker.cpp:
+// 2633-2749) rebuilt on io_uring + PjRt.
+//
+// Contract: a slot holds AT MOST one in-flight op (submit returns -EBUSY
+// otherwise); the caller owns the slot buffers and must keep them mapped
+// until ioengine_stream_close returned (close drains outstanding kernel
+// DMA first). Latency/length reporting matches run_block_loop4: per-op
+// usec stamped submit -> reap-harvest, cqe res returned raw so short
+// reads/writes surface to the caller.
+//
+// Backend tiers: io_uring (registered buffers/files, the primary path)
+// with a kernel-AIO fallback on kernels without io_uring/EXT_ARG — the
+// same async submit/reap semantics either way, so the Python fused loop
+// is backend-agnostic and only ever falls back to the pure-Python loop
+// when NEITHER async engine exists.
+
+struct StreamSlotState {
+    uint64_t submit_usec = 0;
+    uint64_t expected_len = 0;
+    int pending = 0;  // one in-flight op per slot, enforced
+};
+
+struct StreamCtx {
+    bool use_uring = false;
+    UringRings ring;           // io_uring backend
+    aio_context_t aio_ctx = 0; // kernel-AIO fallback backend
+    iocb* aio_cbs = nullptr;   // one control block per slot
+    StreamSlotState* slots = nullptr;
+    uint64_t* slot_addrs = nullptr;
+    uint64_t n_slots = 0;
+    uint64_t slot_size = 0;
+    int* fds = nullptr;
+    uint32_t n_fds = 0;
+    bool fixed_buffers = false;
+    bool fixed_files = false;
+    int in_flight = 0;
+
+    ~StreamCtx() {
+        if (aio_ctx)
+            sys_io_destroy(aio_ctx);
+        delete[] aio_cbs;
+        delete[] slots;
+        delete[] slot_addrs;
+        delete[] fds;
+    }
+};
+
+// ---------------------------------------------------------------------------
 // dir-mode file loop: open -> write/read blocks -> close per file (LOSF
 // hot path; reference: dirModeIterateFiles, LocalWorker.cpp:3055-3281 with
 // unlinkat/fstatat for the delete/stat phases)
@@ -1513,6 +1569,356 @@ int ioengine_run_mmap_loop(void* map_base, const uint64_t* offsets,
                                    nullptr, 0, 0, nullptr);
 }
 
+// ---------------------------------------------------------------------------
+// streaming producer mode entry points (see StreamCtx above for the
+// contract). All return 0/handle on success, -errno on failure.
+
+int ioengine_uring_supported();  // defined below; used by stream_backend
+
+// open a stream over the caller's staging slots. slot_addrs[i] is the
+// base address of slot i (page-aligned worker I/O buffers); every op on
+// slot i reads into / writes from that buffer. Registered buffers/files
+// are pure fast-path optimizations — registration failure (e.g.
+// RLIMIT_MEMLOCK) silently falls back to the unregistered opcodes.
+// Returns NULL with *out_err = -errno when the ring cannot be set up
+// (kernel without io_uring / EXT_ARG -> -ENOSYS: the caller's cue to
+// fall back to the Python loop).
+void* ioengine_stream_open(const int* fds, uint32_t n_fds,
+                           const uint64_t* slot_addrs, uint64_t n_slots,
+                           uint64_t slot_size, int* out_err) {
+    if (!n_slots || !n_fds || !slot_addrs || !fds || !slot_size) {
+        if (out_err)
+            *out_err = -EINVAL;
+        return nullptr;
+    }
+    StreamCtx* c = new StreamCtx;
+    c->use_uring = c->ring.init(static_cast<unsigned>(n_slots)) == 0;
+    if (!c->use_uring) {
+        // kernel without io_uring/EXT_ARG: same ring semantics on
+        // kernel AIO (io_submit/io_getevents)
+        if (sys_io_setup(static_cast<unsigned>(n_slots), &c->aio_ctx) < 0) {
+            if (out_err)
+                *out_err = -errno;
+            c->aio_ctx = 0;
+            delete c;
+            return nullptr;
+        }
+        c->aio_cbs = new iocb[n_slots];
+    }
+    c->n_slots = n_slots;
+    c->slot_size = slot_size;
+    c->slots = new StreamSlotState[n_slots];
+    c->slot_addrs = new uint64_t[n_slots];
+    memcpy(c->slot_addrs, slot_addrs, n_slots * sizeof(uint64_t));
+    c->n_fds = n_fds;
+    c->fds = new int[n_fds];
+    memcpy(c->fds, fds, n_fds * sizeof(int));
+    if (c->use_uring) {
+        iovec* iov = new iovec[n_slots];
+        for (uint64_t i = 0; i < n_slots; ++i) {
+            iov[i].iov_base = reinterpret_cast<void*>(slot_addrs[i]);
+            iov[i].iov_len = slot_size;
+        }
+        c->fixed_buffers = sys_io_uring_register(
+            c->ring.ring_fd, IORING_REGISTER_BUFFERS, iov,
+            static_cast<unsigned>(n_slots)) == 0;
+        delete[] iov;
+        c->fixed_files = sys_io_uring_register(
+            c->ring.ring_fd, IORING_REGISTER_FILES, c->fds, n_fds) == 0;
+    }
+    if (out_err)
+        *out_err = 0;
+    return c;
+}
+
+// the backend a LIVE stream actually uses (the open may have fallen
+// back to AIO even where the 1-entry uring probe succeeds, e.g. ENOMEM
+// on the ring mmaps at a large slot count) — callers enforcing an
+// explicit --ioengine pin must check THIS, not the prediction below
+int ioengine_stream_backend_of(void* handle) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c)
+        return 0;
+    return c->use_uring ? ENGINE_URING : ENGINE_AIO;
+}
+
+// which backend serves a stream on this kernel: 3 = io_uring, 2 = kernel
+// AIO, 0 = neither (stream_open would fail; Python loop territory).
+// Values match the ENGINE_* selector codes so logs/tests share one vocab.
+int ioengine_stream_backend() {
+    if (ioengine_uring_supported())
+        return ENGINE_URING;
+    aio_context_t probe = 0;
+    if (sys_io_setup(1, &probe) == 0) {
+        sys_io_destroy(probe);
+        return ENGINE_AIO;
+    }
+    return 0;
+}
+
+// queue + submit one op on a free slot; the read lands in (or the write
+// is served from) the first `length` bytes of the slot's buffer
+int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
+                           uint64_t offset, uint64_t length, int is_write) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c || slot >= c->n_slots || fd_idx >= c->n_fds
+            || length > c->slot_size || length == 0)
+        return -EINVAL;
+    StreamSlotState& s = c->slots[slot];
+    if (s.pending)
+        return -EBUSY;  // slot-reuse discipline: one in-flight op per slot
+    if (!c->use_uring) {  // kernel-AIO fallback backend
+        iocb& cb = c->aio_cbs[slot];
+        memset(&cb, 0, sizeof(cb));
+        cb.aio_fildes = static_cast<uint32_t>(c->fds[fd_idx]);
+        cb.aio_lio_opcode = is_write ? IOCB_CMD_PWRITE : IOCB_CMD_PREAD;
+        cb.aio_buf = c->slot_addrs[slot];
+        cb.aio_nbytes = length;
+        cb.aio_offset = static_cast<int64_t>(offset);
+        cb.aio_data = slot;
+        s.submit_usec = now_usec();
+        s.expected_len = length;
+        iocb* cbp = &cb;
+        if (sys_io_submit(c->aio_ctx, 1, &cbp) != 1)
+            return -errno;
+        s.pending = 1;
+        ++c->in_flight;
+        return 0;
+    }
+    const unsigned tail = *c->ring.sq_tail;
+    const unsigned idx = tail & *c->ring.sq_mask;
+    io_uring_sqe* sqe = &c->ring.sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    if (c->fixed_buffers) {
+        sqe->opcode = is_write ? IORING_OP_WRITE_FIXED
+                               : IORING_OP_READ_FIXED;
+        sqe->buf_index = static_cast<uint16_t>(slot);
+    } else {
+        sqe->opcode = is_write ? IORING_OP_WRITE : IORING_OP_READ;
+    }
+    if (c->fixed_files) {
+        sqe->fd = static_cast<int32_t>(fd_idx);
+        sqe->flags |= IOSQE_FIXED_FILE;
+    } else {
+        sqe->fd = c->fds[fd_idx];
+    }
+    sqe->addr = c->slot_addrs[slot];
+    sqe->len = static_cast<uint32_t>(length);
+    sqe->off = offset;
+    sqe->user_data = slot;
+    c->ring.sq_array[idx] = idx;
+    s.submit_usec = now_usec();
+    s.expected_len = length;
+    __atomic_store_n(c->ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
+    int res;
+    do {
+        res = sys_io_uring_enter(c->ring.ring_fd, 1, 0, 0, nullptr, 0);
+    } while (res < 0 && errno == EINTR);
+    if (res != 1) {
+        // the kernel did not consume the SQE (no SQPOLL: it only reads
+        // during enter) — rewind the published tail or the orphaned
+        // entry would be submitted in place of the NEXT op, desyncing
+        // every later slot<->completion mapping
+        __atomic_store_n(c->ring.sq_tail, tail, __ATOMIC_RELEASE);
+        return res < 0 ? -errno : -EAGAIN;
+    }
+    s.pending = 1;
+    ++c->in_flight;
+    return 0;
+}
+
+// harvest up to max_events completions, blocking (bounded, interruptible)
+// until at least min_complete arrived or timeout_msecs elapsed. Returns
+// the number reaped (may be < min_complete on timeout/interrupt/empty
+// ring), or -errno on an unrecoverable wait error. Per event: the slot
+// index, the submit->harvest latency in usec, and the raw cqe result
+// (>= 0 bytes moved — the caller checks it against the expected length —
+// or -errno for that op).
+int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
+                         uint32_t* out_slots, uint64_t* out_lat_usec,
+                         int64_t* out_res, int max_events,
+                         int* interrupt_flag) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c || max_events <= 0 || !out_slots || !out_lat_usec || !out_res)
+        return -EINVAL;
+    if (min_complete > max_events)
+        min_complete = max_events;
+    int got = 0;
+    const uint64_t deadline = now_usec()
+        + static_cast<uint64_t>(timeout_msecs < 0 ? 0 : timeout_msecs)
+          * 1000ull;
+    if (!c->use_uring) {  // kernel-AIO fallback backend
+        io_event events[16];
+        for (;;) {
+            const long want = max_events - got > 16 ? 16 : max_events - got;
+            // harvest whatever already completed without blocking
+            timespec zero = {0, 0};
+            int n = sys_io_getevents(c->aio_ctx, 0, want, events, &zero);
+            if (n < 0) {
+                if (errno == EINTR)
+                    continue;
+                return got ? got : -errno;
+            }
+            const uint64_t t_now = now_usec();
+            for (int e = 0; e < n; ++e) {
+                const uint32_t slot = static_cast<uint32_t>(events[e].data);
+                --c->in_flight;
+                if (slot < c->n_slots) {
+                    c->slots[slot].pending = 0;
+                    out_slots[got] = slot;
+                    out_lat_usec[got] = t_now - c->slots[slot].submit_usec;
+                    out_res[got] = events[e].res;
+                    ++got;
+                }
+            }
+            if (got >= min_complete || c->in_flight == 0)
+                return got;
+            if (interrupt_flag && *interrupt_flag)
+                return got;
+            const uint64_t now2 = now_usec();
+            if (now2 >= deadline)
+                return got;
+            uint64_t wait_us = deadline - now2;
+            if (wait_us > 100000)  // interruptible 100ms slices
+                wait_us = 100000;
+            timespec ts = {static_cast<time_t>(wait_us / 1000000ull),
+                           static_cast<long>((wait_us % 1000000ull)
+                                             * 1000ull)};
+            // recompute the bound: the harvest above advanced `got`, and
+            // reusing the stale `want` could overrun the out arrays
+            const long want2 = max_events - got > 16 ? 16
+                                                     : max_events - got;
+            n = sys_io_getevents(c->aio_ctx, 1, want2, events, &ts);
+            if (n < 0 && errno != EINTR)
+                return got ? got : -errno;
+            if (n > 0) {
+                const uint64_t t_done = now_usec();
+                for (int e = 0; e < n; ++e) {
+                    const uint32_t slot =
+                        static_cast<uint32_t>(events[e].data);
+                    --c->in_flight;
+                    if (slot < c->n_slots) {
+                        c->slots[slot].pending = 0;
+                        out_slots[got] = slot;
+                        out_lat_usec[got] =
+                            t_done - c->slots[slot].submit_usec;
+                        out_res[got] = events[e].res;
+                        ++got;
+                    }
+                }
+                if (got >= min_complete || c->in_flight == 0)
+                    return got;
+            }
+        }
+    }
+    for (;;) {
+        unsigned head = *c->ring.cq_head;
+        const unsigned tail =
+            __atomic_load_n(c->ring.cq_tail, __ATOMIC_ACQUIRE);
+        const uint64_t t_now = now_usec();
+        while (head != tail && got < max_events) {
+            const io_uring_cqe& cqe =
+                c->ring.cqes[head & *c->ring.cq_mask];
+            const uint32_t slot = static_cast<uint32_t>(cqe.user_data);
+            ++head;
+            --c->in_flight;
+            if (slot < c->n_slots) {
+                c->slots[slot].pending = 0;
+                out_slots[got] = slot;
+                out_lat_usec[got] = t_now - c->slots[slot].submit_usec;
+                out_res[got] = cqe.res;
+                ++got;
+            }
+        }
+        __atomic_store_n(c->ring.cq_head, head, __ATOMIC_RELEASE);
+        if (got >= min_complete || c->in_flight == 0)
+            return got;
+        if (interrupt_flag && *interrupt_flag)
+            return got;
+        const uint64_t now2 = now_usec();
+        if (now2 >= deadline)
+            return got;
+        // bounded wait in <=100ms slices so interrupts stay responsive
+        uint64_t wait_us = deadline - now2;
+        if (wait_us > 100000)
+            wait_us = 100000;
+        timespec ts = {static_cast<time_t>(wait_us / 1000000ull),
+                       static_cast<long>((wait_us % 1000000ull) * 1000ull)};
+        UringGetEventsArg arg;
+        memset(&arg, 0, sizeof(arg));
+        arg.ts = reinterpret_cast<uint64_t>(&ts);
+        if (sys_io_uring_enter(
+                c->ring.ring_fd, 0, 1,
+                IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
+                sizeof(arg)) < 0
+                && errno != ETIME && errno != EINTR)
+            return got ? got : -errno;
+    }
+}
+
+// ops the kernel currently owns (submitted, not yet reaped)
+int ioengine_stream_inflight(void* handle) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    return c ? c->in_flight : -EINVAL;
+}
+
+// drain outstanding kernel DMA into the slot buffers, then tear the ring
+// down. The drain must complete before the caller may unmap the slots
+// (same use-after-free argument as run_uring_loop's drain); an
+// unrecoverable enter error aborts it with -EIO, and the caller MUST
+// then keep the slot buffers mapped for the life of the process (the
+// Python side leaks the worker's mmaps on a nonzero return) — a late
+// completion still DMAs into them.
+int ioengine_stream_close(void* handle) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c)
+        return -EINVAL;
+    int ret = 0;
+    if (!c->use_uring) {
+        // AIO drain; io_destroy in the dtor then blocks until any
+        // remainder's kernel DMA finished (same ordering argument as
+        // run_aio_loop's teardown)
+        while (c->in_flight > 0) {
+            io_event events[16];
+            timespec ts = {1, 0};
+            const int n = sys_io_getevents(c->aio_ctx, 1, 16, events, &ts);
+            if (n < 0 && errno != EINTR)
+                break;
+            if (n > 0)
+                c->in_flight -= n;
+        }
+        delete c;
+        return 0;
+    }
+    while (c->in_flight > 0) {
+        unsigned head = *c->ring.cq_head;
+        const unsigned tail =
+            __atomic_load_n(c->ring.cq_tail, __ATOMIC_ACQUIRE);
+        if (head == tail) {
+            timespec ts = {1, 0};
+            UringGetEventsArg arg;
+            memset(&arg, 0, sizeof(arg));
+            arg.ts = reinterpret_cast<uint64_t>(&ts);
+            if (sys_io_uring_enter(
+                    c->ring.ring_fd, 0, 1,
+                    IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG,
+                    &arg, sizeof(arg)) < 0
+                    && errno != ETIME && errno != EINTR) {
+                ret = -EIO;
+                break;
+            }
+            continue;
+        }
+        while (head != tail) {
+            ++head;
+            --c->in_flight;
+        }
+        __atomic_store_n(c->ring.cq_head, head, __ATOMIC_RELEASE);
+    }
+    delete c;  // UringRings dtor unmaps the rings and closes the fd
+    return ret;
+}
+
 // 1 if this kernel accepts io_uring_setup (it may be compiled out or
 // disabled via the io_uring_disabled sysctl) AND provides EXT_ARG timed
 // waits (5.11+), which the engine's interruptible wait loops require
@@ -1528,7 +1934,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 8 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog)";
+    return "elbencho-tpu ioengine 9 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog+stream)";
 }
 
 }  // extern "C"

@@ -298,11 +298,19 @@ FLAG_DEFS = [
      "Coalesce this many blocks into one host->HBM DMA (amortizes "
      "per-transfer dispatch overhead, e.g. on tunneled chips; costs one "
      "host-side copy per block and defers the DMA to every Nth block; "
-     "ignored with --tpuverify)"),
+     "rejected with --tpuverify — the aggregated span has no per-block "
+     "on-device check)"),
     ("tpudepth", None, "tpu_depth", "int", 0, "tpu",
      "In-flight TPU transfer ring depth (submission/completion window of "
      "the HBM pipeline; overrides the default of riding --iodepth, like "
      "the reference's cuFile iodepth semantics)"),
+    ("tpustream", None, "tpu_stream", "str", "auto", "tpu",
+     "Fused storage<->HBM streaming loop: the native engine keeps up to "
+     "--iodepth io_uring (or kernel-AIO) ops in flight over the "
+     "registered staging slots while Python overlaps HBM DMA dispatch "
+     "(the cuFileRead overlap analogue). auto = on where eligible with "
+     "a logged fallback to the Python loop; on = required (fail "
+     "loudly when ineligible); off = always use the Python loop"),
     ("tpubudget", None, "tpu_dispatch_budget_usec", "int", 0, "tpu",
      "Fail the run when the measured per-block host-side dispatch "
      "overhead of the TPU transfer pipeline exceeds this many "
@@ -1052,6 +1060,38 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--tpudepth/--tpubudget tune the TPU transfer pipeline — "
                 "they need --tpuids (or --tpubench)")
+        if self.tpu_stream not in ("auto", "on", "off"):
+            raise ConfigError("--tpustream must be auto|on|off")
+        if self.tpu_stream == "on" and not self.tpu_ids_str \
+                and not self.tpu_ids:
+            raise ConfigError(
+                "--tpustream on requires --tpuids (the fused loop streams "
+                "storage into TPU staging slots)")
+        if self.tpu_stream == "on" and self.run_tpu_bench:
+            # --tpubench does synthetic HBM transfers only and never
+            # reaches the block loop: "on" would silently pass green
+            raise ConfigError(
+                "--tpustream on has no effect under --tpubench (no "
+                "storage loop to fuse); drop one of the two")
+        if self.tpu_stream == "on" and (
+                self.use_mmap or self.bench_mode != BenchMode.POSIX):
+            # paths that never reach the block-sized file loop (mmap
+            # memcpy, object/netbench data planes) can't honor the
+            # fail-loudly contract — reject up front instead of letting
+            # a CI gate pass green with the fused loop never engaged
+            raise ConfigError(
+                "--tpustream on requires the POSIX block I/O path "
+                "(incompatible with --mmap and object/netbench modes); "
+                "use --tpustream auto there")
+        if self.tpu_batch_blocks > 1 and self.do_tpu_verify:
+            # the aggregated DMA span skips the per-block on-device check
+            # (host_to_device's aggregation branch returns before the
+            # verify hook) — reject the combination instead of silently
+            # verifying nothing
+            raise ConfigError(
+                "--tpubatch > 1 cannot be combined with --tpuverify: the "
+                "aggregated span has no per-block on-device check — drop "
+                "one of the two")
         if self.run_s3_mpu_complete_phase and not self.s3_mpu_sharing:
             raise ConfigError(
                 "--s3mpucomplphase requires --s3mpusharing (only shared "

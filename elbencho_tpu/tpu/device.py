@@ -52,6 +52,10 @@ PATH_AUDIT_COUNTERS = (
      "tpu_d2h_prefetch_misses"),
     ("pipe_full_stalls", "TpuPipeFullStalls", "tpu_pipe_full_stalls"),
     ("pipe_inflight_hwm", "TpuPipeInflightHwm", "tpu_pipe_inflight_hwm"),
+    # ops completed by the fused native-stream loop (--tpustream): disk
+    # I/O reaped from the engine's ring and handed straight to the
+    # transfer pipeline — zero means the phase ran the Python loop
+    ("stream_fused_ops", "TpuStreamFusedOps", "tpu_stream_fused_ops"),
 )
 
 #: counters that merge across workers as MAX, not sum: a high-water mark
@@ -213,6 +217,14 @@ class TransferPipeline:
                 f"{self.ops} ops ({self.dispatch_usec} usec host-side "
                 f"dispatch total; DMA wall {self.transfer_usec} usec)")
 
+    def drain_to(self, max_inflight: int) -> None:
+        """Drain the ring until at most max_inflight transfers are in
+        flight — the dlpack-stability helper for host-buffer reuse: a
+        caller about to rewrite a buffer submitted k transfers ago
+        drains to k-1 first, making the alias provably released."""
+        while len(self._ring) > max(max_inflight, 0):
+            self._drain_one()
+
     def reset_counters(self) -> None:
         self.dispatch_usec = 0
         self.transfer_usec = 0
@@ -360,6 +372,10 @@ class TpuWorkerContext:
         # miss and speculation self-disables after a miss streak.
         self._d2h_spec: dict = {}
         self._d2h_spec_miss_streak = 0
+        # fused native-stream loop audit (--tpustream; schema entry in
+        # PATH_AUDIT_COUNTERS): ops whose storage I/O ran in the engine's
+        # submission/completion ring
+        self.stream_fused_ops = 0
 
     # -- read path: host buffer -> HBM --------------------------------------
 
@@ -546,6 +562,29 @@ class TpuWorkerContext:
             self.h2d_direct_fallbacks += 1
             self.h2d_staged_ops += 1
             return jax.device_put(np_view, self.device)
+
+    def holdback_depth(self) -> int:
+        """How many freshly-ingested staging slots the fused stream loop
+        must keep OUT of the engine's ring after their host_to_device:
+        with an unbatched --tpudirect import the device array aliases
+        the host buffer until its transfer drains, and the pipeline
+        holds at most depth-1 transfers after every submit — so holding
+        the last depth-1 ingested slots is exactly the drain guarantee
+        the dlpack stability contract needs. The staged path (and the
+        --tpubatch aggregation path) copy the buffer out at submit time
+        and need no holdback."""
+        if self.direct and self._h2d_direct_ok and self.batch_blocks == 1:
+            return max(self.pipeline_depth - 1, 0)
+        return 0
+
+    def drain_to(self, max_inflight: int) -> None:
+        """Drain the in-flight transfer ring to at most max_inflight
+        entries (see TransferPipeline.drain_to): the explicit form of
+        the buffer-rotation guarantee for callers that reuse host
+        buffers on their own schedule — the fused stream loop calls it
+        to release a held-back staging slot without waiting for more
+        storage completions."""
+        self._pipeline.drain_to(max_inflight)
 
     def reset_path_counters(self) -> None:
         """Zero the H2D/D2H path-audit counters (called from the worker's

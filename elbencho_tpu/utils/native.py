@@ -28,12 +28,14 @@ _SO_PATH_INSTALLED = os.path.join(_PKG_DIR, "_native", "libioengine.so")
 
 # engine selector values (must match csrc/ioengine.cpp)
 ENGINE_CODES = {"auto": 0, "sync": 1, "aio": 2, "uring": 3}
+#: reverse map for logs/diagnostics (single owner of the naming)
+ENGINE_NAMES = {code: name for name, code in ENGINE_CODES.items()}
 
 # ABI generation expected from the .so; ioengine_version() reports
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 8
+EXPECTED_ABI = 9
 
 _EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
 
@@ -110,6 +112,102 @@ def _account_chunk(worker, lat_arr, lengths_np, n: int, bytes_done: int,
             worker.live_ops.num_bytes_done += bytes_done
     worker._num_iops_submitted += n
     worker.create_stonewall_stats_if_triggered()
+
+
+class NativeStreamError(OSError):
+    """Stream open/submit/reap failed inside the engine (-errno)."""
+
+    def __init__(self, errno_val: int, what: str):
+        super().__init__(errno_val, f"{os.strerror(errno_val)} ({what})")
+
+
+class NativeStream:
+    """Submission/completion ring over registered staging slots
+    (ioengine_stream_*): up to len(slot_addrs) io_uring reads/writes in
+    flight with the GIL released, reaped slot-by-slot so the caller can
+    overlap storage I/O with TPU HBM transfers (the fused loop of
+    workers/local_worker.py). One in-flight op per slot — the engine
+    returns -EBUSY on a violation of the slot-reuse discipline."""
+
+    #: reap batch bound (cq depth can reach 2x sq entries)
+    _MAX_EVENTS = 64
+
+    def __init__(self, lib: ctypes.CDLL, fds, slot_addrs, slot_size: int):
+        self._lib = lib
+        self._handle = None
+        n_slots = len(slot_addrs)
+        self.n_slots = n_slots
+        fds_arr = (ctypes.c_int * len(fds))(*fds)
+        addr_arr = (ctypes.c_uint64 * n_slots)(*slot_addrs)
+        err = ctypes.c_int(0)
+        handle = lib.ioengine_stream_open(
+            fds_arr, len(fds), addr_arr, n_slots, slot_size,
+            ctypes.byref(err))
+        if not handle:
+            raise NativeStreamError(-err.value or errno_mod.EINVAL,
+                                    "stream open")
+        self._handle = handle
+        #: ENGINE_CODES value of the backend THIS ring runs on (the open
+        #: may fall back from uring to AIO; pins/logs must use this)
+        self.backend = int(lib.ioengine_stream_backend_of(handle))
+        self.backend_name = ENGINE_NAMES.get(self.backend, "none")
+        max_ev = max(self._MAX_EVENTS, 2 * n_slots)
+        self._out_slots = (ctypes.c_uint32 * max_ev)()
+        self._out_lat = (ctypes.c_uint64 * max_ev)()
+        self._out_res = (ctypes.c_int64 * max_ev)()
+        self._max_events = max_ev
+
+    def submit(self, slot: int, fd_idx: int, offset: int, length: int,
+               is_write: bool) -> None:
+        ret = self._lib.ioengine_stream_submit(
+            self._handle, slot, fd_idx, offset, length,
+            1 if is_write else 0)
+        if ret < 0:
+            raise NativeStreamError(-ret, f"stream submit slot {slot}")
+
+    def reap(self, min_complete: int = 1, timeout_msecs: int = 1000,
+             interrupt_flag=None) -> "list[tuple[int, int, int]]":
+        """Blocking (bounded, interruptible) harvest; returns
+        [(slot, lat_usec, res), ...] — res is the raw per-op result
+        (bytes moved, or -errno), checked by the caller so a short read
+        mid-stream surfaces with its slot context."""
+        interrupt = (interrupt_flag if interrupt_flag is not None
+                     else ctypes.c_int(0))
+        got = self._lib.ioengine_stream_reap(
+            self._handle, min_complete, timeout_msecs, self._out_slots,
+            self._out_lat, self._out_res, self._max_events,
+            ctypes.byref(interrupt))
+        if got < 0:
+            raise NativeStreamError(-got, "stream reap")
+        return [(self._out_slots[i], self._out_lat[i], self._out_res[i])
+                for i in range(got)]
+
+    def inflight(self) -> int:
+        return self._lib.ioengine_stream_inflight(self._handle)
+
+    def close(self) -> int:
+        """Drains outstanding kernel DMA before the ring is torn down;
+        idempotent. Returns 0, or -errno when the drain had to be
+        aborted with ops still kernel-owned — the caller must then keep
+        the slot buffers mapped for the life of the process (a late
+        completion DMAs into them)."""
+        ret = 0
+        if self._handle is not None:
+            ret = self._lib.ioengine_stream_close(self._handle)
+            self._handle = None
+        return ret
+
+    def __enter__(self) -> "NativeStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # belt-and-braces: never leak a kernel ring
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
 
 class _NativeEngine:
@@ -202,6 +300,44 @@ class _NativeEngine:
             ctypes.POINTER(ctypes.c_uint64),  # out: open connections left
             ctypes.POINTER(ctypes.c_int),     # interrupt flag
         ]
+        lib.ioengine_stream_open.restype = ctypes.c_void_p
+        lib.ioengine_stream_open.argtypes = [
+            ctypes.POINTER(ctypes.c_int),     # fds
+            ctypes.c_uint32,                  # num fds
+            ctypes.POINTER(ctypes.c_uint64),  # slot base addresses
+            ctypes.c_uint64,                  # num slots
+            ctypes.c_uint64,                  # slot size (bytes)
+            ctypes.POINTER(ctypes.c_int),     # out: -errno on failure
+        ]
+        lib.ioengine_stream_submit.restype = ctypes.c_int
+        lib.ioengine_stream_submit.argtypes = [
+            ctypes.c_void_p,                  # stream handle
+            ctypes.c_uint32,                  # slot index
+            ctypes.c_uint32,                  # fd index
+            ctypes.c_uint64,                  # file offset
+            ctypes.c_uint64,                  # length
+            ctypes.c_int,                     # is_write
+        ]
+        lib.ioengine_stream_reap.restype = ctypes.c_int
+        lib.ioengine_stream_reap.argtypes = [
+            ctypes.c_void_p,                  # stream handle
+            ctypes.c_int,                     # min completions to wait for
+            ctypes.c_int,                     # timeout msecs
+            ctypes.POINTER(ctypes.c_uint32),  # out: completed slot indices
+            ctypes.POINTER(ctypes.c_uint64),  # out: latencies (usec)
+            ctypes.POINTER(ctypes.c_int64),   # out: raw cqe results
+            ctypes.c_int,                     # max events
+            ctypes.POINTER(ctypes.c_int),     # interrupt flag
+        ]
+        lib.ioengine_stream_inflight.restype = ctypes.c_int
+        lib.ioengine_stream_inflight.argtypes = [ctypes.c_void_p]
+        lib.ioengine_stream_close.restype = ctypes.c_int
+        lib.ioengine_stream_close.argtypes = [ctypes.c_void_p]
+        lib.ioengine_stream_backend.restype = ctypes.c_int
+        lib.ioengine_stream_backend.argtypes = []
+        lib.ioengine_stream_backend_of.restype = ctypes.c_int
+        lib.ioengine_stream_backend_of.argtypes = [ctypes.c_void_p]
+        self._stream_backend = None  # kernel capability, probed once
         lib.ioengine_run_file_loop3.restype = ctypes.c_int
         lib.ioengine_run_file_loop3.argtypes = [
             ctypes.c_char_p,                  # NUL-separated paths blob
@@ -238,6 +374,31 @@ class _NativeEngine:
 
     def uring_supported(self) -> bool:
         return bool(self._lib.ioengine_uring_supported())
+
+    def stream_supported(self) -> bool:
+        """Streaming producer mode: io_uring primary, kernel-AIO tier."""
+        return self.stream_backend() != 0
+
+    def stream_backend(self) -> int:
+        """ENGINE_CODES value of the backend a stream would PREDICTABLY
+        use on this kernel: 3 = io_uring, 2 = kernel AIO, 0 =
+        unavailable. Probed once (it creates and destroys a ring); the
+        kernel capability cannot change mid-run. A live stream reports
+        its ACTUAL backend via NativeStream.backend — the open can still
+        fall back to AIO (e.g. ENOMEM on the ring mmaps at a large slot
+        count), so engine pins must check the stream, not this."""
+        if self._stream_backend is None:
+            self._stream_backend = int(self._lib.ioengine_stream_backend())
+        return self._stream_backend
+
+    def stream_backend_name(self) -> str:
+        return ENGINE_NAMES.get(self.stream_backend(), "none")
+
+    def open_stream(self, fds, slot_addrs, slot_size: int) -> NativeStream:
+        """Open a submission/completion ring over the given staging slots
+        (see NativeStream); raises NativeStreamError when the kernel
+        cannot provide one (callers fall back to the Python loop)."""
+        return NativeStream(self._lib, fds, slot_addrs, slot_size)
 
     def version(self) -> str:
         return self._lib.ioengine_version().decode()

@@ -41,6 +41,13 @@ from .shared import WorkerException, WorkerInterruptedException
 MKFILE_MODE = 0o644  # reference: MKFILE_MODE, Common.h:96
 MKDIR_MODE = 0o755
 
+#: staging buffers deliberately kept alive for the life of the process
+#: after a stream-ring drain failed with kernel-owned ops still in
+#: flight — dropping the references would munmap them (CPython frees an
+#: mmap at refcount zero) and hand the late DMA completions unmapped
+#: address space
+_LEAKED_STREAM_BUFFERS: "list" = []
+
 
 class LocalWorker(Worker):
     def __init__(self, shared, rank: int):
@@ -62,6 +69,8 @@ class LocalWorker(Worker):
         self._ops_log = None
         self._num_iops_submitted = 0  # rwmix modulo counter
         self._prepared = False
+        self._stream_mode_logged = False  # once-per-phase fused-loop note
+        self._stream_drain_failed = False  # aborted ring drain: leak bufs
         import ctypes
         self._native_interrupt = ctypes.c_int(0)  # seen by the C++ engine
 
@@ -72,6 +81,7 @@ class LocalWorker(Worker):
     def reset_stats(self) -> None:
         super().reset_stats()
         self._native_interrupt.value = 0
+        self._stream_mode_logged = False  # log the mode once per phase
         if self._tpu is not None:
             # path-audit counters are per-phase, like tpu_transfer_bytes
             self._tpu.reset_path_counters()
@@ -95,12 +105,26 @@ class LocalWorker(Worker):
         if cfg.tpu_ids:
             from ..tpu.device import TpuWorkerContext
             chip = cfg.tpu_ids[self.rank % len(cfg.tpu_ids)]
+            # --tpudepth overrides the iodepth ride-along (the
+            # reference's cuFile iodepth analogue). Under --tpudirect the
+            # depth is clamped to the host-buffer count: an unbatched
+            # direct import aliases its host buffer until the ring drains
+            # it, and buffer rotation only guarantees that when the ring
+            # is no deeper than the rotation period.
+            depth = max(cfg.tpu_depth or cfg.io_depth, 1)
+            if cfg.use_tpu_direct and depth > max(cfg.io_depth, 1):
+                if self.rank % max(1, cfg.num_threads) == 0:
+                    logger.log(
+                        logger.LOG_NORMAL,
+                        f"NOTE: --tpudepth {depth} exceeds --iodepth "
+                        f"{cfg.io_depth}; clamped to {max(cfg.io_depth, 1)} "
+                        f"under --tpudirect (a host buffer must not be "
+                        f"rewritten before its zero-copy import drained)")
+                depth = max(cfg.io_depth, 1)
             self._tpu = TpuWorkerContext(
                 chip_id=chip, block_size=cfg.block_size,
                 direct=cfg.use_tpu_direct, verify_on_device=cfg.do_tpu_verify,
-                # --tpudepth overrides the iodepth ride-along (the
-                # reference's cuFile iodepth analogue)
-                pipeline_depth=max(cfg.tpu_depth or cfg.io_depth, 1),
+                pipeline_depth=depth,
                 hbm_limit_pct=cfg.tpu_hbm_limit_pct,
                 batch_blocks=max(cfg.tpu_batch_blocks, 1),
                 dispatch_budget_usec=cfg.tpu_dispatch_budget_usec)
@@ -176,17 +200,28 @@ class LocalWorker(Worker):
             self._tpu.close()  # drop device arrays before buffer teardown
             self._tpu = None
         self._io_buf = None
-        for mv in self._io_bufs:
-            mv.release()
-        self._io_bufs = []
-        import gc
-        gc.collect()  # drop stray numpy views of the mmaps (jax transfers)
-        for m in self._io_buf_mmaps:
-            try:
-                m.close()
-            except BufferError:
-                pass  # an exported view outlived us; the OS reclaims anyway
-        self._io_buf_mmaps = []
+        if getattr(self, "_stream_drain_failed", False):
+            # a stream-ring drain was aborted with kernel-owned ops
+            # still in flight: unmapping now would hand their late DMA
+            # completions unmapped/reused address space — park the
+            # references in the module-level leak list (just clearing
+            # the attributes would drop the refcount and munmap anyway)
+            _LEAKED_STREAM_BUFFERS.append((self._io_bufs,
+                                           self._io_buf_mmaps))
+            self._io_bufs = []
+            self._io_buf_mmaps = []
+        else:
+            for mv in self._io_bufs:
+                mv.release()
+            self._io_bufs = []
+            import gc
+            gc.collect()  # drop stray numpy views of the mmaps (jax)
+            for m in self._io_buf_mmaps:
+                try:
+                    m.close()
+                except BufferError:
+                    pass  # an exported view outlived us; OS reclaims
+            self._io_buf_mmaps = []
         if self._ops_log is not None:
             self._ops_log.close()
         if getattr(self, "_s3_client", None) is not None:
@@ -699,6 +734,28 @@ class LocalWorker(Worker):
                         global_off % stripe_size)
         from ..utils.native import get_native_engine
         native = get_native_engine()
+        # fused TPU streaming ring (--tpustream): storage I/O runs in the
+        # engine's submission/completion ring while Python overlaps HBM
+        # DMA dispatch — the default on eligible --tpuids phases, with a
+        # clean fallback chain native-stream (uring -> AIO) -> Python
+        # loop, logged once per phase
+        if self._tpu is not None and cfg.tpu_stream != "off":
+            blocker = self._tpu_stream_blocker(native, multi_file, stripe,
+                                               gen)
+            if blocker is None:
+                if self._run_fused_tpu_stream_loop(
+                        native, fd, gen, is_write, file_offset_base,
+                        stripe):
+                    return
+                blocker = ("stream ring setup failed, or the pinned "
+                           "--ioengine is not the ring's actual backend")
+            if cfg.tpu_stream == "on":
+                raise WorkerException(
+                    f"--tpustream on: fused native-stream loop "
+                    f"unavailable ({blocker})")
+            self._log_stream_mode(
+                f"NOTE: fused TPU stream ineligible ({blocker}); "
+                f"using the Python loop")
         sync_path = cfg.io_depth <= 1 and cfg.io_engine in ("auto", "sync")
         if (self._native_loop_eligible(native)
                 and (multi_file is None or stripe is not None)
@@ -808,6 +865,248 @@ class LocalWorker(Worker):
                 and (not cfg.block_variance_pct
                      or cfg.block_variance_algo == "fast"))
 
+    # ------------------------------------------------------------------
+    # fused TPU streaming ring (--tpustream): the engine keeps up to
+    # iodepth storage ops in flight over the registered staging slots
+    # (GIL released across the blocking reap), Python reaps completed
+    # slots and hands them straight to the TPU transfer pipeline — disk
+    # DMA in the kernel overlaps HBM DMA dispatch in Python, the
+    # cuFileRead overlap of the reference's GPUDirect path
+    # (LocalWorker.cpp:2633-2749) rebuilt on io_uring/AIO + PjRt.
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _stripe_offsets(offsets, file_offset_base, stripe_size):
+        """Vectorized calcFileIdxAndOffsetStriped (LocalWorker.cpp:2084):
+        global block offsets -> (per-block fd index or None, in-file
+        offsets). The ONE mapping shared by the native block loop and
+        the fused stream loop, so the two paths can never diverge on
+        which file region a block lands in."""
+        if stripe_size:
+            goffs = offsets + np.uint64(file_offset_base)
+            return ((goffs // np.uint64(stripe_size)).astype(np.uint32),
+                    goffs % np.uint64(stripe_size))
+        if file_offset_base:
+            return None, offsets + np.uint64(file_offset_base)
+        return None, offsets
+
+    def _log_stream_mode(self, msg: str) -> None:
+        """Once per phase, from the first local worker only."""
+        if self._stream_mode_logged:
+            return
+        self._stream_mode_logged = True
+        if self.rank % max(1, self.cfg.num_threads) == 0:
+            logger.log(logger.LOG_NORMAL, msg)
+
+    def _tpu_stream_blocker(self, native, multi_file, stripe,
+                            gen=None) -> "str | None":
+        """Why the fused native-stream loop cannot serve this phase
+        (None = eligible). Everything the stream cannot express stays on
+        the Python loop: per-op Python features, and explicit engine
+        pins that don't match the kernel's stream backend."""
+        from ..utils.native import ENGINE_CODES
+        cfg = self.cfg
+        if native is None:
+            return "native ioengine unavailable"
+        if cfg.bench_path_type == BenchPathType.DIR and gen is not None:
+            # dir-mode/custom-tree phases open one stream PER FILE: for
+            # files only a couple of ring-fills long, the ring setup +
+            # registration + teardown would outweigh the overlap it buys
+            ops = getattr(gen, "num_bytes", 0) // max(cfg.block_size, 1)
+            if ops < 2 * max(len(self._io_bufs), 1):
+                return "per-file stream too short to amortize ring setup"
+        if not native.stream_supported():
+            return "kernel lacks both io_uring and AIO"
+        if multi_file is not None and stripe is None:
+            return "unstructured multi-file mapping"
+        if self._ops_log is not None:
+            return "--opslog per-op records"
+        if self.shared.rwmix_balancer is not None:
+            return "--rwmixthr byte-ratio balancer"
+        if cfg.use_file_locks:
+            return "--flock per-op locks"
+        if cfg.do_read_inline or cfg.do_direct_verify:
+            return "--readinline/--verifydirect inline read-back"
+        if self._rate_limiter_read or self._rate_limiter_write:
+            return "per-op rate limits"
+        if cfg.io_engine != "auto" and \
+                ENGINE_CODES.get(cfg.io_engine) != native.stream_backend():
+            return (f"--ioengine {cfg.io_engine} pinned but the stream "
+                    f"backend is {native.stream_backend_name()}")
+        return None
+
+    def _run_fused_tpu_stream_loop(self, native, fd, gen, is_write,
+                                   file_offset_base,
+                                   stripe=None) -> bool:
+        """Drive the whole block loop through the engine's streaming
+        ring. Returns False when the ring cannot be opened (the caller
+        logs the fallback and runs the Python loop). Accounting goes
+        through the array-based _account_chunk per drained chunk, with
+        the dispatch-vs-DMA split riding the TransferPipeline counters
+        exactly like the Python loop."""
+        import ctypes
+        from collections import deque
+        from ..utils.native import NativeStreamError
+        cfg = self.cfg
+        if stripe is not None:
+            fds, stripe_size = list(stripe[0]), stripe[1]
+        else:
+            fds, stripe_size = [fd], 0
+        slot_addrs = [ctypes.addressof(ctypes.c_char.from_buffer(m))
+                      for m in self._io_buf_mmaps]
+        try:
+            stream = native.open_stream(fds, slot_addrs,
+                                        max(cfg.block_size, 1))
+        except NativeStreamError:
+            return False
+        if cfg.io_engine != "auto":
+            # the open may have fallen back (e.g. uring probe ok but
+            # ring mmaps ENOMEM at this slot count): an explicit
+            # --ioengine pin is enforced against the ACTUAL backend
+            from ..utils.native import ENGINE_CODES
+            if ENGINE_CODES.get(cfg.io_engine) != stream.backend:
+                stream.close()
+                return False
+        self._log_stream_mode(
+            f"fused TPU stream engaged (backend={stream.backend_name}, "
+            f"slots={len(slot_addrs)})")
+        # slot-reuse discipline: a slot is free, in the engine ring
+        # (slot_op), or held back after its H2D until the transfer ring
+        # provably drained its zero-copy import (holdback_depth). The
+        # depth is FROZEN for the phase: if the direct path latches off
+        # mid-stream, dropping it live would release slots whose
+        # earlier zero-copy imports are still in the ring undrained —
+        # holding staged-era slots a little longer is merely
+        # conservative, the reverse is a use-after-reuse.
+        hold = self._tpu.holdback_depth()
+        free = deque(range(len(slot_addrs)))
+        held: "deque[int]" = deque()
+        slot_op: dict = {}
+        chunk = self._native_chunk_blocks()
+        try:
+            while True:
+                batch = gen.next_batch(chunk)
+                if batch is None:
+                    break
+                self._fused_stream_chunk(stream, batch, is_write,
+                                         file_offset_base, stripe_size,
+                                         free, held, slot_op, hold)
+        finally:
+            # drains outstanding kernel DMA first; a failed drain means
+            # the kernel still owns ops targeting the slot buffers —
+            # cleanup() must then leak the mmaps to process teardown
+            # instead of unmapping memory a late completion DMAs into
+            if stream.close() != 0:
+                self._stream_drain_failed = True
+                logger.log_error(
+                    f"worker {self.rank}: stream ring drain failed; "
+                    f"keeping I/O buffers mapped until process exit")
+        self._tpu.flush()  # phase-end transfer drain, --tpubudget check
+        self._sync_tpu_usec()
+        return True
+
+    def _fused_stream_chunk(self, stream, batch, is_write,
+                            file_offset_base, stripe_size, free, held,
+                            slot_op, hold) -> None:
+        """One bounded chunk of the fused loop: submit every op (reaping
+        for slots as needed), then drain to a chunk barrier so the
+        array-based accounting is exact; an interrupt books the
+        completed-prefix estimate before propagating (the same contract
+        as the interrupted native block loop)."""
+        import ctypes
+        from ..utils.native import _account_chunk
+        cfg = self.cfg
+        ctx = self._tpu
+        offsets, lengths = batch
+        n = len(offsets)
+        if n == 0:
+            return
+        fd_idx, real_offs = self._stripe_offsets(offsets,
+                                                 file_offset_base,
+                                                 stripe_size)
+        flags = self._rwmix_read_flags(n) if is_write else None
+        lengths_np = (lengths if isinstance(lengths, np.ndarray)
+                      else np.asarray(lengths, dtype=np.uint64))
+        total = int(lengths_np.sum())
+        lat_arr = (ctypes.c_uint64 * n)()
+        state = {"bytes": 0}
+
+        def reap_some(min_complete: int) -> None:
+            events = stream.reap(min_complete, 1000,
+                                 self._native_interrupt)
+            if not events:
+                # timeout or interrupt: surface the interrupt, else retry
+                self.check_interruption_request(force=True)
+                return
+            for slot, lat, res in events:
+                i, r_off, length, rd = slot_op.pop(slot)
+                if res < 0:
+                    raise OSError(-res, os.strerror(-res))
+                if res != length:
+                    raise WorkerException(
+                        f"short {'read' if rd else 'write'} at offset "
+                        f"{r_off}: {res} != {length}")
+                lat_arr[i] = lat
+                state["bytes"] += res
+                ctx.stream_fused_ops += 1
+                if rd:
+                    # host->HBM DMA + verify (host memcmp or on-device),
+                    # identical to the Python loop's post-read hook
+                    self._post_read_actions(self._io_bufs[slot], r_off,
+                                            length)
+                    if hold:  # frozen per phase, see the caller
+                        held.append(slot)
+                        while len(held) > hold:
+                            free.append(held.popleft())
+                    else:
+                        free.append(slot)
+                else:
+                    free.append(slot)
+
+        try:
+            for i in range(n):
+                self.check_interruption_request()
+                while not free:
+                    if slot_op:
+                        reap_some(0)  # harvest anything already done
+                        if free:
+                            break
+                    if held:
+                        # release the oldest ingested slot by draining
+                        # its H2D from the transfer ring: after
+                        # drain_to(len(held)-1) the ring's FIFO in-flight
+                        # window only covers the newer held slots, so
+                        # held[0]'s import has provably completed.
+                        # Without this, the holdback would cap the engine
+                        # ring at n_slots-(depth-1) ops and serialize
+                        # storage I/O under --tpudirect.
+                        ctx.drain_to(len(held) - 1)
+                        free.append(held.popleft())
+                    else:
+                        reap_some(1)
+                slot = free.popleft()
+                length = int(lengths_np[i])
+                r_off = int(real_offs[i])
+                rd = bool(flags[i]) if (is_write and flags is not None) \
+                    else not is_write
+                if not rd:
+                    # write-source block originates in HBM: D2H into the
+                    # slot (the Python loop's pre-write hook)
+                    self._pre_write_fill(self._io_bufs[slot], r_off,
+                                         length)
+                slot_op[slot] = (i, r_off, length, rd)
+                stream.submit(slot,
+                              int(fd_idx[i]) if fd_idx is not None else 0,
+                              r_off, length, is_write=not rd)
+            while slot_op:  # chunk barrier: exact accounting below
+                reap_some(1)
+        except WorkerInterruptedException:
+            _account_chunk(self, lat_arr, lengths_np, n, state["bytes"],
+                           total, flags)
+            raise
+        _account_chunk(self, lat_arr, lengths_np, n, state["bytes"],
+                       total, flags)
+
     #: bounds for one native engine call, so live stats progress and
     #: interrupts stay responsive (shared by every native delegation)
     _NATIVE_CHUNK_MAX_BLOCKS = 8192
@@ -841,17 +1140,9 @@ class LocalWorker(Worker):
 
         def submit(offsets, lengths):
             self.check_interruption_request(force=True)
-            if stripe_fds:
-                # vectorized calcFileIdxAndOffsetStriped: global offset ->
-                # (file index, in-file offset)
-                goffs = offsets + np.uint64(file_offset_base)
-                fd_idx = (goffs // np.uint64(stripe_size)).astype(np.uint32)
-                offsets = goffs % np.uint64(stripe_size)
-                fds, idx = stripe_fds, fd_idx
-            else:
-                if file_offset_base:
-                    offsets = offsets + np.uint64(file_offset_base)
-                fds = idx = None
+            idx, offsets = self._stripe_offsets(offsets, file_offset_base,
+                                                stripe_size)
+            fds = stripe_fds if stripe_fds else None
             # per-op modulo split, vectorized (reference:
             # (workerRank+numIOPSSubmitted)%100 < pct, :1741-1742)
             flags = self._rwmix_read_flags(len(offsets)) if is_write \

@@ -71,6 +71,66 @@ def test_unreachable_tpu_emits_machine_readable_failure_line():
     assert "pipeline_ab" in rec and rec["pipeline_ab"] is None
 
 
+def test_probe_window_clamps_attempt_timeout(monkeypatch):
+    """The probe window is a HARD deadline (BENCH_r05: attempt 6 started
+    at at_s=1200.0 of a 1200s window and burned 1380s of a 1500s
+    budget): an attempt's timeout is clamped to the window remainder, so
+    a hanging probe consumes the window — never more."""
+    import time as time_mod
+    import bench
+    monkeypatch.setattr(bench, "PROBE_WINDOW_S", 2)
+    monkeypatch.setattr(bench, "PROBE_ATTEMPT_TIMEOUT_S", 600)
+    monkeypatch.setattr(bench, "_T_START", time_mod.monotonic())
+    monkeypatch.setitem(bench._STATE, "timeline", [])
+    monkeypatch.setitem(bench._STATE, "effective_window_s", None)
+
+    def hanging_probe(timeout_secs):
+        # a wedged tunnel: the probe blocks until its own timeout
+        time_mod.sleep(timeout_secs)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout_secs)
+
+    monkeypatch.setattr(bench, "_probe_tpu_once", hanging_probe)
+    t0 = time_mod.monotonic()
+    with pytest.raises(bench.BenchUnavailable):
+        bench._probe_tpu_with_retry()
+    took = time_mod.monotonic() - t0
+    window = bench._STATE["effective_window_s"]
+    assert window == 2
+    timeline = bench._STATE["timeline"]
+    assert timeline, "no attempt recorded"
+    for entry in timeline:
+        # no attempt starts at/after the window edge, and none overruns it
+        assert entry["at_s"] < window, entry
+        assert entry["at_s"] + entry["elapsed_s"] <= window + 0.5, entry
+    # the whole retry loop respects the window (unclamped, the single
+    # 600s attempt timeout would blow straight through it)
+    assert took <= window + 1.5, took
+
+
+def test_probe_window_edge_starts_no_new_attempt(monkeypatch):
+    """Fast-failing attempts with backoff: when the backoff sleep lands
+    on the window edge, the loop must raise instead of starting another
+    attempt at at_s >= window (the exact BENCH_r05 timeline shape)."""
+    import time as time_mod
+    import bench
+    monkeypatch.setattr(bench, "PROBE_WINDOW_S", 1)
+    monkeypatch.setattr(bench, "PROBE_ATTEMPT_TIMEOUT_S", 600)
+    monkeypatch.setattr(bench, "_T_START", time_mod.monotonic())
+    monkeypatch.setitem(bench._STATE, "timeline", [])
+    monkeypatch.setitem(bench._STATE, "effective_window_s", None)
+
+    def failing_probe(timeout_secs):  # noqa: ARG001
+        raise RuntimeError("tunnel down")
+
+    monkeypatch.setattr(bench, "_probe_tpu_once", failing_probe)
+    with pytest.raises(bench.BenchUnavailable) as exc:
+        bench._probe_tpu_with_retry()
+    window = bench._STATE["effective_window_s"]
+    for entry in bench._STATE["timeline"]:
+        assert entry["at_s"] < window, entry
+    assert "window" in str(exc.value)
+
+
 def test_sigterm_mid_probe_emits_artifact_immediately():
     """Round-3 failure mode: the driver killed bench.py before the probe
     window closed and the artifact was never printed. A SIGTERM must now

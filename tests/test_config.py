@@ -352,3 +352,49 @@ def test_write_new_file_without_size_rejected(tmp_path):
     cfg, _ = parse_cli(["-w", "-b", "64K", str(tmp_path / "newfile.bin")])
     with pytest.raises(ConfigError, match="must not be 0"):
         cfg.derive()
+
+
+def test_tpubatch_with_tpuverify_rejected(tmp_path):
+    """--tpubatch > 1 + --tpuverify is a clean ConfigError: the
+    aggregated DMA span has no per-block on-device check, so the
+    combination would silently verify nothing (the host_to_device
+    aggregation branch returns before the verify hook)."""
+    cfg, _ = parse_cli(["-w", "-s", "64K", "-b", "16K", "--tpuids", "0",
+                        "--verify", "7", "--tpuverify", "--tpubatch", "4",
+                        str(tmp_path / "f")])
+    with pytest.raises(ConfigError, match="tpubatch.*tpuverify"):
+        cfg.derive()
+        cfg.check()
+    # either flag alone stays valid
+    cfg2, _ = parse_cli(["-w", "-s", "64K", "-b", "16K", "--tpuids", "0",
+                         "--tpubatch", "4", str(tmp_path / "f")])
+    cfg2.derive()
+    cfg2.check()
+
+
+def test_tpustream_flag_validation(tmp_path):
+    """--tpustream accepts auto|on|off; 'on' demands --tpuids (the fused
+    loop streams storage into TPU staging slots)."""
+    cfg, _ = parse_cli(["-w", "-s", "64K", "-b", "16K", "--tpuids", "0",
+                        "--tpustream", "on", str(tmp_path / "f")])
+    cfg.derive()
+    cfg.check()
+    assert cfg.tpu_stream == "on"
+    cfg2, _ = parse_cli(["-w", "-s", "64K", "-b", "16K", "--tpustream",
+                         "bogus", "--tpuids", "0", str(tmp_path / "f")])
+    with pytest.raises(ConfigError, match="auto.on.off"):
+        cfg2.derive()
+        cfg2.check()
+    cfg3, _ = parse_cli(["-w", "-s", "64K", "-b", "16K", "--tpustream",
+                         "on", str(tmp_path / "f")])
+    with pytest.raises(ConfigError, match="tpuids"):
+        cfg3.derive()
+        cfg3.check()
+    # paths that never reach the block loop can't honor the fail-loudly
+    # contract: reject at config time instead of silently passing green
+    cfg4, _ = parse_cli(["-w", "-s", "64K", "-b", "16K", "--mmap",
+                         "--tpuids", "0", "--tpustream", "on",
+                         str(tmp_path / "f")])
+    with pytest.raises(ConfigError, match="POSIX block I/O"):
+        cfg4.derive()
+        cfg4.check()

@@ -402,7 +402,10 @@ def _bench_capture_file(tmp_path):
         "tpu_pipe_inflight_hwm": 4,
         "pipeline_ab": {"sync_mibs": 400.0, "pipelined_mibs": 900.0,
                         "pipelined_vs_sync": 2.25, "sync_dispatch_usec": 800,
-                        "sync_inflight_hwm": 1}}
+                        "sync_inflight_hwm": 1},
+        "tpustream_ab": {"python_mibs": 700.0, "fused_mibs": 910.0,
+                         "fused_vs_python": 1.3, "fused_ops": 16,
+                         "python_loop_fused_ops": 0}}
     failed = {
         "metric": "seq read ...", "value": None, "unit": "MiB/s",
         "utc": "2026-08-02T00:00:00Z", "pipeline_ab": None,
@@ -438,6 +441,14 @@ def test_summarize_json_bench_capture_ab(tmp_path):
     assert "pipelined/sync" in res.stdout
     assert "2.25" in res.stdout and "measured" in res.stdout
     assert "2.171" in res.stdout and "stale_last_success" in res.stdout
+    # the fused-vs-python stream A/B appends to the RIGHT of the existing
+    # columns (consumers keyed by position keep working)
+    assert "fused/python" in res.stdout and "1.3" in res.stdout
+    csv = _tool("elbencho-tpu-summarize-json", [str(cap), "--csv"])
+    header = csv.stdout.splitlines()[0].split(",")
+    assert header[:6] == ["utc", "value MiB/s", "sync MiB/s",
+                          "pipelined MiB/s", "pipelined/sync", "source"]
+    assert header[6:] == ["python MiB/s", "fused MiB/s", "fused/python"]
 
 
 def test_chart_tool_rejects_phase_records_cleanly(tmp_path):
