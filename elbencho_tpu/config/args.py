@@ -209,6 +209,28 @@ FLAG_DEFS = [
     ("opsloglock", None, "ops_log_lock", "bool", False, "misc",
      "Serialize ops log writes via file lock (for shared-file logs)"),
 
+    # telemetry (Prometheus /metrics + per-op tracing; docs/telemetry.md)
+    ("telemetry", None, "telemetry", "bool", False, "misc",
+     "Serve a Prometheus /metrics endpoint while the benchmark runs "
+     "(local/master: standalone server on --telemetryport; the master "
+     "exports a fleet-aggregated view harvested from its /status polls; "
+     "services always serve /metrics on their control port)"),
+    ("telemetryport", None, "telemetry_port", "int", 1612, "misc",
+     "TCP port of the standalone /metrics endpoint (--telemetry in "
+     "local/master mode; service mode reuses the --port control server)"),
+    ("tracefile", None, "trace_file_path", "str", "", "misc",
+     "Record per-op spans (phase, rank, op, offset, size, latency, "
+     "staging slot; TPU dispatch-vs-DMA and stream-reap sub-spans) into "
+     "this Chrome trace-event JSON file, loadable in Perfetto; services "
+     "write per-host files suffixed .r<rankoffset>; the plain native "
+     "block loops fall back to the (instrumented) Python loop while "
+     "tracing — the fused --tpustream ring records its own spans and "
+     "stays engaged"),
+    ("tracesample", None, "trace_sample", "float", 1.0, "misc",
+     "Keep only this fraction of spans in the --tracefile ring (0..1; "
+     "applies to op spans and the per-op tpu/stream sub-spans; phase "
+     "markers are always kept)"),
+
     # distribution
     ("hosts", None, "hosts_str", "str", "", "dist",
      "Comma-separated service hosts (host[:port])"),
@@ -1132,6 +1154,18 @@ class BenchConfig(BenchConfigBase):
                                   self.s3_acl_grants)
             except ValueError as err:
                 raise ConfigError(str(err)) from err
+        if not (0 < self.telemetry_port < 65536):
+            raise ConfigError("--telemetryport must be in 1..65535")
+        # no service-side telemetry-port checks: the standalone exporter
+        # only ever starts in local/master mode (service mode serves
+        # /metrics on its control --port), and the master's flags travel
+        # the config wire to hosts where its port numbers mean nothing
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ConfigError("--tracesample must be in 0..1")
+        if self.trace_sample != 1.0 and not self.trace_file_path:
+            raise ConfigError(
+                "--tracesample tunes the --tracefile span recorder — "
+                "give --tracefile PATH")
         if self.svc_num_retries < 0:
             raise ConfigError("--svcretries must be >= 0")
         if self.svc_retry_budget_secs < 0:

@@ -30,6 +30,8 @@ class Coordinator:
         self._profile_seq = 0
         self._profile_warned_hosts = False
         self._old_sigint = None
+        self._telemetry = None   # BenchTelemetry when --telemetry
+        self._exporter = None    # its /metrics HTTP server
 
     # ------------------------------------------------------------------
 
@@ -51,6 +53,7 @@ class Coordinator:
         cfg = self.cfg
         self._install_signal_handler()
         try:
+            self._start_telemetry()
             if cfg.hosts:
                 from .service.remote_worker import wait_for_services_ready
                 wait_for_services_ready(cfg.hosts, cfg.service_port,
@@ -73,7 +76,34 @@ class Coordinator:
             except Exception:  # noqa: BLE001 - teardown must not mask errors
                 pass
             self.statistics.close()
+            if self._exporter is not None:
+                self._exporter.stop()
             self._restore_signal_handler()
+
+    def _start_telemetry(self) -> None:
+        """--telemetry: standalone Prometheus /metrics endpoint for
+        local/master runs (service mode piggybacks onto the control
+        server's route table instead, service/http_service.py). The
+        provider indirection follows manager/statistics across
+        --rotatehosts rebuilds."""
+        cfg = self.cfg
+        if not cfg.telemetry:
+            return
+        from .telemetry.exporter import TelemetryExporter
+        from .telemetry.registry import BenchTelemetry
+        telemetry = BenchTelemetry(
+            cfg, lambda: (self.statistics, self.manager),
+            role="master" if cfg.hosts else "local")
+        self._telemetry = telemetry
+        self.statistics.telemetry = telemetry
+        exporter = TelemetryExporter(telemetry, cfg.telemetry_port)
+        try:
+            exporter.start()
+        except OSError as err:
+            raise WorkerException(
+                f"--telemetry: cannot bind --telemetryport "
+                f"{cfg.telemetry_port}: {err}") from err
+        self._exporter = exporter
 
     def _wait_for_sync_start(self) -> None:
         """--start: cross-host synchronized start (reference: :150-159;
@@ -127,7 +157,10 @@ class Coordinator:
     def run_benchmark_phase(self, phase: BenchPhase) -> None:
         """Start phase -> live stats -> wait done -> print results
         (reference: runBenchmarkPhase, Coordinator.cpp:249)."""
+        from .phases import phase_name
         phase_start = time.monotonic()
+        tracer = self.manager.shared.tracer
+        trace_t0 = tracer.now_ns() if tracer is not None else 0
         profiling = self._start_tpu_profile(phase)
         try:
             self.manager.start_next_phase(phase)
@@ -136,6 +169,15 @@ class Coordinator:
         finally:
             if profiling:
                 self._stop_tpu_profile()
+            if tracer is not None:
+                # phase marker span + persist the ring, so the trace file
+                # is loadable after every phase (and after an abort)
+                tracer.record(phase_name(phase), "phase", trace_t0,
+                              (tracer.now_ns() - trace_t0) // 1000)
+                try:
+                    tracer.write()
+                except OSError as err:
+                    logger.log_error(f"--tracefile write failed: {err}")
         self.statistics.print_phase_results(phase)
         if self._interrupted:
             # user Ctrl-C: print what we have for this phase, then abort the
@@ -206,9 +248,16 @@ class Coordinator:
         if not k:
             return
         cfg.hosts = cfg.hosts[k:] + cfg.hosts[:k]
+        old_tracer = self.manager.shared.tracer
         self.manager.join_all_threads()
         self.manager = WorkerManager(cfg)
+        if old_tracer is not None:
+            # keep the run's span ring across the rebuild: a fresh tracer
+            # at the same path would overwrite the file and silently drop
+            # every pre-rotation span at the next phase-end write()
+            self.manager.shared.tracer = old_tracer
         self.statistics = Statistics(cfg, self.manager)
+        self.statistics.telemetry = self._telemetry  # follow the rebuild
         self.manager.prepare_threads()
 
     # ------------------------------------------------------------------

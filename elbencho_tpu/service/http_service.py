@@ -49,6 +49,13 @@ class ServiceState:
         self.pw_hash = ""
         if base_cfg.svc_password_file:
             self.pw_hash = proto.read_pw_file(base_cfg.svc_password_file)
+        # /metrics piggyback (telemetry subsystem): one sampler for the
+        # service lifetime; the provider indirection follows the worker
+        # pool across /preparephase rebuilds
+        from ..telemetry.registry import BenchTelemetry
+        self._telemetry = BenchTelemetry(
+            base_cfg, lambda: (self.statistics, self.manager),
+            role="service")
 
     def teardown_workers(self) -> None:
         if self.manager is not None:
@@ -88,6 +95,11 @@ class ServiceState:
         if cfg.tree_file_path:
             cfg.tree_file_path = self._uploaded_file_path(
                 os.path.basename(cfg.tree_file_path))
+        if cfg.trace_file_path:
+            # one trace file per service host: suffix with the master's
+            # rank offset so a shared filesystem can't clobber files
+            base, ext = os.path.splitext(cfg.trace_file_path)
+            cfg.trace_file_path = f"{base}.r{cfg.rank_offset}{ext}"
         cfg.derive()
         cfg.check()
         self.cfg = cfg
@@ -142,7 +154,17 @@ class ServiceState:
             return {}
         result = self.statistics.get_bench_result_dict()
         result[proto.KEY_ERROR_HISTORY] = logger.get_error_history()
+        tracer = self.manager.shared.tracer if self.manager else None
+        if tracer is not None:
+            try:  # phase is over: persist the span ring for Perfetto
+                tracer.write()
+            except OSError as err:
+                logger.log_error(f"--tracefile write failed: {err}")
         return result
+
+    def metrics(self) -> str:
+        """Prometheus text rendering of this service's live state."""
+        return self._telemetry.render()
 
     def interrupt(self) -> None:
         if self.manager is not None:
@@ -153,6 +175,13 @@ class ServiceState:
 def _make_handler(state: ServiceState, server_holder: dict):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # the server is single-threaded by design (no concurrent worker-
+        # pool mutation); a keep-alive client that parks its connection
+        # between requests (Prometheus scrapers on /metrics do) would
+        # otherwise block the whole control plane inside readline() —
+        # time the idle connection out instead (handle_one_request turns
+        # socket.timeout into close_connection)
+        timeout = 5
 
         def log_message(self, fmt, *args):  # quiet by default
             logger.log(logger.LOG_DEBUG, "HTTP " + fmt % args)
@@ -200,6 +229,10 @@ def _make_handler(state: ServiceState, server_holder: dict):
                                 content_type="text/plain")
                 elif route == proto.PATH_STATUS:
                     self._reply(200, state.status())
+                elif route == proto.PATH_METRICS:
+                    from ..telemetry.registry import PROMETHEUS_CONTENT_TYPE
+                    self._reply(200, state.metrics(),
+                                content_type=PROMETHEUS_CONTENT_TYPE)
                 elif route == proto.PATH_BENCH_RESULT:
                     self._reply(200, state.bench_result())
                 elif route == proto.PATH_START_PHASE:

@@ -18,6 +18,9 @@ PATH_PREPARE_FILE = "/preparefile"
 PATH_PREPARE_PHASE = "/preparephase"
 PATH_START_PHASE = "/startphase"
 PATH_INTERRUPT_PHASE = "/interruptphase"
+# telemetry extension (ours; no reference equivalent): Prometheus
+# text-format metrics piggybacked onto the service route table
+PATH_METRICS = "/metrics"
 
 # transferred parameter keys (reference: XFER_*, Common.h:251-298)
 KEY_PROTOCOL_VERSION = "ProtocolVersion"

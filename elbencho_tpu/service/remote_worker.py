@@ -235,6 +235,7 @@ class RemoteWorker(Worker):
         self.host = host
         self.host_idx = host_idx
         self.last_ping_usec = 0  # --svcping: last /status RTT
+        self.cpu_util_pct = 0.0  # last /status CPUUtil (telemetry gauge)
         self.degraded = False    # --svctolerant: host lost mid-run
         # control-plane audit counters (CONTROL_AUDIT_COUNTERS schema)
         self.svc_retries = 0
@@ -425,6 +426,7 @@ class RemoteWorker(Worker):
                 stats.get(proto.KEY_NUM_BYTES_DONE, 0)
             self.live_ops.num_iops_done = \
                 stats.get(proto.KEY_NUM_IOPS_DONE, 0)
+            self._ingest_live_telemetry(stats)
             if stats.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
                 raise WorkerRemoteException(
                     f"worker error on service {self.host}"
@@ -448,6 +450,27 @@ class RemoteWorker(Worker):
                     f"for {stalled_secs}s (--svcstalledsecs)")
             time.sleep(interval)
             interval = min(interval * 2, max_interval)
+
+    def _ingest_live_telemetry(self, stats: dict) -> None:
+        """Mirror the per-host telemetry harvest of a /status reply into
+        this worker's ingest attributes, so the master's /metrics fleet
+        aggregation (sum_path_audit_counters + the MAX-merge rules) works
+        MID-RUN exactly like the phase-end /benchresult ingest does. The
+        final /benchresult ingest overwrites all of these."""
+        from ..tpu.device import PATH_AUDIT_COUNTERS
+        self.cpu_util_pct = stats.get("CPUUtil", 0.0)
+        if "TpuHbmBytes" not in stats:
+            return  # pre-telemetry service replied (tests with old stubs)
+        self.tpu_transfer_bytes = stats.get("TpuHbmBytes", 0)
+        self.tpu_transfer_usec = stats.get("TpuHbmUSec", 0)
+        self.tpu_dispatch_usec = stats.get("TpuHbmDispatchUSec", 0)
+        for _attr, key, ingest_attr in PATH_AUDIT_COUNTERS:
+            setattr(self, ingest_attr, stats.get(key, 0))
+        if "IOLatHisto" in stats:  # --telemetry: bucket-level live view
+            self.iops_latency_histo = LatencyHistogram.from_dict(
+                stats["IOLatHisto"])
+            self.entries_latency_histo = LatencyHistogram.from_dict(
+                stats.get("EntLatHisto", {}))
 
     def _replay_error_history(self, reply: dict) -> "list[str]":
         """Log the service's error-history lines under this host's prefix

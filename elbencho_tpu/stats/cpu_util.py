@@ -38,3 +38,29 @@ class CPUUtil:
     @property
     def percent(self) -> float:
         return self._current_pct
+
+
+class SampledCPUUtil(CPUUtil):
+    """CPUUtil for on-demand samplers (/status replies, /metrics
+    scrapes) that must not touch the benchmark's shared phase meter —
+    updating that one would reset its /proc/stat baseline out from under
+    the stonewall/last-done snapshots. Baseline-primed at construction
+    (a first unprimed delta would report the since-boot average), and
+    rate-limited so a fast poller can't shrink the measurement window
+    into jiffy noise."""
+
+    def __init__(self, min_interval_secs: float = 1.0):
+        import time
+        super().__init__()
+        self._min_interval = min_interval_secs
+        self.update()  # prime the baseline; percent stays 0 until due
+        self._last_sample = time.monotonic()  # window starts at priming
+
+    def sample(self) -> float:
+        """update() if the window elapsed, else the last value."""
+        import time
+        now = time.monotonic()
+        if now - self._last_sample >= self._min_interval:
+            self._last_sample = now
+            return self.update()
+        return self._current_pct

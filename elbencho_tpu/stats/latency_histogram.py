@@ -152,6 +152,34 @@ class LatencyHistogram:
             histo.buckets[int(idx_str)] = int(count)
         return histo
 
+    def to_prometheus_buckets(self) -> "list[tuple[float, int]]":
+        """Cumulative (upper_bound_usec, count) pairs over the log2
+        buckets for Prometheus histogram exposition (telemetry/registry):
+        a value in bucket i is < bucket_lower_bound(i + 1), so that upper
+        edge is the bucket's ``le`` bound. Always ends with (+Inf,
+        num_values); counts are monotonically non-decreasing by
+        construction. Only buckets up to the last non-empty one are
+        emitted (the tail would repeat num_values 100+ times)."""
+        out: "list[tuple[float, int]]" = []
+        running = 0
+        last_nonzero = -1
+        for idx in range(NUM_BUCKETS - 1, -1, -1):
+            if self.buckets[idx]:
+                last_nonzero = idx
+                break
+        for idx in range(last_nonzero + 1):
+            running += self.buckets[idx]
+            le = bucket_lower_bound(idx + 1)
+            if idx == NUM_BUCKETS - 1 and self.max_micro >= le:
+                # the top bucket CLAMPS outliers beyond its bound
+                # (bucket_index); reporting them under a finite `le`
+                # they exceed would cap every derived quantile there —
+                # fold the clamp bucket into +Inf instead
+                break
+            out.append((le, running))
+        out.append((float("inf"), self.num_values))
+        return out
+
     def histogram_str(self) -> str:
         """Compact "bucketLowerBound=count" dump for --lathisto."""
         parts = [f"{bucket_lower_bound(i):.0f}us={c}"

@@ -23,6 +23,31 @@ from ..tpu.device import PATH_AUDIT_COUNTERS, sum_path_audit_counters
 from .latency_histogram import LatencyHistogram
 
 
+def sum_tpu_transfer_totals(workers) -> "tuple[int, int, int]":
+    """(bytes, dma_usec, dispatch_usec) summed over a worker list — the
+    live-wire and /metrics aggregation of the dispatch-vs-DMA split
+    (one definition so the two exports can never diverge)."""
+    tpu_bytes = tpu_usec = tpu_dispatch_usec = 0
+    for w in workers:
+        tpu_bytes += w.tpu_transfer_bytes
+        tpu_usec += w.tpu_transfer_usec
+        tpu_dispatch_usec += w.tpu_dispatch_usec
+    return tpu_bytes, tpu_usec, tpu_dispatch_usec
+
+
+def merge_live_latency_histos(workers) -> "tuple[LatencyHistogram, ...]":
+    """(io, entries) histograms merged over a worker list for the live
+    telemetry views (rwmix reads fold into io — a live scrape wants one
+    op-latency distribution, not the result table's split)."""
+    io_histo = LatencyHistogram()
+    ent_histo = LatencyHistogram()
+    for w in workers:
+        io_histo.merge(w.iops_latency_histo)
+        io_histo.merge(w.iops_latency_histo_rwmix)
+        ent_histo.merge(w.entries_latency_histo)
+    return io_histo, ent_histo
+
+
 def _fmt_elapsed_usec(usec: int) -> str:
     secs = usec / 1_000_000
     if secs >= 60:
@@ -61,6 +86,9 @@ class PhaseResults:
         self.tpu_path_counters: "dict[str, int]" = {
             key: 0 for _attr, key, _ingest in PATH_AUDIT_COUNTERS}
         self.num_workers = 0
+        # per-service-host CPU util at phase end (telemetry satellite;
+        # JSON-only result key HostCPUUtil)
+        self.host_cpu_util: "dict[str, float]" = {}
         # --svctolerant: hosts lost mid-run (results exclude them)
         self.degraded_hosts: "list[str]" = []
         # control-plane audit (fault_tolerance.CONTROL_AUDIT_COUNTERS)
@@ -76,6 +104,14 @@ class Statistics:
         self._live_json_fh = None
         self._live_started = 0.0
         self._fullscreen_active = False
+        # --telemetry: BenchTelemetry bound by the coordinator; the live
+        # loop samples it at its cadence so scrapes between intervals
+        # read a warm snapshot
+        self.telemetry = None
+        # dedicated CPU meter for /status replies (primed, rate-limited;
+        # see SampledCPUUtil for why the shared phase meter is off limits)
+        from .cpu_util import SampledCPUUtil
+        self._status_cpu = SampledCPUUtil()
 
     # ------------------------------------------------------------------
     # live statistics (reference: printLiveStats, Statistics.cpp:1337)
@@ -124,6 +160,8 @@ class Statistics:
             # live CSV/JSON files are written even when console live stats
             # are off (--nolive / service mode)
             self._write_live_files(phase, entries, num_bytes, iops, elapsed)
+            if self.telemetry is not None:
+                self.telemetry.sample()  # live-stats-cadence sampling
             if not use_line:
                 continue
             unit, div = ("MB", 1000 ** 2) if cfg.use_base10_units \
@@ -208,6 +246,14 @@ class Statistics:
             lines.append(f"... showing {scroll}..{scroll + len(window) - 1} "
                          f"of {len(workers)} workers (arrow keys / PgUp / "
                          f"PgDn scroll)")
+        # footer: per-service-host CPU util sampled from the /status polls
+        # (telemetry satellite; RemoteWorker.cpu_util_pct live ingest)
+        host_cpus = [(w.host, w.cpu_util_pct) for w in workers
+                     if getattr(w, "host", None) is not None
+                     and hasattr(w, "cpu_util_pct")]
+        if host_cpus:
+            lines.append("Host CPU%: " + "  ".join(
+                f"{h}={p:.0f}" for h, p in host_cpus))
         frame = "\x1b[H" + "\x1b[2K" + "\n\x1b[2K".join(lines) + "\x1b[J"
         if not self._fullscreen_active:
             print("\x1b[2J", end="")
@@ -320,6 +366,22 @@ class Statistics:
                     {"Rank": w.rank, **w.live_ops.as_dict()}
                     for w in self.manager.workers]
             print(json.dumps(rec), file=self._live_json_fh, flush=True)
+        self._flush_live_files()
+
+    def _flush_live_files(self) -> None:
+        """Push the live streams all the way to stable storage every
+        interval: flush() alone leaves rows in the OS page cache, where a
+        tailer/scraper on another host (network filesystem) only sees
+        them on buffer-boundary writeback — fsync is best effort (stdout
+        and pipes have no fsync)."""
+        for fh in (self._live_csv_fh, self._live_json_fh):
+            if fh is None:
+                continue
+            try:
+                fh.flush()
+                os.fsync(fh.fileno())
+            except (OSError, ValueError):
+                pass
 
     # ------------------------------------------------------------------
     # phase results (reference: printPhaseResults :1619 /
@@ -376,6 +438,11 @@ class Statistics:
                     b, u = res.tpu_per_chip.get(chip, (0, 0))
                     res.tpu_per_chip[chip] = (b + b2, u + u2)
         res.tpu_path_counters = sum_path_audit_counters(workers)
+        # per-host CPU util (last /status ingest of each RemoteWorker)
+        res.host_cpu_util = {
+            w.host: round(getattr(w, "cpu_util_pct", 0.0), 1)
+            for w in self.manager.workers
+            if getattr(w, "host", None) is not None}
         from ..service.fault_tolerance import merge_control_audit_counters
         res.control_counters = merge_control_audit_counters(
             self.manager.workers)
@@ -587,6 +654,14 @@ class Statistics:
             "NumHostsDegraded": len(res.degraded_hosts),
             "DegradedHosts": list(res.degraded_hosts),
             **res.control_counters,
+            # telemetry (JSON-only): per-host CPU view, /metrics scrapes
+            # served this run, spans recorded by the --tracefile ring
+            "HostCPUUtil": dict(res.host_cpu_util),
+            "TelemetryScrapes": (self.telemetry.registry.scrapes
+                                 if self.telemetry is not None else 0),
+            "TraceEvents": (self.manager.shared.tracer.num_recorded
+                            if self.manager.shared.tracer is not None
+                            else 0),
         }
         # unconditional so CSV rows keep a fixed column count
         rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
@@ -661,6 +736,8 @@ class Statistics:
         rec.pop("DegradedHosts")  # list is JSON-only; the count stays CSV
         for _attr, key, _mode in CONTROL_AUDIT_COUNTERS:  # JSON-only keys
             rec.pop(key)
+        for key in ("HostCPUUtil", "TelemetryScrapes", "TraceEvents"):
+            rec.pop(key)  # telemetry keys are JSON-only
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
         path = self.cfg.csv_file_path
@@ -690,14 +767,17 @@ class Statistics:
     def get_live_stats_dict(self) -> dict:
         entries, num_bytes, iops, done = self._sum_live_ops()
         shared = self.manager.shared
+        workers = self.manager.workers
         lat_sums = {"NumIOLatUSec": 0, "SumIOLatUSec": 0,
                     "NumEntLatUSec": 0, "SumEntLatUSec": 0}
-        for w in self.manager.workers:
+        for w in workers:
             lat_sums["NumIOLatUSec"] += w.iops_latency_histo.num_values
             lat_sums["SumIOLatUSec"] += w.iops_latency_histo.sum_micro
             lat_sums["NumEntLatUSec"] += w.entries_latency_histo.num_values
             lat_sums["SumEntLatUSec"] += w.entries_latency_histo.sum_micro
-        return {
+        tpu_bytes, tpu_usec, tpu_dispatch_usec = \
+            sum_tpu_transfer_totals(workers)
+        stats = {
             "BenchID": shared.bench_uuid,
             "PhaseCode": int(shared.current_phase),
             "PhaseName": phase_name(shared.current_phase),
@@ -706,9 +786,25 @@ class Statistics:
             "NumEntriesDone": entries,
             "NumBytesDone": num_bytes,
             "NumIOPSDone": iops,
-            "CPUUtil": round(shared.cpu_util.percent, 1),
+            "CPUUtil": round(self._status_cpu.sample(), 1),
             **lat_sums,
+            # live telemetry harvest: the master mirrors these into its
+            # RemoteWorker's ingest attributes on every /status poll so
+            # its /metrics fleet view aggregates mid-run (same wire keys
+            # and merge rules as the phase-end /benchresult payload)
+            "TpuHbmBytes": tpu_bytes,
+            "TpuHbmUSec": tpu_usec,
+            "TpuHbmDispatchUSec": tpu_dispatch_usec,
+            **sum_path_audit_counters(workers),
         }
+        if getattr(self.cfg, "telemetry", False):
+            # bucket-level latency for the master's /metrics histogram;
+            # only shipped when the master asked for telemetry (the flag
+            # travels the config wire) to keep the common poll lean
+            io_histo, ent_histo = merge_live_latency_histos(workers)
+            stats["IOLatHisto"] = io_histo.to_dict()
+            stats["EntLatHisto"] = ent_histo.to_dict()
+        return stats
 
     def get_bench_result_dict(self) -> dict:
         """Final per-phase result for the master (per-worker elapsed vec +

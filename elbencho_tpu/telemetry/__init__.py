@@ -1,0 +1,26 @@
+"""Telemetry subsystem: Prometheus /metrics + per-op trace recorder.
+
+The reference's observability stops at console live stats and end-of-phase
+CSV/JSON (source/Statistics.{h,cpp}); a running multi-host benchmark cannot
+be scraped, and a slow phase cannot be decomposed into storage-reap vs HBM
+dispatch vs DMA vs control-plane time without rerunning under the bench
+harness. This package adds both, without touching the workers' hot paths:
+
+  registry.py  lock-light metric registry (counters/gauges/histograms)
+               that SAMPLES the existing per-worker live counters, the
+               PATH_AUDIT_COUNTERS / CONTROL_AUDIT_COUNTERS plumbing and
+               the TPU dispatch-vs-DMA split — workers pay nothing extra.
+  exporter.py  Prometheus text-format /metrics HTTP endpoint
+               (--telemetry/--telemetryport), standalone in local/master
+               mode; in service mode the same rendering piggybacks onto
+               the existing http_service route table. The master
+               re-exports a FLEET-AGGREGATED view harvested from the
+               /status polls it already makes (sum/MAX merge rules of
+               the service wire protocol, docs/telemetry.md).
+  tracer.py    bounded ring-buffer per-op span recorder (--tracefile,
+               --tracesample) with Chrome trace-event JSON output
+               loadable in Perfetto; instrumentation resolves to no-ops
+               when tracing is off.
+"""
+
+from __future__ import annotations

@@ -1,0 +1,91 @@
+"""Standalone Prometheus /metrics HTTP endpoint (--telemetry).
+
+Local and master runs have no HTTP server of their own (the control-plane
+server only exists in --service mode, where /metrics piggybacks onto its
+route table instead — service/http_service.py), so the exporter brings a
+minimal one: a daemon thread serving GET /metrics in the Prometheus text
+exposition format on --telemetryport. The render path samples the live
+benchmark state on every scrape (registry.BenchTelemetry), reading
+worker-owned counters under the GIL — a scrape can never block a worker.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..toolkits import logger
+from .registry import PROMETHEUS_CONTENT_TYPE, BenchTelemetry
+
+#: default --telemetryport (service control port 1611 + 1; netbench's
+#: data port rides +1000, so +1 stays clear of both)
+DEFAULT_TELEMETRY_PORT = 1612
+
+
+def _make_handler(telemetry: BenchTelemetry):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            logger.log(logger.LOG_DEBUG, "telemetry HTTP " + fmt % args)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                try:
+                    body = telemetry.render().encode()
+                except Exception as err:  # noqa: BLE001 - reply over HTTP
+                    self._reply(500, f"# scrape failed: {err}\n".encode(),
+                                "text/plain")
+                    return
+                self._reply(200, body, PROMETHEUS_CONTENT_TYPE)
+            elif path == "/":
+                self._reply(200, b"<html><body>elbencho-tpu telemetry "
+                                 b"&mdash; <a href='/metrics'>/metrics"
+                                 b"</a></body></html>", "text/html")
+            else:
+                self._reply(404, b"unknown path (try /metrics)\n",
+                            "text/plain")
+
+        def _reply(self, code: int, body: bytes, content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    return Handler
+
+
+class TelemetryExporter:
+    """Owns the /metrics HTTP server thread for local/master runs."""
+
+    def __init__(self, telemetry: BenchTelemetry, port: int):
+        self.telemetry = telemetry
+        self.port = port
+        self._server: "ThreadingHTTPServer | None" = None
+        self._thread: "threading.Thread | None" = None
+
+    def start(self) -> None:
+        """Bind + serve in a daemon thread. Raises OSError on a busy port
+        (the caller fails the run loudly — a benchmark whose telemetry
+        the user asked for must not silently run unobserved)."""
+        self._server = ThreadingHTTPServer(
+            ("0.0.0.0", self.port), _make_handler(self.telemetry))
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.5},
+            name="telemetry-exporter", daemon=True)
+        self._thread.start()
+        logger.log(logger.LOG_NORMAL,
+                   f"telemetry: serving Prometheus metrics on "
+                   f":{self.port}/metrics")
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

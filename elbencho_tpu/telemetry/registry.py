@@ -1,0 +1,308 @@
+"""Lock-light metric registry + the benchmark sampler behind /metrics.
+
+Design contract (ISSUE 4 tentpole): workers never push into the registry —
+it SAMPLES the counters the benchmark already maintains (per-worker
+``live_ops``, the ``PATH_AUDIT_COUNTERS`` / ``CONTROL_AUDIT_COUNTERS``
+schemas, the TPU dispatch-vs-DMA split of ``TransferPipeline``) on the
+coordinator's existing live-stats cadence and on scrape. All of those are
+plain ints written by their owning thread and read here under the GIL —
+the same safety argument ``Statistics._sum_live_ops`` already relies on —
+so the hot paths pay nothing and the registry needs no locks beyond a
+snapshot-dict swap.
+
+Fleet aggregation (master mode): ``sum_path_audit_counters`` /
+``merge_control_audit_counters`` are the SAME merge helpers the service
+wire protocol uses (sum, except the documented MAX-merged high-water
+marks), applied over the RemoteWorkers' live-ingested per-host counters —
+the master's /metrics is therefore by construction the sum/MAX of the
+per-host /metrics views.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .. import __version__
+from ..phases import phase_name
+from ..service.fault_tolerance import (CONTROL_AUDIT_COUNTERS,
+                                       merge_control_audit_counters)
+from ..stats.latency_histogram import LatencyHistogram
+from ..tpu.device import (PATH_AUDIT_COUNTERS, PATH_AUDIT_MAX_KEYS,
+                          sum_path_audit_counters)
+
+#: every exported metric name carries this prefix
+METRIC_PREFIX = "elbencho_tpu_"
+
+_SNAKE_RE = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def snake_case(name: str) -> str:
+    """Wire/JSON key -> metric name fragment (TpuH2dDirectOps ->
+    tpu_h2d_direct_ops)."""
+    return _SNAKE_RE.sub("_", name).lower()
+
+
+def _escape_label(value: str) -> str:
+    return str(value).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format (backslash and
+    newline; quotes are legal there)."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+class Metric:
+    """One metric family: name + kind + help + labeled samples. Samples
+    are replaced wholesale per sampling pass (whole-dict swap — atomic
+    under the GIL, so a concurrent render never sees a half-built
+    family and never iterates a mutating dict)."""
+
+    __slots__ = ("name", "kind", "help_txt", "samples")
+
+    def __init__(self, name: str, kind: str, help_txt: str):
+        self.name = name
+        self.kind = kind          # counter | gauge | histogram
+        self.help_txt = help_txt
+        # labels tuple (sorted (k, v) pairs) -> value; histograms store a
+        # LatencyHistogram snapshot instead of a number
+        self.samples: dict = {}
+
+    def set(self, value, labels: "tuple | None" = None) -> None:
+        self.samples[labels or ()] = value
+
+    def render(self, out: "list[str]") -> None:
+        full = METRIC_PREFIX + self.name
+        samples = self.samples  # one snapshot ref for the whole pass
+        out.append(f"# HELP {full} {_escape_help(self.help_txt)}")
+        out.append(f"# TYPE {full} "
+                   f"{'counter' if self.kind == 'counter' else self.kind}")
+        for labels, value in sorted(samples.items()):
+            if self.kind == "histogram":
+                self._render_histogram(out, full, labels, value)
+                continue
+            lbl = self._label_str(labels)
+            out.append(f"{full}{lbl} {value}")
+
+    @staticmethod
+    def _label_str(labels: tuple, extra: "tuple | None" = None) -> str:
+        pairs = tuple(labels) + tuple(extra or ())
+        if not pairs:
+            return ""
+        return "{" + ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in pairs) + "}"
+
+    def _render_histogram(self, out: "list[str]", full: str,
+                          labels: tuple, histo: LatencyHistogram) -> None:
+        """Prometheus histogram exposition over the log2 buckets
+        (LatencyHistogram.to_prometheus_buckets: cumulative counts)."""
+        for le, cum in histo.to_prometheus_buckets():
+            le_str = "+Inf" if le == float("inf") else f"{le:g}"
+            out.append(f"{full}_bucket"
+                       f"{self._label_str(labels, (('le', le_str),))} "
+                       f"{cum}")
+        out.append(f"{full}_sum{self._label_str(labels)} "
+                   f"{histo.sum_micro}")
+        out.append(f"{full}_count{self._label_str(labels)} "
+                   f"{histo.num_values}")
+
+
+class MetricRegistry:
+    """Ordered family registry with Prometheus text rendering
+    (exposition format 0.0.4)."""
+
+    def __init__(self):
+        self._metrics: "dict[str, Metric]" = {}
+        self.scrapes = 0  # served /metrics replies (exported itself)
+
+    def declare(self, name: str, kind: str, help_txt: str) -> Metric:
+        metric = self._metrics.get(name)
+        if metric is None:
+            metric = Metric(name, kind, help_txt)
+            self._metrics[name] = metric
+        return metric
+
+    def counter(self, name: str, help_txt: str) -> Metric:
+        return self.declare(name, "counter", help_txt)
+
+    def gauge(self, name: str, help_txt: str) -> Metric:
+        return self.declare(name, "gauge", help_txt)
+
+    def histogram(self, name: str, help_txt: str) -> Metric:
+        return self.declare(name, "histogram", help_txt)
+
+    def set(self, name: str, value, labels: "tuple | None" = None) -> None:
+        self._metrics[name].set(value, labels)
+
+    def commit(self, updates: "dict[str, dict]") -> None:
+        """Swap whole sample dicts in (one assignment per family): a
+        render running concurrently on another thread sees either the
+        previous complete snapshot or the new one, never a mix and never
+        a dict mutating under iteration."""
+        for name, samples in updates.items():
+            self._metrics[name].samples = samples
+
+    def render(self) -> str:
+        out: "list[str]" = []
+        for metric in self._metrics.values():
+            if metric.samples:
+                metric.render(out)
+        return "\n".join(out) + "\n"
+
+
+#: Content-Type of the Prometheus text exposition format
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class BenchTelemetry:
+    """Samples a live (statistics, manager) pair into a MetricRegistry and
+    renders /metrics replies. ``provider`` is a zero-arg callable returning
+    the CURRENT (statistics, manager) — host rotation and /preparephase
+    rebuild both, so the exporter must never cache them."""
+
+    def __init__(self, cfg, provider, role: str = "local"):
+        self.cfg = cfg
+        self.provider = provider
+        self.role = role
+        self.registry = MetricRegistry()
+        # tracer hookup for the trace-event drop/record gauges (optional)
+        self.tracer = None
+        # dedicated CPU meter (primed, rate-limited): updating the
+        # benchmark's shared phase meter would reset its /proc/stat
+        # baseline out from under the stonewall/last-done snapshots
+        from ..stats.cpu_util import SampledCPUUtil
+        self._cpu = SampledCPUUtil()
+        self._declare()
+
+    # -- declarations --------------------------------------------------------
+
+    def _declare(self) -> None:
+        reg = self.registry
+        reg.gauge("info", "Build/role info (value is always 1)")
+        reg.gauge("phase_code", "Numeric code of the current bench phase")
+        reg.gauge("phase", "Current bench phase (label; value is 1)")
+        reg.gauge("workers", "Workers in the pool (master: one per host)")
+        reg.gauge("workers_done", "Workers finished with the current phase")
+        reg.counter("entries_done_total",
+                    "Entries completed in the current phase")
+        reg.counter("bytes_done_total",
+                    "Payload bytes moved in the current phase")
+        reg.counter("ops_done_total",
+                    "I/O operations completed in the current phase")
+        reg.gauge("cpu_util_pct", "Host CPU utilization percent")
+        reg.gauge("host_cpu_util_pct",
+                  "Per-service-host CPU utilization percent (master only)")
+        for _attr, key, _ingest in PATH_AUDIT_COUNTERS:
+            if key in PATH_AUDIT_MAX_KEYS:
+                reg.gauge(snake_case(key),
+                          f"TPU path audit high-water mark {key} "
+                          f"(MAX-merged across workers/hosts)")
+            else:
+                reg.counter(snake_case(key) + "_total",
+                            f"TPU path audit counter {key} "
+                            f"(summed across workers/hosts)")
+        reg.counter("tpu_hbm_bytes_total",
+                    "Bytes staged through TPU HBM this phase")
+        reg.counter("tpu_dispatch_usec_total",
+                    "Host-side TPU transfer submit cost this phase "
+                    "(dispatch leg of the dispatch-vs-DMA split)")
+        reg.counter("tpu_transfer_usec_total",
+                    "TPU DMA wall time this phase (submit -> ready)")
+        for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
+            if mode == "max":
+                reg.gauge(snake_case(key),
+                          f"Control-plane audit high-water mark {key} "
+                          f"(MAX-merged across hosts)")
+            else:
+                reg.counter(snake_case(key) + "_total",
+                            f"Control-plane audit counter {key}")
+        reg.histogram("io_latency_usec",
+                      "Per-op I/O latency in microseconds "
+                      "(log2 buckets at quarter-log2 resolution)")
+        reg.histogram("entry_latency_usec",
+                      "Per-entry latency in microseconds")
+        reg.counter("scrapes_total", "Served /metrics replies")
+        reg.counter("trace_events_total",
+                    "Spans recorded by the --tracefile ring buffer")
+        reg.counter("trace_events_overwritten_total",
+                    "Ring-buffer spans overwritten before the trace "
+                    "file was written (raise the ring or --tracesample)")
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self) -> None:
+        """One sampling pass over the current benchmark state. Reads
+        worker-owned plain ints under the GIL — never blocks a worker.
+        Built into fresh per-family dicts and committed with whole-dict
+        swaps, so a concurrent render (ThreadingHTTPServer scrape vs the
+        live-stats loop) always sees complete snapshots."""
+        reg = self.registry
+        up: "dict[str, dict]" = {}
+
+        def put(name: str, value, labels: "tuple | None" = None) -> None:
+            up.setdefault(name, {})[labels or ()] = value
+
+        put("info", 1, (("role", self.role), ("version", __version__)))
+        statistics, manager = self.provider()
+        put("scrapes_total", reg.scrapes)
+        tracer = self.tracer
+        if tracer is None and manager is not None:
+            tracer = manager.shared.tracer
+        if tracer is not None:
+            put("trace_events_total", tracer.num_recorded)
+            put("trace_events_overwritten_total", tracer.num_overwritten)
+        if manager is None:
+            reg.commit(up)
+            return
+        shared = manager.shared
+        workers = manager.workers
+        put("phase_code", int(shared.current_phase))
+        put("phase", 1, (("phase", phase_name(shared.current_phase)),))
+        put("workers", len(workers))
+        if statistics is not None:
+            entries, num_bytes, iops, done = statistics._sum_live_ops()
+            put("workers_done", done)
+            put("entries_done_total", entries)
+            put("bytes_done_total", num_bytes)
+            put("ops_done_total", iops)
+        put("cpu_util_pct", round(self._cpu.sample(), 1))
+        # per-host CPU gauges: RemoteWorkers carry the last /status
+        # CPUUtil (fresh dict per pass, so rotated-out hosts drop off)
+        up["host_cpu_util_pct"] = {}
+        for w in workers:
+            host = getattr(w, "host", None)
+            if host is not None:
+                put("host_cpu_util_pct",
+                    getattr(w, "cpu_util_pct", 0.0), (("host", host),))
+        # path audit: the service wire protocol's merge rules (sum/MAX)
+        # applied over local contexts AND RemoteWorker live ingests —
+        # this is the fleet aggregation
+        path_totals = sum_path_audit_counters(workers)
+        for _attr, key, _ingest in PATH_AUDIT_COUNTERS:
+            name = snake_case(key)
+            if key not in PATH_AUDIT_MAX_KEYS:
+                name += "_total"
+            put(name, path_totals[key])
+        from ..stats.statistics import (merge_live_latency_histos,
+                                        sum_tpu_transfer_totals)
+        tpu_bytes, tpu_usec, tpu_dispatch = sum_tpu_transfer_totals(workers)
+        put("tpu_hbm_bytes_total", tpu_bytes)
+        put("tpu_dispatch_usec_total", tpu_dispatch)
+        put("tpu_transfer_usec_total", tpu_usec)
+        ctl_totals = merge_control_audit_counters(workers)
+        for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
+            name = snake_case(key) + ("" if mode == "max" else "_total")
+            put(name, ctl_totals[key])
+        io_histo, ent_histo = merge_live_latency_histos(workers)
+        put("io_latency_usec", io_histo)
+        put("entry_latency_usec", ent_histo)
+        reg.commit(up)
+
+    def render(self) -> str:
+        """Sample-then-render: a scrape always sees the current counters
+        (the live-stats loop also samples at its cadence, so the snapshot
+        stays warm between scrapes)."""
+        self.registry.scrapes += 1
+        self.sample()
+        return self.registry.render()

@@ -1,0 +1,145 @@
+"""Bounded ring-buffer per-op span recorder with Chrome trace output.
+
+``--tracefile PATH`` arms the recorder; every instrumentation point in the
+workers (storage ops), the TPU transfer pipeline (dispatch-vs-DMA
+sub-spans) and the native stream ring (reap sub-spans) records one
+complete span ("ph": "X") per event. ``--tracesample R`` keeps only a
+probabilistic R fraction of op spans so long phases fit the ring.
+
+When tracing is OFF the recorder does not exist: workers hold
+``self._tracer is None`` and every instrumentation point is a single
+attribute test — no allocation, no call, no formatting (the overhead
+guard in tests/test_telemetry.py pins this).
+
+The output is Chrome trace-event JSON (the ``traceEvents`` array format),
+loadable in Perfetto / chrome://tracing; ``pid`` is the service's rank
+offset (host slot in a distributed run), ``tid`` the worker rank.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+#: default ring capacity (events); old spans are overwritten when a phase
+#: outgrows it — num_overwritten says how many were lost
+DEFAULT_RING_EVENTS = 1 << 18
+
+
+class Tracer:
+    """Thread-safe bounded span ring. ``record`` is only ever called from
+    instrumentation points that already checked the tracer exists, so the
+    off path costs nothing; the on path takes one short lock per span."""
+
+    def __init__(self, path: str, sample: float = 1.0,
+                 max_events: int = DEFAULT_RING_EVENTS,
+                 rank_offset: int = 0):
+        self.path = path
+        self.sample = min(max(sample, 0.0), 1.0)
+        self.rank_offset = rank_offset
+        self._cap = max(int(max_events), 1)
+        self._ring: "list" = [None] * self._cap
+        self._idx = 0
+        self.num_recorded = 0
+        self.num_overwritten = 0
+        self._lock = threading.Lock()
+        self._rng = random.Random(0xe1be0 + rank_offset)
+        self._t0_ns = time.perf_counter_ns()
+
+    # -- recording -----------------------------------------------------------
+
+    def now_ns(self) -> int:
+        return time.perf_counter_ns()
+
+    def record(self, name: str, cat: str, start_ns: int, dur_usec: int,
+               rank: int = 0, sampled: bool = False, **args) -> None:
+        """One complete span. ``start_ns`` is a perf_counter_ns timestamp;
+        ``sampled=True`` subjects the span to --tracesample (op spans and
+        the per-op tpu/stream sub-spans — anything with per-op volume);
+        phase markers pass sampled=False and are always kept."""
+        if sampled and self.sample < 1.0 \
+                and self._rng.random() >= self.sample:
+            return
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": max(start_ns - self._t0_ns, 0) // 1000,
+            "dur": max(int(dur_usec), 0),
+            "pid": self.rank_offset,
+            "tid": rank,
+            "args": args,
+        }
+        with self._lock:
+            slot = self._idx % self._cap
+            if self._ring[slot] is not None:
+                self.num_overwritten += 1
+            self._ring[slot] = event
+            self._idx += 1
+            self.num_recorded += 1
+
+    def record_op(self, op: str, phase: str, start_ns: int, dur_usec: int,
+                  rank: int, offset: int, size: int,
+                  slot: "int | None" = None) -> None:
+        """Storage-op span (the ISSUE's schema: phase, rank, op type,
+        offset, size, latency, staging slot). Subject to --tracesample."""
+        args = {"phase": phase, "offset": offset, "size": size}
+        if slot is not None:
+            args["slot"] = slot
+        self.record(op, "io", start_ns, dur_usec, rank=rank, sampled=True,
+                    **args)
+
+    # -- output --------------------------------------------------------------
+
+    def snapshot_events(self) -> "list[dict]":
+        """Chronological copy of the ring (oldest first)."""
+        with self._lock:
+            if self._idx <= self._cap:
+                events = [e for e in self._ring[:self._idx]]
+            else:
+                head = self._idx % self._cap
+                events = self._ring[head:] + self._ring[:head]
+            return [e for e in events if e is not None]
+
+    def write(self) -> None:
+        """(Re)write the Chrome trace JSON file with everything recorded
+        so far. Idempotent; called at phase end and at teardown so a
+        killed run still leaves a loadable trace. Atomic via
+        temp-then-rename so a scraper/Perfetto never reads a torn file."""
+        events = self.snapshot_events()
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "elbencho-tpu",
+                "rankOffset": self.rank_offset,
+                "sample": self.sample,
+                "numRecorded": self.num_recorded,
+                "numOverwritten": self.num_overwritten,
+            },
+        }
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+
+def make_tracer(cfg) -> "Tracer | None":
+    """The single arming point: a Tracer exists iff --tracefile was given
+    (instrumentation stays no-op otherwise)."""
+    path = getattr(cfg, "trace_file_path", "")
+    if not path:
+        return None
+    return Tracer(path,
+                  sample=getattr(cfg, "trace_sample", 1.0),
+                  rank_offset=getattr(cfg, "rank_offset", 0))

@@ -146,6 +146,10 @@ class TransferPipeline:
         self.full_stalls = 0
         self.inflight_hwm = 0
         self.ops = 0
+        # --tracefile sub-span recorder (telemetry/tracer.py); None keeps
+        # the hot path a single attribute test per transfer
+        self.tracer = None
+        self.trace_rank = 0
 
     def submit(self, submit_fn):
         """Issue one transfer (submit_fn() -> device array) into the ring,
@@ -158,6 +162,9 @@ class TransferPipeline:
         t1 = time.perf_counter_ns()
         self.dispatch_usec += (t1 - t0) // 1000
         self.ops += 1
+        if self.tracer is not None:
+            self.tracer.record("tpu_dispatch", "tpu", t0, (t1 - t0) // 1000,
+                               rank=self.trace_rank, sampled=True)
         self._ring.append((arr, t1))
         if len(self._ring) > self.inflight_hwm:
             self.inflight_hwm = len(self._ring)
@@ -171,11 +178,19 @@ class TransferPipeline:
         covers both directions."""
         self.dispatch_usec += usec
         self.ops += 1
+        if self.tracer is not None:
+            self.tracer.record("tpu_dispatch", "tpu",
+                               self.tracer.now_ns() - usec * 1000, usec,
+                               rank=self.trace_rank, sampled=True)
 
     def note_transfer(self, usec: int) -> None:
         """Account DMA wall time of a transfer completed outside the ring
         (blocking D2H export waits)."""
         self.transfer_usec += usec
+        if self.tracer is not None:
+            self.tracer.record("tpu_dma", "tpu",
+                               self.tracer.now_ns() - usec * 1000, usec,
+                               rank=self.trace_rank, sampled=True)
 
     def _drain_one(self, count_stall: bool = False) -> None:
         """Complete the oldest in-flight transfer. A full-ring drain
@@ -191,7 +206,12 @@ class TransferPipeline:
             if is_ready is None or not is_ready():
                 self.full_stalls += 1
         arr.block_until_ready()
-        self.transfer_usec += (time.perf_counter_ns() - t_submit) // 1000
+        done_ns = time.perf_counter_ns()
+        self.transfer_usec += (done_ns - t_submit) // 1000
+        if self.tracer is not None:
+            self.tracer.record("tpu_dma", "tpu", t_submit,
+                               (done_ns - t_submit) // 1000,
+                               rank=self.trace_rank, sampled=True)
 
     def flush(self, check_budget: bool = True) -> None:
         """Drain every in-flight transfer (phase-end completion wait); by
@@ -576,6 +596,12 @@ class TpuWorkerContext:
         if self.direct and self._h2d_direct_ok and self.batch_blocks == 1:
             return max(self.pipeline_depth - 1, 0)
         return 0
+
+    def set_tracer(self, tracer, rank: int) -> None:
+        """Arm --tracefile dispatch-vs-DMA sub-spans on this context's
+        transfer pipeline (telemetry/tracer.py; no-op path untouched)."""
+        self._pipeline.tracer = tracer
+        self._pipeline.trace_rank = rank
 
     def drain_to(self, max_inflight: int) -> None:
         """Drain the in-flight transfer ring to at most max_inflight

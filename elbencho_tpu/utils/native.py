@@ -156,6 +156,10 @@ class NativeStream:
         self._out_lat = (ctypes.c_uint64 * max_ev)()
         self._out_res = (ctypes.c_int64 * max_ev)()
         self._max_events = max_ev
+        # --tracefile stream-reap sub-spans (telemetry/tracer.py); None
+        # keeps reap() free of any per-call trace work
+        self.tracer = None
+        self.trace_rank = 0
 
     def submit(self, slot: int, fd_idx: int, offset: int, length: int,
                is_write: bool) -> None:
@@ -173,12 +177,21 @@ class NativeStream:
         mid-stream surfaces with its slot context."""
         interrupt = (interrupt_flag if interrupt_flag is not None
                      else ctypes.c_int(0))
+        tracer = self.tracer
+        t0 = tracer.now_ns() if tracer is not None else 0
         got = self._lib.ioengine_stream_reap(
             self._handle, min_complete, timeout_msecs, self._out_slots,
             self._out_lat, self._out_res, self._max_events,
             ctypes.byref(interrupt))
         if got < 0:
             raise NativeStreamError(-got, "stream reap")
+        if tracer is not None:
+            # reap sub-span: how long the worker sat in the engine's
+            # completion wait, and how many storage ops it harvested
+            tracer.record("stream_reap", "stream", t0,
+                          (tracer.now_ns() - t0) // 1000,
+                          rank=self.trace_rank, sampled=True,
+                          events=got, min_complete=min_complete)
         return [(self._out_slots[i], self._out_lat[i], self._out_res[i])
                 for i in range(got)]
 

@@ -76,6 +76,9 @@ class Worker:
         self.is_interrupted = False
         self.phase_finished = False
         self._ops_since_check = 0
+        # --tracefile span recorder; None keeps every instrumentation
+        # point a single attribute test (telemetry/tracer.py contract)
+        self._tracer = getattr(shared, "tracer", None)
         self.tpu_transfer_bytes = 0   # HBM ingest accounting (TPU data path)
         self.tpu_transfer_usec = 0    # DMA wall time (submit -> ready)
         self.tpu_dispatch_usec = 0    # host-side submit cost (the overhead

@@ -26,7 +26,7 @@ import time
 
 import numpy as np
 
-from ..phases import BenchMode, BenchPathType, BenchPhase
+from ..phases import BenchMode, BenchPathType, BenchPhase, phase_name
 from ..toolkits import logger
 from ..toolkits.file_tk import FileRangeLock
 from ..toolkits.offset_gen import (OffsetGenRandom, OffsetGenRandomAligned,
@@ -128,6 +128,9 @@ class LocalWorker(Worker):
                 hbm_limit_pct=cfg.tpu_hbm_limit_pct,
                 batch_blocks=max(cfg.tpu_batch_blocks, 1),
                 dispatch_budget_usec=cfg.tpu_dispatch_budget_usec)
+            if self._tracer is not None:
+                # dispatch-vs-DMA sub-spans ride the transfer pipeline
+                self._tpu.set_tracer(self._tracer, self.rank)
             needs_fill = (cfg.run_create_files
                           or (cfg.run_tpu_bench
                               and cfg.tpu_bench_pattern in ("d2h", "both")))
@@ -599,6 +602,10 @@ class LocalWorker(Worker):
                     lat_usec = (time.perf_counter_ns() - t0) // 1000
                 self.entries_latency_histo.add_latency(lat_usec)
                 self.live_ops.num_entries_done += 1
+                if self._tracer is not None:
+                    self._tracer.record_op(
+                        phase.name.lower(), phase_name(phase), t0,
+                        lat_usec, self.rank, 0, cfg.file_size)
 
     def _open_flags_write(self) -> int:
         cfg = self.cfg
@@ -771,8 +778,9 @@ class LocalWorker(Worker):
             raise WorkerException(
                 f"--ioengine {cfg.io_engine} only supports the native "
                 f"block loop — incompatible with --rwmixthrpct/--tpuids/"
-                f"non-'fast' --blockvaralgo (and --verifydirect/"
-                f"--readinline/--flock need the sync engine)")
+                f"--tracefile/non-'fast' --blockvaralgo (and "
+                f"--verifydirect/--readinline/--flock need the sync "
+                f"engine)")
         num_bufs = len(self._io_bufs)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
         # the byte-ratio balancer only applies to the mixed WRITE phase
@@ -834,6 +842,12 @@ class LocalWorker(Worker):
                      if (is_write and do_read_this_op)
                      else self.iops_latency_histo)
             histo.add_latency(lat_usec)
+            if self._tracer is not None:  # no-op path: one attribute test
+                self._tracer.record_op(
+                    "read" if do_read_this_op else "write",
+                    phase_name(self.shared.current_phase), t0, lat_usec,
+                    self.rank, real_off, length,
+                    slot=self._num_iops_submitted % num_bufs)
             ops.num_bytes_done += n
             ops.num_iops_done += 1
             self._num_iops_submitted += 1
@@ -861,6 +875,9 @@ class LocalWorker(Worker):
         cfg = self.cfg
         return (native is not None
                 and self._tpu is None
+                # --tracefile spans are recorded by the Python loops (the
+                # fused TPU stream loop records its own and stays native)
+                and self._tracer is None
                 and self.shared.rwmix_balancer is None
                 and (not cfg.block_variance_pct
                      or cfg.block_variance_algo == "fast"))
@@ -970,6 +987,9 @@ class LocalWorker(Worker):
         self._log_stream_mode(
             f"fused TPU stream engaged (backend={stream.backend_name}, "
             f"slots={len(slot_addrs)})")
+        if self._tracer is not None:  # stream-reap sub-spans (--tracefile)
+            stream.tracer = self._tracer
+            stream.trace_rank = self.rank
         # slot-reuse discipline: a slot is free, in the engine ring
         # (slot_op), or held back after its H2D until the transfer ring
         # provably drained its zero-copy import (holdback_depth). The
@@ -1049,6 +1069,13 @@ class LocalWorker(Worker):
                 lat_arr[i] = lat
                 state["bytes"] += res
                 ctx.stream_fused_ops += 1
+                if self._tracer is not None:
+                    # span start back-derived from the engine's latency
+                    self._tracer.record_op(
+                        "read" if rd else "write",
+                        phase_name(self.shared.current_phase),
+                        self._tracer.now_ns() - int(lat) * 1000, lat,
+                        self.rank, r_off, length, slot=slot)
                 if rd:
                     # host->HBM DMA + verify (host memcmp or on-device),
                     # identical to the Python loop's post-read hook

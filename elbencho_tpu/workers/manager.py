@@ -187,6 +187,11 @@ class WorkerManager:
         self.start_next_phase(BenchPhase.TERMINATE)
         for t in self.threads:
             t.join(timeout=30)
+        if self.shared.tracer is not None:
+            try:  # a killed run must still leave a loadable trace file
+                self.shared.tracer.write()
+            except OSError:
+                pass
         for fd in self._shared_fds:
             try:
                 os.close(fd)

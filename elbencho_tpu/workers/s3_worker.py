@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 
-from ..phases import BenchPhase
+from ..phases import BenchPhase, phase_name
 from ..toolkits.s3_upload_store import shared_upload_store
 from .shared import WorkerException
 
@@ -163,6 +163,12 @@ class _S3Pipeline:
             worker.live_ops.num_bytes_done += nbytes
             worker.live_ops.num_iops_done += 1
             worker._num_iops_submitted += 1
+            tracer = getattr(worker, "_tracer", None)
+            if tracer is not None:  # --tracefile op span
+                tracer.record_op(
+                    "s3_req", phase_name(worker.shared.current_phase),
+                    tracer.now_ns() - lat_usec * 1000, lat_usec,
+                    worker.rank, 0, nbytes)
 
     def drain(self) -> None:
         while self._inflight:
@@ -410,11 +416,15 @@ def _upload_object(worker, bucket: str, key: str) -> None:
         headers = _body_headers(cfg, body, _upload_init_headers(cfg))
         t0 = time.perf_counter_ns()
         client.put_object(bucket, key, body, extra_headers=headers)
-        worker.iops_latency_histo.add_latency(
-            (time.perf_counter_ns() - t0) // 1000)
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        worker.iops_latency_histo.add_latency(lat_usec)
         worker.live_ops.num_bytes_done += size
         worker.live_ops.num_iops_done += 1
         worker._num_iops_submitted += 1
+        if worker._tracer is not None:  # --tracefile op span
+            worker._tracer.record_op(
+                "s3_put", phase_name(worker.shared.current_phase), t0,
+                lat_usec, worker.rank, 0, size)
         return
     upload_id = client.create_multipart_upload(
         bucket, key, extra_headers=_mpu_init_headers(cfg))
@@ -628,8 +638,12 @@ def _download_object(worker, bucket: str, key: str) -> None:
         t0 = time.perf_counter_ns()
         got, data = _get_block(client, cfg, bucket, key, whole, offset,
                                length, sse_c)
-        worker.iops_latency_histo.add_latency(
-            (time.perf_counter_ns() - t0) // 1000)
+        lat_usec = (time.perf_counter_ns() - t0) // 1000
+        worker.iops_latency_histo.add_latency(lat_usec)
+        if worker._tracer is not None:  # --tracefile op span
+            worker._tracer.record_op(
+                "s3_get", phase_name(worker.shared.current_phase), t0,
+                lat_usec, worker.rank, offset, length)
         if not cfg.s3_fast_get:
             buf = worker._io_bufs[
                 worker._num_iops_submitted % len(worker._io_bufs)]

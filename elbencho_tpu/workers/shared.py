@@ -63,6 +63,10 @@ class WorkersSharedData:
         self.cpu_util_stonewall: float = 0.0
         self.cpu_util_last_done: float = 0.0
         self.first_error: "Exception | None" = None
+        # --tracefile: the per-process span ring all workers record into
+        # (None when tracing is off — instrumentation stays no-op)
+        from ..telemetry.tracer import make_tracer
+        self.tracer = make_tracer(config)
         # --rwmixthrpct byte-ratio balancer, shared by all workers
         # (reference: RateLimiterRWMixThreads static atomics)
         self.rwmix_balancer = None

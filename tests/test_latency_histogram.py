@@ -86,3 +86,69 @@ def test_bucket_lower_bound_inverse():
     for idx in range(0, NUM_BUCKETS, 7):
         v = bucket_lower_bound(idx)
         assert bucket_index(v * 1.001) == idx
+
+
+# -- to_prometheus_buckets (telemetry exposition) ---------------------------
+
+def test_prometheus_buckets_monotonic_and_complete():
+    h = LatencyHistogram()
+    for v in [1, 3, 7, 80, 900, 12345, 12346, 10 ** 7]:
+        h.add_latency(v)
+    buckets = h.to_prometheus_buckets()
+    # cumulative counts must never decrease, bounds strictly increase
+    last_cum, last_le = -1, 0.0
+    for le, cum in buckets:
+        assert cum >= last_cum
+        assert le > last_le
+        last_cum, last_le = cum, le
+    # +Inf bucket closes the histogram with the total count
+    assert buckets[-1] == (float("inf"), h.num_values)
+    # the finite tail already covers every value (values land in buckets)
+    assert buckets[-2][1] == h.num_values
+
+
+def test_prometheus_buckets_upper_bounds_match_bucket_edges():
+    h = LatencyHistogram()
+    h.add_latency(100)
+    buckets = h.to_prometheus_buckets()
+    idx = bucket_index(100)
+    # the first bucket whose cumulative count reaches the value's rank
+    # must have the value's bucket upper edge as its `le` bound
+    first_le = next(le for le, cum in buckets if cum >= 1)
+    assert first_le == bucket_lower_bound(idx + 1)
+    # and the value itself lies below that edge
+    assert 100 < first_le
+
+
+def test_prometheus_buckets_agree_with_percentile():
+    h = LatencyHistogram()
+    for v in range(1, 2001):
+        h.add_latency(v)
+    buckets = h.to_prometheus_buckets()
+    for pct in (50, 75, 90, 99):
+        target = h.num_values * (pct / 100.0)
+        # percentile() returns the LOWER bound of the bucket whose
+        # cumulative count first reaches the target; the prometheus
+        # exposition reports the same bucket's UPPER edge — one
+        # quarter-log2 step apart by construction
+        le = next(le for le, cum in buckets if cum >= target)
+        lower = h.percentile(pct)
+        assert lower < le
+        assert le == lower * (2 ** 0.25) or abs(
+            le / lower - 2 ** 0.25) < 1e-9
+
+
+def test_prometheus_buckets_empty_histogram():
+    h = LatencyHistogram()
+    assert h.to_prometheus_buckets() == [(float("inf"), 0)]
+
+
+def test_prometheus_buckets_fold_clamped_outliers_into_inf():
+    h = LatencyHistogram()
+    h.add_latency(10)
+    h.add_latency(3 * 10 ** 8)  # beyond the 2^28us top bucket bound
+    buckets = h.to_prometheus_buckets()
+    # the clamp bucket must not claim the outlier under a finite le
+    assert all(le > h.max_micro or cum < h.num_values
+               for le, cum in buckets[:-1])
+    assert buckets[-1] == (float("inf"), 2)
