@@ -2,8 +2,8 @@
 # the C++ build; here the Python package needs no build and the native
 # engine lives in csrc/)
 
-.PHONY: all native native-tsan native-asan check test test-fast \
-	test-examples fuzz bench docs clean deb rpm docker
+.PHONY: all native native-tsan native-asan tsan asan check test \
+	test-fast test-examples fuzz bench docs clean deb rpm docker
 
 all: native
 
@@ -29,6 +29,21 @@ native-asan:
 	@echo "asan build done; run tests with:" \
 		"LD_PRELOAD=\$$(gcc -print-file-name=libasan.so)" \
 		"ASAN_OPTIONS=detect_leaks=0 pytest ..."
+
+# sanitizer gates: build the sanitized engine AND run the native test
+# file against it (covers the raw-ctypes stream/slot-reuse tests plus
+# the ABI-10 cancel + fault-injection + deadline tests), then restore
+# the normal build
+tsan: native-tsan
+	LD_PRELOAD=$$(gcc -print-file-name=libtsan.so) \
+		python -m pytest tests/test_native_engine.py -q
+	$(MAKE) native
+
+asan: native-asan
+	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
+		ASAN_OPTIONS=detect_leaks=0 \
+		python -m pytest tests/test_native_engine.py -q
+	$(MAKE) native
 
 # the single green command (SURVEY.md section 5.2 sanitizer/robustness
 # gate): pytest + seeded fuzz sweeps + asan/tsan engine builds each

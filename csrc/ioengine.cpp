@@ -320,6 +320,9 @@ inline int sys_io_getevents(aio_context_t ctx, long min_nr, long nr,
     return static_cast<int>(
         syscall(SYS_io_getevents, ctx, min_nr, nr, events, timeout));
 }
+inline int sys_io_cancel(aio_context_t ctx, iocb* cb, io_event* result) {
+    return static_cast<int>(syscall(SYS_io_cancel, ctx, cb, result));
+}
 
 int run_sync_loop(const int* fds, const uint32_t* fd_idx,
                   const uint64_t* offsets, const uint64_t* lengths,
@@ -932,10 +935,41 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
 // is backend-agnostic and only ever falls back to the pure-Python loop
 // when NEITHER async engine exists.
 
+// deterministic fault-injection kinds (ioengine_stream_set_fault; TEST
+// ONLY — the Python side refuses the env knob outside a test harness)
+enum {
+    STREAM_FAULT_NONE = 0,
+    STREAM_FAULT_EIO = 1,        // completed op's result replaced by -EIO
+    STREAM_FAULT_SHORT = 2,      // completed op's result halved (short r/w)
+    STREAM_FAULT_HANG = 3,       // op never submitted to the kernel: it
+                                 // only completes via deadline/cancel
+};
+
+// user_data tag of ASYNC_CANCEL SQEs so their CQEs are never mistaken
+// for data-op completions (and never decrement in_flight)
+constexpr uint64_t kStreamCancelTag = 0x8000000000000000ull;
+constexpr uint8_t kOpAsyncCancel = 14;  // IORING_OP_ASYNC_CANCEL (5.5+)
+
+// data-op user_data: (generation << 32) | slot. The generation makes
+// cancellation race-free across slot re-arm: a stale ASYNC_CANCEL still
+// queued when the slot's NEXT op is submitted targets the OLD
+// generation's user_data and finds nothing — without it, the cancel
+// would kill the new (healthy) op and surface a spurious -ECANCELED.
+inline uint64_t stream_user_data(uint32_t slot, uint32_t gen) {
+    return (static_cast<uint64_t>(gen & 0x7FFFFFFFu) << 32) | slot;
+}
+
 struct StreamSlotState {
     uint64_t submit_usec = 0;
     uint64_t expected_len = 0;
     int pending = 0;  // one in-flight op per slot, enforced
+    uint32_t gen = 0;       // bumped per submit; see stream_user_data
+    int fault_kind = STREAM_FAULT_NONE;  // injected fault of THIS op
+    int kernel_owned = 0;   // a real kernel op is in flight for the slot
+    int cancel_sent = 0;    // cancellation was issued for this op
+    int deadline_hit = 0;   // cancellation came from --iotimeout expiry
+    int synth_pending = 0;  // synthetic completion queued for next reap
+    int64_t synth_res = 0;
 };
 
 struct StreamCtx {
@@ -952,6 +986,17 @@ struct StreamCtx {
     bool fixed_buffers = false;
     bool fixed_files = false;
     int in_flight = 0;
+    // per-op deadline (--iotimeout; 0 = none): reap cancels ops older
+    // than this and surfaces them as -ETIMEDOUT with the slot re-armed
+    uint64_t op_timeout_usec = 0;
+    // deterministic fault injection (seed, every_n, kind): op k is
+    // faulted when every_n && (k + seed) % every_n == 0, counted at
+    // submit so the schedule is independent of completion order
+    uint64_t fault_seed = 0;
+    uint64_t fault_every_n = 0;
+    int fault_kind = STREAM_FAULT_NONE;
+    uint64_t submit_counter = 0;
+    int cancel_inflight = 0;   // outstanding ASYNC_CANCEL SQEs (uring)
 
     ~StreamCtx() {
         if (aio_ctx)
@@ -1667,6 +1712,28 @@ int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
     StreamSlotState& s = c->slots[slot];
     if (s.pending)
         return -EBUSY;  // slot-reuse discipline: one in-flight op per slot
+    // deterministic fault schedule, decided at submit time so it is
+    // independent of completion order (reap applies EIO/short to the
+    // real result; a hang op never reaches the kernel at all)
+    const uint64_t op_idx = c->submit_counter++;
+    s.fault_kind = (c->fault_every_n
+                    && (op_idx + c->fault_seed) % c->fault_every_n == 0)
+        ? c->fault_kind : STREAM_FAULT_NONE;
+    ++s.gen;  // see stream_user_data: cancel-vs-re-arm race immunity
+    s.cancel_sent = 0;
+    s.deadline_hit = 0;
+    s.synth_pending = 0;
+    if (s.fault_kind == STREAM_FAULT_HANG) {
+        // injected hang: the slot is in flight but no kernel op exists —
+        // it only completes via the --iotimeout deadline or an explicit
+        // cancel (both synthesize the completion)
+        s.submit_usec = now_usec();
+        s.expected_len = length;
+        s.kernel_owned = 0;
+        s.pending = 1;
+        ++c->in_flight;
+        return 0;
+    }
     if (!c->use_uring) {  // kernel-AIO fallback backend
         iocb& cb = c->aio_cbs[slot];
         memset(&cb, 0, sizeof(cb));
@@ -1675,12 +1742,13 @@ int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
         cb.aio_buf = c->slot_addrs[slot];
         cb.aio_nbytes = length;
         cb.aio_offset = static_cast<int64_t>(offset);
-        cb.aio_data = slot;
+        cb.aio_data = stream_user_data(slot, s.gen);
         s.submit_usec = now_usec();
         s.expected_len = length;
         iocb* cbp = &cb;
         if (sys_io_submit(c->aio_ctx, 1, &cbp) != 1)
             return -errno;
+        s.kernel_owned = 1;
         s.pending = 1;
         ++c->in_flight;
         return 0;
@@ -1705,7 +1773,7 @@ int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
     sqe->addr = c->slot_addrs[slot];
     sqe->len = static_cast<uint32_t>(length);
     sqe->off = offset;
-    sqe->user_data = slot;
+    sqe->user_data = stream_user_data(slot, s.gen);
     c->ring.sq_array[idx] = idx;
     s.submit_usec = now_usec();
     s.expected_len = length;
@@ -1722,9 +1790,188 @@ int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
         __atomic_store_n(c->ring.sq_tail, tail, __ATOMIC_RELEASE);
         return res < 0 ? -errno : -EAGAIN;
     }
+    s.kernel_owned = 1;
     s.pending = 1;
     ++c->in_flight;
     return 0;
+}
+
+// ---------------------------------------------------------------------------
+// per-op deadlines + cancellation (--iotimeout; engine ABI 10)
+
+// arm/disarm the per-op deadline: ops older than timeout_usec at reap
+// time are cancelled and surfaced as -ETIMEDOUT with the slot re-armed
+int ioengine_stream_set_timeout(void* handle, uint64_t timeout_usec) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c)
+        return -EINVAL;
+    c->op_timeout_usec = timeout_usec;
+    return 0;
+}
+
+// arm deterministic fault injection (TEST ONLY; see STREAM_FAULT_*).
+// every_n == 0 disarms. The schedule keys on the submit counter, so the
+// same (seed, every_n) faults the same ops run after run.
+int ioengine_stream_set_fault(void* handle, uint64_t seed,
+                              uint64_t every_n, int kind) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c || kind < STREAM_FAULT_NONE || kind > STREAM_FAULT_HANG)
+        return -EINVAL;
+    c->fault_seed = seed;
+    c->fault_every_n = every_n;
+    c->fault_kind = every_n ? kind : STREAM_FAULT_NONE;
+    return 0;
+}
+
+// age of the oldest in-flight op in usec (op age tracking for
+// diagnostics/tests), 0 when nothing is in flight
+int64_t ioengine_stream_oldest_age_usec(void* handle) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c)
+        return -EINVAL;
+    uint64_t oldest = 0;
+    const uint64_t now = now_usec();
+    for (uint64_t i = 0; i < c->n_slots; ++i) {
+        const StreamSlotState& s = c->slots[i];
+        if (s.pending && now - s.submit_usec > oldest)
+            oldest = now - s.submit_usec;
+    }
+    return static_cast<int64_t>(oldest);
+}
+
+// issue cancellation of one slot's kernel op (uring ASYNC_CANCEL keyed
+// by user_data; AIO io_cancel best-effort). The completion surfaces via
+// reap: -ECANCELED for an explicit cancel, -ETIMEDOUT when the cancel
+// came from the deadline scan. Returns 0 when the cancel was issued (or
+// synthesized), -ENOENT when the slot has no in-flight op.
+static int stream_cancel_slot(StreamCtx* c, uint32_t slot,
+                              int deadline_initiated) {
+    StreamSlotState& s = c->slots[slot];
+    if (!s.pending)
+        return -ENOENT;
+    if (deadline_initiated)
+        s.deadline_hit = 1;
+    if (!s.kernel_owned) {
+        // injected hang: no kernel op exists — complete synthetically
+        s.synth_pending = 1;
+        s.synth_res = deadline_initiated ? -ETIMEDOUT : -ECANCELED;
+        return 0;
+    }
+    if (s.cancel_sent)
+        return 0;
+    s.cancel_sent = 1;
+    if (!c->use_uring) {
+        io_event result;
+        memset(&result, 0, sizeof(result));
+        if (sys_io_cancel(c->aio_ctx, &c->aio_cbs[slot], &result) == 0) {
+            // kernel dropped the op: no event will be delivered for it
+            s.synth_pending = 1;
+            s.synth_res = deadline_initiated ? -ETIMEDOUT : -ECANCELED;
+        }
+        // EINVAL/EAGAIN: disk AIO is rarely cancellable — the op will
+        // complete normally; deadline_hit rewrites a late -ECANCELED/
+        // -EINTR result, a real result passes through (the op made it)
+        return 0;
+    }
+    const unsigned tail = *c->ring.sq_tail;
+    const unsigned idx = tail & *c->ring.sq_mask;
+    io_uring_sqe* sqe = &c->ring.sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    sqe->opcode = kOpAsyncCancel;
+    sqe->fd = -1;
+    // cancel target: THIS generation's user_data — a stale cancel that
+    // outlives the op can never match the slot's next (re-armed) op
+    sqe->addr = stream_user_data(slot, s.gen);
+    sqe->user_data = kStreamCancelTag | slot;
+    c->ring.sq_array[idx] = idx;
+    __atomic_store_n(c->ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
+    int res;
+    do {
+        res = sys_io_uring_enter(c->ring.ring_fd, 1, 0, 0, nullptr, 0);
+    } while (res < 0 && errno == EINTR);
+    if (res != 1) {
+        __atomic_store_n(c->ring.sq_tail, tail, __ATOMIC_RELEASE);
+        s.cancel_sent = 0;  // not issued; the deadline scan may retry
+        return res < 0 ? -errno : -EAGAIN;
+    }
+    ++c->cancel_inflight;
+    return 0;
+}
+
+int ioengine_stream_cancel(void* handle, uint32_t slot) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    if (!c || slot >= c->n_slots)
+        return -EINVAL;
+    return stream_cancel_slot(c, slot, /*deadline_initiated=*/0);
+}
+
+// harvest queued synthetic completions (injected-hang timeouts,
+// successful cancels of ops the kernel never saw/dropped) into the
+// reap out-arrays; re-arms each slot
+static void stream_collect_synth(StreamCtx* c, uint32_t* out_slots,
+                                 uint64_t* out_lat_usec, int64_t* out_res,
+                                 int max_events, int* got) {
+    const uint64_t now = now_usec();
+    for (uint64_t i = 0; i < c->n_slots && *got < max_events; ++i) {
+        StreamSlotState& s = c->slots[i];
+        if (!s.pending || !s.synth_pending)
+            continue;
+        s.pending = 0;
+        s.synth_pending = 0;
+        s.kernel_owned = 0;
+        --c->in_flight;
+        out_slots[*got] = static_cast<uint32_t>(i);
+        out_lat_usec[*got] = now - s.submit_usec;
+        out_res[*got] = s.synth_res;
+        ++(*got);
+    }
+}
+
+// deadline scan: cancel every in-flight op older than --iotimeout (a
+// hung op must surface as -ETIMEDOUT with its slot re-armed instead of
+// wedging the reap loop forever)
+static void stream_apply_deadlines(StreamCtx* c) {
+    if (!c->op_timeout_usec)
+        return;
+    const uint64_t now = now_usec();
+    for (uint64_t i = 0; i < c->n_slots; ++i) {
+        StreamSlotState& s = c->slots[i];
+        if (s.pending && !s.synth_pending
+                && now - s.submit_usec >= c->op_timeout_usec)
+            stream_cancel_slot(c, static_cast<uint32_t>(i),
+                               /*deadline_initiated=*/1);
+    }
+}
+
+// decode a data-op completion: the slot index, validated against the
+// slot's CURRENT generation (a completion for a superseded/synthetically
+// retired op is dropped — its in_flight decrement already happened)
+static StreamSlotState* stream_match(StreamCtx* c, uint64_t ud,
+                                     uint32_t* out_slot) {
+    const uint32_t slot = static_cast<uint32_t>(ud & 0xFFFFFFFFu);
+    if (slot >= c->n_slots)
+        return nullptr;
+    StreamSlotState& s = c->slots[slot];
+    if (!s.pending
+            || static_cast<uint32_t>((ud >> 32) & 0x7FFFFFFFu)
+               != (s.gen & 0x7FFFFFFFu))
+        return nullptr;
+    *out_slot = slot;
+    return &s;
+}
+
+// per-op result shaping at harvest: injected EIO/short-read faults, and
+// the deadline rewrite of a cancelled op's -ECANCELED/-EINTR into
+// -ETIMEDOUT (a real result that beat the cancel passes through — the
+// data arrived, the deadline check is moot for that op)
+static int64_t stream_shape_result(StreamSlotState& s, int64_t res) {
+    if (s.fault_kind == STREAM_FAULT_EIO && res >= 0)
+        res = -EIO;
+    else if (s.fault_kind == STREAM_FAULT_SHORT && res > 1)
+        res = res / 2;
+    if (s.deadline_hit && (res == -ECANCELED || res == -EINTR))
+        res = -ETIMEDOUT;
+    return res;
 }
 
 // harvest up to max_events completions, blocking (bounded, interruptible)
@@ -1750,6 +1997,14 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
     if (!c->use_uring) {  // kernel-AIO fallback backend
         io_event events[16];
         for (;;) {
+            // --iotimeout scan + queued synthetic completions (injected
+            // hangs, successfully cancelled ops) before touching the
+            // kernel: a hung op must re-arm its slot, not wedge the wait
+            stream_apply_deadlines(c);
+            stream_collect_synth(c, out_slots, out_lat_usec, out_res,
+                                 max_events, &got);
+            if (got >= max_events)
+                return got;
             const long want = max_events - got > 16 ? 16 : max_events - got;
             // harvest whatever already completed without blocking
             timespec zero = {0, 0};
@@ -1761,13 +2016,16 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
             }
             const uint64_t t_now = now_usec();
             for (int e = 0; e < n; ++e) {
-                const uint32_t slot = static_cast<uint32_t>(events[e].data);
-                --c->in_flight;
-                if (slot < c->n_slots) {
-                    c->slots[slot].pending = 0;
+                uint32_t slot;
+                StreamSlotState* s = stream_match(c, events[e].data,
+                                                  &slot);
+                if (s) {
+                    s->pending = 0;
+                    s->kernel_owned = 0;
+                    --c->in_flight;
                     out_slots[got] = slot;
-                    out_lat_usec[got] = t_now - c->slots[slot].submit_usec;
-                    out_res[got] = events[e].res;
+                    out_lat_usec[got] = t_now - s->submit_usec;
+                    out_res[got] = stream_shape_result(*s, events[e].res);
                     ++got;
                 }
             }
@@ -1779,8 +2037,8 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
             if (now2 >= deadline)
                 return got;
             uint64_t wait_us = deadline - now2;
-            if (wait_us > 100000)  // interruptible 100ms slices
-                wait_us = 100000;
+            if (wait_us > 100000)  // interruptible 100ms slices; also the
+                wait_us = 100000;  // --iotimeout re-scan cadence
             timespec ts = {static_cast<time_t>(wait_us / 1000000ull),
                            static_cast<long>((wait_us % 1000000ull)
                                              * 1000ull)};
@@ -1788,21 +2046,33 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
             // reusing the stale `want` could overrun the out arrays
             const long want2 = max_events - got > 16 ? 16
                                                      : max_events - got;
+            // with only non-kernel ops in flight (injected hangs) there
+            // is no event to wait for: sleep the slice and re-scan
+            int kernel_inflight = 0;
+            for (uint64_t i = 0; i < c->n_slots; ++i)
+                if (c->slots[i].pending && c->slots[i].kernel_owned)
+                    ++kernel_inflight;
+            if (!kernel_inflight) {
+                usleep(static_cast<useconds_t>(wait_us));
+                continue;
+            }
             n = sys_io_getevents(c->aio_ctx, 1, want2, events, &ts);
             if (n < 0 && errno != EINTR)
                 return got ? got : -errno;
             if (n > 0) {
                 const uint64_t t_done = now_usec();
                 for (int e = 0; e < n; ++e) {
-                    const uint32_t slot =
-                        static_cast<uint32_t>(events[e].data);
-                    --c->in_flight;
-                    if (slot < c->n_slots) {
-                        c->slots[slot].pending = 0;
+                    uint32_t slot;
+                    StreamSlotState* s = stream_match(c, events[e].data,
+                                                      &slot);
+                    if (s) {
+                        s->pending = 0;
+                        s->kernel_owned = 0;
+                        --c->in_flight;
                         out_slots[got] = slot;
-                        out_lat_usec[got] =
-                            t_done - c->slots[slot].submit_usec;
-                        out_res[got] = events[e].res;
+                        out_lat_usec[got] = t_done - s->submit_usec;
+                        out_res[got] = stream_shape_result(*s,
+                                                           events[e].res);
                         ++got;
                     }
                 }
@@ -1812,6 +2082,11 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
         }
     }
     for (;;) {
+        stream_apply_deadlines(c);
+        stream_collect_synth(c, out_slots, out_lat_usec, out_res,
+                             max_events, &got);
+        if (got >= max_events)
+            return got;
         unsigned head = *c->ring.cq_head;
         const unsigned tail =
             __atomic_load_n(c->ring.cq_tail, __ATOMIC_ACQUIRE);
@@ -1819,14 +2094,23 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
         while (head != tail && got < max_events) {
             const io_uring_cqe& cqe =
                 c->ring.cqes[head & *c->ring.cq_mask];
-            const uint32_t slot = static_cast<uint32_t>(cqe.user_data);
+            const uint64_t ud = cqe.user_data;
             ++head;
-            --c->in_flight;
-            if (slot < c->n_slots) {
-                c->slots[slot].pending = 0;
+            if (ud & kStreamCancelTag) {
+                // the ASYNC_CANCEL op's own completion — bookkeeping
+                // only, never a data-op event
+                --c->cancel_inflight;
+                continue;
+            }
+            uint32_t slot;
+            StreamSlotState* s = stream_match(c, ud, &slot);
+            if (s) {
+                s->pending = 0;
+                s->kernel_owned = 0;
+                --c->in_flight;
                 out_slots[got] = slot;
-                out_lat_usec[got] = t_now - c->slots[slot].submit_usec;
-                out_res[got] = cqe.res;
+                out_lat_usec[got] = t_now - s->submit_usec;
+                out_res[got] = stream_shape_result(*s, cqe.res);
                 ++got;
             }
         }
@@ -1839,9 +2123,20 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
         if (now2 >= deadline)
             return got;
         // bounded wait in <=100ms slices so interrupts stay responsive
+        // (and the --iotimeout deadline scan re-runs at that cadence)
         uint64_t wait_us = deadline - now2;
         if (wait_us > 100000)
             wait_us = 100000;
+        // with only non-kernel ops in flight (injected hangs) there is
+        // no CQE to wait for: sleep the slice and re-scan
+        int kernel_inflight = 0;
+        for (uint64_t i = 0; i < c->n_slots; ++i)
+            if (c->slots[i].pending && c->slots[i].kernel_owned)
+                ++kernel_inflight;
+        if (!kernel_inflight && !c->cancel_inflight) {
+            usleep(static_cast<useconds_t>(wait_us));
+            continue;
+        }
         timespec ts = {static_cast<time_t>(wait_us / 1000000ull),
                        static_cast<long>((wait_us % 1000000ull) * 1000ull)};
         UringGetEventsArg arg;
@@ -1874,27 +2169,62 @@ int ioengine_stream_close(void* handle) {
     if (!c)
         return -EINVAL;
     int ret = 0;
+    // retire in-flight ops the kernel never saw (injected hangs, ops a
+    // successful io_cancel dropped): no completion will ever arrive for
+    // them, so the drain loops below must not wait on their count
+    for (uint64_t i = 0; i < c->n_slots; ++i) {
+        StreamSlotState& s = c->slots[i];
+        if (s.pending && !s.kernel_owned) {
+            s.pending = 0;
+            --c->in_flight;
+        } else if (s.pending && s.synth_pending) {
+            // synthetic completion queued for a kernel-dropped op
+            s.pending = 0;
+            --c->in_flight;
+        }
+    }
     if (!c->use_uring) {
         // AIO drain; io_destroy in the dtor then blocks until any
         // remainder's kernel DMA finished (same ordering argument as
-        // run_aio_loop's teardown)
-        while (c->in_flight > 0) {
+        // run_aio_loop's teardown). BOUNDED: a truly hung, un-cancellable
+        // op (hard-mounted NFS) must not wedge teardown forever — after
+        // 30 zero-progress seconds the context is LEAKED (io_destroy on
+        // it would block just the same) and -EIO tells the caller to
+        // keep the slot buffers mapped for the life of the process.
+        int stalled_secs = 0;
+        while (c->in_flight > 0 && stalled_secs < 30) {
             io_event events[16];
             timespec ts = {1, 0};
             const int n = sys_io_getevents(c->aio_ctx, 1, 16, events, &ts);
             if (n < 0 && errno != EINTR)
                 break;
-            if (n > 0)
+            if (n > 0) {
                 c->in_flight -= n;
+                stalled_secs = 0;
+            } else {
+                ++stalled_secs;
+            }
+        }
+        if (c->in_flight > 0) {
+            ret = -EIO;
+            c->aio_ctx = 0;  // leak: destroying would block on the hang
         }
         delete c;
-        return 0;
+        return ret;
     }
+    int stalled_secs = 0;
     while (c->in_flight > 0) {
         unsigned head = *c->ring.cq_head;
         const unsigned tail =
             __atomic_load_n(c->ring.cq_tail, __ATOMIC_ACQUIRE);
         if (head == tail) {
+            // bounded like the AIO drain: a hung op must not wedge
+            // teardown — give up after 30 zero-progress seconds with
+            // -EIO (the caller then leaks the slot buffers)
+            if (++stalled_secs > 30) {
+                ret = -EIO;
+                break;
+            }
             timespec ts = {1, 0};
             UringGetEventsArg arg;
             memset(&arg, 0, sizeof(arg));
@@ -1909,9 +2239,17 @@ int ioengine_stream_close(void* handle) {
             }
             continue;
         }
+        stalled_secs = 0;
         while (head != tail) {
+            // a cancel op's own CQE is bookkeeping, not a data-op
+            // completion — counting it would under-drain the real ops
+            const io_uring_cqe& cqe =
+                c->ring.cqes[head & *c->ring.cq_mask];
             ++head;
-            --c->in_flight;
+            if (cqe.user_data & kStreamCancelTag)
+                --c->cancel_inflight;
+            else
+                --c->in_flight;
         }
         __atomic_store_n(c->ring.cq_head, head, __ATOMIC_RELEASE);
     }
@@ -1934,7 +2272,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 9 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog+stream)";
+    return "elbencho-tpu ioengine 10 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog+stream+deadline+cancel+faultinj)";
 }
 
 }  // extern "C"

@@ -74,6 +74,20 @@ FLAG_DEFS = [
     ("ioengine", None, "io_engine", "str", "auto", "large",
      "Native block-loop engine: auto|sync|aio|uring (auto = sync when "
      "iodepth is 1, kernel AIO otherwise)"),
+    ("ioretries", None, "io_num_retries", "int", 0, "large",
+     "Per-op retries on transient storage errors (EINTR/EAGAIN/"
+     "ETIMEDOUT/short reads, EIO on network filesystems; jittered "
+     "exponential backoff; permanent errors like ENOSPC/EROFS still "
+     "fail fast; 0 = fail on first error, the default). Object modes "
+     "take the larger of this and --s3retries"),
+    ("ioretrybudget", None, "io_retry_budget_secs", "int", 30, "large",
+     "Per-phase, per-worker cap on total I/O retry backoff seconds; "
+     "when spent, the next transient error is final (--ioretries)"),
+    ("iotimeout", None, "io_timeout_secs", "int", 0, "large",
+     "Per-op deadline in seconds for storage ops in the native "
+     "streaming ring (--tpustream): a hung op is cancelled and "
+     "surfaces as ETIMEDOUT — transient, so --ioretries can re-drive "
+     "it on the re-armed slot (0 = no deadline)"),
 
     # access pattern
     ("rand", None, "use_random_offsets", "bool", False, "large",
@@ -337,6 +351,14 @@ FLAG_DEFS = [
      "Fail the run when the measured per-block host-side dispatch "
      "overhead of the TPU transfer pipeline exceeds this many "
      "microseconds (0 = no budget)"),
+    ("tpufallback", None, "tpu_fallback", "str", "abort", "tpu",
+     "Reaction to a TPU chip lost mid-phase (XLA runtime/device-loss "
+     "error): abort = fail fast (default); chip = drain+poison the "
+     "failed chip and redistribute its workers across surviving "
+     "--tpuids chips (degrading to host staging when none survive); "
+     "host = degrade straight to host-memory staging. Failovers are "
+     "audited as TpuChipFailovers and flagged DEGRADED-TPU by "
+     "summarize-json"),
     ("tpuverify", None, "do_tpu_verify", "bool", False, "tpu",
      "Run integrity verification on-device (Pallas kernel) instead of host"),
     ("tpuprofile", None, "tpu_profile_dir", "str", "", "tpu",
@@ -1166,6 +1188,39 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--tracesample tunes the --tracefile span recorder — "
                 "give --tracefile PATH")
+        if self.io_num_retries < 0:
+            raise ConfigError("--ioretries must be >= 0")
+        if self.io_retry_budget_secs < 0:
+            raise ConfigError("--ioretrybudget must be >= 0")
+        if self.io_timeout_secs < 0:
+            raise ConfigError("--iotimeout must be >= 0")
+        if self.io_timeout_secs and self.bench_mode != BenchMode.POSIX:
+            raise ConfigError(
+                "--iotimeout applies to the native streaming ring (POSIX "
+                "block I/O); object/netbench transports already bound "
+                "their requests via HTTP timeouts")
+        if self.tpu_fallback not in ("abort", "chip", "host"):
+            raise ConfigError("--tpufallback must be abort|chip|host")
+        if self.tpu_fallback != "abort" and not self.tpu_ids_str \
+                and not self.tpu_ids:
+            raise ConfigError(
+                "--tpufallback tunes the TPU chip-failover path — it "
+                "needs --tpuids")
+        if os.environ.get("ELBENCHO_TPU_IO_FAULT") \
+                and os.environ.get("ELBENCHO_TPU_TESTING") != "1":
+            # deterministic fault injection is a TEST-ONLY knob: a
+            # release benchmark run with it set would silently publish
+            # corrupted-by-design numbers
+            raise ConfigError(
+                "ELBENCHO_TPU_IO_FAULT is a test-only fault-injection "
+                "knob (docs/fault-tolerance.md); refusing to run with it "
+                "set outside a test harness (ELBENCHO_TPU_TESTING=1)")
+        if os.environ.get("ELBENCHO_TPU_IO_FAULT"):
+            from ..utils.native import parse_fault_spec
+            try:  # malformed specs fail at config time, not mid-phase
+                parse_fault_spec(os.environ["ELBENCHO_TPU_IO_FAULT"])
+            except ValueError as err:
+                raise ConfigError(str(err)) from None
         if self.svc_num_retries < 0:
             raise ConfigError("--svcretries must be >= 0")
         if self.svc_retry_budget_secs < 0:

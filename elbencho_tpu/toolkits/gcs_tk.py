@@ -152,7 +152,8 @@ class GcsClient:
     def __init__(self, endpoint: str = GCS_DEFAULT_ENDPOINT,
                  project: str = "", token_provider=None,
                  timeout: float = 60.0, num_retries: int = 0,
-                 interrupt_check=None, resumable: bool = False):
+                 interrupt_check=None, resumable: bool = False,
+                 retry_notify=None):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "https://" + endpoint)
         self.scheme = parsed.scheme or "https"
@@ -163,6 +164,8 @@ class GcsClient:
         self.timeout = timeout
         self.num_retries = num_retries
         self.interrupt_check = interrupt_check
+        # retry_notify(slept_secs): feeds the worker's IoRetries audit
+        self.retry_notify = retry_notify
         #: --gcsresumable: serve the MPU interface via resumable upload
         #: sessions (the native GCS large-single-object idiom) instead of
         #: component objects + compose
@@ -232,10 +235,12 @@ class GcsClient:
             except (OSError, http.client.HTTPException) as err:
                 last_err = err
                 if attempt < self.num_retries:
-                    time.sleep(0.2 * (attempt + 1))
+                    from .s3_tk import retry_backoff_sleep
+                    retry_backoff_sleep(attempt, self.retry_notify)
                 continue
             if status in self._RETRY_STATUSES and attempt < self.num_retries:
-                time.sleep(0.2 * (attempt + 1))
+                from .s3_tk import retry_backoff_sleep
+                retry_backoff_sleep(attempt, self.retry_notify)
                 continue
             return status, resp_headers, data
         raise last_err if last_err is not None else S3Error(
@@ -335,7 +340,8 @@ class GcsClient:
         return run_discard_with_retries(
             lambda: self._get_discard_once(bucket, key, range_start,
                                            range_len, extra_headers),
-            self.num_retries, self._RETRY_STATUSES, self.interrupt_check)
+            self.num_retries, self._RETRY_STATUSES, self.interrupt_check,
+            retry_notify=self.retry_notify)
 
     def _get_discard_once(self, bucket, key, range_start, range_len,
                           extra_headers) -> "tuple[int, int]":

@@ -152,14 +152,27 @@ def build_checksum_headers(algo: str, body: bytes) -> "dict":
             f"x-amz-checksum-{algo}": base64.b64encode(digest).decode()}
 
 
+def retry_backoff_sleep(attempt: int, retry_notify=None) -> None:
+    """The object clients' shared linear backoff (0.2s * attempt number)
+    with the --ioretries audit hook: ONE definition so the per-retry
+    accounting cannot silently diverge between the request, discard and
+    resumable paths of the S3/GCS clients."""
+    import time as _time
+    delay = 0.2 * (attempt + 1)
+    if retry_notify:
+        retry_notify(delay)
+    _time.sleep(delay)
+
+
 def run_discard_with_retries(attempt_fn, num_retries: int,
-                             retry_statuses, interrupt_check) -> int:
+                             retry_statuses, interrupt_check,
+                             retry_notify=None) -> int:
     """Shared retry skeleton for streaming-discard downloads (used by the
     S3 and GCS clients): attempt_fn() -> (status, total_bytes). Retries
     connection errors and retryable statuses with linear backoff, checks
     for interruption between attempts, and raises the REAL final HTTP
-    status on exhaustion instead of returning a zero byte count."""
-    import time as _time
+    status on exhaustion instead of returning a zero byte count.
+    retry_notify(slept_secs) feeds the worker's IoRetries audit."""
     last_err = None
     for attempt in range(num_retries + 1):
         if interrupt_check:
@@ -169,11 +182,11 @@ def run_discard_with_retries(attempt_fn, num_retries: int,
         except (OSError, http.client.HTTPException) as err:
             last_err = err
             if attempt < num_retries:
-                _time.sleep(0.2 * (attempt + 1))
+                retry_backoff_sleep(attempt, retry_notify)
             continue
         if status in retry_statuses:
             if attempt < num_retries:
-                _time.sleep(0.2 * (attempt + 1))
+                retry_backoff_sleep(attempt, retry_notify)
                 continue
             raise S3Error(status, "RetryExhausted",
                           f"download failed with HTTP {status} after "
@@ -192,7 +205,8 @@ class S3Client:
                  virtual_hosted: bool = False, timeout: float = 60.0,
                  num_retries: int = 0, interrupt_check=None,
                  session_token: str = "", log_level: int = 0,
-                 log_prefix: str = "s3_", unsigned_payload: bool = False):
+                 log_prefix: str = "s3_", unsigned_payload: bool = False,
+                 retry_notify=None):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "http://" + endpoint)
         self.scheme = parsed.scheme or "http"
@@ -206,6 +220,9 @@ class S3Client:
         self.timeout = timeout
         self.num_retries = num_retries
         self.interrupt_check = interrupt_check
+        # retry_notify(slept_secs): per-retry hook feeding the worker's
+        # IoRetries/IoRetryUsec audit counters (docs/fault-tolerance.md)
+        self.retry_notify = retry_notify
         self.log_level = log_level
         self.log_prefix = log_prefix
         # --s3fastput / --s3sign 2: skip the per-request SHA256 of the
@@ -337,7 +354,6 @@ class S3Client:
         (reference: S3InterruptibleRetryStrategy — retry whole requests on
         connection errors / retryable statuses, checking for interruption
         between attempts; accounting stays per successful request)."""
-        import time as _time
         last_err = None
         for attempt in range(self.num_retries + 1):
             if self.interrupt_check:
@@ -349,12 +365,12 @@ class S3Client:
                 # covers dropped connections too (IncompleteRead etc.)
                 last_err = err
                 if attempt < self.num_retries:
-                    _time.sleep(0.2 * (attempt + 1))
+                    retry_backoff_sleep(attempt, self.retry_notify)
                 continue
             self._log_request(method, bucket, key, status,
                               len(body) if body else len(data))
             if status in self._RETRY_STATUSES and attempt < self.num_retries:
-                _time.sleep(0.2 * (attempt + 1))
+                retry_backoff_sleep(attempt, self.retry_notify)
                 continue
             return status, resp_headers, data
         raise last_err if last_err is not None else S3Error(
@@ -458,7 +474,8 @@ class S3Client:
         return run_discard_with_retries(
             lambda: self._get_discard_once(bucket, key, range_start,
                                            range_len, extra_headers),
-            self.num_retries, self._RETRY_STATUSES, self.interrupt_check)
+            self.num_retries, self._RETRY_STATUSES, self.interrupt_check,
+            retry_notify=self.retry_notify)
 
     def _get_discard_once(self, bucket, key, range_start, range_len,
                           extra_headers) -> "tuple[int, int]":
@@ -791,11 +808,18 @@ class S3CredentialStore:
         return self.pairs[rank % len(self.pairs)]
 
 
-def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
+def make_client_for_rank(cfg, rank: int, interrupt_check=None,
+                         retry_notify=None) -> S3Client:
     """Endpoint + credential round-robin by worker rank
     (reference: S3Tk.cpp:167-316 + S3CredentialStore). With the GCS-native
     backend (gs:// paths) this returns a `gcs_tk.GcsClient` instead — the
-    method surface is identical, so callers stay backend-agnostic."""
+    method surface is identical, so callers stay backend-agnostic.
+
+    Request-level retries take the LARGER of --s3retries and --ioretries
+    (the object transport is the data plane here), and every retry is
+    reported through retry_notify into the worker's IoRetries audit."""
+    num_retries = max(cfg.s3_num_retries,
+                      getattr(cfg, "io_num_retries", 0))
     if getattr(cfg, "object_backend", "") == "gcs":
         from .gcs_tk import (GCS_DEFAULT_ENDPOINT, GcsClient,
                              GcsTokenProvider)
@@ -804,8 +828,9 @@ def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
         return GcsClient(
             endpoints[rank % len(endpoints)], project=cfg.gcs_project,
             token_provider=GcsTokenProvider.for_config(cfg),
-            num_retries=cfg.s3_num_retries, interrupt_check=interrupt_check,
-            resumable=getattr(cfg, "gcs_resumable", False))
+            num_retries=num_retries, interrupt_check=interrupt_check,
+            resumable=getattr(cfg, "gcs_resumable", False),
+            retry_notify=retry_notify)
     endpoints = [e.strip() for e in cfg.s3_endpoints_str.split(",")
                  if e.strip()]
     if not endpoints:
@@ -815,12 +840,13 @@ def make_client_for_rank(cfg, rank: int, interrupt_check=None) -> S3Client:
     return S3Client(endpoint, access_key=access_key,
                     secret_key=secret_key, region=cfg.s3_region,
                     virtual_hosted=cfg.s3_virtual_hosted,
-                    num_retries=cfg.s3_num_retries,
+                    num_retries=num_retries,
                     interrupt_check=interrupt_check,
                     session_token=cfg.s3_session_token,
                     log_level=cfg.s3_log_level,
                     log_prefix=cfg.s3_log_prefix,
                     unsigned_payload=(cfg.s3_fast_put
-                                      or cfg.s3_sign_policy == 2))
+                                      or cfg.s3_sign_policy == 2),
+                    retry_notify=retry_notify)
 
 

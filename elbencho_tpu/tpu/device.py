@@ -56,30 +56,91 @@ PATH_AUDIT_COUNTERS = (
     # I/O reaped from the engine's ring and handed straight to the
     # transfer pipeline — zero means the phase ran the Python loop
     ("stream_fused_ops", "TpuStreamFusedOps", "tpu_stream_fused_ops"),
+    # data-plane fault tolerance (--ioretries/--iotimeout/--tpufallback):
+    # per-op retry/timeout accounting lives on the WORKER (the retries
+    # happen in storage loops that exist with or without a TPU context —
+    # see PATH_AUDIT_WORKER_ATTRS); chip failover lives on the context
+    ("io_retries", "IoRetries", "io_retries"),
+    ("io_retry_usec", "IoRetryUsec", "io_retry_usec"),
+    ("io_timeouts", "IoTimeouts", "io_timeouts"),
+    ("chip_failovers", "TpuChipFailovers", "tpu_chip_failovers"),
 )
+
+#: counters owned by the Worker object itself rather than the
+#: TpuWorkerContext: the merge reads them from the worker even when a
+#: TPU context is attached, and the context's per-phase counter reset
+#: must not shadow them with zeros on the context
+PATH_AUDIT_WORKER_ATTRS = frozenset({
+    "io_retries", "io_retry_usec", "io_timeouts"})
 
 #: counters that merge across workers as MAX, not sum: a high-water mark
 #: summed over workers would report an in-flight depth no single ring
-#: ever reached
-PATH_AUDIT_MAX_KEYS = frozenset({"TpuPipeInflightHwm"})
+#: ever reached. TpuChipFailovers is a hwm too: every worker sharing a
+#: lost chip records its own failover, so a sum would multiply one chip
+#: loss by the worker count — MAX reports the deepest failover chain any
+#: single worker ran (~ chips lost along the worst path).
+PATH_AUDIT_MAX_KEYS = frozenset({"TpuPipeInflightHwm", "TpuChipFailovers"})
 
 
 def sum_path_audit_counters(workers) -> dict:
     """Total the path-audit counters over a worker list, reading local
-    workers' TpuWorkerContext directly and RemoteWorkers' ingested
-    attributes (keyed by wire/JSON name, ready to merge into records).
-    PATH_AUDIT_MAX_KEYS entries merge as max instead of sum."""
+    workers' TpuWorkerContext directly (worker-owned entries always come
+    from the worker) and RemoteWorkers' ingested attributes (keyed by
+    wire/JSON name, ready to merge into records). PATH_AUDIT_MAX_KEYS
+    entries merge as max instead of sum."""
     totals = {key: 0 for _, key, _ in PATH_AUDIT_COUNTERS}
     for w in workers:
         ctx = getattr(w, "_tpu", None)
         for attr, key, ingest_attr in PATH_AUDIT_COUNTERS:
-            val = getattr(ctx, attr) if ctx is not None \
-                else getattr(w, ingest_attr, 0)
+            if ctx is not None and attr not in PATH_AUDIT_WORKER_ATTRS:
+                val = getattr(ctx, attr)
+            else:
+                val = getattr(w, ingest_attr, 0)
             if key in PATH_AUDIT_MAX_KEYS:
                 totals[key] = max(totals[key], val)
             else:
                 totals[key] += val
     return totals
+
+
+#: conservative message markers for device-loss classification —
+#: deliberately narrow so an unrelated RuntimeError (e.g. the --tpubudget
+#: breach, whose message mentions DMA) can never be eaten by failover
+_DEVICE_LOSS_MARKERS = (
+    "device lost", "data loss", "data_loss", "failed to enqueue",
+    "device is in an error state", "device unavailable",
+    "chip is unavailable", "hardware failure", "device halted",
+)
+
+#: exception type names that identify an XLA runtime / device failure
+#: (matched by name: jaxlib's XlaRuntimeError moves between modules
+#: across versions, and tests raise a shape-compatible fake)
+_DEVICE_LOSS_TYPE_NAMES = ("XlaRuntimeError", "DeviceLostError",
+                           "TpuDeviceLostError")
+
+
+def is_device_loss_error(err: BaseException) -> bool:
+    """Classify an exception raised on the TPU transfer path: True for
+    XLA-runtime/device-loss failures (the chip-failover trigger of
+    --tpufallback), False for everything else — a logic error or a
+    --tpubudget breach must abort, never failover."""
+    for cls in type(err).__mro__:
+        if cls.__name__ in _DEVICE_LOSS_TYPE_NAMES:
+            return True
+    msg = str(err).lower()
+    return any(marker in msg for marker in _DEVICE_LOSS_MARKERS)
+
+
+class TpuDeviceLostError(RuntimeError):
+    """Raised when --tpufallback abort (the default) sees a device loss:
+    carries the chip id so the phase error names the failed chip."""
+
+    def __init__(self, chip_id: int, cause: BaseException):
+        self.chip_id = chip_id
+        super().__init__(
+            f"TPU chip {chip_id} lost mid-phase "
+            f"({type(cause).__name__}: {cause}); rerun with --tpufallback "
+            f"chip|host to survive single-chip loss")
 
 
 def _get_jax():
@@ -245,6 +306,13 @@ class TransferPipeline:
         while len(self._ring) > max(max_inflight, 0):
             self._drain_one()
 
+    def poison(self) -> None:
+        """Drop every in-flight entry WITHOUT completion waits: the chip
+        failover path — block_until_ready on a lost chip would hang or
+        re-raise, and the data of in-flight transfers is gone either
+        way. Timing counters keep what they accumulated."""
+        self._ring.clear()
+
     def reset_counters(self) -> None:
         self.dispatch_usec = 0
         self.transfer_usec = 0
@@ -396,6 +464,118 @@ class TpuWorkerContext:
         # PATH_AUDIT_COUNTERS): ops whose storage I/O ran in the engine's
         # submission/completion ring
         self.stream_fused_ops = 0
+        # --tpufallback: chip-failover audit + host-staging degraded mode.
+        # chip_failovers is per-phase (PATH_AUDIT_COUNTERS); the
+        # host-staging latch persists for the run — a lost chip stays
+        # lost (workers/local_worker.py drives the failover decisions)
+        self.chip_failovers = 0
+        self._host_staging = False
+        self._host_sink = None       # host staging: H2D sink buffer
+        self._host_fill_pool: list = []  # host staging: write-source pool
+
+    # -- chip failover (--tpufallback; the data-plane analogue of
+    # --svctolerant: survive single-chip loss instead of aborting) -------
+
+    @property
+    def host_staging(self) -> bool:
+        """True when the context degraded to host-memory staging after a
+        chip loss (--tpufallback host, or chip mode with no survivor)."""
+        return self._host_staging
+
+    def _poison_device_state(self) -> None:
+        """Drop every reference to device-resident state without touching
+        the (possibly dead) chip: in-flight ring entries, staging slots,
+        fill pool, speculative D2H blocks, the jitted copy step. No
+        block_until_ready anywhere — the chip may never answer again."""
+        self._pipeline.poison()
+        self._slot_prev = [None] * self.pipeline_depth
+        self._staged_submits = 0
+        self._copy_step = None
+        self._donate_ok = True
+        self._donate_probed = False
+        self._fill_pool = []
+        self._fill_idx = 0
+        self._d2h_spec = {}
+        self._d2h_spec_miss_streak = 0
+        self._last_ingested = None
+        self._h2d_agg_fill = 0
+
+    def failover_to_chip(self, new_chip_id: int) -> None:
+        """Drain-and-poison the failed chip's state, then redirect this
+        context to a surviving chip. The caller (LocalWorker) picks the
+        survivor and registers the failed chip in the shared poison set
+        so sibling workers stop submitting to it."""
+        from ..toolkits.logger import log_error
+        self._poison_device_state()
+        jax = _get_jax()
+        devices = jax.devices()
+        old = self.chip_id
+        self.chip_id = new_chip_id
+        self.device = devices[new_chip_id % len(devices)]
+        self._key = jax.random.PRNGKey(new_chip_id)
+        self.chip_failovers += 1
+        log_error(f"TPU chip {old} lost; worker failed over to chip "
+                  f"{new_chip_id} (--tpufallback chip)")
+
+    def failover_to_host(self) -> None:
+        """Degrade to host-memory staging: transfers become host memcpys
+        (the accounting keeps flowing so phase results stay complete and
+        the TpuChipFailovers counter marks them DEGRADED-TPU). On-device
+        verify falls back to the host-side check."""
+        from ..toolkits.logger import log_error
+        self._poison_device_state()
+        self._host_staging = True
+        self.verify_on_device = False  # host memcmp takes over
+        self.chip_failovers += 1
+        log_error(f"TPU chip {self.chip_id} lost; worker degraded to "
+                  f"host-memory staging (--tpufallback host)")
+
+    def _host_staged_h2d(self, np_view: np.ndarray) -> None:
+        """Host-staging H2D: the staging copy without a device. Counted
+        as a staged op so op-count parity checks keep holding."""
+        import time
+        t0 = time.perf_counter_ns()
+        if self._host_sink is None or len(self._host_sink) < len(np_view):
+            self._host_sink = np.empty(max(len(np_view), self._num_words),
+                                       dtype=np.uint32)
+        self._host_sink[:len(np_view)] = np_view
+        self.h2d_staged_ops += 1
+        self._pipeline.note_dispatch(
+            (time.perf_counter_ns() - t0) // 1000)
+
+    def _host_staged_d2h(self, buf: memoryview, length: int,
+                         verify_salt: int, file_offset: int) -> None:
+        """Host-staging D2H: produce the exact bytes the device path
+        would have produced — the verify pattern for --verify phases, a
+        deterministic PRNG pool otherwise — so a failed-over write phase
+        still writes verifiable content."""
+        import time
+        t0 = time.perf_counter_ns()
+        dst = np.frombuffer(buf, dtype=np.uint8, count=length)
+        if verify_salt:
+            n_words = length // 8
+            arr = np.frombuffer(buf[:n_words * 8], dtype=np.uint64)
+            with np.errstate(over="ignore"):
+                arr[:] = (np.arange(n_words, dtype=np.uint64)
+                          * np.uint64(8) + np.uint64(file_offset)
+                          + np.uint64(verify_salt))
+            if length % 8:
+                dst[n_words * 8:] = 0
+        else:
+            if not self._host_fill_pool:
+                from ..toolkits.random_algos import create_rand_algo
+                fill = create_rand_algo("fast", seed=self.chip_id + 1)
+                blk = max(self._num_words * 4, 4)
+                self._host_fill_pool = [
+                    np.frombuffer(fill.fill_buffer(blk), dtype=np.uint8)
+                    for _ in range(self._FILL_POOL_BLOCKS)]
+            self._fill_idx = (self._fill_idx + 1) \
+                % len(self._host_fill_pool)
+            src = self._host_fill_pool[self._fill_idx]
+            dst[:length] = src[:length]
+        self.d2h_staged_ops += 1
+        self._pipeline.note_dispatch(
+            (time.perf_counter_ns() - t0) // 1000)
 
     # -- read path: host buffer -> HBM --------------------------------------
 
@@ -427,6 +607,9 @@ class TpuWorkerContext:
         """
         n_words = length // 4
         np_view = np.frombuffer(buf[:n_words * 4], dtype=np.uint32)
+        if self._host_staging:  # degraded after chip loss (--tpufallback)
+            self._host_staged_h2d(np_view)
+            return
         if self.batch_blocks > 1:
             # --tpubatch: stage into the aggregation buffer; the DMA
             # fires once per batch_blocks blocks (or at flush), so the
@@ -620,7 +803,12 @@ class TpuWorkerContext:
         disabled for a later sequential phase, and stale speculated
         blocks must not charge a miss to the next phase's record."""
         for attr, _key, _ingest in PATH_AUDIT_COUNTERS:
-            if not attr.startswith("pipe_"):  # pipeline-owned, reset below
+            # pipeline-owned counters reset below; worker-owned counters
+            # (io_retries & co) reset in Worker.reset_stats — creating
+            # zeros for them HERE would shadow the worker's real counts
+            # in sum_path_audit_counters
+            if not attr.startswith("pipe_") \
+                    and attr not in PATH_AUDIT_WORKER_ATTRS:
                 setattr(self, attr, 0)
         # dispatch/transfer timing and the ring audit are per-phase like
         # the rest; an interrupted phase must also drain its in-flight
@@ -712,6 +900,9 @@ class TpuWorkerContext:
           staged np.asarray, whose async copy the ring already started).
         """
         import time
+        if self._host_staging:  # degraded after chip loss (--tpufallback)
+            self._host_staged_d2h(buf, length, verify_salt, file_offset)
+            return
         n_words = max(length // 4, 1)
         t0 = time.perf_counter_ns()
         if verify_salt:

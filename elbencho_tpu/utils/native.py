@@ -35,7 +35,28 @@ ENGINE_NAMES = {code: name for name, code in ENGINE_CODES.items()}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 9
+EXPECTED_ABI = 10
+
+#: ioengine_stream_set_fault kinds (csrc STREAM_FAULT_*; TEST ONLY —
+#: config validation rejects the env knob outside a test harness)
+STREAM_FAULT_KINDS = {"eio": 1, "short": 2, "hang": 3}
+
+
+def parse_fault_spec(spec: str) -> "tuple[int, int, int]":
+    """Parse the ELBENCHO_TPU_IO_FAULT test knob: "kind:every_n[:seed]"
+    (kind in eio|short|hang) -> (seed, every_n, kind_code). Raises
+    ValueError on a malformed spec so a typo fails loudly in the harness
+    instead of silently injecting nothing."""
+    parts = spec.split(":")
+    if len(parts) not in (2, 3) or parts[0] not in STREAM_FAULT_KINDS:
+        raise ValueError(
+            f"malformed ELBENCHO_TPU_IO_FAULT {spec!r} (want "
+            f"'eio|short|hang:EVERY_N[:SEED]')")
+    every_n = int(parts[1])
+    seed = int(parts[2]) if len(parts) == 3 else 0
+    if every_n <= 0:
+        raise ValueError("ELBENCHO_TPU_IO_FAULT every_n must be > 0")
+    return seed, every_n, STREAM_FAULT_KINDS[parts[0]]
 
 _EILSEQ = errno_mod.EILSEQ  # engine's verify-mismatch return code
 
@@ -198,6 +219,39 @@ class NativeStream:
     def inflight(self) -> int:
         return self._lib.ioengine_stream_inflight(self._handle)
 
+    def set_timeout(self, timeout_usec: int) -> None:
+        """--iotimeout: per-op deadline. Ops older than this at reap time
+        are cancelled and surface as res == -ETIMEDOUT with their slot
+        re-armed (0 disarms)."""
+        ret = self._lib.ioengine_stream_set_timeout(self._handle,
+                                                    max(timeout_usec, 0))
+        if ret < 0:
+            raise NativeStreamError(-ret, "stream set_timeout")
+
+    def set_fault(self, seed: int, every_n: int, kind: int) -> None:
+        """Deterministic fault injection (TEST ONLY; STREAM_FAULT_KINDS).
+        Op k (by submit order) is faulted when (k+seed) % every_n == 0."""
+        ret = self._lib.ioengine_stream_set_fault(self._handle, seed,
+                                                  every_n, kind)
+        if ret < 0:
+            raise NativeStreamError(-ret, "stream set_fault")
+
+    def set_fault_from_spec(self, spec: str) -> None:
+        seed, every_n, kind = parse_fault_spec(spec)
+        self.set_fault(seed, every_n, kind)
+
+    def cancel(self, slot: int) -> None:
+        """Request cancellation of the slot's in-flight op; its completion
+        surfaces via reap (-ECANCELED, or the real result if the op beat
+        the cancel). -ENOENT (no in-flight op) is not an error here."""
+        ret = self._lib.ioengine_stream_cancel(self._handle, slot)
+        if ret < 0 and ret != -errno_mod.ENOENT:
+            raise NativeStreamError(-ret, f"stream cancel slot {slot}")
+
+    def oldest_age_usec(self) -> int:
+        """Age of the oldest in-flight op (op-age tracking; 0 = idle)."""
+        return int(self._lib.ioengine_stream_oldest_age_usec(self._handle))
+
     def close(self) -> int:
         """Drains outstanding kernel DMA before the ring is torn down;
         idempotent. Returns 0, or -errno when the drain had to be
@@ -346,6 +400,18 @@ class _NativeEngine:
         lib.ioengine_stream_inflight.argtypes = [ctypes.c_void_p]
         lib.ioengine_stream_close.restype = ctypes.c_int
         lib.ioengine_stream_close.argtypes = [ctypes.c_void_p]
+        # ABI 10: per-op deadlines, cancellation, fault injection
+        lib.ioengine_stream_set_timeout.restype = ctypes.c_int
+        lib.ioengine_stream_set_timeout.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_uint64]
+        lib.ioengine_stream_set_fault.restype = ctypes.c_int
+        lib.ioengine_stream_set_fault.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int]
+        lib.ioengine_stream_cancel.restype = ctypes.c_int
+        lib.ioengine_stream_cancel.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_uint32]
+        lib.ioengine_stream_oldest_age_usec.restype = ctypes.c_int64
+        lib.ioengine_stream_oldest_age_usec.argtypes = [ctypes.c_void_p]
         lib.ioengine_stream_backend.restype = ctypes.c_int
         lib.ioengine_stream_backend.argtypes = []
         lib.ioengine_stream_backend_of.restype = ctypes.c_int

@@ -83,6 +83,12 @@ class Worker:
         self.tpu_transfer_usec = 0    # DMA wall time (submit -> ready)
         self.tpu_dispatch_usec = 0    # host-side submit cost (the overhead
                                       # --tpubudget bounds)
+        # data-plane fault-tolerance audit (--ioretries/--iotimeout;
+        # worker-owned entries of PATH_AUDIT_COUNTERS — see
+        # tpu.device.PATH_AUDIT_WORKER_ATTRS)
+        self.io_retries = 0       # per-op transient-error retries
+        self.io_retry_usec = 0    # total backoff slept for those retries
+        self.io_timeouts = 0      # ops cancelled by the --iotimeout deadline
 
     def oplog(self, op_name: str, entry_name: str = "", offset: int = 0,
               length: int = 0):
@@ -117,6 +123,9 @@ class Worker:
         self.tpu_transfer_bytes = 0
         self.tpu_transfer_usec = 0
         self.tpu_dispatch_usec = 0
+        self.io_retries = 0
+        self.io_retry_usec = 0
+        self.io_timeouts = 0
 
     def create_stonewall_stats_if_triggered(self) -> None:
         """Snapshot current counters when the first worker finished

@@ -126,11 +126,24 @@ def dispatch_hdfs_phase(worker, phase: BenchPhase) -> None:
                 try:
                     fs.delete_file(path)
                 except (OSError, FileNotFoundError):
-                    if not cfg.ignore_delete_errors:
+                    if not cfg.ignore_delete_errors \
+                            and not worker._partial_tolerance(phase):
                         raise
             worker.entries_latency_histo.add_latency(
                 (time.perf_counter_ns() - t0) // 1000)
             worker.live_ops.num_entries_done += 1
+
+
+def _retrying_op(worker, op):
+    """--ioretries for one IDEMPOTENT HDFS op (positional reads, stats):
+    the transport is a network filesystem by definition, so EIO
+    classifies transient (io_errors.py classifier with netfs forced).
+    Sequential stream writes are NOT routed through this — see the note
+    in _write_file."""
+    retrier = getattr(worker, "_io_retrier", None)
+    if retrier is None:
+        return op()
+    return retrier.run(op, netfs=True)
 
 
 def _write_file(worker, fs, path: str) -> None:
@@ -145,6 +158,10 @@ def _write_file(worker, fs, path: str) -> None:
             buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
             worker._pre_write_fill(buf, offset, length)
             t0 = time.perf_counter_ns()
+            # NO --ioretries here: the output stream is a sequential
+            # append whose position may have advanced before a failure
+            # surfaced — re-writing the block would duplicate bytes, not
+            # replay them. Only the positional read path retries.
             out.write(bytes(buf[:length]))
             worker.iops_latency_histo.add_latency(
                 (time.perf_counter_ns() - t0) // 1000)
@@ -163,12 +180,26 @@ def _read_file(worker, fs, path: str) -> None:
         while offset < size:
             worker.check_interruption_request()
             length = min(bs, size - offset)
+
+            def read_op(length=length, offset=offset):
+                from .io_errors import ShortIOError
+                data = inp.read_at(length, offset)
+                if len(data) != length:
+                    # transient for the retrier; the historic message is
+                    # restored below when retries are off/exhausted
+                    raise ShortIOError(True, offset, len(data), length)
+                return data
+
             t0 = time.perf_counter_ns()
-            data = inp.read_at(length, offset)
+            try:
+                data = _retrying_op(worker, read_op)
+            except OSError as err:
+                from .io_errors import ShortIOError
+                if isinstance(err, ShortIOError):
+                    raise WorkerException(
+                        f"short HDFS read at {offset} of {path}") from None
+                raise
             lat = (time.perf_counter_ns() - t0) // 1000
-            if len(data) != length:
-                raise WorkerException(
-                    f"short HDFS read at {offset} of {path}")
             buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
             buf[:length] = data
             worker._post_read_actions(buf, offset, length)

@@ -1,0 +1,257 @@
+"""Data-plane fault tolerance: storage-error classifier + per-op retry.
+
+PR 2 gave the *control plane* a transient-vs-permanent discipline
+(`service/fault_tolerance.py`); this module applies the same idiom to the
+*data plane* — the per-op storage I/O of the worker loops — so a transient
+storage hiccup (`EINTR`, `EAGAIN`, `ETIMEDOUT`, a short read, `EIO` on a
+network filesystem) no longer aborts a whole multi-hour phase, while a
+permanent condition (`ENOSPC`, `EROFS`, `EBADF`, ...) still fails fast.
+
+Classifier table (docs/fault-tolerance.md):
+
+==============  ===========  =============================================
+error           class        rationale
+==============  ===========  =============================================
+EINTR           transient    interrupted syscall; retry is the POSIX idiom
+EAGAIN          transient    transient resource pressure
+ETIMEDOUT       transient    per-op deadline (--iotimeout) or netfs timeout
+short read/wr   transient    racing truncation/eof settles, netfs hiccup
+EIO on netfs    transient    NFS/FUSE/parallel-fs transport errors surface
+                             as EIO; local-disk EIO stays permanent
+ESTALE/EREMOTEIO transient   stale NFS handle / remote I/O hiccup
+ENOSPC EROFS    permanent    retrying cannot create space / writability
+EBADF EINVAL    permanent    programming/setup error
+ENOENT EACCES   permanent    namespace/permission problems don't heal
+everything else permanent    fail-fast default (classify-by-allowlist)
+==============  ===========  =============================================
+
+Retry shape: ``--ioretries N`` attempts on top of the first try, jittered
+exponential backoff (the shared ``RetryPolicy``), all backoff drawing from
+one per-phase ``--ioretrybudget`` seconds account (``RetryBudget``) so a
+dying device converges to an error instead of retrying forever. The
+default of 0 retries preserves today's fail-fast behavior bit for bit.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+
+from ..service.fault_tolerance import RetryBudget, RetryPolicy
+
+#: always-transient errnos (see the classifier table above)
+TRANSIENT_ERRNOS = frozenset({
+    errno.EINTR, errno.EAGAIN, errno.ETIMEDOUT, errno.ESTALE,
+    getattr(errno, "EREMOTEIO", 121),
+})
+
+#: errnos that are never retried, even on a network filesystem
+PERMANENT_ERRNOS = frozenset({
+    errno.ENOSPC, errno.EROFS, errno.EBADF, errno.EDQUOT, errno.EINVAL,
+    errno.ENOENT, errno.EACCES, errno.EPERM, errno.EISDIR, errno.ENOTDIR,
+})
+
+#: /proc/mounts fstypes treated as network/parallel filesystems, where
+#: EIO usually means a transport hiccup rather than dying media
+NETFS_TYPES = frozenset({
+    "nfs", "nfs4", "cifs", "smb3", "smbfs", "9p", "afs", "ceph",
+    "lustre", "beegfs", "gpfs", "glusterfs", "panfs", "pvfs2",
+    "virtiofs", "fuse", "fuse.gcsfuse", "fuse.s3fs", "fuse.sshfs",
+    "fuse.juicefs",
+})
+
+_mount_cache: "dict[str, bool] | None" = None
+
+
+class ShortIOError(OSError):
+    """A read/write moved fewer bytes than requested — transient (racing
+    truncation settles; netfs hiccups heal). Message matches the worker
+    loops' historic short-I/O error text so ``--ioretries 0`` output is
+    byte-for-byte identical to the pre-retry behavior."""
+
+    def __init__(self, is_read: bool, offset: int, got: int, want: int):
+        self.is_read = is_read
+        self.offset = offset
+        self.got = got
+        self.want = want
+        super().__init__(errno.EIO,
+                         f"short {'read' if is_read else 'write'} at "
+                         f"offset {offset}: {got} != {want}")
+
+    def __str__(self) -> str:  # exact parity with the historic message
+        return (f"short {'read' if self.is_read else 'write'} at "
+                f"offset {self.offset}: {self.got} != {self.want}")
+
+
+def _load_netfs_mounts() -> "list[tuple[str, bool]]":
+    """[(mountpoint, is_netfs)] sorted longest-mountpoint-first so a
+    longest-prefix match resolves nested mounts correctly."""
+    mounts: "list[tuple[str, bool]]" = []
+    try:
+        with open("/proc/self/mounts") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                mnt = parts[1].replace("\\040", " ")
+                fstype = parts[2]
+                is_net = (fstype in NETFS_TYPES
+                          or fstype.split(".", 1)[0] == "fuse")
+                mounts.append((mnt, is_net))
+    except OSError:
+        pass
+    mounts.sort(key=lambda m: len(m[0]), reverse=True)
+    return mounts
+
+
+def is_netfs_path(path: str) -> bool:
+    """Whether path lives on a network/parallel filesystem (longest
+    mountpoint prefix match over /proc/self/mounts, cached)."""
+    global _mount_cache
+    if not path:
+        return False
+    if _mount_cache is None:
+        _mount_cache = {}
+    path = os.path.abspath(path)
+    hit = _mount_cache.get(path)
+    if hit is not None:
+        return hit
+    result = False
+    for mnt, is_net in _load_netfs_mounts():
+        if path == mnt or path.startswith(mnt.rstrip("/") + "/") \
+                or mnt == "/":
+            result = is_net
+            break
+    _mount_cache[path] = result
+    return result
+
+
+def reset_netfs_cache() -> None:
+    global _mount_cache
+    _mount_cache = None
+
+
+def classify_io_error(err: BaseException, path: str = "",
+                      netfs: "bool | None" = None) -> str:
+    """'transient' (a retry plausibly succeeds) or 'permanent' (abort
+    now). netfs overrides the path-based network-filesystem detection —
+    object/HDFS callers pass True, their transport is a network by
+    definition."""
+    if isinstance(err, ShortIOError):
+        return "transient"
+    if not isinstance(err, OSError) or err.errno is None:
+        return "permanent"
+    if err.errno in PERMANENT_ERRNOS:
+        return "permanent"
+    if err.errno in TRANSIENT_ERRNOS:
+        return "transient"
+    if err.errno == errno.EIO:
+        on_net = netfs if netfs is not None else is_netfs_path(path)
+        return "transient" if on_net else "permanent"
+    return "permanent"
+
+
+class IoRetrier:
+    """Per-worker retry driver for storage ops, sharing PR 2's
+    ``RetryPolicy``/``RetryBudget`` idiom. Counts every retry into the
+    worker's ``io_retries``/``io_retry_usec`` audit counters (plumbed to
+    JSON//metrics via ``PATH_AUDIT_COUNTERS``) and checks the worker's
+    interruption flag between backoff slices so Ctrl-C/time limits stay
+    responsive even mid-backoff."""
+
+    #: backoff sleep slice so interrupts are noticed promptly
+    _SLEEP_SLICE_SECS = 0.1
+
+    def __init__(self, worker, policy: RetryPolicy):
+        self.worker = worker
+        self.policy = policy
+        self.budget = RetryBudget(policy.budget_secs)
+        # deterministic per-rank jitter stream (reproducible chaos runs)
+        self._rng = random.Random(worker.rank)
+        self._consec = 0
+
+    def reset(self) -> None:
+        """Per-phase reset (the budget is a per-phase account)."""
+        self.budget.reset()
+        self._consec = 0
+
+    def should_retry(self, err: BaseException, path: str = "",
+                     netfs: "bool | None" = None,
+                     attempt: "int | None" = None) -> bool:
+        """attempt: explicit per-op retry count for callers that
+        interleave many in-flight ops (the fused ring) — the shared
+        consecutive counter would let one op's retry falsely exhaust (or
+        another op's success falsely reset) a sibling's allowance."""
+        if self.policy.num_retries <= 0:
+            return False
+        done = self._consec if attempt is None else attempt
+        if done >= self.policy.num_retries:
+            return False
+        return classify_io_error(err, path, netfs) == "transient"
+
+    def note_success(self) -> None:
+        self._consec = 0
+
+    def backoff(self, attempt: "int | None" = None) -> None:
+        """One jittered-backoff sleep drawn from the per-phase budget;
+        raises the budget exhaustion as a StopIteration-free RuntimeError
+        equivalent — the caller re-raises the original error instead."""
+        import time
+        done = self._consec if attempt is None else attempt
+        delay = self.policy.backoff_delay(done, self._rng)
+        if not self.budget.try_spend(delay):
+            raise IoRetryBudgetExhausted(
+                f"--ioretrybudget exhausted: {self.budget.spent_secs:.1f}s "
+                f"of I/O retry backoff already spent this phase")
+        if attempt is None:
+            self._consec += 1
+        self.worker.io_retries += 1
+        self.worker.io_retry_usec += int(delay * 1_000_000)
+        tracer = getattr(self.worker, "_tracer", None)
+        t0 = tracer.now_ns() if tracer is not None else 0
+        remaining = delay
+        while remaining > 0:
+            self.worker.check_interruption_flag_only()
+            slice_ = min(self._SLEEP_SLICE_SECS, remaining)
+            time.sleep(slice_)
+            remaining -= slice_
+        if tracer is not None:  # --tracefile: backoff visible per op
+            tracer.record("io_retry", "fault", t0,
+                          (tracer.now_ns() - t0) // 1000,
+                          rank=self.worker.rank, sampled=True)
+
+    def run(self, op, path: str = "", netfs: "bool | None" = None):
+        """Run op() with transient-error retries. The final failure
+        re-raises the ORIGINAL error so ``--ioretries 0`` (where this is
+        never even called) and exhausted-retry output look identical."""
+        while True:
+            try:
+                result = op()
+            except Exception as err:  # noqa: BLE001 - classified below
+                if not self.should_retry(err, path, netfs):
+                    raise
+                try:
+                    self.backoff()
+                except IoRetryBudgetExhausted:
+                    raise err from None
+                continue
+            self.note_success()
+            return result
+
+
+class IoRetryBudgetExhausted(Exception):
+    """Internal: the per-phase backoff budget ran dry; the caller
+    re-raises the original storage error."""
+
+
+def make_io_retrier(worker) -> "IoRetrier | None":
+    """Build the worker's retrier from --ioretries/--ioretrybudget
+    (None when retries are disabled — the hot loops then skip every
+    retry-related branch, preserving exact fail-fast behavior)."""
+    cfg = worker.cfg
+    if getattr(cfg, "io_num_retries", 0) <= 0:
+        return None
+    policy = RetryPolicy(num_retries=cfg.io_num_retries,
+                         budget_secs=max(cfg.io_retry_budget_secs, 0))
+    return IoRetrier(worker, policy)

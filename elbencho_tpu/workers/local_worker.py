@@ -19,6 +19,7 @@ the same spots.
 
 from __future__ import annotations
 
+import errno
 import mmap
 import os
 import stat as stat_mod
@@ -71,6 +72,8 @@ class LocalWorker(Worker):
         self._prepared = False
         self._stream_mode_logged = False  # once-per-phase fused-loop note
         self._stream_drain_failed = False  # aborted ring drain: leak bufs
+        self._io_retrier = None        # --ioretries (workers/io_errors.py)
+        self._tolerate_note_logged = False  # partial-dataset delete note
         import ctypes
         self._native_interrupt = ctypes.c_int(0)  # seen by the C++ engine
 
@@ -82,6 +85,9 @@ class LocalWorker(Worker):
         super().reset_stats()
         self._native_interrupt.value = 0
         self._stream_mode_logged = False  # log the mode once per phase
+        self._tolerate_note_logged = False
+        if self._io_retrier is not None:
+            self._io_retrier.reset()  # per-phase backoff budget
         if self._tpu is not None:
             # path-audit counters are per-phase, like tpu_transfer_bytes
             self._tpu.reset_path_counters()
@@ -168,6 +174,10 @@ class LocalWorker(Worker):
             self._rate_limiter_read = RateLimiter(cfg.limit_read_bps)
         if cfg.limit_write_bps:
             self._rate_limiter_write = RateLimiter(cfg.limit_write_bps)
+        # --ioretries: per-op transient-error retry (None = exact
+        # fail-fast parity; workers/io_errors.py)
+        from .io_errors import make_io_retrier
+        self._io_retrier = make_io_retrier(self)
         # native limiter windows (RateState x2: read, write); created once
         # per prepare and shared by this worker's phases — the exact
         # lifetime of the Python RateLimiter objects above
@@ -462,7 +472,8 @@ class LocalWorker(Worker):
                             except OSError:
                                 pass
                     except FileNotFoundError:
-                        if not cfg.ignore_delete_errors:
+                        if not cfg.ignore_delete_errors \
+                                and not self._partial_tolerance(phase):
                             raise
                         op_rec.error = True
                 else:  # STATDIRS
@@ -520,12 +531,14 @@ class LocalWorker(Worker):
 
         def submit():
             self.check_interruption_request(force=True)
-            try:
+
+            def call(paths=paths):
                 native.run_file_loop(
                     paths, op, open_flags, cfg.file_size, cfg.block_size,
                     # stat/unlink (and 0-byte files) never touch the buffer
                     buf_addr=self._buf_addr() if self._io_bufs else 0,
-                    ignore_delete_errors=cfg.ignore_delete_errors,
+                    ignore_delete_errors=cfg.ignore_delete_errors
+                    or self._partial_tolerance(phase),
                     worker=self, interrupt_flag=self._native_interrupt,
                     verify_salt=cfg.integrity_check_salt,
                     block_var_pct=cfg.block_variance_pct,
@@ -538,6 +551,11 @@ class LocalWorker(Worker):
                     inline_readback=(cfg.do_read_inline
                                      or cfg.do_direct_verify),
                     flock_mode=self._flock_mode_code())
+
+            try:
+                # unlink chunks never retry: a re-run would ENOENT on the
+                # files the first attempt already removed
+                self._retrying_native(call, retryable=op != "unlink")
             except NativeVerifyError as err:
                 bpf = max((cfg.file_size + cfg.block_size - 1)
                           // cfg.block_size, 1)
@@ -596,7 +614,8 @@ class LocalWorker(Worker):
                         try:
                             os.unlink(path)
                         except FileNotFoundError:
-                            if not cfg.ignore_delete_errors:
+                            if not cfg.ignore_delete_errors \
+                                    and not self._partial_tolerance(phase):
                                 raise
                             op_rec.error = True
                     lat_usec = (time.perf_counter_ns() - t0) // 1000
@@ -812,23 +831,42 @@ class LocalWorker(Worker):
                 real_off = file_offset_base + off
             if not do_read_this_op:
                 self._pre_write_fill(buf, real_off, length)
-            t0 = time.perf_counter_ns()
-            if cfg.use_file_locks:
-                with FileRangeLock(fd, cfg.use_file_locks, real_off, length,
-                                   is_write=not do_read_this_op):
-                    if do_read_this_op:
-                        n = os.preadv(fd, [buf[:length]], real_off)
-                    else:
-                        n = os.pwritev(fd, [buf[:length]], real_off)
-            elif do_read_this_op:
-                n = os.preadv(fd, [buf[:length]], real_off)
-            else:
-                n = os.pwritev(fd, [buf[:length]], real_off)
-            lat_usec = (time.perf_counter_ns() - t0) // 1000
-            if n != length:
-                raise WorkerException(
-                    f"short {'read' if do_read_this_op else 'write'} at "
-                    f"offset {real_off}: {n} != {length}")
+
+            def one_op(fd=fd, real_off=real_off, length=length,
+                       do_read=do_read_this_op, buf=buf):
+                """One positional I/O attempt; a short transfer raises
+                the (transient) ShortIOError so --ioretries covers it."""
+                t0 = time.perf_counter_ns()
+                if cfg.use_file_locks:
+                    with FileRangeLock(fd, cfg.use_file_locks, real_off,
+                                       length, is_write=not do_read):
+                        if do_read:
+                            n = os.preadv(fd, [buf[:length]], real_off)
+                        else:
+                            n = os.pwritev(fd, [buf[:length]], real_off)
+                elif do_read:
+                    n = os.preadv(fd, [buf[:length]], real_off)
+                else:
+                    n = os.pwritev(fd, [buf[:length]], real_off)
+                if n != length:
+                    from .io_errors import ShortIOError
+                    raise ShortIOError(do_read, real_off, n, length)
+                # t0 rides along for the tracer span (the final
+                # successful attempt's window, excluding retry backoff)
+                return n, (time.perf_counter_ns() - t0) // 1000, t0
+
+            try:
+                if self._io_retrier is None:
+                    n, lat_usec, t0 = one_op()
+                else:
+                    n, lat_usec, t0 = self._io_retrier.run(
+                        one_op, path=self._retry_path_hint())
+            except OSError as err:
+                from .io_errors import ShortIOError
+                if isinstance(err, ShortIOError):
+                    # exact historic short-I/O message (fail-fast parity)
+                    raise WorkerException(str(err)) from None
+                raise
             if self._ops_log:
                 self._ops_log.log_op("read" if do_read_this_op else "write",
                                      "", real_off, length)
@@ -852,7 +890,9 @@ class LocalWorker(Worker):
             ops.num_iops_done += 1
             self._num_iops_submitted += 1
         if self._tpu is not None:
-            self._tpu.flush()  # drain pipelined transfers before phase end
+            # drain pipelined transfers before phase end (guarded: an
+            # in-flight transfer of a dying chip surfaces here)
+            self._tpu_guarded(self._tpu.flush)
             self._sync_tpu_usec()
 
     def _sync_tpu_usec(self) -> None:
@@ -861,6 +901,96 @@ class LocalWorker(Worker):
         wall time; both accumulated per-phase by TransferPipeline)."""
         self.tpu_dispatch_usec = self._tpu.dispatch_usec
         self.tpu_transfer_usec = self._tpu.transfer_usec
+
+    # ------------------------------------------------------------------
+    # data-plane fault tolerance (--ioretries / --iotimeout /
+    # --tpufallback; workers/io_errors.py + tpu/device.py failover)
+    # ------------------------------------------------------------------
+
+    def _partial_tolerance(self, phase: BenchPhase) -> bool:
+        """Delete phases tolerate missing entries when an earlier write
+        phase of this run was aborted (time limit, interrupt, or a
+        permanent storage error): the dataset is partial by definition,
+        and failing the cleanup over expected ENOENTs would bury the
+        benchmark results that were already printed. Logged once per
+        phase; --nodelerr keeps covering the cross-run cleanup case."""
+        if phase not in (BenchPhase.DELETEFILES, BenchPhase.DELETEDIRS):
+            return False
+        if not self.shared.partial_dataset:
+            return False
+        if not self._tolerate_note_logged:
+            self._tolerate_note_logged = True
+            if self.rank % max(1, self.cfg.num_threads) == 0:
+                logger.log(
+                    logger.LOG_NORMAL,
+                    "NOTE: an earlier write phase was aborted; the delete "
+                    "phase tolerates entries missing from the partial "
+                    "dataset")
+        return True
+
+    def _retry_path_hint(self) -> str:
+        """Path used by the retry classifier's network-filesystem check
+        (EIO is transient on NFS/FUSE/parallel filesystems, permanent on
+        local media)."""
+        paths = self.cfg.paths
+        return paths[0] if paths else ""
+
+    def _retrying_native(self, call, retryable: bool = True):
+        """Run one native-engine chunk call under --ioretries. A retry
+        re-issues the WHOLE chunk (accounting only books after a chunk
+        succeeds, so nothing double-counts; re-running completed
+        read/write ops is idempotent benchmark I/O)."""
+        if self._io_retrier is None or not retryable:
+            return call()
+        return self._io_retrier.run(call, path=self._retry_path_hint())
+
+    def _tpu_guarded(self, fn, *args, **kwargs):
+        """Run one TPU transfer-path call with device-loss failover
+        (--tpufallback). Anything that is not a classified XLA-runtime/
+        device-loss error propagates untouched — a --tpubudget breach or
+        a logic error must abort, never failover."""
+        from ..tpu.device import is_device_loss_error
+        attempts = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except WorkerInterruptedException:
+                raise
+            except Exception as err:  # noqa: BLE001 - classified below
+                if self._tpu is None or not is_device_loss_error(err):
+                    raise
+                attempts += 1
+                if attempts > len(self.cfg.tpu_ids) + 1:
+                    raise  # every chip (and host staging) failed: abort
+                self._tpu_handle_device_loss(err)
+
+    def _tpu_handle_device_loss(self, err: Exception) -> None:
+        """One device-loss event: poison the chip fleet-wide, then abort
+        (default), fail over to a surviving --tpuids chip, or degrade to
+        host-memory staging per --tpufallback."""
+        cfg = self.cfg
+        ctx = self._tpu
+        with self.shared.cond:
+            self.shared.poisoned_tpu_chips.add(ctx.chip_id)
+        if self._tracer is not None:  # --tracefile failover marker
+            self._tracer.record("tpu_failover", "fault",
+                                self._tracer.now_ns(), 0, rank=self.rank)
+        mode = getattr(cfg, "tpu_fallback", "abort")
+        if mode == "abort":
+            from ..tpu.device import TpuDeviceLostError
+            raise WorkerException(
+                str(TpuDeviceLostError(ctx.chip_id, err))) from err
+        if mode == "chip":
+            with self.shared.cond:
+                survivors = [c for c in cfg.tpu_ids
+                             if c not in self.shared.poisoned_tpu_chips]
+            if survivors:
+                ctx.failover_to_chip(survivors[self.rank % len(survivors)])
+                return
+            logger.log_error(
+                "--tpufallback chip: no surviving --tpuids chip left; "
+                "degrading to host-memory staging")
+        ctx.failover_to_host()
 
     def _native_loop_eligible(self, native) -> bool:
         """Conditions every native delegation shares: no per-op Python
@@ -987,6 +1117,15 @@ class LocalWorker(Worker):
         self._log_stream_mode(
             f"fused TPU stream engaged (backend={stream.backend_name}, "
             f"slots={len(slot_addrs)})")
+        if cfg.io_timeout_secs:
+            # --iotimeout: hung ops surface as -ETIMEDOUT with the slot
+            # re-armed instead of wedging the reap loop
+            stream.set_timeout(cfg.io_timeout_secs * 1_000_000)
+        fault_spec = os.environ.get("ELBENCHO_TPU_IO_FAULT")
+        if fault_spec:
+            # test-only deterministic fault injection; config validation
+            # already rejected this knob outside a test harness
+            stream.set_fault_from_spec(fault_spec)
         if self._tracer is not None:  # stream-reap sub-spans (--tracefile)
             stream.tracer = self._tracer
             stream.trace_rank = self.rank
@@ -1021,7 +1160,9 @@ class LocalWorker(Worker):
                 logger.log_error(
                     f"worker {self.rank}: stream ring drain failed; "
                     f"keeping I/O buffers mapped until process exit")
-        self._tpu.flush()  # phase-end transfer drain, --tpubudget check
+        # phase-end transfer drain + --tpubudget check (guarded for
+        # --tpufallback like every other transfer-path call)
+        self._tpu_guarded(self._tpu.flush)
         self._sync_tpu_usec()
         return True
 
@@ -1051,21 +1192,71 @@ class LocalWorker(Worker):
         lat_arr = (ctypes.c_uint64 * n)()
         state = {"bytes": 0}
 
+        def retry_or_raise(slot, i, fdi, r_off, length, rd, attempts,
+                           err) -> bool:
+            """--ioretries for a failed fused-ring op: backoff, then
+            re-submit the SAME op on the SAME slot (the slot buffer still
+            holds the write source; a read retries into it). attempts is
+            tracked PER OP in slot_op — the ring interleaves many
+            in-flight ops, so the retrier's shared consecutive counter
+            would misaccount across them. Returns True when the retry
+            was submitted, raises the original error when retries are
+            off/exhausted/not applicable."""
+            from .io_errors import IoRetryBudgetExhausted, ShortIOError
+            retrier = self._io_retrier
+            if retrier is None or not retrier.should_retry(
+                    err, path=self._retry_path_hint(), attempt=attempts):
+                if isinstance(err, ShortIOError):
+                    raise WorkerException(str(err)) from None
+                raise err
+            try:
+                retrier.backoff(attempt=attempts)
+            except IoRetryBudgetExhausted:
+                raise err from None
+            slot_op[slot] = (i, fdi, r_off, length, rd, attempts + 1)
+            stream.submit(slot, fdi, r_off, length, is_write=not rd)
+            return True
+
         def reap_some(min_complete: int) -> None:
+            from .io_errors import ShortIOError
             events = stream.reap(min_complete, 1000,
                                  self._native_interrupt)
             if not events:
                 # timeout or interrupt: surface the interrupt, else retry
                 self.check_interruption_request(force=True)
+                if cfg.io_timeout_secs and slot_op:
+                    # un-cancellable hung op (kernel-AIO io_cancel is
+                    # best-effort): once an op is WAY past the deadline
+                    # with no completion in sight, abort the phase
+                    # loudly instead of spinning forever — the ring's
+                    # close() drain then leaks the slot buffers safely
+                    age = stream.oldest_age_usec()
+                    limit = cfg.io_timeout_secs * 2_000_000 + 5_000_000
+                    if age > limit:
+                        raise WorkerException(
+                            f"storage op stuck for {age // 1_000_000}s — "
+                            f"past --iotimeout {cfg.io_timeout_secs}s and "
+                            f"uncancellable on the "
+                            f"{stream.backend_name} backend; aborting "
+                            f"the phase")
                 return
             for slot, lat, res in events:
-                i, r_off, length, rd = slot_op.pop(slot)
+                i, fdi, r_off, length, rd, attempts = slot_op.pop(slot)
                 if res < 0:
-                    raise OSError(-res, os.strerror(-res))
+                    if -res == errno.ETIMEDOUT:
+                        # --iotimeout cancelled a hung op (audited; the
+                        # error itself is transient, so --ioretries can
+                        # re-drive the op on the re-armed slot)
+                        self.io_timeouts += 1
+                    retry_or_raise(slot, i, fdi, r_off, length, rd,
+                                   attempts,
+                                   OSError(-res, os.strerror(-res)))
+                    continue
                 if res != length:
-                    raise WorkerException(
-                        f"short {'read' if rd else 'write'} at offset "
-                        f"{r_off}: {res} != {length}")
+                    retry_or_raise(slot, i, fdi, r_off, length, rd,
+                                   attempts,
+                                   ShortIOError(rd, r_off, res, length))
+                    continue
                 lat_arr[i] = lat
                 state["bytes"] += res
                 ctx.stream_fused_ops += 1
@@ -1121,10 +1312,9 @@ class LocalWorker(Worker):
                     # slot (the Python loop's pre-write hook)
                     self._pre_write_fill(self._io_bufs[slot], r_off,
                                          length)
-                slot_op[slot] = (i, r_off, length, rd)
-                stream.submit(slot,
-                              int(fd_idx[i]) if fd_idx is not None else 0,
-                              r_off, length, is_write=not rd)
+                fdi = int(fd_idx[i]) if fd_idx is not None else 0
+                slot_op[slot] = (i, fdi, r_off, length, rd, 0)
+                stream.submit(slot, fdi, r_off, length, is_write=not rd)
             while slot_op:  # chunk barrier: exact accounting below
                 reap_some(1)
         except WorkerInterruptedException:
@@ -1174,7 +1364,9 @@ class LocalWorker(Worker):
             # (workerRank+numIOPSSubmitted)%100 < pct, :1741-1742)
             flags = self._rwmix_read_flags(len(offsets)) if is_write \
                 else None
-            try:
+
+            def call(offsets=offsets, lengths=lengths, idx=idx, fds=fds,
+                     flags=flags):
                 native.run_block_loop(
                     fd=fd, offsets=offsets, lengths=lengths,
                     is_write=is_write, buf_addr=self._buf_addr(),
@@ -1194,6 +1386,12 @@ class LocalWorker(Worker):
                     ops_fd=(self._ops_log.fd if self._ops_log is not None
                             else -1),
                     ops_lock=cfg.ops_log_lock, worker_rank=self.rank)
+
+            try:
+                # --ioretries: a transient chunk failure re-issues the
+                # whole chunk (accounting only books after success, so
+                # nothing double-counts; the re-run is idempotent I/O)
+                self._retrying_native(call)
             except NativeVerifyError as err:
                 file_off = int(offsets[err.block_idx]) + err.word_idx * 8
                 raise WorkerException(
@@ -1259,10 +1457,11 @@ class LocalWorker(Worker):
             # transfer lands it in the write buffer (replaces cudaMemcpy
             # D2H pre-write, reference LocalWorker.cpp:2437-2490). With
             # --verify the pattern itself is generated on-device so the
-            # read-back check still holds.
-            self._tpu.device_to_host(buf, length,
-                                     verify_salt=cfg.integrity_check_salt,
-                                     file_offset=offset)
+            # read-back check still holds. Guarded: a device loss here
+            # triggers --tpufallback failover instead of a bare abort.
+            self._tpu_guarded(self._tpu.device_to_host, buf, length,
+                              verify_salt=cfg.integrity_check_salt,
+                              file_offset=offset)
             self._sync_tpu_usec()
             self.tpu_transfer_bytes += length
             return
@@ -1313,14 +1512,18 @@ class LocalWorker(Worker):
         cfg = self.cfg
         if self._tpu is not None:
             # host->HBM DMA of the read block (replaces cudaMemcpy H2D post-
-            # read / cuFile read, reference LocalWorker.cpp:2633-2749)
-            self._tpu.host_to_device(buf, length,
-                                     verify_salt=cfg.integrity_check_salt
-                                     if cfg.do_tpu_verify else 0,
-                                     file_offset=offset)
+            # read / cuFile read, reference LocalWorker.cpp:2633-2749);
+            # guarded for --tpufallback chip failover
+            self._tpu_guarded(self._tpu.host_to_device, buf, length,
+                              verify_salt=cfg.integrity_check_salt
+                              if cfg.do_tpu_verify else 0,
+                              file_offset=offset)
             self._sync_tpu_usec()
             self.tpu_transfer_bytes += length
-            if cfg.do_tpu_verify and cfg.integrity_check_salt:
+            # host-staging failover clears verify_on_device, so a
+            # degraded phase falls through to the host memcmp below
+            if cfg.do_tpu_verify and cfg.integrity_check_salt \
+                    and self._tpu.verify_on_device:
                 return  # verified on-device by the Pallas kernel
         if cfg.integrity_check_salt:
             self._verify_read_buf(buf, offset, length)
@@ -1374,7 +1577,7 @@ class LocalWorker(Worker):
                 self.live_ops.num_iops_done += 1
                 self._num_iops_submitted += 1
             if self._tpu is not None:
-                self._tpu.flush()
+                self._tpu_guarded(self._tpu.flush)
                 self._sync_tpu_usec()
         finally:
             mapped.close()
@@ -1455,7 +1658,8 @@ class LocalWorker(Worker):
                     try:
                         os.unlink(p)
                     except FileNotFoundError:
-                        if not cfg.ignore_delete_errors:
+                        if not cfg.ignore_delete_errors \
+                                and not self._partial_tolerance(phase):
                             raise
                     self.live_ops.num_entries_done += 1
             return
@@ -1604,7 +1808,8 @@ class LocalWorker(Worker):
                 try:  # non-zero shared slices were filtered out above
                     os.unlink(path)
                 except FileNotFoundError:
-                    if not cfg.ignore_delete_errors:
+                    if not cfg.ignore_delete_errors \
+                            and not self._partial_tolerance(phase):
                         raise
             lat_usec = (time.perf_counter_ns() - t0) // 1000
             self.entries_latency_histo.add_latency(lat_usec)
@@ -1635,11 +1840,13 @@ class LocalWorker(Worker):
 
         def submit():
             self.check_interruption_request(force=True)
-            try:
+
+            def call(paths=paths, starts=starts, lens=lens):
                 native.run_file_loop(
                     paths, op, open_flags, cfg.file_size, cfg.block_size,
                     buf_addr=self._buf_addr() if self._io_bufs else 0,
-                    ignore_delete_errors=cfg.ignore_delete_errors,
+                    ignore_delete_errors=cfg.ignore_delete_errors
+                    or self._partial_tolerance(phase),
                     worker=self, interrupt_flag=self._native_interrupt,
                     ranges=(starts, lens) if op in ("write", "read")
                     else None,
@@ -1654,6 +1861,9 @@ class LocalWorker(Worker):
                     inline_readback=(cfg.do_read_inline
                                      or cfg.do_direct_verify),
                     flock_mode=self._flock_mode_code())
+
+            try:
+                self._retrying_native(call, retryable=op != "unlink")
             except NativeVerifyError as err:
                 # map the global block index back through the per-file
                 # [range_start, range_len) slices

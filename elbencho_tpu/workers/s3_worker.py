@@ -24,6 +24,23 @@ from .shared import WorkerException
 MAX_LIST_PAGE = 1000
 
 
+def _retry_notify_for(worker):
+    """Per-retry hook feeding the worker's IoRetries/IoRetryUsec audit
+    counters (--ioretries unification: object-transport retries count in
+    the same columns as POSIX per-op retries). With --s3single the shared
+    client attributes retries to its creating worker. Locked: unlike the
+    worker-thread-owned live counters, this hook fires from the S3
+    pipeline's executor threads, where a bare += would lose updates."""
+    import threading
+    lock = threading.Lock()
+
+    def notify(slept_secs: float) -> None:
+        with lock:
+            worker.io_retries += 1
+            worker.io_retry_usec += int(slept_secs * 1_000_000)
+    return notify
+
+
 def _client(worker):
     if getattr(worker, "_s3_client", None) is None:
         from ..toolkits.s3_tk import make_client_for_rank
@@ -40,14 +57,16 @@ def _client(worker):
                 if client is None:
                     client = make_client_for_rank(
                         worker.cfg, 0,
-                        interrupt_check=worker.check_interruption_flag_only)
+                        interrupt_check=worker.check_interruption_flag_only,
+                        retry_notify=_retry_notify_for(worker))
                     shared.s3_client_singleton = client
             worker._s3_client = client
         else:
             worker._s3_client = make_client_for_rank(
                 worker.cfg, worker.rank,
                 interrupt_check=lambda: worker.check_interruption_request(
-                    force=True))
+                    force=True),
+                retry_notify=_retry_notify_for(worker))
     return worker._s3_client
 
 
@@ -126,7 +145,8 @@ class _S3Pipeline:
             # thread business
             client = make_client_for_rank(
                 self.worker.cfg, self.worker.rank,
-                interrupt_check=self.worker.check_interruption_flag_only)
+                interrupt_check=self.worker.check_interruption_flag_only,
+                retry_notify=_retry_notify_for(self.worker))
             self._tls.client = client
             with self._clients_lock:
                 self._clients.append(client)
@@ -234,7 +254,9 @@ def dispatch_s3_phase(worker, phase: BenchPhase) -> None:
             f"S3 phase {phase.name} is not implemented yet")
     handler(worker, phase)
     if worker._tpu is not None:
-        worker._tpu.flush()  # drain pipelined staging; --tpubudget checks
+        # drain pipelined staging + --tpubudget checks (guarded for
+        # --tpufallback chip failover like the POSIX loops)
+        worker._tpu_guarded(worker._tpu.flush)
         worker._sync_tpu_usec()
 
 
@@ -333,7 +355,8 @@ def _iterate_objects(worker, phase: BenchPhase) -> None:
                     _client(worker).delete_object(bucket, key)
                 except Exception:
                     if not cfg.ignore_delete_errors \
-                            and not cfg.s3_ignore_errors:
+                            and not cfg.s3_ignore_errors \
+                            and not worker._partial_tolerance(phase):
                         raise
                     op_rec.error = True
             lat_usec = (time.perf_counter_ns() - t0) // 1000

@@ -59,6 +59,15 @@ class WorkersSharedData:
         self.stonewall_triggered = False
         self.interrupt_requested = False
         self.phase_time_expired = False
+        # --tpufallback: chips declared lost by a worker's failover; a
+        # dead chip stays dead for the run, and sibling workers consult
+        # this set when picking a failover target
+        self.poisoned_tpu_chips: "set[int]" = set()
+        # latched when a write phase ends interrupted/errored: later
+        # delete phases then tolerate missing entries (a partial dataset
+        # is EXPECTED after an aborted write — raising FileNotFoundError
+        # noise over it would fail the cleanup the user asked for)
+        self.partial_dataset = False
         self.cpu_util = CPUUtil()
         self.cpu_util_stonewall: float = 0.0
         self.cpu_util_last_done: float = 0.0
@@ -81,6 +90,14 @@ class WorkersSharedData:
         """Set new phase + fresh bench UUID and wake all workers
         (reference: WorkerManager::startNextPhase, WorkerManager.cpp:292)."""
         with self.cond:
+            # latch BEFORE the flags reset: a write phase that ended via
+            # --timelimit expiry, an interrupt, or a worker error left a
+            # partial dataset behind — the delete phases of this run must
+            # tolerate the files that were never created
+            if self.current_phase == BenchPhase.CREATEFILES and (
+                    self.phase_time_expired or self.interrupt_requested
+                    or self.num_workers_done_with_error):
+                self.partial_dataset = True
             self.current_phase = phase
             self.bench_uuid = str(uuid_mod.uuid4())
             self.num_workers_done = 0

@@ -774,6 +774,18 @@ def test_opslog_written_by_native_block_loop(tmp_path, monkeypatch):
 
 
 def _stream_api(lib):
+    # ABI 10: deadlines, cancellation, fault injection, op-age tracking
+    lib.ioengine_stream_set_timeout.restype = ctypes.c_int
+    lib.ioengine_stream_set_timeout.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_uint64]
+    lib.ioengine_stream_set_fault.restype = ctypes.c_int
+    lib.ioengine_stream_set_fault.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int]
+    lib.ioengine_stream_cancel.restype = ctypes.c_int
+    lib.ioengine_stream_cancel.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32]
+    lib.ioengine_stream_oldest_age_usec.restype = ctypes.c_int64
+    lib.ioengine_stream_oldest_age_usec.argtypes = [ctypes.c_void_p]
     lib.ioengine_stream_open.restype = ctypes.c_void_p
     lib.ioengine_stream_open.argtypes = [
         ctypes.POINTER(ctypes.c_int), ctypes.c_uint32,
@@ -958,6 +970,144 @@ def test_stream_reap_interrupt_and_close_drain(engine, tmp_path):
             handle, 0, 0, 0, 4096, 0) == 0
         assert engine.ioengine_stream_submit(
             handle, 1, 0, 4096, 4096, 0) == 0
+        assert engine.ioengine_stream_close(handle) == 0
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# engine ABI 10: per-op deadlines + cancellation + deterministic fault
+# injection — raw-ctypes so the make tsan / make asan re-runs of this
+# file hammer the cancel/timeout/fault entry points directly
+
+
+def test_stream_fault_injection_eio_and_short(engine, tmp_path):
+    """Deterministic schedule: with every_n=2, seed=0 ops 0,2 fault and
+    ops 1,3 complete clean — EIO kind replaces the result, short kind
+    halves it; disarming restores clean completions."""
+    _stream_api(engine)
+    if not engine.ioengine_stream_backend():
+        pytest.skip("no stream backend on this kernel")
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        bs = 4096
+        os.pwrite(fd, b"y" * 8 * bs, 0)
+        bufs = [ctypes.create_string_buffer(bs)]
+        handle, err = _stream_open(engine, [fd], bufs, bs)
+        assert handle, err
+        assert engine.ioengine_stream_set_fault(handle, 0, 2, 1) == 0  # eio
+        results = []
+        for i in range(4):
+            assert engine.ioengine_stream_submit(
+                handle, 0, 0, i * bs, bs, 0) == 0
+            ev = _stream_reap(engine, handle)
+            assert len(ev) == 1
+            results.append(ev[0][2])
+        assert results == [-5, bs, -5, bs]  # (k+0) % 2 == 0 faults
+        assert engine.ioengine_stream_set_fault(handle, 0, 1, 2) == 0  # short
+        assert engine.ioengine_stream_submit(handle, 0, 0, 0, bs, 0) == 0
+        ev = _stream_reap(engine, handle)
+        assert ev[0][2] == bs // 2
+        assert engine.ioengine_stream_set_fault(handle, 0, 0, 0) == 0  # off
+        assert engine.ioengine_stream_submit(handle, 0, 0, 0, bs, 0) == 0
+        ev = _stream_reap(engine, handle)
+        assert ev[0][2] == bs
+        assert engine.ioengine_stream_close(handle) == 0
+    finally:
+        os.close(fd)
+
+
+def test_stream_timeout_surfaces_hang_and_rearms_slot(engine, tmp_path):
+    """--iotimeout core semantics: an injected-hang op (never reaches the
+    kernel) surfaces as -ETIMEDOUT within ~the deadline, the slot is
+    re-armed, and op-age tracking sees the op aging meanwhile."""
+    import errno as errno_mod
+    import time as time_mod
+    _stream_api(engine)
+    if not engine.ioengine_stream_backend():
+        pytest.skip("no stream backend on this kernel")
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        bs = 4096
+        os.pwrite(fd, b"z" * bs, 0)
+        bufs = [ctypes.create_string_buffer(bs)]
+        handle, err = _stream_open(engine, [fd], bufs, bs)
+        assert handle, err
+        assert engine.ioengine_stream_set_fault(handle, 0, 1, 3) == 0  # hang
+        assert engine.ioengine_stream_set_timeout(handle, 300_000) == 0
+        assert engine.ioengine_stream_submit(handle, 0, 0, 0, bs, 0) == 0
+        assert engine.ioengine_stream_inflight(handle) == 1
+        time_mod.sleep(0.05)
+        age = engine.ioengine_stream_oldest_age_usec(handle)
+        assert 30_000 < age < 5_000_000
+        t0 = time_mod.monotonic()
+        ev = _stream_reap(engine, handle, min_complete=1, timeout_ms=3000)
+        assert time_mod.monotonic() - t0 < 1.5  # ~deadline, not the reap cap
+        assert ev and ev[0][2] == -errno_mod.ETIMEDOUT
+        assert engine.ioengine_stream_inflight(handle) == 0
+        # slot re-armed: a clean op on the same slot completes normally
+        assert engine.ioengine_stream_set_fault(handle, 0, 0, 0) == 0
+        assert engine.ioengine_stream_submit(handle, 0, 0, 0, bs, 0) == 0
+        ev = _stream_reap(engine, handle)
+        assert ev[0][2] == bs
+        assert engine.ioengine_stream_close(handle) == 0
+    finally:
+        os.close(fd)
+
+
+def test_stream_cancel_injected_hang_and_close_drain(engine, tmp_path):
+    """Explicit ioengine_stream_cancel surfaces -ECANCELED for a hung op
+    (no deadline involved), cancel of an idle slot is -ENOENT, and a
+    close with a hung op still pending drains clean (the op never
+    reached the kernel, so close retires it instead of waiting)."""
+    import errno as errno_mod
+    _stream_api(engine)
+    if not engine.ioengine_stream_backend():
+        pytest.skip("no stream backend on this kernel")
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        bs = 4096
+        os.pwrite(fd, b"c" * bs, 0)
+        bufs = [ctypes.create_string_buffer(bs) for _ in range(2)]
+        handle, err = _stream_open(engine, [fd], bufs, bs)
+        assert handle, err
+        assert engine.ioengine_stream_cancel(handle, 0) == -errno_mod.ENOENT
+        assert engine.ioengine_stream_set_fault(handle, 0, 1, 3) == 0  # hang
+        assert engine.ioengine_stream_submit(handle, 0, 0, 0, bs, 0) == 0
+        assert engine.ioengine_stream_cancel(handle, 0) == 0
+        ev = _stream_reap(engine, handle)
+        assert ev and ev[0][2] == -errno_mod.ECANCELED
+        # close with another hung op still pending must not wedge
+        assert engine.ioengine_stream_submit(handle, 1, 0, 0, bs, 0) == 0
+        assert engine.ioengine_stream_close(handle) == 0
+    finally:
+        os.close(fd)
+
+
+def test_stream_cancel_kernel_op_best_effort(engine, tmp_path):
+    """Cancelling a REAL kernel op: the completion arrives either as
+    -ECANCELED (cancel won) or with the real result (op beat the
+    cancel) — never a wedged reap, and the ring stays consistent."""
+    _stream_api(engine)
+    if not engine.ioengine_stream_backend():
+        pytest.skip("no stream backend on this kernel")
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        bs = 4096
+        os.pwrite(fd, b"k" * bs, 0)
+        bufs = [ctypes.create_string_buffer(bs)]
+        handle, err = _stream_open(engine, [fd], bufs, bs)
+        assert handle, err
+        assert engine.ioengine_stream_submit(handle, 0, 0, 0, bs, 0) == 0
+        engine.ioengine_stream_cancel(handle, 0)  # best-effort
+        ev = _stream_reap(engine, handle, min_complete=1, timeout_ms=5000)
+        assert ev, "cancelled op never completed"
+        assert ev[0][2] == bs or ev[0][2] < 0
+        assert engine.ioengine_stream_inflight(handle) == 0
         assert engine.ioengine_stream_close(handle) == 0
     finally:
         os.close(fd)

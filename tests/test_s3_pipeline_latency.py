@@ -38,7 +38,8 @@ def pipeline(monkeypatch):
     # no real S3 endpoint: client construction is stubbed out
     monkeypatch.setattr(
         "elbencho_tpu.toolkits.s3_tk.make_client_for_rank",
-        lambda cfg, rank, interrupt_check=None: object())
+        lambda cfg, rank, interrupt_check=None, retry_notify=None:
+        object())
 
     def make(depth):
         return _S3Pipeline(_stub_worker(), depth)
@@ -82,7 +83,8 @@ def test_client_construction_outside_measured_span(pipeline, monkeypatch):
     pays client construction."""
     built = []
 
-    def slow_client_factory(cfg, rank, interrupt_check=None):
+    def slow_client_factory(cfg, rank, interrupt_check=None,
+                            retry_notify=None):
         built.append(threading.current_thread().name)
         time.sleep(0.05)
         return object()
@@ -133,7 +135,7 @@ def test_failed_client_construction_surfaces_fast(monkeypatch):
     barrier's 60s timeout (round-3 advisor, low)."""
     calls = []
 
-    def flaky_make(cfg, rank, interrupt_check=None):
+    def flaky_make(cfg, rank, interrupt_check=None, retry_notify=None):
         calls.append(1)
         if len(calls) == 1:
             raise OSError("endpoint resolution failed")

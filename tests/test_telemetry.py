@@ -323,7 +323,8 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     assert proc.returncode == 0, proc.stderr
     header, row = proc.stdout.strip().splitlines()[:2]
     cols = header.split(",")
-    # appended, never reordered: the telemetry columns sit at the END
-    assert cols[-5:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
-                         "TraceEv"]
-    assert row.split(",")[-5:] == ["3", "7", "2", "5", "11"]
+    # appended, never reordered: the telemetry columns keep their order,
+    # with the (later) data-plane fault-tolerance columns after them
+    assert cols[-8:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+                         "TraceEv", "IoRetry", "IoTmo", "ChipFail"]
+    assert row.split(",")[-8:-3] == ["3", "7", "2", "5", "11"]
