@@ -344,7 +344,7 @@ def _install_signal_handlers() -> None:
     signal.signal(signal.SIGINT, _signal_handler)
 
 
-def _run_cli(args, jsonfile, timeout=240):
+def _run_cli(args, jsonfile, timeout=240, extra_env=None):
     # a healthy pass takes well under a minute (jax import + cached jit +
     # a 256 MiB transfer); the timeout only catches a hung tunnel, and it
     # must be short enough that one dead pass can't eat the whole bench.
@@ -359,6 +359,8 @@ def _run_cli(args, jsonfile, timeout=240):
     timeout = max(10, min(timeout, budget_left))
     env = _subproc_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
     cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
            "--jsonfile", jsonfile] + args
     res = _tracked_run(cmd, env, timeout)
@@ -422,6 +424,26 @@ def _probe_tpu_with_retry() -> "tuple[str, list]":
     when the window closes without a live TPU."""
     timeline = _STATE["timeline"]
     t_start = time.monotonic()
+    # JAX_PLATFORMS already answers the question: a pin to known
+    # non-TPU backends means jax can NEVER hand the probe a TPU —
+    # burning the 180s x 6 window on it produced five straight null
+    # rounds (ROADMAP open item 1). Collapse to an instant verdict; the
+    # host-path fallback ladder still records a real number for the
+    # round. Unknown platform strings still run the real probe loop
+    # (they fail fast anyway, and the window mechanics stay exercised).
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    known_non_tpu = {"cpu", "cuda", "gpu", "rocm", "metal"}
+    pinned = {p.strip().lower() for p in env_platforms.split(",")
+              if p.strip()}
+    if not _SELFTEST and pinned and pinned <= known_non_tpu:
+        timeline.append({
+            "attempt": 0, "utc": _utc_now(), "at_s": 0.0, "elapsed_s": 0.0,
+            "outcome": f"skipped: JAX_PLATFORMS={env_platforms!r} pins a "
+                       f"non-TPU backend"})
+        _STATE["effective_window_s"] = 0
+        raise BenchUnavailable(
+            f"JAX_PLATFORMS={env_platforms!r} pins a non-TPU backend; "
+            f"probe window collapsed to 0s", timeline)
     # the probe may not consume the slice of budget the measured passes
     # need: leave at least 240s of budget after the window closes
     window_s = min(PROBE_WINDOW_S,
@@ -478,6 +500,182 @@ def _probe_tpu_with_retry() -> "tuple[str, list]":
         backoff_s = min(backoff_s * 2, 120)
 
 
+#: the env every fallback-ladder subprocess runs under: jax pinned to
+#: the CPU backend so no child ever touches (or hangs on) a TPU tunnel
+_FALLBACK_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+def _median_mibs(passes):
+    """Sorts `passes` IN PLACE by rate and returns the median
+    (mibs, record) pair — after the call, passes[0]/passes[-1] are the
+    true min/max (both emit sites index them for the artifact)."""
+    passes.sort(key=lambda p: p[0])
+    return passes[len(passes) // 2]
+
+
+def _fixedbuf_ab(target, jsonfile, extra_env=None):
+    """Fixed-buffers-vs-malloc A/B rider: one read pass on the unified
+    staging pool's registered ring (--ioengine uring where the kernel
+    has io_uring) vs one with --poolreg off (per-call buffer
+    registration, the pre-pool path). Storage-only — runs on the TPU
+    path AND every fallback tier, so the allocator/SQPOLL win has a
+    recorded number even in chipless rounds. Returns the labeled dict
+    (never the headline value); failures return {"error": ...}."""
+    try:
+        from elbencho_tpu.utils.native import get_native_engine
+        native = get_native_engine()
+        has_uring = native is not None and native.uring_supported()
+        has_sqpoll = native is not None and native.sqpoll_supported()
+        # pin uring so the classic pool ring actually serves the loop;
+        # without kernel io_uring the A/B still runs (engine auto) and
+        # the op counters prove registration never engaged — labeled,
+        # not a silent approximation of the win
+        engine_args = ["--ioengine", "uring"] if has_uring else []
+        sq_args = ["--iosqpoll"] if has_sqpoll else []
+        sides = {}
+        for name, extra in (
+                ("registered", engine_args + sq_args),
+                ("percall", engine_args + ["--poolreg", "off"])):
+            open(jsonfile, "w").close()
+            recs = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
+                             "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH]
+                            + extra + [target], jsonfile,
+                            extra_env=extra_env)
+            rec = next(r for r in recs if r["Phase"] == "READ")
+            sides[name] = rec
+        reg = sides["registered"].get("MiBPerSecLast") or 0.0
+        percall = sides["percall"].get("MiBPerSecLast") or 0.0
+        return {
+            "registered_mibs": round(reg, 1),
+            "percall_mibs": round(percall, 1),
+            "registered_vs_percall": round(reg / max(percall, 1e-9), 3),
+            # proof of which path each side ran: > 0 means the ops went
+            # through the once-registered pool ring / SQPOLL submission
+            "pool_registered_ops": sides["registered"].get(
+                "PoolRegisteredOps", 0),
+            "pool_sqpoll_ops": sides["registered"].get("PoolSqpollOps", 0),
+            "pool_buf_reuses": sides["registered"].get("PoolBufReuses", 0),
+            "uring_available": has_uring,
+            "sqpoll_available": has_sqpoll,
+        }
+    except (RuntimeError, subprocess.TimeoutExpired, StopIteration,
+            ImportError) as err:
+        return {"error": str(err)[-300:]}
+
+
+def _run_fallback_ladder(probe_err) -> int:
+    """No chip: host-memory staging tier (jax CPU backend serves as the
+    staging sink, so the WHOLE data path incl. TpuWorkerContext runs and
+    TpuHbmMiBPerSec is real) -> pure storage tier (plain read). The
+    record is clearly labeled — tier in the metric name AND a
+    machine-readable fallback_tier key — and is never cached as TPU
+    evidence."""
+    _STATE["stage"] = "host_fallback"
+    import shutil
+    tmpdir = tempfile.mkdtemp(prefix="elbencho_tpu_bench_fb_")
+    _STATE["tmpdir"] = tmpdir
+    target = os.path.join(tmpdir, "benchfile")
+    jf = os.path.join(tmpdir, "fb.json")
+    try:
+        _run_cli(["-w", "-t", "1", "-s", FILE_SIZE, "-b", BLOCK_SIZE,
+                  target], jf, extra_env=_FALLBACK_ENV)
+        # host-only read baseline (same role as the TPU path's pass 1)
+        open(jf, "w").close()
+        host = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
+                         "-b", BLOCK_SIZE, target], jf,
+                        extra_env=_FALLBACK_ENV)
+        host_mibs = next(r["MiBPerSecLast"] for r in host
+                         if r["Phase"] == "READ")
+        tier = None
+        passes = []
+        pass_errors = []
+        # tier 2: host-memory staging — the workers' --tpufallback host
+        # shape: every block still runs the staging copy + transfer
+        # pipeline accounting, just onto the CPU backend's device
+        _STATE["stage"] = "host_staging_passes"
+        for _ in range(3):
+            if _remaining_s() < DEADLINE_RESERVE_S + 60:
+                break
+            open(jf, "w").close()
+            try:
+                recs = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
+                                 "-b", BLOCK_SIZE, "--iodepth", IO_DEPTH,
+                                 "--tpuids", "0", target], jf,
+                                extra_env=_FALLBACK_ENV, timeout=300)
+                rec = next(r for r in recs if r["Phase"] == "READ")
+                mibs = rec.get("TpuHbmMiBPerSec") or 0.0
+                if mibs > 0:
+                    passes.append((mibs, rec))
+                    _STATE["partial_pass_mibs"].append(mibs)
+            except (RuntimeError, subprocess.TimeoutExpired) as err:
+                pass_errors.append(str(err)[-300:])
+        if passes:
+            tier = "host_staging"
+        else:
+            # tier 3: pure storage path — still a real measured number
+            _STATE["stage"] = "storage_passes"
+            for _ in range(3):
+                if _remaining_s() < DEADLINE_RESERVE_S + 30:
+                    break
+                open(jf, "w").close()
+                try:
+                    recs = _run_cli(["-r", "-t", THREADS, "-s", FILE_SIZE,
+                                     "-b", BLOCK_SIZE, "--iodepth",
+                                     IO_DEPTH, target], jf,
+                                    extra_env=_FALLBACK_ENV)
+                    rec = next(r for r in recs if r["Phase"] == "READ")
+                    mibs = rec.get("MiBPerSecLast") or 0.0
+                    if mibs > 0:
+                        passes.append((mibs, rec))
+                        _STATE["partial_pass_mibs"].append(mibs)
+                except (RuntimeError, subprocess.TimeoutExpired) as err:
+                    pass_errors.append(str(err)[-300:])
+            if passes:
+                tier = "storage_only"
+        if not passes:
+            raise RuntimeError(
+                "every fallback tier failed: "
+                + " | ".join(pass_errors[-3:]))
+        med_mibs, med_rec = _median_mibs(passes)  # sorts passes in place
+        tier_label = ("host-memory staging" if tier == "host_staging"
+                      else "pure storage path")
+        rec = {
+            # the label leads the metric name so the number can never
+            # masquerade as a TPU capture downstream
+            "metric": f"HOST-PATH FALLBACK ({tier_label}, no TPU): "
+                      + METRIC_NAME,
+            "value": round(med_mibs, 1),
+            "unit": "MiB/s",
+            "vs_baseline": round(med_mibs / max(host_mibs, 1e-9), 3),
+            "fallback_tier": tier,
+            "median_of": len(passes),
+            "min": round(passes[0][0], 1),
+            "max": round(passes[-1][0], 1),
+            "host_read_mibs": round(host_mibs, 1),
+            "probe_error": str(probe_err)[-500:],
+            "probe_timeline": _STATE["timeline"],
+            "pool_buf_reuses": med_rec.get("PoolBufReuses", 0),
+            "pool_occupancy_hwm": med_rec.get("PoolOccupancyHwm", 0),
+            "pool_registered_ops": med_rec.get("PoolRegisteredOps", 0),
+            "pipeline_ab": None,  # machine-written contract key
+            "utc": _utc_now(),
+        }
+        if pass_errors:
+            rec["pass_errors"] = pass_errors[-3:]
+        _STATE["pending_success"] = rec
+        # the allocator/SQPOLL A/B runs on every tier: the registration
+        # win is a storage-path property, no chip required
+        if _remaining_s() > DEADLINE_RESERVE_S + 120:
+            _STATE["stage"] = "fixedbuf_ab"
+            rec["fixedbuf_ab"] = _fixedbuf_ab(target, jf,
+                                              extra_env=_FALLBACK_ENV)
+        _emit_record(rec)  # NEVER cached: not TPU evidence
+        _STATE["pending_success"] = None
+        return 0
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main() -> int:
     _install_signal_handlers()
     _STATE["stage"] = "tpu_probe"
@@ -485,9 +683,25 @@ def main() -> int:
         platform, probe_timeline = _probe_tpu_with_retry()
         _STATE["platform"] = platform
     except BenchUnavailable as err:
-        print(f"ERROR: TPU device unreachable, cannot run the HBM ingest "
-              f"benchmark: {err}", file=sys.stderr)
-        return _emit_failure("tpu_probe", err)
+        # no chip this round — degrade through the same ladder the
+        # workers already have (TPU -> host-memory staging -> pure
+        # storage path) instead of publishing yet another null artifact:
+        # the fused-ring/pipelining/allocator work still gets a real,
+        # clearly-labeled number (ROADMAP open item 1). Drivers that
+        # want the hard-fail (value=null) record can pin
+        # ELBENCHO_TPU_BENCH_NO_FALLBACK=1.
+        if os.environ.get("ELBENCHO_TPU_BENCH_NO_FALLBACK") == "1":
+            print(f"ERROR: TPU device unreachable and the fallback "
+                  f"ladder is disabled: {err}", file=sys.stderr)
+            return _emit_failure("tpu_probe", err)
+        print(f"# TPU unreachable ({err}); degrading to the host-path "
+              f"fallback ladder", file=sys.stderr)
+        try:
+            return _run_fallback_ladder(err)
+        except Exception as ladder_err:  # noqa: BLE001 - never-null line
+            print(f"ERROR: host-path fallback ladder failed too: "
+                  f"{ladder_err}", file=sys.stderr)
+            return _emit_failure("host_fallback", ladder_err)
     except Exception as err:  # noqa: BLE001 - artifact must never be null
         print(f"ERROR: TPU probe crashed: {err}", file=sys.stderr)
         return _emit_failure("tpu_probe", err)
@@ -582,8 +796,7 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                 f"only {len(passes)}/{HBM_PASSES} HBM passes succeeded"
                 f"{' (deadline-truncated)' if truncated else ''}; "
                 f"errors: {' | '.join(e[-300:] for e in pass_errors)}")
-        passes.sort(key=lambda p: p[0])
-        med_mibs, med_rec = passes[len(passes) // 2]
+        med_mibs, med_rec = _median_mibs(passes)  # sorts passes in place
         # per-chip ingest over PHASE WALL TIME: per-worker transfer-busy
         # usecs overlap across threads, so summing them (TpuPerChip.USec)
         # would understate a chip's delivered bandwidth
@@ -736,6 +949,15 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             except (RuntimeError, subprocess.TimeoutExpired,
                     StopIteration) as err:
                 rec["tpustream_ab"] = {"error": str(err)[-300:]}
+
+        # A/B rider: fixed-buffers-vs-malloc (the registered staging
+        # pool's persistent ring vs per-call buffer registration,
+        # --poolreg off) so the trajectory shows the registration win
+        # explicitly. Storage-only: no tunnel traffic, no idle gap
+        # needed. Never at the expense of the primary median.
+        if not truncated and _remaining_s() > DEADLINE_RESERVE_S + 120:
+            _STATE["stage"] = "fixedbuf_ab"
+            rec["fixedbuf_ab"] = _fixedbuf_ab(target, j3)
 
         # emit FIRST: a SIGTERM landing between these two calls must lose
         # at worst the cache update, never the measured record (a handler

@@ -592,6 +592,15 @@ inline int sys_io_uring_register(int ring_fd, unsigned opcode,
 #ifndef IORING_FEAT_EXT_ARG
 #define IORING_FEAT_EXT_ARG (1U << 8)
 #endif
+#ifndef IORING_SETUP_SQPOLL
+#define IORING_SETUP_SQPOLL (1U << 1)
+#endif
+#ifndef IORING_SQ_NEED_WAKEUP
+#define IORING_SQ_NEED_WAKEUP (1U << 0)
+#endif
+#ifndef IORING_ENTER_SQ_WAKEUP
+#define IORING_ENTER_SQ_WAKEUP (1U << 1)
+#endif
 
 // defined locally in case the image's linux/io_uring.h predates 5.11
 struct UringGetEventsArg {
@@ -623,8 +632,22 @@ struct UringRings {
     unsigned* cq_tail = nullptr;
     unsigned* cq_mask = nullptr;
     io_uring_cqe* cqes = nullptr;
+    // SQPOLL additions (ABI 11): the kernel-consumed SQ head (space
+    // check — with a polling thread the SQ drains asynchronously, so
+    // the producer must not overwrite unconsumed SQEs) and the SQ flags
+    // word (IORING_SQ_NEED_WAKEUP when the idle thread went to sleep)
+    unsigned* sq_khead = nullptr;
+    unsigned* sq_kflags = nullptr;
+    unsigned sq_entries = 0;
+    bool sqpoll = false;
 
-    ~UringRings() {
+    ~UringRings() { reset(); }
+
+    // unmap/close everything and return to the freshly-constructed
+    // state — also the cleanup between init() attempts (a partially
+    // successful init may leave the ring fd open and some rings mapped;
+    // re-initializing over them would leak fd + mappings)
+    void reset() {
         if (sqes)
             munmap(sqes, sqes_sz);
         if (cq_ptr && cq_ptr != sq_ptr)
@@ -633,14 +656,29 @@ struct UringRings {
             munmap(sq_ptr, sq_sz);
         if (ring_fd >= 0)
             close(ring_fd);
+        ring_fd = -1;
+        sq_ptr = cq_ptr = nullptr;
+        sqes = nullptr;
+        sq_sz = cq_sz = sqes_sz = 0;
+        sq_tail = sq_mask = sq_array = nullptr;
+        cq_head = cq_tail = cq_mask = nullptr;
+        cqes = nullptr;
+        sq_khead = sq_kflags = nullptr;
+        sq_entries = 0;
+        sqpoll = false;
     }
 
-    int init(unsigned entries) {
+    int init(unsigned entries, unsigned setup_flags = 0,
+             unsigned sq_thread_idle_ms = 0) {
         io_uring_params p;
         memset(&p, 0, sizeof(p));
+        p.flags = setup_flags;
+        if (setup_flags & IORING_SETUP_SQPOLL)
+            p.sq_thread_idle = sq_thread_idle_ms;
         ring_fd = sys_io_uring_setup(entries, &p);
         if (ring_fd < 0)
             return -errno;
+        sqpoll = (setup_flags & IORING_SETUP_SQPOLL) != 0;
         // the bounded-wait loops need EXT_ARG timeouts (5.11+); without
         // them a blocking GETEVENTS could never notice interrupts
         if (!(p.features & IORING_FEAT_EXT_ARG))
@@ -683,8 +721,76 @@ struct UringRings {
         cq_tail = reinterpret_cast<unsigned*>(cq + p.cq_off.tail);
         cq_mask = reinterpret_cast<unsigned*>(cq + p.cq_off.ring_mask);
         cqes = reinterpret_cast<io_uring_cqe*>(cq + p.cq_off.cqes);
+        sq_khead = reinterpret_cast<unsigned*>(sq + p.sq_off.head);
+        sq_kflags = reinterpret_cast<unsigned*>(sq + p.sq_off.flags);
+        sq_entries = p.sq_entries;
         return 0;
     }
+
+    // SQ space check for async (SQPOLL) submission: true when writing
+    // one more SQE would overwrite an entry the polling thread has not
+    // consumed yet. Without SQPOLL the synchronous enter drains the SQ
+    // before this can trip (slot discipline bounds queued <= entries).
+    bool sq_full() const {
+        return *sq_tail - __atomic_load_n(sq_khead, __ATOMIC_ACQUIRE)
+            >= sq_entries;
+    }
+
+    // make queued SQEs visible to the kernel. Non-SQPOLL: one enter
+    // syscall, returns the number consumed. SQPOLL: the polling thread
+    // consumes asynchronously — no syscall at all unless the idle
+    // thread went to sleep (NEED_WAKEUP), and the full queued count is
+    // reported consumed (the slot discipline guarantees SQ capacity).
+    int flush_submissions(unsigned queued) {
+        if (!sqpoll) {
+            int res;
+            do {
+                res = sys_io_uring_enter(ring_fd, queued, 0, 0, nullptr, 0);
+            } while (res < 0 && errno == EINTR);
+            return res < 0 ? -errno : res;
+        }
+        if (__atomic_load_n(sq_kflags, __ATOMIC_ACQUIRE)
+                & IORING_SQ_NEED_WAKEUP) {
+            int res;
+            do {
+                res = sys_io_uring_enter(ring_fd, 0, 0,
+                                         IORING_ENTER_SQ_WAKEUP, nullptr,
+                                         0);
+            } while (res < 0 && errno == EINTR);
+            if (res < 0)
+                return -errno;
+        }
+        return static_cast<int>(queued);
+    }
+};
+
+// ---------------------------------------------------------------------------
+// registered-buffer staging pool (ABI 11): a PERSISTENT io_uring whose
+// fixed-buffer table is the worker's staging-pool slab, registered once
+// at pool open and shared by the classic block loop
+// (ioengine_run_block_loop5) and the streaming producer mode
+// (ioengine_stream_open_pooled) — today's per-call/per-context
+// registration pays a get_user_pages pin + unpin on every ring
+// lifetime; the pool pays it once per worker. Optionally SQPOLL
+// (kernel submission-queue polling thread, idle-timeout configurable):
+// submission becomes a published SQ-tail store, no io_uring_enter on
+// the hot path at all unless the idle thread went to sleep.
+
+enum {
+    POOL_FEAT_URING = 1 << 0,       // persistent ring exists
+    POOL_FEAT_FIXED_BUFFERS = 1 << 1,  // slab registered as fixed buffers
+    POOL_FEAT_SQPOLL = 1 << 2,      // SQPOLL thread active
+};
+
+struct PoolCtx {
+    UringRings ring;
+    uint64_t* slot_addrs = nullptr;
+    uint64_t n_slots = 0;
+    uint64_t slot_size = 0;
+    bool fixed_buffers = false;
+    bool stream_active = false;  // a pooled stream currently owns the ring
+
+    ~PoolCtx() { delete[] slot_addrs; }
 };
 
 int run_uring_loop(const int* fds, const uint32_t* fd_idx,
@@ -910,6 +1016,216 @@ int run_uring_loop(const int* fds, const uint32_t* fd_idx,
     return ret;
 }
 
+// classic block loop over the POOL's persistent ring (ABI 11): same
+// seed/refill/latency semantics as run_uring_loop, but no ring setup, no
+// per-call buffer allocation and no per-call registration — the ops run
+// READ/WRITE_FIXED against the pool slab registered once at pool open.
+// out_pool_stats (3 uint64, caller-zeroed): [0] ops completed with fixed
+// buffers, [1] ops submitted without a synchronous enter (SQPOLL),
+// [2] 1 when the teardown drain failed — the kernel may still own ops
+// targeting pool slots, so the caller MUST stop using the pool and keep
+// the slab mapped for the life of the process.
+int run_pool_uring_loop(PoolCtx* pool, const int* fds,
+                        const uint32_t* fd_idx, const uint64_t* offsets,
+                        const uint64_t* lengths, uint64_t n, int is_write,
+                        const char* src_buf, uint64_t buf_size, int iodepth,
+                        uint64_t* out_lat_usec, uint64_t* out_bytes,
+                        volatile int* interrupt_flag, const BlockMod& mod,
+                        uint64_t* out_pool_stats) {
+    UringRings& ring = pool->ring;
+    if (iodepth < 1)
+        iodepth = 1;
+    if (static_cast<uint64_t>(iodepth) > pool->n_slots)
+        iodepth = static_cast<int>(pool->n_slots);
+    if (buf_size > pool->slot_size)
+        return -EINVAL;  // an op would overrun its registered slot
+
+    UringSlot* slots = new UringSlot[iodepth];
+    for (int i = 0; i < iodepth; ++i) {
+        slots[i].buf = reinterpret_cast<char*>(pool->slot_addrs[i]);
+        slots[i].buf_index = static_cast<uint16_t>(i);
+        // write payload: replicate the caller's (pre-randomized) buffer
+        // into the other slots — the caller's buffer IS slot 0 of the
+        // pool, so that one is already in place
+        if (is_write && slots[i].buf != src_buf)
+            memcpy(slots[i].buf, src_buf, buf_size);
+    }
+
+    uint64_t next_submit = 0;
+    uint64_t completed = 0;
+    uint64_t bytes_done = 0;
+    int queued = 0;
+    int in_flight = 0;
+    int ret = 0;
+    UringSlot** pending = new UringSlot*[iodepth];
+    int n_pending = 0;
+    UringSlot** freed = new UringSlot*[iodepth];
+
+    auto queue_one = [&](UringSlot& s) {
+        const bool rd = mod.op_reads(next_submit, is_write);
+        mod.rate_limit(rd, lengths[next_submit], interrupt_flag);
+        if (!rd)
+            mod.pre_write(s.buf, offsets[next_submit], lengths[next_submit]);
+        const unsigned tail = *ring.sq_tail;
+        const unsigned idx = tail & *ring.sq_mask;
+        io_uring_sqe* sqe = &ring.sqes[idx];
+        memset(sqe, 0, sizeof(*sqe));
+        if (pool->fixed_buffers) {
+            sqe->opcode = rd ? IORING_OP_READ_FIXED : IORING_OP_WRITE_FIXED;
+            sqe->buf_index = s.buf_index;
+        } else {
+            sqe->opcode = rd ? IORING_OP_READ : IORING_OP_WRITE;
+        }
+        sqe->fd = fds[fd_idx ? fd_idx[next_submit] : 0];
+        sqe->addr = reinterpret_cast<uint64_t>(s.buf);
+        sqe->len = static_cast<uint32_t>(lengths[next_submit]);
+        sqe->off = offsets[next_submit];
+        sqe->user_data = reinterpret_cast<uint64_t>(&s);
+        ring.sq_array[idx] = idx;
+        s.submit_usec = now_usec();
+        s.block_idx = next_submit;
+        __atomic_store_n(ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
+        ++next_submit;
+        ++queued;
+        pending[n_pending++] = &s;
+    };
+
+    // seed the window up to iodepth
+    while (queued < iodepth && next_submit < n)
+        queue_one(slots[queued]);
+
+    while (ret == 0 && completed < n) {
+        if (interrupt_flag && *interrupt_flag)
+            break;
+        if (queued) {
+            // non-SQPOLL: refresh pending stamps right before the enter
+            // (rate-limiter sleeps between queue_one calls must not book
+            // as device latency). SQPOLL: the polling thread may already
+            // be mid-DMA on these ops — the queue-time stamp is the
+            // honest submit time, so keep it.
+            if (!ring.sqpoll) {
+                const uint64_t t_enter = now_usec();
+                for (int q = 0; q < n_pending; ++q)
+                    pending[q]->submit_usec = t_enter;
+            } else if (out_pool_stats) {
+                out_pool_stats[1] += static_cast<uint64_t>(queued);
+            }
+            n_pending = 0;
+            const int res = ring.flush_submissions(
+                static_cast<unsigned>(queued));
+            if (res < 0) {
+                ret = res;
+                break;
+            }
+            in_flight += res;
+            queued -= res;
+        }
+        // wait for at least one completion (bounded, interruptible)
+        unsigned head = *ring.cq_head;
+        unsigned tail = __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
+        if (head == tail) {
+            timespec ts = {1, 0};
+            UringGetEventsArg arg;
+            memset(&arg, 0, sizeof(arg));
+            arg.ts = reinterpret_cast<uint64_t>(&ts);
+            if (sys_io_uring_enter(
+                    ring.ring_fd, 0, 1,
+                    IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
+                    sizeof(arg)) < 0
+                    && errno != ETIME && errno != EINTR) {
+                ret = -errno;
+                break;
+            }
+            tail = __atomic_load_n(ring.cq_tail, __ATOMIC_ACQUIRE);
+        }
+        const uint64_t t_now = now_usec();
+        int n_freed = 0;
+        while (head != tail && ret == 0) {
+            const io_uring_cqe& cqe = ring.cqes[head & *ring.cq_mask];
+            UringSlot* s = reinterpret_cast<UringSlot*>(cqe.user_data);
+            ++head;
+            --in_flight;
+            const bool was_read = mod.op_reads(s->block_idx, is_write);
+            if (cqe.res < 0) {
+                ret = cqe.res;
+            } else if (static_cast<uint64_t>(cqe.res)
+                       != lengths[s->block_idx]) {
+                ret = -EIO;
+            } else if ((ret = mod.log_op(was_read, offsets[s->block_idx],
+                                         lengths[s->block_idx])) != 0) {
+                // opslog write failed: fail the run like the sync loop
+            } else if (was_read
+                       && (ret = mod.post_read(
+                               s->buf, offsets[s->block_idx],
+                               lengths[s->block_idx], s->block_idx))
+                          != 0) {
+                // verify mismatch: ret carries -EILSEQ, info[] is set
+            } else {
+                out_lat_usec[s->block_idx] = t_now - s->submit_usec;
+                bytes_done += static_cast<uint64_t>(cqe.res);
+                ++completed;
+                if (out_pool_stats && pool->fixed_buffers)
+                    ++out_pool_stats[0];
+                freed[n_freed++] = s;
+            }
+        }
+        __atomic_store_n(ring.cq_head, head, __ATOMIC_RELEASE);
+        for (int f = 0; f < n_freed && ret == 0; ++f)
+            if (next_submit < n)
+                queue_one(*freed[f]);
+    }
+
+    // drain outstanding kernel DMA into the POOL slots before returning:
+    // the caller will reuse them immediately (-EIO on an unrecoverable
+    // wait error; the Python side then leaks the pool slab like a failed
+    // stream drain, see StagingPool.leak)
+    bool drain_failed = false;
+    while (in_flight > 0 || queued > 0) {
+        if (queued > 0) {
+            // published-but-unconsumed SQEs must reach the kernel (or the
+            // ring's next use would submit them in place of new ops)
+            const int res = ring.flush_submissions(
+                static_cast<unsigned>(queued));
+            if (res < 0) {
+                drain_failed = true;
+                break;
+            }
+            in_flight += res;
+            queued -= res;
+        }
+        unsigned head = *ring.cq_head;
+        const unsigned tail = __atomic_load_n(ring.cq_tail,
+                                              __ATOMIC_ACQUIRE);
+        if (head == tail) {
+            timespec ts = {1, 0};
+            UringGetEventsArg arg;
+            memset(&arg, 0, sizeof(arg));
+            arg.ts = reinterpret_cast<uint64_t>(&ts);
+            if (sys_io_uring_enter(
+                    ring.ring_fd, 0, 1,
+                    IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG,
+                    &arg, sizeof(arg)) < 0
+                    && errno != ETIME && errno != EINTR) {
+                drain_failed = true;
+                break;
+            }
+            continue;
+        }
+        while (head != tail) {
+            ++head;
+            --in_flight;
+        }
+        __atomic_store_n(ring.cq_head, head, __ATOMIC_RELEASE);
+    }
+    if (drain_failed && out_pool_stats)
+        out_pool_stats[2] = 1;
+    delete[] pending;
+    delete[] freed;
+    delete[] slots;
+    *out_bytes = bytes_done;
+    return ret;
+}
+
 // ---------------------------------------------------------------------------
 // streaming producer mode (fused storage<->HBM loop): instead of running a
 // whole block loop to completion, the engine exposes an io_uring
@@ -974,7 +1290,10 @@ struct StreamSlotState {
 
 struct StreamCtx {
     bool use_uring = false;
-    UringRings ring;           // io_uring backend
+    UringRings ring;           // owned io_uring backend
+    PoolCtx* pool = nullptr;   // borrowed persistent pool ring (ABI 11):
+                               // buffers registered once at pool open,
+                               // the ring survives this stream's close
     aio_context_t aio_ctx = 0; // kernel-AIO fallback backend
     iocb* aio_cbs = nullptr;   // one control block per slot
     StreamSlotState* slots = nullptr;
@@ -997,6 +1316,10 @@ struct StreamCtx {
     int fault_kind = STREAM_FAULT_NONE;
     uint64_t submit_counter = 0;
     int cancel_inflight = 0;   // outstanding ASYNC_CANCEL SQEs (uring)
+
+    // the ring every uring operation goes through: the borrowed pool
+    // ring when attached, else the stream's own
+    UringRings& rings() { return pool ? pool->ring : ring; }
 
     ~StreamCtx() {
         if (aio_ctx)
@@ -1323,6 +1646,67 @@ int ioengine_run_block_loop4(const int* fds, const uint32_t* fd_idx,
                         out_lat_usec, out_bytes, interrupt_flag, mod);
 }
 
+// pool-aware block loop (ABI 11): run_block_loop4 semantics, but when a
+// registered-buffer pool handle is given and the engine resolves to
+// io_uring, the loop runs on the POOL's persistent ring with its
+// once-registered fixed buffers (no per-call ring setup / buffer alloc /
+// registration). Any other engine resolution, a busy pool ring (a
+// pooled stream is live), or a missing pool falls through to the exact
+// loop4 behavior. out_pool_stats: 3 caller-zeroed uint64s
+// {fixed_buffer_ops, sqpoll_submits, drain_failed} (may be NULL).
+int ioengine_run_block_loop5(void* pool_handle, const int* fds,
+                             const uint32_t* fd_idx,
+                             const uint64_t* offsets,
+                             const uint64_t* lengths, uint64_t n,
+                             int is_write, void* buf, uint64_t buf_size,
+                             int iodepth, uint64_t* out_lat_usec,
+                             uint64_t* out_bytes, int* interrupt_flag,
+                             int engine, const unsigned char* op_is_read,
+                             uint64_t verify_salt, int do_verify,
+                             int block_var_pct, uint64_t block_var_seed,
+                             uint64_t* out_verify_info,
+                             uint64_t limit_read_bps,
+                             uint64_t limit_write_bps,
+                             uint64_t* rl_state,
+                             int inline_readback, int flock_mode,
+                             int ops_fd, int ops_lock, int worker_rank,
+                             uint64_t* out_pool_stats) {
+    PoolCtx* pool = static_cast<PoolCtx*>(pool_handle);
+    if (pool != nullptr && engine == ENGINE_URING && n > 0
+            && pool->ring.ring_fd >= 0 && !pool->stream_active
+            && !inline_readback && !flock_mode
+            && buf_size <= pool->slot_size) {
+        VarRng var_rng(block_var_seed);
+        uint64_t info_fallback[4];
+        BlockMod mod;
+        mod.op_is_read = op_is_read;
+        mod.verify_salt = verify_salt;
+        mod.do_verify = do_verify;
+        mod.var_pct = do_verify ? 0 : block_var_pct;
+        mod.var_rng = &var_rng;
+        mod.verify_info = out_verify_info ? out_verify_info : info_fallback;
+        mod.limit_read_bps = limit_read_bps;
+        mod.limit_write_bps = limit_write_bps;
+        if (rl_state) {
+            mod.rl_read = reinterpret_cast<RateState*>(rl_state);
+            mod.rl_write = reinterpret_cast<RateState*>(rl_state + 2);
+        }
+        mod.ops_fd = ops_fd;
+        mod.ops_lock = ops_lock;
+        mod.worker_rank = worker_rank;
+        return run_pool_uring_loop(
+            pool, fds, fd_idx, offsets, lengths, n, is_write,
+            static_cast<const char*>(buf), buf_size, iodepth,
+            out_lat_usec, out_bytes, interrupt_flag, mod, out_pool_stats);
+    }
+    return ioengine_run_block_loop4(
+        fds, fd_idx, offsets, lengths, n, is_write, buf, buf_size,
+        iodepth, out_lat_usec, out_bytes, interrupt_flag, engine,
+        op_is_read, verify_salt, do_verify, block_var_pct, block_var_seed,
+        out_verify_info, limit_read_bps, limit_write_bps, rl_state,
+        inline_readback, flock_mode, ops_fd, ops_lock, worker_rank);
+}
+
 // multi-fd variant: fd_idx[i] selects fds[] per block (NULL -> fds[0]);
 // this is the shared-file striping path (calcFileIdxAndOffsetStriped)
 int ioengine_run_block_loop_mf(const int* fds, const uint32_t* fd_idx,
@@ -1637,7 +2021,7 @@ void* ioengine_stream_open(const int* fds, uint32_t n_fds,
         return nullptr;
     }
     StreamCtx* c = new StreamCtx;
-    c->use_uring = c->ring.init(static_cast<unsigned>(n_slots)) == 0;
+    c->use_uring = c->rings().init(static_cast<unsigned>(n_slots)) == 0;
     if (!c->use_uring) {
         // kernel without io_uring/EXT_ARG: same ring semantics on
         // kernel AIO (io_submit/io_getevents)
@@ -1665,12 +2049,58 @@ void* ioengine_stream_open(const int* fds, uint32_t n_fds,
             iov[i].iov_len = slot_size;
         }
         c->fixed_buffers = sys_io_uring_register(
-            c->ring.ring_fd, IORING_REGISTER_BUFFERS, iov,
+            c->rings().ring_fd, IORING_REGISTER_BUFFERS, iov,
             static_cast<unsigned>(n_slots)) == 0;
         delete[] iov;
         c->fixed_files = sys_io_uring_register(
-            c->ring.ring_fd, IORING_REGISTER_FILES, c->fds, n_fds) == 0;
+            c->rings().ring_fd, IORING_REGISTER_FILES, c->fds, n_fds) == 0;
     }
+    if (out_err)
+        *out_err = 0;
+    return c;
+}
+
+// open a stream over the POOL's persistent ring (ABI 11): the pool slab
+// is already registered as fixed buffers, so this open pays no ring
+// setup and no get_user_pages pin — just slot-state allocation. The
+// stream ops run on the pool's slots (slot i == pool slot i); n_slots/
+// slot_size come from the pool. SQPOLL rides along when the pool was
+// opened with it. Fails with -EBUSY when another stream already owns
+// the ring, -ENOSYS when the pool has no ring (caller falls back to
+// ioengine_stream_open).
+void* ioengine_stream_open_pooled(void* pool_handle, const int* fds,
+                                  uint32_t n_fds, int* out_err) {
+    PoolCtx* pool = static_cast<PoolCtx*>(pool_handle);
+    if (!pool || !n_fds || !fds) {
+        if (out_err)
+            *out_err = -EINVAL;
+        return nullptr;
+    }
+    if (pool->ring.ring_fd < 0) {
+        if (out_err)
+            *out_err = -ENOSYS;
+        return nullptr;
+    }
+    if (pool->stream_active) {
+        if (out_err)
+            *out_err = -EBUSY;
+        return nullptr;
+    }
+    StreamCtx* c = new StreamCtx;
+    c->pool = pool;
+    c->use_uring = true;
+    c->n_slots = pool->n_slots;
+    c->slot_size = pool->slot_size;
+    c->slots = new StreamSlotState[pool->n_slots];
+    c->slot_addrs = new uint64_t[pool->n_slots];
+    memcpy(c->slot_addrs, pool->slot_addrs,
+           pool->n_slots * sizeof(uint64_t));
+    c->n_fds = n_fds;
+    c->fds = new int[n_fds];
+    memcpy(c->fds, fds, n_fds * sizeof(int));
+    c->fixed_buffers = pool->fixed_buffers;
+    c->fixed_files = false;  // fds change per phase; plain fds in SQEs
+    pool->stream_active = true;
     if (out_err)
         *out_err = 0;
     return c;
@@ -1753,9 +2183,12 @@ int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
         ++c->in_flight;
         return 0;
     }
-    const unsigned tail = *c->ring.sq_tail;
-    const unsigned idx = tail & *c->ring.sq_mask;
-    io_uring_sqe* sqe = &c->ring.sqes[idx];
+    UringRings& r = c->rings();
+    if (r.sqpoll && r.sq_full())
+        return -EAGAIN;  // SQPOLL thread lagging; caller reaps and retries
+    const unsigned tail = *r.sq_tail;
+    const unsigned idx = tail & *r.sq_mask;
+    io_uring_sqe* sqe = &r.sqes[idx];
     memset(sqe, 0, sizeof(*sqe));
     if (c->fixed_buffers) {
         sqe->opcode = is_write ? IORING_OP_WRITE_FIXED
@@ -1774,21 +2207,22 @@ int ioengine_stream_submit(void* handle, uint32_t slot, uint32_t fd_idx,
     sqe->len = static_cast<uint32_t>(length);
     sqe->off = offset;
     sqe->user_data = stream_user_data(slot, s.gen);
-    c->ring.sq_array[idx] = idx;
+    r.sq_array[idx] = idx;
     s.submit_usec = now_usec();
     s.expected_len = length;
-    __atomic_store_n(c->ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
-    int res;
-    do {
-        res = sys_io_uring_enter(c->ring.ring_fd, 1, 0, 0, nullptr, 0);
-    } while (res < 0 && errno == EINTR);
+    __atomic_store_n(r.sq_tail, tail + 1, __ATOMIC_RELEASE);
+    // SQPOLL (pool ring): the polling thread consumes the published
+    // tail asynchronously — flush_submissions only pays a syscall when
+    // the idle thread went to sleep. Without SQPOLL it is the usual
+    // 1-op synchronous enter.
+    const int res = r.flush_submissions(1);
     if (res != 1) {
         // the kernel did not consume the SQE (no SQPOLL: it only reads
         // during enter) — rewind the published tail or the orphaned
         // entry would be submitted in place of the NEXT op, desyncing
         // every later slot<->completion mapping
-        __atomic_store_n(c->ring.sq_tail, tail, __ATOMIC_RELEASE);
-        return res < 0 ? -errno : -EAGAIN;
+        __atomic_store_n(r.sq_tail, tail, __ATOMIC_RELEASE);
+        return res < 0 ? res : -EAGAIN;
     }
     s.kernel_owned = 1;
     s.pending = 1;
@@ -1873,9 +2307,14 @@ static int stream_cancel_slot(StreamCtx* c, uint32_t slot,
         // -EINTR result, a real result passes through (the op made it)
         return 0;
     }
-    const unsigned tail = *c->ring.sq_tail;
-    const unsigned idx = tail & *c->ring.sq_mask;
-    io_uring_sqe* sqe = &c->ring.sqes[idx];
+    UringRings& r = c->rings();
+    if (r.sqpoll && r.sq_full()) {
+        s.cancel_sent = 0;  // no SQ space; the deadline scan may retry
+        return -EAGAIN;
+    }
+    const unsigned tail = *r.sq_tail;
+    const unsigned idx = tail & *r.sq_mask;
+    io_uring_sqe* sqe = &r.sqes[idx];
     memset(sqe, 0, sizeof(*sqe));
     sqe->opcode = kOpAsyncCancel;
     sqe->fd = -1;
@@ -1883,16 +2322,13 @@ static int stream_cancel_slot(StreamCtx* c, uint32_t slot,
     // outlives the op can never match the slot's next (re-armed) op
     sqe->addr = stream_user_data(slot, s.gen);
     sqe->user_data = kStreamCancelTag | slot;
-    c->ring.sq_array[idx] = idx;
-    __atomic_store_n(c->ring.sq_tail, tail + 1, __ATOMIC_RELEASE);
-    int res;
-    do {
-        res = sys_io_uring_enter(c->ring.ring_fd, 1, 0, 0, nullptr, 0);
-    } while (res < 0 && errno == EINTR);
+    r.sq_array[idx] = idx;
+    __atomic_store_n(r.sq_tail, tail + 1, __ATOMIC_RELEASE);
+    const int res = r.flush_submissions(1);
     if (res != 1) {
-        __atomic_store_n(c->ring.sq_tail, tail, __ATOMIC_RELEASE);
+        __atomic_store_n(r.sq_tail, tail, __ATOMIC_RELEASE);
         s.cancel_sent = 0;  // not issued; the deadline scan may retry
-        return res < 0 ? -errno : -EAGAIN;
+        return res < 0 ? res : -EAGAIN;
     }
     ++c->cancel_inflight;
     return 0;
@@ -2087,13 +2523,13 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
                              max_events, &got);
         if (got >= max_events)
             return got;
-        unsigned head = *c->ring.cq_head;
+        unsigned head = *c->rings().cq_head;
         const unsigned tail =
-            __atomic_load_n(c->ring.cq_tail, __ATOMIC_ACQUIRE);
+            __atomic_load_n(c->rings().cq_tail, __ATOMIC_ACQUIRE);
         const uint64_t t_now = now_usec();
         while (head != tail && got < max_events) {
             const io_uring_cqe& cqe =
-                c->ring.cqes[head & *c->ring.cq_mask];
+                c->rings().cqes[head & *c->rings().cq_mask];
             const uint64_t ud = cqe.user_data;
             ++head;
             if (ud & kStreamCancelTag) {
@@ -2114,7 +2550,7 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
                 ++got;
             }
         }
-        __atomic_store_n(c->ring.cq_head, head, __ATOMIC_RELEASE);
+        __atomic_store_n(c->rings().cq_head, head, __ATOMIC_RELEASE);
         if (got >= min_complete || c->in_flight == 0)
             return got;
         if (interrupt_flag && *interrupt_flag)
@@ -2143,7 +2579,7 @@ int ioengine_stream_reap(void* handle, int min_complete, int timeout_msecs,
         memset(&arg, 0, sizeof(arg));
         arg.ts = reinterpret_cast<uint64_t>(&ts);
         if (sys_io_uring_enter(
-                c->ring.ring_fd, 0, 1,
+                c->rings().ring_fd, 0, 1,
                 IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
                 sizeof(arg)) < 0
                 && errno != ETIME && errno != EINTR)
@@ -2214,9 +2650,9 @@ int ioengine_stream_close(void* handle) {
     }
     int stalled_secs = 0;
     while (c->in_flight > 0) {
-        unsigned head = *c->ring.cq_head;
+        unsigned head = *c->rings().cq_head;
         const unsigned tail =
-            __atomic_load_n(c->ring.cq_tail, __ATOMIC_ACQUIRE);
+            __atomic_load_n(c->rings().cq_tail, __ATOMIC_ACQUIRE);
         if (head == tail) {
             // bounded like the AIO drain: a hung op must not wedge
             // teardown — give up after 30 zero-progress seconds with
@@ -2230,7 +2666,7 @@ int ioengine_stream_close(void* handle) {
             memset(&arg, 0, sizeof(arg));
             arg.ts = reinterpret_cast<uint64_t>(&ts);
             if (sys_io_uring_enter(
-                    c->ring.ring_fd, 0, 1,
+                    c->rings().ring_fd, 0, 1,
                     IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG,
                     &arg, sizeof(arg)) < 0
                     && errno != ETIME && errno != EINTR) {
@@ -2244,17 +2680,146 @@ int ioengine_stream_close(void* handle) {
             // a cancel op's own CQE is bookkeeping, not a data-op
             // completion — counting it would under-drain the real ops
             const io_uring_cqe& cqe =
-                c->ring.cqes[head & *c->ring.cq_mask];
+                c->rings().cqes[head & *c->rings().cq_mask];
             ++head;
             if (cqe.user_data & kStreamCancelTag)
                 --c->cancel_inflight;
             else
                 --c->in_flight;
         }
-        __atomic_store_n(c->ring.cq_head, head, __ATOMIC_RELEASE);
+        __atomic_store_n(c->rings().cq_head, head, __ATOMIC_RELEASE);
+    }
+    if (c->pool != nullptr) {
+        // borrowed pool ring: release it ONLY after a clean drain — a
+        // failed drain leaves kernel-owned ops targeting pool slots, so
+        // the ring stays marked busy and the caller must stop using the
+        // pool (and keep the slab mapped for the life of the process)
+        if (ret == 0)
+            c->pool->stream_active = false;
+        delete c;  // the owned (never-initialized) ring dtor is a no-op
+        return ret;
     }
     delete c;  // UringRings dtor unmaps the rings and closes the fd
     return ret;
+}
+
+// ---------------------------------------------------------------------------
+// registered-buffer staging pool entry points (ABI 11; see PoolCtx)
+
+// open a persistent pool ring over the caller's staging slab and
+// register the slots as fixed buffers ONCE. want_sqpoll != 0 asks for a
+// kernel submission-queue polling thread (idle timeout in ms) — when
+// the kernel refuses SQPOLL (EPERM pre-5.11 unprivileged, compiled
+// out), the open RETRIES without it and reports the downgrade via
+// ioengine_pool_features, so the caller can log the loud fallback.
+// Returns NULL with *out_err when no ring can be set up at all (the
+// caller then keeps today's per-call paths).
+void* ioengine_pool_open(const uint64_t* slot_addrs, uint64_t n_slots,
+                         uint64_t slot_size, int want_sqpoll,
+                         uint32_t sqpoll_idle_ms, int* out_err) {
+    if (!slot_addrs || !n_slots || !slot_size) {
+        if (out_err)
+            *out_err = -EINVAL;
+        return nullptr;
+    }
+    PoolCtx* pool = new PoolCtx;
+    // 2x slots of SQ entries: data ops are bounded by the slot count,
+    // but ASYNC_CANCEL SQEs of a pooled stream ride the same ring and
+    // must never find it full
+    const unsigned entries = static_cast<unsigned>(n_slots * 2);
+    int ret = -ENOSYS;
+    if (want_sqpoll)
+        ret = pool->ring.init(entries, IORING_SETUP_SQPOLL,
+                              sqpoll_idle_ms ? sqpoll_idle_ms : 2000);
+    if (ret != 0) {  // no-SQPOLL retry (or the plain first attempt)
+        // a partially-successful SQPOLL attempt (e.g. ring up but no
+        // EXT_ARG) left an fd + mappings behind: drop them first
+        pool->ring.reset();
+        ret = pool->ring.init(entries);
+    }
+    if (ret != 0) {
+        if (out_err)
+            *out_err = ret;
+        delete pool;
+        return nullptr;
+    }
+    pool->n_slots = n_slots;
+    pool->slot_size = slot_size;
+    pool->slot_addrs = new uint64_t[n_slots];
+    memcpy(pool->slot_addrs, slot_addrs, n_slots * sizeof(uint64_t));
+    iovec* iov = new iovec[n_slots];
+    for (uint64_t i = 0; i < n_slots; ++i) {
+        iov[i].iov_base = reinterpret_cast<void*>(slot_addrs[i]);
+        iov[i].iov_len = slot_size;
+    }
+    // the ONE registration of the pool's lifetime (pages stay pinned:
+    // no per-ring get_user_pages ever again); EPERM/ENOMEM (e.g.
+    // RLIMIT_MEMLOCK) degrades to unregistered opcodes, reported via
+    // features so the fallback is loud on the Python side
+    pool->fixed_buffers = sys_io_uring_register(
+        pool->ring.ring_fd, IORING_REGISTER_BUFFERS, iov,
+        static_cast<unsigned>(n_slots)) == 0;
+    delete[] iov;
+    if (out_err)
+        *out_err = 0;
+    return pool;
+}
+
+// POOL_FEAT_* bitmask of a live pool (0 for NULL)
+int ioengine_pool_features(void* handle) {
+    PoolCtx* pool = static_cast<PoolCtx*>(handle);
+    if (!pool)
+        return 0;
+    int feats = 0;
+    if (pool->ring.ring_fd >= 0)
+        feats |= POOL_FEAT_URING;
+    if (pool->fixed_buffers)
+        feats |= POOL_FEAT_FIXED_BUFFERS;
+    if (pool->ring.sqpoll)
+        feats |= POOL_FEAT_SQPOLL;
+    return feats;
+}
+
+// tear the pool ring down (unregisters the fixed buffers implicitly).
+// -EBUSY when a pooled stream still owns the ring (close the stream
+// first — its drain guarantees no kernel DMA targets the slab).
+int ioengine_pool_close(void* handle) {
+    PoolCtx* pool = static_cast<PoolCtx*>(handle);
+    if (!pool)
+        return -EINVAL;
+    if (pool->stream_active)
+        return -EBUSY;
+    delete pool;  // UringRings dtor unmaps and closes the ring fd
+    return 0;
+}
+
+// 1 if this kernel grants an SQPOLL ring to this process (unprivileged
+// needs 5.11+; may also be refused by RLIMIT/seccomp policy) — the
+// capability probe behind --iosqpoll's loud fallback
+int ioengine_sqpoll_supported() {
+    io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    p.flags = IORING_SETUP_SQPOLL;
+    p.sq_thread_idle = 100;
+    int fd = sys_io_uring_setup(1, &p);
+    if (fd < 0)
+        return 0;
+    close(fd);
+    return (p.features & IORING_FEAT_EXT_ARG) ? 1 : 0;
+}
+
+// 1 when a live stream's ops run READ/WRITE_FIXED against registered
+// buffers (per-open registration or the borrowed pool's) — the
+// verification hook behind the PoolRegisteredOps audit counter
+int ioengine_stream_fixed_buffers(void* handle) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    return (c && c->use_uring && c->fixed_buffers) ? 1 : 0;
+}
+
+// 1 when a live stream submits through an SQPOLL pool ring
+int ioengine_stream_sqpoll(void* handle) {
+    StreamCtx* c = static_cast<StreamCtx*>(handle);
+    return (c && c->pool && c->pool->ring.sqpoll) ? 1 : 0;
 }
 
 // 1 if this kernel accepts io_uring_setup (it may be compiled out or
@@ -2272,7 +2837,7 @@ int ioengine_uring_supported() {
 
 // engine self-description for diagnostics / tests
 const char* ioengine_version() {
-    return "elbencho-tpu ioengine 10 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog+stream+deadline+cancel+faultinj)";
+    return "elbencho-tpu ioengine 11 (sync+aio+uring+fixedbufs+fileloop+blockmods+ratelimit+flock+opslog+stream+deadline+cancel+faultinj+pool+sqpoll)";
 }
 
 }  // extern "C"

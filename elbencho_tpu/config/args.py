@@ -88,6 +88,23 @@ FLAG_DEFS = [
      "streaming ring (--tpustream): a hung op is cancelled and "
      "surfaces as ETIMEDOUT — transient, so --ioretries can re-drive "
      "it on the re-armed slot (0 = no deadline)"),
+    ("iosqpoll", None, "io_sqpoll", "bool", False, "large",
+     "Run the staging pool's persistent io_uring with a kernel "
+     "submission-queue polling thread (SQPOLL): submission becomes a "
+     "shared-memory tail store — no io_uring_enter syscall on the hot "
+     "path. Falls back LOUDLY to enter-based submission when the "
+     "kernel/process cannot get an SQPOLL ring (needs io_uring, "
+     "kernel 5.11+ unprivileged)"),
+    ("iosqpollidle", None, "io_sqpoll_idle_ms", "int", 2000, "large",
+     "SQPOLL thread idle timeout in milliseconds before the kernel "
+     "thread sleeps; a sleeping thread costs one wakeup enter on the "
+     "next submit (--iosqpoll)"),
+    ("poolreg", None, "pool_registration", "str", "auto", "large",
+     "Staging-pool fixed-buffer registration: auto (default) registers "
+     "the worker's staging slab ONCE with io_uring where the kernel "
+     "supports it — shared by the classic block engine and the "
+     "streaming ring; off keeps the per-call buffer registration "
+     "paths (the A/B baseline isolating the registration win)"),
 
     # access pattern
     ("rand", None, "use_random_offsets", "bool", False, "large",
@@ -117,8 +134,10 @@ FLAG_DEFS = [
     ("fadv", None, "fadvise_flags", "str", "", "misc",
      "posix_fadvise flags (comma-sep: seq,rand,willneed,dontneed,noreuse)"),
     ("madv", None, "madvise_flags", "str", "", "misc",
-     "madvise flags for mmap (comma-sep: seq,rand,willneed,dontneed,"
-     "hugepage,nohugepage)"),
+     "madvise flags (comma-sep: seq,rand,willneed,dontneed,hugepage,"
+     "nohugepage) for --mmap file mappings; hugepage/nohugepage also "
+     "steer the staging pool's slab (THP advice, or skipping the "
+     "MAP_HUGETLB attempt)"),
     ("trunc", None, "do_truncate", "bool", False, "misc",
      "Truncate files to 0 on open for write"),
     ("trunctosize", None, "do_truncate_to_size", "bool", False, "misc",
@@ -1038,6 +1057,34 @@ class BenchConfig(BenchConfigBase):
                     "assigns per-host ids)")
         if self.io_engine not in ("auto", "sync", "aio", "uring"):
             raise ConfigError("--ioengine must be auto|sync|aio|uring")
+        if self.pool_registration not in ("auto", "off"):
+            raise ConfigError("--poolreg must be auto|off")
+        if self.io_sqpoll:
+            if self.pool_registration == "off":
+                raise ConfigError(
+                    "--iosqpoll rides the registered staging-pool ring; "
+                    "it cannot be combined with --poolreg off")
+            if self.io_engine in ("sync", "aio"):
+                raise ConfigError(
+                    "--iosqpoll applies to the io_uring paths only; "
+                    "--ioengine sync/aio would silently never use it")
+        if self.io_sqpoll_idle_ms <= 0:
+            raise ConfigError("--iosqpollidle must be > 0 milliseconds")
+        if self.madvise_flags:
+            flags = [f.strip() for f in self.madvise_flags.split(",")
+                     if f.strip()]
+            known = {"seq", "rand", "willneed", "dontneed", "hugepage",
+                     "nohugepage"}
+            unknown = [f for f in flags if f not in known]
+            if unknown:
+                raise ConfigError(
+                    f"unknown --madv flag(s): {', '.join(unknown)} "
+                    f"(valid: {', '.join(sorted(known))})")
+            if "hugepage" in flags and "nohugepage" in flags:
+                # genuinely contradictory: one advice per region wins in
+                # the kernel, so accepting both would silently ignore one
+                raise ConfigError(
+                    "--madv hugepage and nohugepage are contradictory")
         if self.object_backend not in ("", "s3", "gcs"):
             raise ConfigError("--objectbackend must be s3 or gcs")
         if self.gcs_resumable and self.s3_mpu_sharing:

@@ -171,9 +171,17 @@ class Coordinator:
                 self._stop_tpu_profile()
             if tracer is not None:
                 # phase marker span + persist the ring, so the trace file
-                # is loadable after every phase (and after an abort)
+                # is loadable after every phase (and after an abort). The
+                # marker carries the phase's non-zero path-audit totals
+                # (TPU path, retry, staging-pool counters) as span args —
+                # the whole PATH_AUDIT_COUNTERS schema is inspectable in
+                # Perfetto without cross-referencing the JSON record.
+                from .tpu.device import sum_path_audit_counters
+                audit = {k: v for k, v in sum_path_audit_counters(
+                    self.manager.workers).items() if v}
                 tracer.record(phase_name(phase), "phase", trace_t0,
-                              (tracer.now_ns() - trace_t0) // 1000)
+                              (tracer.now_ns() - trace_t0) // 1000,
+                              **audit)
                 try:
                     tracer.write()
                 except OSError as err:

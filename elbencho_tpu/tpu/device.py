@@ -64,6 +64,14 @@ PATH_AUDIT_COUNTERS = (
     ("io_retry_usec", "IoRetryUsec", "io_retry_usec"),
     ("io_timeouts", "IoTimeouts", "io_timeouts"),
     ("chip_failovers", "TpuChipFailovers", "tpu_chip_failovers"),
+    # unified staging pool (utils/staging_pool.py): slot-reuse /
+    # occupancy / fixed-buffer-registration / SQPOLL audit — the proof
+    # that the shared allocator (and its one-time io_uring registration)
+    # actually served the phase's I/O (see PATH_AUDIT_POOL_ATTRS)
+    ("pool_buf_reuses", "PoolBufReuses", "pool_buf_reuses"),
+    ("pool_occupancy_hwm", "PoolOccupancyHwm", "pool_occupancy_hwm"),
+    ("pool_registered_ops", "PoolRegisteredOps", "pool_registered_ops"),
+    ("pool_sqpoll_ops", "PoolSqpollOps", "pool_sqpoll_ops"),
 )
 
 #: counters owned by the Worker object itself rather than the
@@ -71,7 +79,16 @@ PATH_AUDIT_COUNTERS = (
 #: TPU context is attached, and the context's per-phase counter reset
 #: must not shadow them with zeros on the context
 PATH_AUDIT_WORKER_ATTRS = frozenset({
-    "io_retries", "io_retry_usec", "io_timeouts"})
+    "io_retries", "io_retry_usec", "io_timeouts",
+    "pool_buf_reuses", "pool_occupancy_hwm", "pool_registered_ops",
+    "pool_sqpoll_ops"})
+
+#: counters owned by the worker's StagingPool: the merge reads them
+#: from worker._staging_pool when one is attached (local workers), and
+#: from the ingested worker attribute otherwise (RemoteWorkers)
+PATH_AUDIT_POOL_ATTRS = frozenset({
+    "pool_buf_reuses", "pool_occupancy_hwm", "pool_registered_ops",
+    "pool_sqpoll_ops"})
 
 #: counters that merge across workers as MAX, not sum: a high-water mark
 #: summed over workers would report an in-flight depth no single ring
@@ -79,20 +96,26 @@ PATH_AUDIT_WORKER_ATTRS = frozenset({
 #: lost chip records its own failover, so a sum would multiply one chip
 #: loss by the worker count — MAX reports the deepest failover chain any
 #: single worker ran (~ chips lost along the worst path).
-PATH_AUDIT_MAX_KEYS = frozenset({"TpuPipeInflightHwm", "TpuChipFailovers"})
+PATH_AUDIT_MAX_KEYS = frozenset({"TpuPipeInflightHwm", "TpuChipFailovers",
+                                 "PoolOccupancyHwm"})
 
 
 def sum_path_audit_counters(workers) -> dict:
     """Total the path-audit counters over a worker list, reading local
-    workers' TpuWorkerContext directly (worker-owned entries always come
-    from the worker) and RemoteWorkers' ingested attributes (keyed by
-    wire/JSON name, ready to merge into records). PATH_AUDIT_MAX_KEYS
-    entries merge as max instead of sum."""
+    workers' TpuWorkerContext (or StagingPool, for PATH_AUDIT_POOL_ATTRS
+    entries) directly — worker-owned entries always come from the
+    worker — and RemoteWorkers' ingested attributes (keyed by wire/JSON
+    name, ready to merge into records). PATH_AUDIT_MAX_KEYS entries
+    merge as max instead of sum."""
     totals = {key: 0 for _, key, _ in PATH_AUDIT_COUNTERS}
     for w in workers:
         ctx = getattr(w, "_tpu", None)
+        pool = getattr(w, "_staging_pool", None)
         for attr, key, ingest_attr in PATH_AUDIT_COUNTERS:
-            if ctx is not None and attr not in PATH_AUDIT_WORKER_ATTRS:
+            if attr in PATH_AUDIT_POOL_ATTRS:
+                val = getattr(pool, attr) if pool is not None \
+                    else getattr(w, ingest_attr, 0)
+            elif ctx is not None and attr not in PATH_AUDIT_WORKER_ATTRS:
                 val = getattr(ctx, attr)
             else:
                 val = getattr(w, ingest_attr, 0)
@@ -331,7 +354,7 @@ class TpuWorkerContext:
     def __init__(self, chip_id: int, block_size: int, direct: bool = False,
                  verify_on_device: bool = False, pipeline_depth: int = 1,
                  hbm_limit_pct: int = 90, batch_blocks: int = 1,
-                 dispatch_budget_usec: int = 0):
+                 dispatch_budget_usec: int = 0, staging_pool=None):
         jax = _get_jax()
         devices = jax.devices()
         if not devices:
@@ -389,8 +412,8 @@ class TpuWorkerContext:
         self.pipeline_depth = min(self.pipeline_depth, max_depth)
         self._h2d_agg = None
         self._h2d_agg_fill = 0  # words staged in the active agg buffer
+        self._own_pool = None   # private allocator when no worker pool
         if self.batch_blocks > 1:
-            import mmap as _mmap
             # page-aligned host aggregation buffers (64B-aligned for the
             # dlpack export of the --tpudirect path). One buffer per
             # ring slot: a buffer stays aliased by its in-flight direct
@@ -399,13 +422,21 @@ class TpuWorkerContext:
             # as the worker's iodepth I/O buffers). The byte size is
             # rounded up to a uint32 multiple so non-word-aligned block
             # sizes (e.g. -b 6 --tpubatch 3) still view cleanly.
+            # Allocation comes from the worker's unified staging pool
+            # (same hugepage/NUMA policy, one teardown owner); contexts
+            # without a pool (tpubench probes, tests) fall back to a
+            # private pool-less slab via a throwaway allocator.
             agg_bytes = self.batch_blocks * max(block_size, 1)
             agg_bytes += (-agg_bytes) % 4
-            self._h2d_agg_mmaps = [
-                _mmap.mmap(-1, max(agg_bytes, 4))
-                for _ in range(max(self.pipeline_depth, 1))]
-            self._h2d_agg_ring = [np.frombuffer(m, dtype=np.uint32)
-                                  for m in self._h2d_agg_mmaps]
+            agg_bytes = max(agg_bytes, 4)
+            if staging_pool is None:
+                from ..utils.staging_pool import StagingPool
+                staging_pool = self._own_pool = StagingPool(
+                    1, 4096, register=False, log_rank=None)
+            self._h2d_agg_views = staging_pool.alloc_aux(
+                max(self.pipeline_depth, 1), agg_bytes)
+            self._h2d_agg_ring = [np.frombuffer(mv, dtype=np.uint32)
+                                  for mv in self._h2d_agg_views]
             self._h2d_agg_idx = 0
             self._h2d_agg = self._h2d_agg_ring[0]
         self._key = jax.random.PRNGKey(chip_id)
@@ -1006,6 +1037,11 @@ class TpuWorkerContext:
         if self._h2d_agg is not None:
             self._h2d_agg = None
             self._h2d_agg_ring = []
+            self._h2d_agg_views = []
+        if self._own_pool is not None:
+            # contexts without a worker pool own their aggregation slab
+            self._own_pool.close()
+            self._own_pool = None
 
 
 def _d2h_async(arr) -> None:

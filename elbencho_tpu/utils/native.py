@@ -35,7 +35,12 @@ ENGINE_NAMES = {code: name for name, code in ENGINE_CODES.items()}
 # "elbencho-tpu ioengine <N> (...)". A mismatch means a stale binary
 # (e.g. installed prebuilt vs newer source) — refuse it rather than run
 # benchmarks against outdated native code.
-EXPECTED_ABI = 10
+EXPECTED_ABI = 11
+
+#: ioengine_pool_features bits (csrc POOL_FEAT_*)
+POOL_FEAT_URING = 1
+POOL_FEAT_FIXED_BUFFERS = 2
+POOL_FEAT_SQPOLL = 4
 
 #: ioengine_stream_set_fault kinds (csrc STREAM_FAULT_*; TEST ONLY —
 #: config validation rejects the env knob outside a test harness)
@@ -132,6 +137,11 @@ def _account_chunk(worker, lat_arr, lengths_np, n: int, bytes_done: int,
             worker.live_ops.num_iops_done += done
             worker.live_ops.num_bytes_done += bytes_done
     worker._num_iops_submitted += n
+    pool = getattr(worker, "_staging_pool", None)
+    if pool is not None:
+        # staging-slot reuse accounting at the one seam every array
+        # path (native block/mmap loops, fused stream) flows through
+        pool.account_ops(n)
     worker.create_stonewall_stats_if_triggered()
 
 
@@ -142,32 +152,110 @@ class NativeStreamError(OSError):
         super().__init__(errno_val, f"{os.strerror(errno_val)} ({what})")
 
 
+class NativePoolError(OSError):
+    """Pool ring open failed inside the engine (-errno) — the caller's
+    cue to log the loud per-call-registration fallback."""
+
+    def __init__(self, errno_val: int, what: str):
+        super().__init__(errno_val, f"{os.strerror(errno_val)} ({what})")
+
+
+class NativePool:
+    """Persistent registered-buffer pool ring (ioengine_pool_*; ABI 11):
+    the staging pool's slab registered ONCE as io_uring fixed buffers,
+    shared by the classic block loop (run_block_loop(pool=...)) and the
+    streaming producer mode (open_stream(pool=...)). Optionally SQPOLL —
+    kernel submission-queue polling, no io_uring_enter on the submit
+    path. The slot buffers belong to the caller (utils/staging_pool.py)
+    and must stay mapped until close() returned."""
+
+    def __init__(self, lib: ctypes.CDLL, slot_addrs, slot_size: int,
+                 want_sqpoll: bool = False, sqpoll_idle_ms: int = 2000):
+        self._lib = lib
+        self._handle = None
+        n_slots = len(slot_addrs)
+        self.n_slots = n_slots
+        self.slot_size = slot_size
+        addr_arr = (ctypes.c_uint64 * n_slots)(*slot_addrs)
+        err = ctypes.c_int(0)
+        handle = lib.ioengine_pool_open(
+            addr_arr, n_slots, slot_size, 1 if want_sqpoll else 0,
+            max(sqpoll_idle_ms, 0), ctypes.byref(err))
+        if not handle:
+            raise NativePoolError(-err.value or errno_mod.EINVAL,
+                                  "pool open")
+        self._handle = handle
+        feats = int(lib.ioengine_pool_features(handle))
+        self.fixed_buffers = bool(feats & POOL_FEAT_FIXED_BUFFERS)
+        self.sqpoll_active = bool(feats & POOL_FEAT_SQPOLL)
+
+    @property
+    def handle(self):
+        return self._handle
+
+    def close(self) -> int:
+        """0, or -EBUSY while a pooled stream still owns the ring (the
+        stream's close drains kernel DMA out of the slab first)."""
+        ret = 0
+        if self._handle is not None:
+            ret = self._lib.ioengine_pool_close(self._handle)
+            if ret == 0:
+                self._handle = None
+        return ret
+
+    def __del__(self):  # belt-and-braces: never leak a kernel ring
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
 class NativeStream:
     """Submission/completion ring over registered staging slots
     (ioengine_stream_*): up to len(slot_addrs) io_uring reads/writes in
     flight with the GIL released, reaped slot-by-slot so the caller can
     overlap storage I/O with TPU HBM transfers (the fused loop of
     workers/local_worker.py). One in-flight op per slot — the engine
-    returns -EBUSY on a violation of the slot-reuse discipline."""
+    returns -EBUSY on a violation of the slot-reuse discipline.
+
+    With ``pool`` (a NativePool), the stream borrows the pool's
+    PERSISTENT ring instead of building its own: no ring setup and no
+    buffer registration on this open — the slab was registered once at
+    pool open (slot i of the stream is pool slot i). Falls back to an
+    owned ring when the pool ring is unavailable/busy."""
 
     #: reap batch bound (cq depth can reach 2x sq entries)
     _MAX_EVENTS = 64
 
-    def __init__(self, lib: ctypes.CDLL, fds, slot_addrs, slot_size: int):
+    def __init__(self, lib: ctypes.CDLL, fds, slot_addrs, slot_size: int,
+                 pool: "NativePool | None" = None):
         self._lib = lib
         self._handle = None
         n_slots = len(slot_addrs)
         self.n_slots = n_slots
         fds_arr = (ctypes.c_int * len(fds))(*fds)
-        addr_arr = (ctypes.c_uint64 * n_slots)(*slot_addrs)
-        err = ctypes.c_int(0)
-        handle = lib.ioengine_stream_open(
-            fds_arr, len(fds), addr_arr, n_slots, slot_size,
-            ctypes.byref(err))
+        handle = None
+        self.pooled = False
+        if pool is not None and pool.handle is not None \
+                and pool.n_slots == n_slots:
+            err = ctypes.c_int(0)
+            handle = lib.ioengine_stream_open_pooled(
+                pool.handle, fds_arr, len(fds), ctypes.byref(err))
+            self.pooled = bool(handle)
+        if not handle:
+            addr_arr = (ctypes.c_uint64 * n_slots)(*slot_addrs)
+            err = ctypes.c_int(0)
+            handle = lib.ioengine_stream_open(
+                fds_arr, len(fds), addr_arr, n_slots, slot_size,
+                ctypes.byref(err))
         if not handle:
             raise NativeStreamError(-err.value or errno_mod.EINVAL,
                                     "stream open")
         self._handle = handle
+        #: registration/SQPOLL audit hooks (pool counters ride on these)
+        self.fixed_buffers = bool(
+            lib.ioengine_stream_fixed_buffers(handle))
+        self.sqpoll = bool(lib.ioengine_stream_sqpoll(handle))
         #: ENGINE_CODES value of the backend THIS ring runs on (the open
         #: may fall back from uring to AIO; pins/logs must use this)
         self.backend = int(lib.ioengine_stream_backend_of(handle))
@@ -416,6 +504,37 @@ class _NativeEngine:
         lib.ioengine_stream_backend.argtypes = []
         lib.ioengine_stream_backend_of.restype = ctypes.c_int
         lib.ioengine_stream_backend_of.argtypes = [ctypes.c_void_p]
+        # ABI 11: registered-buffer staging pool + SQPOLL
+        lib.ioengine_pool_open.restype = ctypes.c_void_p
+        lib.ioengine_pool_open.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),  # slot base addresses
+            ctypes.c_uint64,                  # num slots
+            ctypes.c_uint64,                  # slot size (bytes)
+            ctypes.c_int,                     # want SQPOLL
+            ctypes.c_uint32,                  # SQPOLL idle timeout (ms)
+            ctypes.POINTER(ctypes.c_int),     # out: -errno on failure
+        ]
+        lib.ioengine_pool_features.restype = ctypes.c_int
+        lib.ioengine_pool_features.argtypes = [ctypes.c_void_p]
+        lib.ioengine_pool_close.restype = ctypes.c_int
+        lib.ioengine_pool_close.argtypes = [ctypes.c_void_p]
+        lib.ioengine_sqpoll_supported.restype = ctypes.c_int
+        lib.ioengine_sqpoll_supported.argtypes = []
+        lib.ioengine_stream_open_pooled.restype = ctypes.c_void_p
+        lib.ioengine_stream_open_pooled.argtypes = [
+            ctypes.c_void_p,                  # pool handle
+            ctypes.POINTER(ctypes.c_int),     # fds
+            ctypes.c_uint32,                  # num fds
+            ctypes.POINTER(ctypes.c_int),     # out: -errno on failure
+        ]
+        lib.ioengine_stream_fixed_buffers.restype = ctypes.c_int
+        lib.ioengine_stream_fixed_buffers.argtypes = [ctypes.c_void_p]
+        lib.ioengine_stream_sqpoll.restype = ctypes.c_int
+        lib.ioengine_stream_sqpoll.argtypes = [ctypes.c_void_p]
+        lib.ioengine_run_block_loop5.restype = ctypes.c_int
+        lib.ioengine_run_block_loop5.argtypes = \
+            [ctypes.c_void_p] + list(lib.ioengine_run_block_loop4.argtypes) \
+            + [ctypes.POINTER(ctypes.c_uint64)]  # out: pool stats[3]
         self._stream_backend = None  # kernel capability, probed once
         lib.ioengine_run_file_loop3.restype = ctypes.c_int
         lib.ioengine_run_file_loop3.argtypes = [
@@ -454,6 +573,22 @@ class _NativeEngine:
     def uring_supported(self) -> bool:
         return bool(self._lib.ioengine_uring_supported())
 
+    def sqpoll_supported(self) -> bool:
+        """--iosqpoll capability probe: can this process get an SQPOLL
+        ring (kernel 5.11+ for unprivileged; policy may refuse)."""
+        return bool(self._lib.ioengine_sqpoll_supported())
+
+    def open_pool(self, slot_addrs, slot_size: int,
+                  want_sqpoll: bool = False,
+                  sqpoll_idle_ms: int = 2000) -> NativePool:
+        """Open the persistent registered-buffer pool ring over the
+        staging slab (see NativePool); raises NativePoolError when the
+        kernel cannot provide a ring — callers log the loud fallback to
+        the per-call registration paths."""
+        return NativePool(self._lib, slot_addrs, slot_size,
+                          want_sqpoll=want_sqpoll,
+                          sqpoll_idle_ms=sqpoll_idle_ms)
+
     def stream_supported(self) -> bool:
         """Streaming producer mode: io_uring primary, kernel-AIO tier."""
         return self.stream_backend() != 0
@@ -473,11 +608,15 @@ class _NativeEngine:
     def stream_backend_name(self) -> str:
         return ENGINE_NAMES.get(self.stream_backend(), "none")
 
-    def open_stream(self, fds, slot_addrs, slot_size: int) -> NativeStream:
+    def open_stream(self, fds, slot_addrs, slot_size: int,
+                    pool: "NativePool | None" = None) -> NativeStream:
         """Open a submission/completion ring over the given staging slots
         (see NativeStream); raises NativeStreamError when the kernel
-        cannot provide one (callers fall back to the Python loop)."""
-        return NativeStream(self._lib, fds, slot_addrs, slot_size)
+        cannot provide one (callers fall back to the Python loop). With
+        ``pool``, the stream borrows the pool's persistent ring and its
+        once-registered fixed buffers instead of building its own."""
+        return NativeStream(self._lib, fds, slot_addrs, slot_size,
+                            pool=pool)
 
     def version(self) -> str:
         return self._lib.ioengine_version().decode()
@@ -694,7 +833,9 @@ class _NativeEngine:
                        rl_state=None, inline_readback: bool = False,
                        flock_mode: int = 0, ops_fd: int = -1,
                        ops_lock: bool = False,
-                       worker_rank: int = 0) -> bool:
+                       worker_rank: int = 0,
+                       pool: "NativePool | None" = None,
+                       pool_stats=None) -> bool:
         """fds/fd_idx: striped multi-file mode — fd_idx[i] selects the
         file of block i (reference: calcFileIdxAndOffsetStriped). offsets/
         lengths/fd_idx may be numpy uint64/uint32 arrays, passed zero-copy
@@ -705,7 +846,14 @@ class _NativeEngine:
         phase (accounting is split into the worker's rwmix-read counters);
         verify_salt — --verify fill-on-write/check-on-read, raising
         NativeVerifyError with the exact mismatch location;
-        block_var_pct/seed — --blockvarpct refill of each write block."""
+        block_var_pct/seed — --blockvarpct refill of each write block.
+
+        pool: a NativePool — the uring engine then runs this chunk over
+        the pool's persistent ring with its once-registered fixed
+        buffers (ioengine_run_block_loop5); the caller's staging buffers
+        MUST be the pool's slots. pool_stats: the StagingPool whose
+        registration/SQPOLL audit counters the chunk's engine stats are
+        booked into."""
         import numpy as np
         n = len(offsets)
         off_arr = _as_u64_ptr(offsets, n)
@@ -726,7 +874,7 @@ class _NativeEngine:
         flags_arr = None
         if op_is_read is not None:
             flags_arr = _as_ptr(op_is_read, n, "uint8", ctypes.c_ubyte)
-        ret = self._lib.ioengine_run_block_loop4(
+        loop4_args = (
             fds_arr, idx_arr, off_arr, len_arr, n, 1 if is_write else 0,
             ctypes.c_void_p(buf_addr), buf_size, iodepth,
             lat_arr, ctypes.byref(bytes_done), ctypes.byref(interrupt),
@@ -735,6 +883,16 @@ class _NativeEngine:
             verify_info, limit_read_bps, limit_write_bps, rl_state,
             1 if inline_readback else 0, flock_mode, ops_fd,
             1 if ops_lock else 0, worker_rank)
+        if pool is not None and pool.handle is not None:
+            engine_stats = (ctypes.c_uint64 * 3)()
+            ret = self._lib.ioengine_run_block_loop5(
+                pool.handle, *loop4_args, engine_stats)
+            if pool_stats is not None:
+                pool_stats.book_engine_stats(int(engine_stats[0]),
+                                             int(engine_stats[1]),
+                                             bool(engine_stats[2]))
+        else:
+            ret = self._lib.ioengine_run_block_loop4(*loop4_args)
         if ret == -_EILSEQ:
             raise NativeVerifyError(int(verify_info[0]),
                                     int(verify_info[1]),

@@ -1,0 +1,381 @@
+"""Unified registered-buffer staging allocator (ROADMAP open item 5).
+
+One per-worker pool owns the WHOLE staging-buffer lifecycle that used to
+be scattered across three bespoke implementations:
+
+  - the worker's per-iodepth ``mmap`` I/O buffers (local_worker.py
+    ``_alloc_io_buffer`` + the ``gc.collect()``-guarded teardown dance),
+  - ``TpuWorkerContext``'s page-aligned aggregation mmaps (--tpubatch),
+  - the plain Python buffers of the S3/GCS multipart and HDFS paths.
+
+The pool allocates ONE slab, right, once:
+
+  - hugepage-backed where available: ``MAP_HUGETLB`` first (real
+    reserved hugepages — TLB-cheap and unswappable for DMA), graceful
+    fallback to a normal anonymous mapping with ``MADV_HUGEPAGE``
+    honoring the existing ``--madvise hugepage``/``nohugepage`` idiom;
+  - O_DIRECT-safe: every slot starts on a 4 KiB boundary (64-byte
+    alignment for the dlpack export of --tpudirect falls out of that);
+  - NUMA-bound: the slab is ``mbind``-pinned to the worker's ``--zones``
+    zone via the existing mempolicy plumbing (utils/numa.py), so DMA
+    source/target pages live next to the core driving them;
+  - registered ONCE: the slab becomes the fixed-buffer table of a
+    persistent io_uring (csrc ABI 11 ``ioengine_pool_*``) shared by the
+    classic block loop and the streaming ring — no per-call
+    ``get_user_pages`` pin/unpin ever again — optionally with an SQPOLL
+    submission thread (``--iosqpoll``) that takes ``io_uring_enter``
+    off the submit path entirely.
+
+Every capability degrades LOUDLY down a fallback ladder mirroring the
+engine's uring -> AIO -> Python chain:
+
+  hugetlb slab  -> THP-advised slab  -> plain slab
+  SQPOLL ring   -> enter-based ring  -> no pool ring (per-call paths)
+
+Audit counters (``pool_buf_reuses``/``pool_occupancy_hwm``/
+``pool_registered_ops``/``pool_sqpoll_ops``) flow through
+``PATH_AUDIT_COUNTERS`` into the service wire, JSON, ``/metrics`` and
+trace spans like every prior counter.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+
+from ..toolkits import logger
+
+#: O_DIRECT-safe slot stride (matches csrc kAlign; 64B-alignment for the
+#: --tpudirect dlpack export is implied)
+SLOT_ALIGN = 4096
+
+#: hugetlb mappings must be multiples of the huge page size
+HUGE_PAGE_BYTES = 2 << 20
+
+_MAP_HUGETLB = getattr(mmap, "MAP_HUGETLB", 0x40000)
+_MADV_HUGEPAGE = getattr(mmap, "MADV_HUGEPAGE", 14)
+_MADV_NOHUGEPAGE = getattr(mmap, "MADV_NOHUGEPAGE", 15)
+
+#: slabs deliberately kept alive for the life of the process after a
+#: ring drain failed with kernel-owned ops still in flight — dropping
+#: the references would munmap memory a late DMA completion lands in
+#: (the pool-owned successor of local_worker._LEAKED_STREAM_BUFFERS)
+_LEAKED_SLABS: "list" = []
+
+
+class StagingPoolExhausted(RuntimeError):
+    """acquire() found no free slot (checkout API; the rotation-based
+    hot loops never hit this — their slot count IS the pool size)."""
+
+
+def _align_up(n: int, align: int) -> int:
+    return (n + align - 1) // align * align
+
+
+class StagingPool:
+    """Per-worker staging allocator; see the module docstring.
+
+    The hot loops address slots by rotation index (``views[i]`` /
+    ``slot_addrs[i]``, the worker's existing ``% n_slots`` discipline);
+    ``acquire``/``release`` is the checkout API for auxiliary users and
+    tests. Both feed the same occupancy/reuse audit counters.
+    """
+
+    def __init__(self, n_slots: int, slot_size: int, *,
+                 numa_zone: "int | None" = None, fill_algo=None,
+                 madvise_flags: str = "", register: bool = True,
+                 want_sqpoll: bool = False, sqpoll_idle_ms: int = 2000,
+                 native=None, log_rank: "int | None" = 0):
+        self.n_slots = max(n_slots, 1)
+        self.slot_size = max(slot_size, 1)
+        self.stride = _align_up(self.slot_size, SLOT_ALIGN)
+        self.numa_zone = numa_zone
+        self._madvise = {f.strip() for f in madvise_flags.split(",")
+                         if f.strip()}
+        self._log = log_rank == 0  # one worker logs for the host
+        self.broken = False       # a ring drain failed: pool unusable
+        self._leaked = False
+        self._aux_slabs: "list" = []    # (mmap, views) of alloc_aux
+        self._free: "list[int]" = []    # checkout API free list
+        self._checked_out: "set[int]" = set()
+        # -- audit counters (PATH_AUDIT_POOL_ATTRS schema names) --------
+        self.pool_buf_reuses = 0       # slot hand-outs beyond first use
+        self.pool_occupancy_hwm = 0    # max slots simultaneously in use
+        self.pool_registered_ops = 0   # ops run against fixed buffers
+        self.pool_sqpoll_ops = 0       # ops submitted with no enter
+        self._first_uses_left = self.n_slots
+        # -- the slab ---------------------------------------------------
+        slab_bytes = self.n_slots * self.stride
+        self._slab, self.hugepage_backed = self._map_slab(slab_bytes)
+        base = ctypes.addressof(ctypes.c_char.from_buffer(self._slab))
+        if numa_zone is not None:
+            # pin the slab's pages to the worker's zone (MPOL_MF_MOVE
+            # migrates anything the fill below would otherwise fault on
+            # a foreign node) — the existing mempolicy plumbing
+            from .numa import mbind_buffer
+            mbind_buffer(base, len(self._slab), numa_zone)
+        whole = memoryview(self._slab)
+        self.views = [whole[i * self.stride:
+                            i * self.stride + self.slot_size]
+                      for i in range(self.n_slots)]
+        self.slot_addrs = [base + i * self.stride
+                           for i in range(self.n_slots)]
+        self._free = list(range(self.n_slots))
+        if fill_algo is not None:
+            # pre-fill with random data so writes aren't trivially
+            # compressible (same contract as the old _alloc_io_buffer)
+            for mv in self.views:
+                mv[:] = fill_algo.fill_buffer(self.slot_size)
+        # -- the one-time registration / SQPOLL ladder ------------------
+        self.native_pool = None
+        self.registered = False
+        self.sqpoll_active = False
+        self.fallback_reason = ""
+        if register:
+            self._open_native_pool(native, want_sqpoll, sqpoll_idle_ms)
+        elif want_sqpoll:
+            self._note("NOTE: --iosqpoll ignored: pool registration is "
+                       "disabled for this run")
+
+    # ------------------------------------------------------------------
+    # slab mapping ladder: hugetlb -> (THP-advised) normal mapping
+    # ------------------------------------------------------------------
+
+    def _map_slab(self, nbytes: int) -> "tuple[mmap.mmap, bool]":
+        want_thp = "hugepage" in self._madvise
+        no_huge = "nohugepage" in self._madvise
+        if not no_huge:
+            try:
+                m = mmap.mmap(-1, _align_up(nbytes, HUGE_PAGE_BYTES),
+                              flags=(mmap.MAP_PRIVATE | mmap.MAP_ANONYMOUS
+                                     | _MAP_HUGETLB))
+                return m, True
+            except (OSError, ValueError):
+                # no reserved hugepages (vm.nr_hugepages=0 is the common
+                # case) or no MAP_HUGETLB support: normal mapping below
+                pass
+        m = mmap.mmap(-1, nbytes)
+        try:
+            if no_huge:
+                m.madvise(_MADV_NOHUGEPAGE)
+            elif want_thp:
+                # --madvise hugepage routed to the staging slab too, not
+                # just --mmap file mappings (transparent huge pages)
+                m.madvise(_MADV_HUGEPAGE)
+        except OSError:
+            pass  # advice is advisory; an old kernel refusing it is fine
+        return m, False
+
+    # ------------------------------------------------------------------
+    # native registration ladder: SQPOLL ring -> plain ring -> no ring
+    # ------------------------------------------------------------------
+
+    def _open_native_pool(self, native, want_sqpoll: bool,
+                          sqpoll_idle_ms: int) -> None:
+        if native is None:
+            from .native import get_native_engine
+            native = get_native_engine()
+        if native is None:
+            self.fallback_reason = "native ioengine unavailable"
+            if want_sqpoll:
+                self._note("NOTE: --iosqpoll requires the native "
+                           "ioengine; staging buffers stay unregistered")
+            return
+        if want_sqpoll and not native.sqpoll_supported():
+            # loud capability fallback BEFORE the open so the log names
+            # the reason — and don't ask the open for SQPOLL at all (its
+            # internal retry exists for races, not as the normal path)
+            self._note("NOTE: --iosqpoll requested but this kernel/"
+                       "process cannot get an SQPOLL ring (needs "
+                       "io_uring with kernel 5.11+); falling back to "
+                       "enter-based submission")
+            want_sqpoll = False
+        from .native import NativePoolError
+        try:
+            self.native_pool = native.open_pool(
+                self.slot_addrs, self.stride, want_sqpoll=want_sqpoll,
+                sqpoll_idle_ms=sqpoll_idle_ms)
+        except NativePoolError as err:
+            # kernel without io_uring (CI's 4.4 included): the loud tail
+            # of the fallback ladder — everything keeps working on the
+            # per-call registration paths
+            self.fallback_reason = str(err)
+            self._note(f"NOTE: staging-pool buffer registration "
+                       f"unavailable ({err}); block loops and streams "
+                       f"keep their per-call buffer paths")
+            return
+        self.registered = self.native_pool.fixed_buffers
+        self.sqpoll_active = self.native_pool.sqpoll_active
+        if want_sqpoll and not self.sqpoll_active:
+            self._note("NOTE: --iosqpoll: SQPOLL ring refused at open; "
+                       "running the pool ring with enter-based "
+                       "submission instead")
+        if not self.registered:
+            self._note("NOTE: staging-pool fixed-buffer registration "
+                       "refused (RLIMIT_MEMLOCK?); pool ring runs with "
+                       "unregistered opcodes")
+        elif self._log:
+            mode = "sqpoll" if self.sqpoll_active else "enter"
+            self._note(f"staging pool: {self.n_slots} x "
+                       f"{self.slot_size} B slots registered once as "
+                       f"io_uring fixed buffers (submit={mode}, "
+                       f"hugepages={'on' if self.hugepage_backed else 'off'})")
+
+    def _note(self, msg: str) -> None:
+        if self._log:
+            logger.log(logger.LOG_NORMAL, msg)
+
+    # ------------------------------------------------------------------
+    # slot access: rotation (hot loops) + checkout (aux users, tests)
+    # ------------------------------------------------------------------
+
+    def slot(self, i: int) -> memoryview:
+        return self.views[i % self.n_slots]
+
+    def acquire(self) -> int:
+        """Check a slot out; raises StagingPoolExhausted when every slot
+        is taken (the caller sized the pool — silent overcommit would
+        alias in-flight DMA buffers)."""
+        if not self._free:
+            raise StagingPoolExhausted(
+                f"all {self.n_slots} staging slots checked out")
+        idx = self._free.pop()
+        self._checked_out.add(idx)
+        if self._first_uses_left > 0:
+            self._first_uses_left -= 1
+        else:
+            self.pool_buf_reuses += 1
+        self.note_occupancy(len(self._checked_out))
+        return idx
+
+    def release(self, idx: int) -> None:
+        if idx in self._checked_out:
+            self._checked_out.remove(idx)
+            self._free.append(idx)
+
+    def note_occupancy(self, in_use: int) -> None:
+        if in_use > self.pool_occupancy_hwm:
+            self.pool_occupancy_hwm = min(in_use, self.n_slots)
+
+    def account_ops(self, n: int) -> None:
+        """Rotation-path reuse accounting: n ops each consumed one slot
+        hand-out; hand-outs beyond the slab's first full rotation are
+        reuses (called from the shared _account_chunk seam and the
+        per-op Python loops)."""
+        if n <= 0:
+            return
+        first = min(n, self._first_uses_left)
+        self._first_uses_left -= first
+        self.pool_buf_reuses += n - first
+
+    def book_engine_stats(self, fixed_ops: int, sqpoll_ops: int,
+                          drain_failed: bool) -> None:
+        """Ingest one native chunk's pool-engine stats
+        (ioengine_run_block_loop5 out_pool_stats)."""
+        self.pool_registered_ops += fixed_ops
+        self.pool_sqpoll_ops += sqpoll_ops
+        if drain_failed:
+            # kernel-owned ops may still target the slab: stop using the
+            # ring and keep the memory mapped for the life of the process
+            self.broken = True
+            logger.log_error(
+                "staging pool: ring drain failed; keeping the slab "
+                "mapped until process exit")
+            self.leak()
+
+    def account_stream_events(self, stream, n_events: int) -> None:
+        """Registration/SQPOLL audit for n reaped streaming ops (the
+        fused loop calls this per reap batch)."""
+        if n_events <= 0:
+            return
+        if getattr(stream, "fixed_buffers", False):
+            self.pool_registered_ops += n_events
+        if getattr(stream, "sqpoll", False):
+            self.pool_sqpoll_ops += n_events
+
+    def reset_counters(self) -> None:
+        """Per-phase counter reset. The pool itself persists across
+        phases — that is its whole point — so _first_uses_left carries
+        over: ops of a later phase on an already-rotated slab all count
+        as reuses, which is exactly the cross-phase reuse the counter
+        exists to prove."""
+        self.pool_buf_reuses = 0
+        self.pool_occupancy_hwm = 0
+        self.pool_registered_ops = 0
+        self.pool_sqpoll_ops = 0
+
+    # ------------------------------------------------------------------
+    # auxiliary allocations: same policy, same lifecycle, one owner
+    # ------------------------------------------------------------------
+
+    def alloc_aux(self, count: int, nbytes: int) -> "list[memoryview]":
+        """Carve `count` page-aligned buffers of `nbytes` with the
+        pool's allocation policy (hugepage attempt, NUMA bind) — the
+        TpuWorkerContext aggregation slots; freed by pool close()."""
+        m, _huge = self._map_slab(_align_up(nbytes, SLOT_ALIGN) * count)
+        base = ctypes.addressof(ctypes.c_char.from_buffer(m))
+        if self.numa_zone is not None:
+            from .numa import mbind_buffer
+            mbind_buffer(base, len(m), self.numa_zone)
+        stride = _align_up(nbytes, SLOT_ALIGN)
+        whole = memoryview(m)
+        views = [whole[i * stride: i * stride + nbytes]
+                 for i in range(count)]
+        self._aux_slabs.append((m, whole, views))
+        return views
+
+    # ------------------------------------------------------------------
+    # teardown: ONE lifecycle for every staging buffer
+    # ------------------------------------------------------------------
+
+    def leak(self) -> None:
+        """Park the slab(s) in the module leak list: called when kernel
+        DMA may still target them after a failed ring drain — unmapping
+        would hand late completions unmapped address space."""
+        if not self._leaked:
+            self._leaked = True
+            _LEAKED_SLABS.append((self._slab, self.views,
+                                  list(self._aux_slabs)))
+        self.broken = True
+
+    def close(self) -> None:
+        """Close the native ring and unmap every buffer the pool ever
+        handed out. Replaces three bespoke teardown paths (including the
+        gc.collect()-guarded mmap dance); a view exported to jax/numpy
+        that outlives us leaves its mapping to process teardown via the
+        BufferError guard — never a crash, never a use-after-free."""
+        if self.native_pool is not None:
+            if self.native_pool.close() != 0:
+                # a pooled stream never released the ring (failed drain):
+                # kernel DMA may still target the slab
+                self.leak()
+            self.native_pool = None
+        if self._leaked:
+            return
+        for mv in self.views:
+            _release_quietly(mv)
+        self.views = []
+        for m, whole, views in self._aux_slabs:
+            for mv in views:
+                _release_quietly(mv)
+            _release_quietly(whole)
+            try:
+                m.close()
+            except BufferError:
+                pass  # an exported view outlived us; OS reclaims at exit
+        self._aux_slabs = []
+        try:
+            self._slab.close()
+        except BufferError:
+            pass
+
+
+def _release_quietly(mv: memoryview) -> None:
+    """release() raises BufferError while an export (numpy/jax view) is
+    still alive — the mapping then stays with the exporter and the OS
+    reclaims it at process exit, same contract as the mmap close guard."""
+    try:
+        mv.release()
+    except BufferError:
+        pass

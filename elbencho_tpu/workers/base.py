@@ -89,6 +89,14 @@ class Worker:
         self.io_retries = 0       # per-op transient-error retries
         self.io_retry_usec = 0    # total backoff slept for those retries
         self.io_timeouts = 0      # ops cancelled by the --iotimeout deadline
+        # unified staging-pool audit (utils/staging_pool.py): local
+        # workers serve these from _staging_pool via PATH_AUDIT_POOL_ATTRS;
+        # the attributes exist so RemoteWorker ingest and pool-less
+        # workers read as zero
+        self.pool_buf_reuses = 0
+        self.pool_occupancy_hwm = 0
+        self.pool_registered_ops = 0
+        self.pool_sqpoll_ops = 0
 
     def oplog(self, op_name: str, entry_name: str = "", offset: int = 0,
               length: int = 0):
@@ -126,6 +134,10 @@ class Worker:
         self.io_retries = 0
         self.io_retry_usec = 0
         self.io_timeouts = 0
+        self.pool_buf_reuses = 0
+        self.pool_occupancy_hwm = 0
+        self.pool_registered_ops = 0
+        self.pool_sqpoll_ops = 0
 
     def create_stonewall_stats_if_triggered(self) -> None:
         """Snapshot current counters when the first worker finished

@@ -149,13 +149,12 @@ def _retrying_op(worker, op):
 def _write_file(worker, fs, path: str) -> None:
     cfg = worker.cfg
     size, bs = cfg.file_size, cfg.block_size
-    num_bufs = len(worker._io_bufs)
     with fs.open_output_stream(path) as out:
         offset = 0
         while offset < size:
             worker.check_interruption_request()
             length = min(bs, size - offset)
-            buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+            buf = worker.rotated_staging_buf()
             worker._pre_write_fill(buf, offset, length)
             t0 = time.perf_counter_ns()
             # NO --ioretries here: the output stream is a sequential
@@ -174,7 +173,6 @@ def _write_file(worker, fs, path: str) -> None:
 def _read_file(worker, fs, path: str) -> None:
     cfg = worker.cfg
     size, bs = cfg.file_size, cfg.block_size
-    num_bufs = len(worker._io_bufs)
     with fs.open_input_file(path) as inp:
         offset = 0
         while offset < size:
@@ -200,7 +198,7 @@ def _read_file(worker, fs, path: str) -> None:
                         f"short HDFS read at {offset} of {path}") from None
                 raise
             lat = (time.perf_counter_ns() - t0) // 1000
-            buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+            buf = worker.rotated_staging_buf()
             buf[:length] = data
             worker._post_read_actions(buf, offset, length)
             worker.iops_latency_histo.add_latency(lat)

@@ -42,21 +42,16 @@ from .shared import WorkerException, WorkerInterruptedException
 MKFILE_MODE = 0o644  # reference: MKFILE_MODE, Common.h:96
 MKDIR_MODE = 0o755
 
-#: staging buffers deliberately kept alive for the life of the process
-#: after a stream-ring drain failed with kernel-owned ops still in
-#: flight — dropping the references would munmap them (CPython frees an
-#: mmap at refcount zero) and hand the late DMA completions unmapped
-#: address space
-_LEAKED_STREAM_BUFFERS: "list" = []
-
-
 class LocalWorker(Worker):
     def __init__(self, shared, rank: int):
         super().__init__(shared, rank)
         self.cfg = shared.config
-        # io_depth buffers so async/pipelined paths never overwrite a block
-        # still in flight (reference: allocIOBuffer x iodepth, :1386)
-        self._io_buf_mmaps: "list[mmap.mmap]" = []
+        # io_depth staging slots so async/pipelined paths never overwrite
+        # a block still in flight (reference: allocIOBuffer x iodepth,
+        # :1386). All slots come from the unified staging pool
+        # (utils/staging_pool.py) — one allocator owns the hugepage/NUMA/
+        # registration lifecycle for every data path.
+        self._staging_pool = None
         self._io_bufs: "list[memoryview]" = []
         self._io_buf: "memoryview | None" = None
         self._own_path_fds: "list[int]" = []
@@ -91,6 +86,9 @@ class LocalWorker(Worker):
         if self._tpu is not None:
             # path-audit counters are per-phase, like tpu_transfer_bytes
             self._tpu.reset_path_counters()
+        if self._staging_pool is not None:
+            # pool audit counters are per-phase; the POOL persists
+            self._staging_pool.reset_counters()
 
     # ------------------------------------------------------------------
     # preparation (reference: preparePhase, LocalWorker.cpp:424)
@@ -133,7 +131,8 @@ class LocalWorker(Worker):
                 pipeline_depth=depth,
                 hbm_limit_pct=cfg.tpu_hbm_limit_pct,
                 batch_blocks=max(cfg.tpu_batch_blocks, 1),
-                dispatch_budget_usec=cfg.tpu_dispatch_budget_usec)
+                dispatch_budget_usec=cfg.tpu_dispatch_budget_usec,
+                staging_pool=self._staging_pool)
             if self._tracer is not None:
                 # dispatch-vs-DMA sub-spans ride the transfer pipeline
                 self._tpu.set_tracer(self._tracer, self.rank)
@@ -213,28 +212,17 @@ class LocalWorker(Worker):
             self._tpu.close()  # drop device arrays before buffer teardown
             self._tpu = None
         self._io_buf = None
-        if getattr(self, "_stream_drain_failed", False):
-            # a stream-ring drain was aborted with kernel-owned ops
-            # still in flight: unmapping now would hand their late DMA
-            # completions unmapped/reused address space — park the
-            # references in the module-level leak list (just clearing
-            # the attributes would drop the refcount and munmap anyway)
-            _LEAKED_STREAM_BUFFERS.append((self._io_bufs,
-                                           self._io_buf_mmaps))
-            self._io_bufs = []
-            self._io_buf_mmaps = []
-        else:
-            for mv in self._io_bufs:
-                mv.release()
-            self._io_bufs = []
-            import gc
-            gc.collect()  # drop stray numpy views of the mmaps (jax)
-            for m in self._io_buf_mmaps:
-                try:
-                    m.close()
-                except BufferError:
-                    pass  # an exported view outlived us; OS reclaims
-            self._io_buf_mmaps = []
+        if self._staging_pool is not None:
+            # ONE teardown for every staging buffer (io slots + TPU
+            # aggregation aux slabs). A failed stream-ring drain leaks
+            # the slab to process teardown inside the pool — kernel DMA
+            # may still target it (the old gc.collect()-guarded mmap
+            # dance and the module leak list both lived here).
+            if getattr(self, "_stream_drain_failed", False):
+                self._staging_pool.leak()
+            self._staging_pool.close()
+            self._staging_pool = None
+        self._io_bufs = []
         if self._ops_log is not None:
             self._ops_log.close()
         if getattr(self, "_s3_client", None) is not None:
@@ -273,28 +261,27 @@ class LocalWorker(Worker):
                     self._numa_zone = zone
 
     def _alloc_io_buffer(self) -> None:
-        """Page-aligned I/O buffers via anonymous mmap, one per iodepth slot
+        """One unified staging pool per worker, one slot per iodepth
         (replaces the reference's posix_memalign x iodepth,
-        LocalWorker.cpp:1386-1401) — page alignment satisfies O_DIRECT.
-        Pre-filled with random data so writes aren't trivially
-        compressible."""
-        size = max(self.cfg.block_size, 1)
-        fill = create_rand_algo("fast", seed=self.rank + 1)
-        for _ in range(max(self.cfg.io_depth, 1)):
-            m = mmap.mmap(-1, size)
-            if self._numa_zone is not None:
-                # pin the staging buffer's pages to the worker's zone
-                # (reference: NumaTk.h mbind of the staging buffers);
-                # MPOL_MF_MOVE migrates any page the mmap pre-fill
-                # below would otherwise fault on a foreign node
-                import ctypes
-                from ..utils.numa import mbind_buffer
-                addr = ctypes.addressof(ctypes.c_char.from_buffer(m))
-                mbind_buffer(addr, size, self._numa_zone)
-            mv = memoryview(m)
-            mv[:] = fill.fill_buffer(size)
-            self._io_buf_mmaps.append(m)
-            self._io_bufs.append(mv)
+        LocalWorker.cpp:1386-1401, AND this worker's former bespoke
+        per-slot mmaps): hugepage-backed where available, O_DIRECT-
+        aligned, NUMA-bound to the worker's --zones zone, and — where
+        the kernel provides io_uring — registered ONCE as fixed buffers
+        shared by the classic block loop and the streaming ring
+        (--iosqpoll rides on the same ring). Slots are pre-filled with
+        random data so writes aren't trivially compressible."""
+        from ..utils.staging_pool import StagingPool
+        cfg = self.cfg
+        self._staging_pool = StagingPool(
+            max(cfg.io_depth, 1), max(cfg.block_size, 1),
+            numa_zone=self._numa_zone,
+            fill_algo=create_rand_algo("fast", seed=self.rank + 1),
+            madvise_flags=cfg.madvise_flags,
+            register=cfg.pool_registration != "off",
+            want_sqpoll=cfg.io_sqpoll,
+            sqpoll_idle_ms=cfg.io_sqpoll_idle_ms,
+            log_rank=self.rank)
+        self._io_bufs = self._staging_pool.views
         self._io_buf = self._io_bufs[0]
 
     def _prepare_path_fds(self) -> None:
@@ -889,6 +876,8 @@ class LocalWorker(Worker):
             ops.num_bytes_done += n
             ops.num_iops_done += 1
             self._num_iops_submitted += 1
+            if self._staging_pool is not None:
+                self._staging_pool.account_ops(1)
         if self._tpu is not None:
             # drain pipelined transfers before phase end (guarded: an
             # in-flight transfer of a dying chip surfaces here)
@@ -1091,7 +1080,6 @@ class LocalWorker(Worker):
         through the array-based _account_chunk per drained chunk, with
         the dispatch-vs-DMA split riding the TransferPipeline counters
         exactly like the Python loop."""
-        import ctypes
         from collections import deque
         from ..utils.native import NativeStreamError
         cfg = self.cfg
@@ -1099,11 +1087,15 @@ class LocalWorker(Worker):
             fds, stripe_size = list(stripe[0]), stripe[1]
         else:
             fds, stripe_size = [fd], 0
-        slot_addrs = [ctypes.addressof(ctypes.c_char.from_buffer(m))
-                      for m in self._io_buf_mmaps]
+        pool = self._staging_pool
+        slot_addrs = pool.slot_addrs
         try:
-            stream = native.open_stream(fds, slot_addrs,
-                                        max(cfg.block_size, 1))
+            # borrow the pool's persistent ring where one exists: the
+            # slab was registered as fixed buffers ONCE at pool open
+            # (and SQPOLL rides along) — else an owned per-phase ring
+            stream = native.open_stream(
+                fds, slot_addrs, max(cfg.block_size, 1),
+                pool=None if pool.broken else pool.native_pool)
         except NativeStreamError:
             return False
         if cfg.io_engine != "auto":
@@ -1116,7 +1108,9 @@ class LocalWorker(Worker):
                 return False
         self._log_stream_mode(
             f"fused TPU stream engaged (backend={stream.backend_name}, "
-            f"slots={len(slot_addrs)})")
+            f"slots={len(slot_addrs)}"
+            + (", pool-registered" if stream.pooled else "")
+            + (", sqpoll" if stream.sqpoll else "") + ")")
         if cfg.io_timeout_secs:
             # --iotimeout: hung ops surface as -ETIMEDOUT with the slot
             # re-armed instead of wedging the reap loop
@@ -1221,6 +1215,10 @@ class LocalWorker(Worker):
             from .io_errors import ShortIOError
             events = stream.reap(min_complete, 1000,
                                  self._native_interrupt)
+            if self._staging_pool is not None:
+                # registration/SQPOLL audit (PoolRegisteredOps and co)
+                self._staging_pool.account_stream_events(stream,
+                                                         len(events))
             if not events:
                 # timeout or interrupt: surface the interrupt, else retry
                 self.check_interruption_request(force=True)
@@ -1315,6 +1313,8 @@ class LocalWorker(Worker):
                 fdi = int(fd_idx[i]) if fd_idx is not None else 0
                 slot_op[slot] = (i, fdi, r_off, length, rd, 0)
                 stream.submit(slot, fdi, r_off, length, is_write=not rd)
+                if self._staging_pool is not None:
+                    self._staging_pool.note_occupancy(len(slot_op))
             while slot_op:  # chunk barrier: exact accounting below
                 reap_some(1)
         except WorkerInterruptedException:
@@ -1385,7 +1385,20 @@ class LocalWorker(Worker):
                     flock_mode=self._flock_mode_code(),
                     ops_fd=(self._ops_log.fd if self._ops_log is not None
                             else -1),
-                    ops_lock=cfg.ops_log_lock, worker_rank=self.rank)
+                    ops_lock=cfg.ops_log_lock, worker_rank=self.rank,
+                    # classic-engine leg of the unified pool: the uring
+                    # engine runs this chunk over the pool's persistent
+                    # ring + once-registered fixed buffers (the engine
+                    # falls through to the per-call path for sync/aio)
+                    pool=(self._staging_pool.native_pool
+                          if self._staging_pool is not None
+                          and not self._staging_pool.broken else None),
+                    pool_stats=self._staging_pool)
+                if self._staging_pool is not None \
+                        and self._staging_pool.native_pool is not None \
+                        and cfg.io_engine == "uring":
+                    self._staging_pool.note_occupancy(
+                        min(cfg.io_depth, self._staging_pool.n_slots))
 
             try:
                 # --ioretries: a transient chunk failure re-issues the
@@ -1408,9 +1421,17 @@ class LocalWorker(Worker):
         return True
 
     def _buf_addr(self) -> int:
-        import ctypes
-        return ctypes.addressof(
-            ctypes.c_char.from_buffer(self._io_buf_mmaps[0]))
+        return self._staging_pool.slot_addrs[0]
+
+    def rotated_staging_buf(self) -> memoryview:
+        """The staging slot serving the NEXT op under the worker's
+        rotation discipline — the shared hand-out point of the S3/GCS,
+        HDFS and tpubench families (the POSIX loops rotate inline).
+        Books the hand-out in the pool's reuse accounting."""
+        buf = self._io_bufs[self._num_iops_submitted % len(self._io_bufs)]
+        if self._staging_pool is not None:
+            self._staging_pool.account_ops(1)
+        return buf
 
     def _rwmix_read_flags(self, n: int) -> "np.ndarray | None":
         """Per-op rwmix read flags for the next n ops of a write phase —
@@ -1559,10 +1580,9 @@ class LocalWorker(Worker):
             if self._native_loop_eligible(native):
                 self._run_native_mmap_loop(native, mapped, gen, is_write)
                 return
-            num_bufs = len(self._io_bufs)
             for off, length in gen:
                 self.check_interruption_request()
-                buf = self._io_bufs[self._num_iops_submitted % num_bufs]
+                buf = self.rotated_staging_buf()
                 t0 = time.perf_counter_ns()
                 if is_write:
                     self._pre_write_fill(buf, off, length)

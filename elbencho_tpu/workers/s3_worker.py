@@ -375,7 +375,6 @@ def _download_random_objects(worker) -> None:
         // ndst
     rand = worker._rand_offset_algo
     blocks_per_obj = max(1, size // bs)
-    num_bufs = len(worker._io_bufs)
     done = 0
     from .local_worker import LocalWorker
     while done < amount:
@@ -403,7 +402,7 @@ def _download_random_objects(worker) -> None:
         if len(data) != length:
             raise WorkerException(
                 f"short random S3 read for {bucket}/{key} at {offset}")
-        buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+        buf = worker.rotated_staging_buf()
         buf[:length] = data
         worker._post_read_actions(buf, offset, length)
         worker.iops_latency_histo.add_latency(lat)
@@ -594,7 +593,7 @@ def _upload_object_shared_mpu(worker, bucket: str, key: str) -> None:
 def _next_upload_block(worker, offset: int, length: int) -> bytes:
     """Upload payload from the worker's io buffer, via the same pre-write
     fill path as POSIX mode (verify pattern / block variance / TPU pool)."""
-    buf = worker._io_bufs[worker._num_iops_submitted % len(worker._io_bufs)]
+    buf = worker.rotated_staging_buf()
     worker._pre_write_fill(buf, offset, length)
     return bytes(buf[:length])
 
@@ -668,8 +667,7 @@ def _download_object(worker, bucket: str, key: str) -> None:
                 "s3_get", phase_name(worker.shared.current_phase), t0,
                 lat_usec, worker.rank, offset, length)
         if not cfg.s3_fast_get:
-            buf = worker._io_bufs[
-                worker._num_iops_submitted % len(worker._io_bufs)]
+            buf = worker.rotated_staging_buf()
             buf[:length] = data
             worker._post_read_actions(buf, offset, length)
         worker.live_ops.num_bytes_done += got

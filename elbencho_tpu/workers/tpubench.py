@@ -53,11 +53,10 @@ def run_tpubench_phase(worker, phase: BenchPhase) -> None:
     bs = cfg.block_size
     total = max(cfg.file_size, bs)
     done = 0
-    num_bufs = len(worker._io_bufs)
     while done < total:
         worker.check_interruption_request()
         length = min(bs, total - done)
-        buf = worker._io_bufs[worker._num_iops_submitted % num_bufs]
+        buf = worker.rotated_staging_buf()
         t0 = time.perf_counter_ns()
         if pattern in ("h2d", "both"):
             ctx.host_to_device(buf, length)

@@ -496,10 +496,12 @@ def test_summarize_json_columns_and_degraded_tpu_banner(tmp_path):
         capture_output=True, text=True, check=True)
     header = out.stdout.splitlines()[0].split(",")
     row = out.stdout.splitlines()[1].split(",")
-    # appended after every pre-existing column, never reordered
-    assert header[-8:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
-                           "TraceEv", "IoRetry", "IoTmo", "ChipFail"]
-    assert row[-3:] == ["4", "2", "1"]
+    # appended after every pre-existing column, never reordered (the
+    # staging-pool columns append after the fault-tolerance block)
+    assert header[-11:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+                            "TraceEv", "IoRetry", "IoTmo", "ChipFail",
+                            "PoolReuse", "RegOps", "SqpollOps"]
+    assert row[-6:-3] == ["4", "2", "1"]
     assert "DEGRADED-TPU" in out.stderr
     # clean records: no banner
     jf.write_text(json.dumps({"Phase": "READ"}) + "\n")

@@ -1111,3 +1111,195 @@ def test_stream_cancel_kernel_op_best_effort(engine, tmp_path):
         assert engine.ioengine_stream_close(handle) == 0
     finally:
         os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# registered-buffer staging pool (ioengine_pool_*, engine ABI 11) —
+# raw-ctypes tests so the sanitizer re-runs of this file (make tsan /
+# make asan) exercise the pool open/register/loop5/pooled-stream/close
+# entry points directly. On kernels without io_uring (CI's 4.4) the
+# contract under test is the LOUD -ENOSYS fallback.
+
+
+def _pool_api(lib):
+    lib.ioengine_pool_open.restype = ctypes.c_void_p
+    lib.ioengine_pool_open.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_uint32, ctypes.POINTER(ctypes.c_int)]
+    lib.ioengine_pool_features.restype = ctypes.c_int
+    lib.ioengine_pool_features.argtypes = [ctypes.c_void_p]
+    lib.ioengine_pool_close.restype = ctypes.c_int
+    lib.ioengine_pool_close.argtypes = [ctypes.c_void_p]
+    lib.ioengine_sqpoll_supported.restype = ctypes.c_int
+    lib.ioengine_sqpoll_supported.argtypes = []
+    lib.ioengine_stream_open_pooled.restype = ctypes.c_void_p
+    lib.ioengine_stream_open_pooled.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.ioengine_stream_fixed_buffers.restype = ctypes.c_int
+    lib.ioengine_stream_fixed_buffers.argtypes = [ctypes.c_void_p]
+    lib.ioengine_stream_sqpoll.restype = ctypes.c_int
+    lib.ioengine_stream_sqpoll.argtypes = [ctypes.c_void_p]
+    lib.ioengine_uring_supported.restype = ctypes.c_int
+    return lib
+
+
+def _pool_open(lib, bufs, slot_size, want_sqpoll=0):
+    addrs = [ctypes.addressof(b) for b in bufs]
+    err = ctypes.c_int(0)
+    handle = lib.ioengine_pool_open(
+        (ctypes.c_uint64 * len(addrs))(*addrs), len(addrs), slot_size,
+        want_sqpoll, 500, ctypes.byref(err))
+    return handle, err.value
+
+
+def _run_loop5(lib, pool, fd, offsets, lengths, is_write, buf,
+               iodepth=2, engine="uring"):
+    n = len(offsets)
+    off_arr = (ctypes.c_uint64 * n)(*offsets)
+    len_arr = (ctypes.c_uint64 * n)(*lengths)
+    lat_arr = (ctypes.c_uint64 * n)()
+    bytes_done = ctypes.c_uint64(0)
+    flag = ctypes.c_int(0)
+    stats = (ctypes.c_uint64 * 3)()
+    fds = (ctypes.c_int * 1)(fd)
+    ret = lib.ioengine_run_block_loop5(
+        pool, fds, None, off_arr, len_arr, ctypes.c_uint64(n),
+        1 if is_write else 0, buf, ctypes.c_uint64(max(lengths)), iodepth,
+        lat_arr, ctypes.byref(bytes_done), ctypes.byref(flag),
+        ENGINE_CODES[engine], None, ctypes.c_uint64(0), 0, 0,
+        ctypes.c_uint64(0), None, ctypes.c_uint64(0), ctypes.c_uint64(0),
+        None, 0, 0, -1, 0, 0, stats)
+    return ret, bytes_done.value, list(lat_arr), list(stats)
+
+
+def test_abi11_version(engine):
+    # loop5/pool symbols belong to ABI 11; a stale .so must be refused
+    # by the Python loader (EXPECTED_ABI), so the source tree's build
+    # must self-describe as 11
+    assert b"ioengine 11" in engine.ioengine_version()
+    assert b"pool" in engine.ioengine_version()
+    assert b"sqpoll" in engine.ioengine_version()
+
+
+def test_pool_open_fallback_or_features(engine):
+    """Without io_uring the pool open fails -ENOSYS (the Python side's
+    loud per-call fallback); with it, the features word reports the
+    ring and (registration permitting) fixed buffers."""
+    _pool_api(engine)
+    engine.ioengine_run_block_loop5.restype = ctypes.c_int
+    bufs = [ctypes.create_string_buffer(4096) for _ in range(4)]
+    handle, err = _pool_open(engine, bufs, 4096)
+    if not engine.ioengine_uring_supported():
+        assert handle is None
+        assert err < 0  # -ENOSYS (or the kernel's specific refusal)
+        return
+    assert handle
+    feats = engine.ioengine_pool_features(ctypes.c_void_p(handle))
+    assert feats & 1  # POOL_FEAT_URING
+    assert engine.ioengine_pool_close(ctypes.c_void_p(handle)) == 0
+
+
+def test_sqpoll_probe_is_stable(engine):
+    """The capability probe must answer the same on every call (it backs
+    the --iosqpoll loud-fallback decision) and never crash."""
+    _pool_api(engine)
+    first = engine.ioengine_sqpoll_supported()
+    assert first in (0, 1)
+    assert engine.ioengine_sqpoll_supported() == first
+
+
+def test_loop5_without_pool_matches_loop4(engine, tmp_path):
+    """ioengine_run_block_loop5(NULL pool) must behave exactly like
+    loop4 — the fallback leg every non-uring engine resolution takes."""
+    _pool_api(engine)
+    engine.ioengine_run_block_loop5.restype = ctypes.c_int
+    path = str(tmp_path / "f")
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        buf = ctypes.create_string_buffer(b"x" * 4096, 4096)
+        offsets = [i * 4096 for i in range(8)]
+        ret, nbytes, lats, stats = _run_loop5(
+            engine, None, fd, offsets, [4096] * 8, True, buf,
+            engine="sync", iodepth=1)
+        assert ret == 0
+        assert nbytes == 8 * 4096
+        assert stats == [0, 0, 0]  # no pool: no pool stats
+    finally:
+        os.close(fd)
+
+
+def test_pool_loop5_and_pooled_stream_roundtrip(engine, tmp_path):
+    """Full ABI-11 path (uring kernels): classic loop over the pool's
+    persistent ring with fixed buffers, then a pooled stream borrowing
+    the same ring, then clean close ordering (stream before pool)."""
+    _pool_api(engine)
+    _stream_api(engine)
+    engine.ioengine_run_block_loop5.restype = ctypes.c_int
+    if not engine.ioengine_uring_supported():
+        pytest.skip("no io_uring on this kernel")
+    path = str(tmp_path / "f")
+    payload = os.urandom(64 * 1024)
+    with open(path, "wb") as f:
+        f.write(payload)
+    fd = os.open(path, os.O_RDWR)
+    bufs = [ctypes.create_string_buffer(4096) for _ in range(4)]
+    try:
+        handle, err = _pool_open(engine, bufs, 4096)
+        assert handle, err
+        pool = ctypes.c_void_p(handle)
+        feats = engine.ioengine_pool_features(pool)
+        # classic loop over the pool ring: reads land in pool slots
+        offsets = [i * 4096 for i in range(16)]
+        ret, nbytes, lats, stats = _run_loop5(
+            engine, pool, fd, offsets, [4096] * 16, False,
+            ctypes.cast(bufs[0], ctypes.c_void_p), iodepth=4)
+        assert ret == 0
+        assert nbytes == 16 * 4096
+        assert all(lat_ > 0 for lat_ in lats)
+        if feats & 2:  # fixed buffers registered
+            assert stats[0] == 16  # every op counted as registered
+        assert stats[2] == 0  # drain clean
+        # pooled stream: borrows the ring, no re-registration
+        serr = ctypes.c_int(0)
+        stream = engine.ioengine_stream_open_pooled(
+            pool, (ctypes.c_int * 1)(fd), 1, ctypes.byref(serr))
+        assert stream, serr.value
+        sh = ctypes.c_void_p(stream)
+        assert engine.ioengine_stream_fixed_buffers(sh) == (
+            1 if feats & 2 else 0)
+        # a second pooled stream must be refused while the first owns
+        # the ring (-EBUSY), and pool close too
+        serr2 = ctypes.c_int(0)
+        assert not engine.ioengine_stream_open_pooled(
+            pool, (ctypes.c_int * 1)(fd), 1, ctypes.byref(serr2))
+        assert serr2.value == -16  # -EBUSY
+        assert engine.ioengine_pool_close(pool) == -16
+        assert engine.ioengine_stream_submit(sh, 0, 0, 0, 4096, 0) == 0
+        events = _stream_reap(engine, sh)
+        assert len(events) == 1
+        slot, _lat, res = events[0]
+        assert slot == 0 and res == 4096
+        assert bytes(bufs[0][:4096]) == payload[:4096]
+        assert engine.ioengine_stream_close(sh) == 0
+        # ring released: pool closes cleanly now
+        assert engine.ioengine_pool_close(pool) == 0
+    finally:
+        os.close(fd)
+
+
+def test_pool_sqpoll_open_degrades_gracefully(engine, tmp_path):
+    """want_sqpoll on a kernel that refuses SQPOLL must still yield a
+    working (enter-based) pool ring — the loud-fallback contract."""
+    _pool_api(engine)
+    if not engine.ioengine_uring_supported():
+        pytest.skip("no io_uring on this kernel")
+    bufs = [ctypes.create_string_buffer(4096) for _ in range(2)]
+    handle, err = _pool_open(engine, bufs, 4096, want_sqpoll=1)
+    assert handle, err
+    pool = ctypes.c_void_p(handle)
+    feats = engine.ioengine_pool_features(pool)
+    assert feats & 1
+    if not engine.ioengine_sqpoll_supported():
+        assert not (feats & 4)  # downgrade reported, not silent
+    assert engine.ioengine_pool_close(pool) == 0

@@ -86,3 +86,54 @@ def test_worker_io_buffers_get_zone_policy(tmp_path):
     rc = main(["-w", "-r", "-t", "1", "-s", "16K", "-b", "16K",
                "--zones", "0", "--nolive", str(tmp_path / "f")])
     assert rc == 0
+
+def test_staging_pool_slab_bound_to_zone():
+    """The unified staging pool mbinds its WHOLE slab (and aux slabs) to
+    the worker's zone — the per-slot mbind loop it replaced covered each
+    buffer individually; one slab, one policy."""
+    _require_mempolicy()
+    from elbencho_tpu.utils.staging_pool import StagingPool
+    pool = StagingPool(4, 8192, numa_zone=0, log_rank=None)
+    try:
+        if numa.get_buffer_policy(pool.slot_addrs[0]) is None:
+            pytest.skip("get_mempolicy(MPOL_F_ADDR) blocked (seccomp?)")
+        for addr in pool.slot_addrs:
+            mode, mask = numa.get_buffer_policy(addr)
+            if mode == numa.MPOL_DEFAULT:
+                pytest.skip("mbind blocked (seccomp?)")
+            assert mode == numa.MPOL_BIND
+            assert mask & 1  # node 0
+        aux = pool.alloc_aux(2, 16384)
+        import ctypes
+        for mv in aux:
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(mv))
+            mode, mask = numa.get_buffer_policy(addr)
+            assert mode == numa.MPOL_BIND and mask & 1
+    finally:
+        pool.close()
+
+
+def test_zones_run_routes_pool_through_zone(tmp_path):
+    """End-to-end: a --zones run allocates the worker's staging pool
+    with the zone (the pool replaces the per-buffer mbind loop)."""
+    _require_mempolicy()
+    if not numa.set_thread_mempolicy_bind(0):
+        pytest.skip("set_mempolicy blocked (seccomp?)")
+    numa.reset_thread_mempolicy()
+    from elbencho_tpu.workers.local_worker import LocalWorker
+    seen = {}
+    orig = LocalWorker._alloc_io_buffer
+
+    def spy(self):
+        orig(self)
+        seen["zone"] = self._staging_pool.numa_zone
+
+    LocalWorker._alloc_io_buffer = spy
+    try:
+        from elbencho_tpu.cli import main
+        rc = main(["-w", "-t", "1", "-s", "16K", "-b", "16K",
+                   "--zones", "0", "--nolive", str(tmp_path / "f")])
+        assert rc == 0
+        assert seen.get("zone") == 0
+    finally:
+        LocalWorker._alloc_io_buffer = orig

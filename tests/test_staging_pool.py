@@ -1,0 +1,327 @@
+"""Unified staging allocator tests (elbencho_tpu/utils/staging_pool.py):
+alignment, hugepage fallback ladder, fixed-buffer registration (via the
+ABI-11 native pool where the kernel has io_uring; the loud -ENOSYS
+fallback elsewhere), SQPOLL probe fallback, exhaustion behavior, and the
+PATH_AUDIT_COUNTERS plumbing of the pool counters."""
+
+import ctypes
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from elbencho_tpu.utils.staging_pool import (SLOT_ALIGN, StagingPool,
+                                             StagingPoolExhausted)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _native():
+    from elbencho_tpu.utils.native import get_native_engine
+    return get_native_engine()
+
+
+# ---------------------------------------------------------------------------
+# allocation contract: alignment, slot geometry, fill
+
+
+def test_slots_are_o_direct_aligned():
+    pool = StagingPool(4, 5000, log_rank=None)  # odd size: stride rounds up
+    try:
+        assert pool.stride % SLOT_ALIGN == 0
+        for addr in pool.slot_addrs:
+            assert addr % SLOT_ALIGN == 0  # O_DIRECT-safe (and 64B for dlpack)
+        assert len(pool.views) == 4
+        assert all(len(v) == 5000 for v in pool.views)
+        # slots must not overlap
+        for a, b in zip(pool.slot_addrs, pool.slot_addrs[1:]):
+            assert b - a >= 5000
+    finally:
+        pool.close()
+
+
+def test_slots_are_independently_writable():
+    pool = StagingPool(3, 4096, log_rank=None)
+    try:
+        for i, v in enumerate(pool.views):
+            v[:4] = bytes([i] * 4)
+        for i, v in enumerate(pool.views):
+            assert bytes(v[:4]) == bytes([i] * 4)
+    finally:
+        pool.close()
+
+
+def test_fill_algo_prefills_slots():
+    from elbencho_tpu.toolkits.random_algos import create_rand_algo
+    pool = StagingPool(2, 4096, log_rank=None,
+                       fill_algo=create_rand_algo("fast", seed=7))
+    try:
+        assert bytes(pool.views[0]) != b"\0" * 4096
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# hugepage ladder: MAP_HUGETLB attempt -> THP advice -> plain mapping
+
+
+def test_nohugepage_skips_hugetlb():
+    pool = StagingPool(2, 4096, madvise_flags="nohugepage", log_rank=None)
+    try:
+        assert pool.hugepage_backed is False
+    finally:
+        pool.close()
+
+
+def test_hugepage_fallback_is_graceful(monkeypatch):
+    """When MAP_HUGETLB cannot be served (no reserved hugepages), the
+    slab degrades to a normal mapping and stays fully usable."""
+    import mmap as mmap_mod
+    import elbencho_tpu.utils.staging_pool as sp
+    real_mmap = mmap_mod.mmap
+
+    def refuse_hugetlb(fileno, length, **kw):
+        if kw.get("flags", 0) & sp._MAP_HUGETLB:
+            raise OSError(12, "Cannot allocate memory")
+        return real_mmap(fileno, length, **kw)
+
+    monkeypatch.setattr(sp.mmap, "mmap", refuse_hugetlb)
+    pool = StagingPool(2, 4096, log_rank=None)
+    try:
+        assert pool.hugepage_backed is False
+        pool.views[0][:4] = b"abcd"
+        assert bytes(pool.views[0][:4]) == b"abcd"
+    finally:
+        pool.close()
+
+
+def test_madvise_hugepage_applies_thp_advice(monkeypatch):
+    """--madv hugepage routes to the staging slab: when the hugetlb
+    attempt fails, MADV_HUGEPAGE is applied to the fallback mapping
+    (and nohugepage applies MADV_NOHUGEPAGE)."""
+    import mmap as mmap_mod
+    import elbencho_tpu.utils.staging_pool as sp
+    advised = []
+
+    class SpyMmap(mmap_mod.mmap):  # real mmap: buffer protocol intact
+        def madvise(self, advice, *args):
+            advised.append(advice)
+            return super().madvise(advice, *args)
+
+    def spy(fileno, length, **kw):
+        if kw.get("flags", 0) & sp._MAP_HUGETLB:
+            raise OSError(12, "no hugepages")
+        return SpyMmap(fileno, length)
+
+    monkeypatch.setattr(sp.mmap, "mmap", spy)
+    pool = StagingPool(2, 4096, madvise_flags="hugepage", log_rank=None)
+    try:
+        assert pool.hugepage_backed is False
+        assert sp._MADV_HUGEPAGE in advised
+    finally:
+        pool.close()
+    advised.clear()
+    pool = StagingPool(2, 4096, madvise_flags="nohugepage", log_rank=None)
+    try:
+        assert sp._MADV_NOHUGEPAGE in advised
+        assert sp._MADV_HUGEPAGE not in advised
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# checkout API: occupancy, reuse, exhaustion
+
+
+def test_exhaustion_raises_instead_of_aliasing():
+    pool = StagingPool(2, 4096, log_rank=None)
+    try:
+        a = pool.acquire()
+        b = pool.acquire()
+        with pytest.raises(StagingPoolExhausted):
+            pool.acquire()
+        pool.release(a)
+        c = pool.acquire()  # released slot circulates again
+        assert c == a
+        pool.release(b)
+        pool.release(c)
+        assert pool.pool_occupancy_hwm == 2
+        # 3 successful hand-outs, 2 distinct slots -> 1 reuse
+        assert pool.pool_buf_reuses == 1
+    finally:
+        pool.close()
+
+
+def test_rotation_accounting_counts_reuses_across_phases():
+    pool = StagingPool(4, 4096, log_rank=None)
+    try:
+        pool.account_ops(4)       # first full rotation: all first-uses
+        assert pool.pool_buf_reuses == 0
+        pool.account_ops(6)
+        assert pool.pool_buf_reuses == 6
+        pool.reset_counters()     # per-phase reset...
+        pool.account_ops(5)       # ...but the slab stays warm: all reuses
+        assert pool.pool_buf_reuses == 5
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# registration / SQPOLL ladder
+
+
+def test_registration_fallback_is_loud_without_uring():
+    native = _native()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    pool = StagingPool(2, 4096, log_rank=0, native=native)
+    try:
+        if native.uring_supported():
+            assert pool.native_pool is not None
+        else:
+            # CI's 4.4 kernel: the loud tail of the ladder
+            assert pool.native_pool is None
+            assert pool.registered is False
+            assert pool.fallback_reason  # reason recorded for the log
+    finally:
+        pool.close()
+
+
+def test_sqpoll_fallback_never_breaks_the_pool():
+    """--iosqpoll on an unsupported kernel must degrade loudly to the
+    enter path (or to no ring at all) — never fail the run."""
+    native = _native()
+    if native is None:
+        pytest.skip("native engine unavailable")
+    pool = StagingPool(2, 4096, want_sqpoll=True, log_rank=0,
+                       native=native)
+    try:
+        if not native.sqpoll_supported():
+            assert pool.sqpoll_active is False
+        pool.views[0][:4] = b"ok!!"  # slab usable regardless of tier
+    finally:
+        pool.close()
+
+
+def test_stream_event_accounting_follows_stream_capabilities():
+    pool = StagingPool(2, 4096, register=False, log_rank=None)
+    try:
+        class FakeStream:
+            fixed_buffers = True
+            sqpoll = True
+
+        pool.account_stream_events(FakeStream(), 5)
+        assert pool.pool_registered_ops == 5
+        assert pool.pool_sqpoll_ops == 5
+        FakeStream.fixed_buffers = False
+        FakeStream.sqpoll = False
+        pool.account_stream_events(FakeStream(), 3)
+        assert pool.pool_registered_ops == 5
+        assert pool.pool_sqpoll_ops == 5
+    finally:
+        pool.close()
+
+
+def test_book_engine_stats_marks_pool_broken_on_drain_failure():
+    pool = StagingPool(2, 4096, register=False, log_rank=None)
+    pool.book_engine_stats(4, 2, drain_failed=True)
+    assert pool.pool_registered_ops == 4
+    assert pool.pool_sqpoll_ops == 2
+    assert pool.broken is True
+    # close() after a leak must be a no-op, not an unmap
+    pool.close()
+    assert pool.views  # still referenced by the leak list
+
+
+# ---------------------------------------------------------------------------
+# aux allocations (the TpuWorkerContext aggregation slots)
+
+
+def test_alloc_aux_same_policy_one_lifecycle():
+    pool = StagingPool(2, 4096, log_rank=None)
+    try:
+        views = pool.alloc_aux(3, 100_000)
+        assert len(views) == 3
+        assert all(len(v) == 100_000 for v in views)
+        for v in views:
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(v))
+            assert addr % SLOT_ALIGN == 0
+        views[0][:4] = b"aggr"
+        assert bytes(views[0][:4]) == b"aggr"
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# PATH_AUDIT_COUNTERS plumbing
+
+
+def test_pool_counters_flow_through_path_audit_schema():
+    from elbencho_tpu.tpu.device import (PATH_AUDIT_COUNTERS,
+                                         PATH_AUDIT_POOL_ATTRS,
+                                         sum_path_audit_counters)
+    keys = {key for _attr, key, _ingest in PATH_AUDIT_COUNTERS}
+    assert {"PoolBufReuses", "PoolOccupancyHwm", "PoolRegisteredOps",
+            "PoolSqpollOps"} <= keys
+    pool = StagingPool(2, 4096, register=False, log_rank=None)
+    try:
+        pool.account_ops(5)
+        pool.note_occupancy(2)
+        pool.book_engine_stats(7, 3, drain_failed=False)
+
+        class FakeWorker:
+            _tpu = None
+            _staging_pool = pool
+
+        class RemoteLike:
+            _tpu = None
+            _staging_pool = None
+            pool_buf_reuses = 10
+            pool_occupancy_hwm = 4
+            pool_registered_ops = 1
+            pool_sqpoll_ops = 0
+
+        totals = sum_path_audit_counters([FakeWorker(), RemoteLike()])
+        assert totals["PoolBufReuses"] == 3 + 10
+        assert totals["PoolOccupancyHwm"] == 4  # MAX-merged hwm
+        assert totals["PoolRegisteredOps"] == 7 + 1
+        assert totals["PoolSqpollOps"] == 3
+        assert PATH_AUDIT_POOL_ATTRS <= {
+            attr for attr, _k, _i in PATH_AUDIT_COUNTERS}
+    finally:
+        pool.close()
+
+
+def test_pool_counters_reach_json_records(tmp_path):
+    """End-to-end: a local run's JSON records carry the pool counters
+    (the service wire and /metrics read the same schema)."""
+    target = str(tmp_path / "f")
+    jf = str(tmp_path / "r.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    res = subprocess.run(
+        [sys.executable, "-m", "elbencho_tpu", "-w", "-r", "-t", "1",
+         "-s", "256K", "-b", "64K", "--iodepth", "2", "--nolive",
+         "--jsonfile", jf, target],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, res.stderr[-2000:]
+    recs = [json.loads(ln) for ln in open(jf) if ln.strip()]
+    read_rec = next(r for r in recs if r["Phase"] == "READ")
+    for key in ("PoolBufReuses", "PoolOccupancyHwm", "PoolRegisteredOps",
+                "PoolSqpollOps"):
+        assert key in read_rec
+    # 1 worker, 2 slots, 4 ops/phase: the read phase runs on a warm slab
+    assert read_rec["PoolBufReuses"] > 0
+
+
+def test_exhaustion_message_names_the_pool_size():
+    pool = StagingPool(1, 4096, log_rank=None)
+    try:
+        pool.acquire()
+        with pytest.raises(StagingPoolExhausted, match="1 staging slots"):
+            pool.acquire()
+    finally:
+        pool.close()

@@ -324,7 +324,9 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     header, row = proc.stdout.strip().splitlines()[:2]
     cols = header.split(",")
     # appended, never reordered: the telemetry columns keep their order,
-    # with the (later) data-plane fault-tolerance columns after them
-    assert cols[-8:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
-                         "TraceEv", "IoRetry", "IoTmo", "ChipFail"]
-    assert row.split(",")[-8:-3] == ["3", "7", "2", "5", "11"]
+    # with the (later) data-plane fault-tolerance and staging-pool
+    # columns after them
+    assert cols[-11:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+                          "TraceEv", "IoRetry", "IoTmo", "ChipFail",
+                          "PoolReuse", "RegOps", "SqpollOps"]
+    assert row.split(",")[-11:-6] == ["3", "7", "2", "5", "11"]

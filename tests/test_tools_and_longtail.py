@@ -448,7 +448,11 @@ def test_summarize_json_bench_capture_ab(tmp_path):
     header = csv.stdout.splitlines()[0].split(",")
     assert header[:6] == ["utc", "value MiB/s", "sync MiB/s",
                           "pipelined MiB/s", "pipelined/sync", "source"]
-    assert header[6:] == ["python MiB/s", "fused MiB/s", "fused/python"]
+    assert header[6:9] == ["python MiB/s", "fused MiB/s",
+                           "fused/python"]
+    # the fixed-buffers A/B + fallback-tier columns append after them
+    assert header[9:] == ["regbuf MiB/s", "percall MiB/s",
+                          "reg/percall", "tier"]
 
 
 def test_chart_tool_rejects_phase_records_cleanly(tmp_path):
