@@ -3,7 +3,7 @@
 # engine lives in csrc/)
 
 .PHONY: all native native-tsan native-asan tsan asan check test \
-	test-fast test-examples fuzz bench docs clean deb rpm docker
+	test-fast test-chaos test-examples fuzz bench docs clean deb rpm docker
 
 all: native
 
@@ -74,6 +74,15 @@ test: native
 test-fast: native
 	python -m pytest tests/ -q -x --ignore=tests/test_service_mode.py \
 		--ignore=tests/test_netbench.py
+
+# chaos gates alone: the fault-injection suites that drive control-plane
+# retry/watchdog/degradation, data-plane I/O faults, and the crash-safe
+# run lifecycle (lease orphaning, journal/resume, signal shutdown)
+# through real master/service processes (pytest marker `chaos`)
+test-chaos: native
+	python -m pytest tests/test_fault_tolerance.py \
+		tests/test_io_fault_tolerance.py tests/test_run_lifecycle.py \
+		-q -m chaos
 
 # end-to-end example suite against real resources (loopdevs, services)
 test-examples: native

@@ -307,6 +307,13 @@ FLAG_DEFS = [
      "Max service hosts that may be lost mid-run; lost hosts are "
      "dropped and results are marked DEGRADED (0 = fail fast, the "
      "default)"),
+    ("svcleasesecs", None, "svc_lease_secs", "int", 0, "dist",
+     "Master liveness lease in seconds: each service arms a watchdog at "
+     "/preparephase and treats every master poll as a lease renewal; "
+     "when the lease expires (master crashed/partitioned), the service "
+     "interrupts its workers, logs ORPHANED, and returns to idle so the "
+     "host is immediately reusable by a new run (0 = off, the default; "
+     "must exceed --svcupint when set)"),
     ("rotatehosts", None, "rotate_hosts_num", "int", 0, "dist",
      "Rotate hosts list by this many positions between phases"),
     ("datasetthreads", None, "num_dataset_threads_override", "int", 0, "dist",
@@ -558,6 +565,18 @@ FLAG_DEFS = [
      "Anonymous GCS access (public buckets, unauthenticated endpoints)"),
     ("objectbackend", None, "object_backend", "str", "", "s3",
      "Object-storage backend: s3|gcs (derived from path scheme if unset)"),
+
+    # crash-safe run lifecycle (docs/fault-tolerance.md "Run lifecycle")
+    ("journal", None, "journal_file_path", "str", "", "misc",
+     "Append-only run journal (fsync'd JSONL): config fingerprint, "
+     "per-phase start/finish/interrupted records with per-host result "
+     "summaries, and a terminal run_complete record — the restart "
+     "point --resume replays"),
+    ("resume", None, "resume_run", "bool", False, "misc",
+     "Resume an interrupted journaled run (requires --journal FILE): "
+     "phases with finish records are skipped, the first incomplete "
+     "phase re-runs from scratch, and a config-fingerprint mismatch "
+     "against the journal is a hard error"),
 
     # misc
     ("configfile", "c", "config_file_path", "str", "", "misc",
@@ -1285,6 +1304,20 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--svctolerant is incompatible with --netbench (the "
                 "client/server topology cannot lose hosts mid-run)")
+        if self.svc_lease_secs < 0:
+            raise ConfigError("--svcleasesecs must be >= 0")
+        if self.svc_lease_secs \
+                and self.svc_lease_secs * 1000 <= self.svc_update_interval_ms:
+            # the /status poll IS the lease renewal: a lease shorter than
+            # the poll cadence would orphan services mid-run with the
+            # master alive and well
+            raise ConfigError(
+                "--svcleasesecs must exceed the --svcupint poll interval "
+                "(every /status poll renews the lease)")
+        if self.resume_run and not self.journal_file_path:
+            raise ConfigError(
+                "--resume replays a run journal — give --journal FILE "
+                "(the same path the interrupted run journaled to)")
         if self.run_netbench:
             if not self.hosts and not self.netbench_total_hosts:
                 raise ConfigError(
@@ -1389,6 +1422,11 @@ class BenchConfig(BenchConfigBase):
         # result files are written by the master only (the reference never
         # serializes resFilePath* to services)
         d["res_file_path"] = d["csv_file_path"] = d["json_file_path"] = ""
+        # the run journal is the MASTER's restart point; services never
+        # journal (svc_lease_secs deliberately stays on the wire — it IS
+        # the lease advertisement the service watchdog arms on)
+        d["journal_file_path"] = ""
+        d["resume_run"] = False
         d["num_dataset_threads_override"] = self.num_dataset_threads
         if self.assign_tpu_per_service and self.tpu_ids:
             # --tpuperservice: round-robin chips across service instances —

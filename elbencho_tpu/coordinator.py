@@ -29,9 +29,11 @@ class Coordinator:
         self._interrupted = False
         self._profile_seq = 0
         self._profile_warned_hosts = False
-        self._old_sigint = None
+        self._old_handlers = []  # (signum, previous handler) pairs
         self._telemetry = None   # BenchTelemetry when --telemetry
         self._exporter = None    # its /metrics HTTP server
+        self._journal = None     # RunJournal when --journal
+        self._resume_plan = None  # ResumePlan when --resume
 
     # ------------------------------------------------------------------
 
@@ -50,9 +52,18 @@ class Coordinator:
         return self._run_master_or_local()
 
     def _run_master_or_local(self) -> int:
+        from .config.args import ConfigError
         cfg = self.cfg
         self._install_signal_handler()
         try:
+            try:
+                if self._setup_journal():
+                    return 0  # --resume against a complete journal
+            except (ConfigError, OSError) as err:
+                # OSError: unwritable/unreadable --journal path — fail
+                # before any phase runs, not mid-run
+                logger.log_error(str(err))
+                return 1
             self._start_telemetry()
             if cfg.hosts:
                 from .service.remote_worker import wait_for_services_ready
@@ -61,24 +72,83 @@ class Coordinator:
             self._wait_for_sync_start()
             self.manager.prepare_threads()
             self.run_benchmarks()
+            if self._journal is not None:
+                self._journal_write(self._journal.run_complete)
             return 0
         except WorkerException as err:
             logger.log_error(f"Aborting due to worker error: {err}")
             self.manager.interrupt_and_notify_workers()
+            self._abort_hygiene()
             return 1
         except KeyboardInterrupt:
             logger.log_error("Interrupted. Shutting down workers...")
             self.manager.interrupt_and_notify_workers()
+            self._abort_hygiene()
             return 3
         finally:
+            # exporter first: the abort path must free --telemetryport
+            # before the (up to 30s/thread) worker join, so back-to-back
+            # runs on the same port bind cleanly (stop() is idempotent)
+            if self._exporter is not None:
+                self._exporter.stop()
+                self._exporter = None
             try:
                 self.manager.join_all_threads()
             except Exception:  # noqa: BLE001 - teardown must not mask errors
                 pass
             self.statistics.close()
-            if self._exporter is not None:
-                self._exporter.stop()
+            if self._journal is not None:
+                self._journal.close()
             self._restore_signal_handler()
+
+    def _setup_journal(self) -> bool:
+        """--journal/--resume wiring. Returns True when --resume finds a
+        terminal run_complete record (nothing left to run). Raises
+        ConfigError on a missing journal or a config-fingerprint
+        mismatch — resuming a different workload would silently mix
+        incompatible datasets."""
+        cfg = self.cfg
+        if not cfg.journal_file_path:
+            return False
+        from .journal import RunJournal, load_resume_plan
+        if cfg.resume_run:
+            plan = load_resume_plan(cfg.journal_file_path, cfg)
+            if plan.run_complete:
+                logger.log(0, "RESUME: journal already has run_complete — "
+                              "nothing left to resume")
+                return True
+            self._resume_plan = plan
+            # surfaced in the JSON result records ("Resumed") and the
+            # summarize tool's RESUMED banner
+            cfg.resumed_skipped_phases = plan.num_finished
+            if plan.partial_dataset:
+                # the interrupted run died inside a write/delete phase:
+                # the re-run's delete/overwrite work must tolerate the
+                # partial dataset it left on disk (PR 5 latch)
+                self.manager.shared.mark_partial_dataset()
+            logger.log(0, f"RESUME: {plan.num_finished} finished phase(s) "
+                          f"will be skipped per {cfg.journal_file_path}; "
+                          f"the first incomplete phase re-runs from "
+                          f"scratch")
+        self._journal = RunJournal(cfg.journal_file_path, cfg)
+        if cfg.resume_run:
+            self._journal.resume(self._resume_plan.num_finished)
+        else:
+            # a fresh run refuses to append after an incomplete journal
+            # (that restart point is someone's resume) and truncates a
+            # complete one — mixing runs in one file would poison every
+            # later --resume replay
+            self._journal.start_fresh(cfg.enabled_phases(), cfg.iterations)
+        return False
+
+    def _abort_hygiene(self) -> None:
+        """Master-side abort: close the telemetry exporter socket NOW and
+        drop live-stats files that never saw a data row, so back-to-back
+        runs on the same port/paths never inherit stale state."""
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
+        self.statistics.abort_cleanup()
 
     def _start_telemetry(self) -> None:
         """--telemetry: standalone Prometheus /metrics endpoint for
@@ -131,9 +201,14 @@ class Coordinator:
 
     def run_benchmarks(self) -> None:
         """Iterations x ordered phases with sync/dropcaches interleave
-        (reference: runBenchmarks, Coordinator.cpp:299-376)."""
+        (reference: runBenchmarks, Coordinator.cpp:299-376). With
+        --journal every table phase is bracketed by start/finish records;
+        with --resume, phases the journal proves finished are skipped —
+        host rotation still applies to skipped slots so the re-run phases
+        see the same rank assignments the original run would have."""
         cfg = self.cfg
         phases = cfg.enabled_phases()
+        from .phases import phase_name
         for iteration in range(cfg.iterations):
             if cfg.iterations > 1:
                 logger.log(0, f"[Starting iteration {iteration + 1} of "
@@ -141,12 +216,72 @@ class Coordinator:
             self.statistics.print_phase_results_table_header()
             self._run_sync_and_drop_caches()
             for idx, phase in enumerate(phases):
-                self.run_benchmark_phase(phase)
-                self._run_sync_and_drop_caches()
+                skipped = self._resume_plan is not None \
+                    and (iteration, idx) in self._resume_plan.finished
+                if skipped:
+                    logger.log(0, f"RESUME: skipping finished phase "
+                                  f"{phase_name(phase)} "
+                                  f"(iteration {iteration + 1})")
+                else:
+                    self._run_journaled_phase(iteration, idx, phase)
+                    self._run_sync_and_drop_caches()
                 if idx < len(phases) - 1:
-                    if cfg.next_phase_delay_secs:
+                    if cfg.next_phase_delay_secs and not skipped:
                         time.sleep(cfg.next_phase_delay_secs)
                     self._rotate_hosts()
+
+    def _run_journaled_phase(self, iteration: int, idx: int,
+                             phase: BenchPhase) -> None:
+        """One table phase, bracketed by journal records: the fsync'd
+        phase_start makes a later crash provable (no finish record = the
+        phase did not complete), phase_interrupted marks signal/error
+        aborts, phase_finish carries per-host result summaries."""
+        from .phases import UNJOURNALED_PHASES
+        if self._journal is None or phase in UNJOURNALED_PHASES:
+            self.run_benchmark_phase(phase)
+            return
+        self._journal_write(self._journal.phase_start, iteration, idx,
+                            phase)
+        try:
+            self.run_benchmark_phase(phase)
+        except BaseException as err:
+            reason = f"{type(err).__name__}: {err}" if str(err) \
+                else type(err).__name__
+            try:  # best effort: never mask the original abort cause
+                self._journal.phase_interrupted(iteration, idx, phase,
+                                                reason)
+            except OSError:
+                pass
+            raise
+        self._journal_write(self._journal.phase_finish, iteration, idx,
+                            phase, self._phase_host_summaries())
+
+    def _journal_write(self, method, *args) -> None:
+        """A mid-run journal append failure (disk full, lost mount) must
+        abort like any worker error — cleanly, with interrupt + hygiene —
+        not escape as a raw OSError traceback: a run whose restart point
+        can no longer be recorded must not keep running as if it could."""
+        try:
+            method(*args)
+        except OSError as err:
+            raise WorkerException(
+                f"--journal write failed ({self.cfg.journal_file_path}): "
+                f"{err}") from err
+
+    def _phase_host_summaries(self) -> "dict[str, dict]":
+        """Per-host finish summary for the journal: local workers fold
+        into one "local" entry, RemoteWorkers report per host."""
+        out: "dict[str, dict]" = {}
+        for w in self.manager.workers:
+            key = getattr(w, "host", None) or "local"
+            s = out.setdefault(key, {"entries": 0, "bytes": 0, "iops": 0,
+                                     "elapsed_usec": 0})
+            s["entries"] += w.live_ops.num_entries_done
+            s["bytes"] += w.live_ops.num_bytes_done
+            s["iops"] += w.live_ops.num_iops_done
+            s["elapsed_usec"] = max(s["elapsed_usec"],
+                                    max(w.elapsed_usec_vec, default=0))
+        return out
 
     def _run_sync_and_drop_caches(self) -> None:
         if self.cfg.run_sync_phase:
@@ -176,9 +311,15 @@ class Coordinator:
                 # (TPU path, retry, staging-pool counters) as span args —
                 # the whole PATH_AUDIT_COUNTERS schema is inspectable in
                 # Perfetto without cross-referencing the JSON record.
+                from .service.fault_tolerance import \
+                    merge_control_audit_counters
                 from .tpu.device import sum_path_audit_counters
                 audit = {k: v for k, v in sum_path_audit_counters(
                     self.manager.workers).items() if v}
+                # control-plane audit (retries, lease expiries/age) rides
+                # the same phase marker so Perfetto shows both planes
+                audit.update({k: v for k, v in merge_control_audit_counters(
+                    self.manager.workers).items() if v})
                 tracer.record(phase_name(phase), "phase", trace_t0,
                               (tracer.now_ns() - trace_t0) // 1000,
                               **audit)
@@ -271,30 +412,41 @@ class Coordinator:
     # ------------------------------------------------------------------
 
     def _install_signal_handler(self) -> None:
-        """First SIGINT interrupts workers gracefully; another SIGINT >5s
-        later restores the default handler (reference: Coordinator.cpp:23,
-        :420-442)."""
-        self._last_sigint = 0.0
+        """Two-stage graceful shutdown (reference: Coordinator.cpp:23,
+        :420-442, tightened for unattended runs): the FIRST SIGINT or
+        SIGTERM interrupts local workers and remote services and lets the
+        run unwind normally — the journal's phase_interrupted record is
+        written on the way out, services get /interruptphase. A SECOND
+        signal is immediate: the default disposition is restored and the
+        signal re-delivered to this process."""
 
         def handler(signum, frame):
-            now = time.monotonic()
-            if self._interrupted and now - self._last_sigint > 5:
-                signal.signal(signal.SIGINT, signal.SIG_DFL)
+            if self._interrupted:
+                # second signal: immediate — no more graceful anything
+                for sig in (signal.SIGINT, signal.SIGTERM):
+                    try:
+                        signal.signal(sig, signal.SIG_DFL)
+                    except (ValueError, OSError):
+                        pass
+                os.kill(os.getpid(), signum)
+                return
             self._interrupted = True
-            self._last_sigint = now
             print("Interrupt received. Finishing up... "
-                  "(Ctrl-C again after 5s to force quit)", file=sys.stderr)
+                  "(send the signal again to force quit)", file=sys.stderr)
             self.manager.shared.request_interrupt()
             self.manager.interrupt_and_notify_workers()
 
-        try:
-            self._old_sigint = signal.signal(signal.SIGINT, handler)
-        except ValueError:
-            self._old_sigint = None  # not on main thread (tests)
+        self._old_handlers = []
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                self._old_handlers.append((sig, signal.signal(sig, handler)))
+            except ValueError:
+                pass  # not on main thread (tests)
 
     def _restore_signal_handler(self) -> None:
-        if self._old_sigint is not None:
+        for sig, old in self._old_handlers:
             try:
-                signal.signal(signal.SIGINT, self._old_sigint)
-            except ValueError:
+                signal.signal(sig, old)
+            except (ValueError, OSError, TypeError):
                 pass
+        self._old_handlers = []

@@ -1,0 +1,295 @@
+"""Run journal: fsync'd append-only record of a benchmark run's lifecycle.
+
+A multi-hour sweep that dies at phase 9 of 10 should resume, not restart
+(PAPERS.md "Optimizing High-Throughput Distributed Data Pipelines for
+Reproducible Deep Learning at Scale": long benchmark campaigns need
+journaled, reproducible restart points). ``--journal FILE`` makes the
+coordinator append one JSON line per lifecycle event:
+
+- ``run_start``    — config fingerprint, version, label, planned phases
+- ``phase_start``  — (iteration, phase index, phase code/name)
+- ``phase_finish`` — same key plus per-host result summaries
+- ``phase_interrupted`` — a phase cut short by signal/error/crash
+- ``resume``       — a ``--resume`` run took over this journal
+- ``run_complete`` — terminal record; nothing left to resume
+
+Every append is flushed AND fsync'd before the phase proceeds, so the
+journal is trustworthy after a SIGKILL: the absence of a ``phase_finish``
+record *proves* the phase did not complete.
+
+``--resume`` replays the journal (`load_resume_plan`): the config
+fingerprint must match (a changed workload would make the old phase
+records meaningless — hard `ConfigError`), phases with ``finish`` records
+are skipped, and the first incomplete phase re-runs from scratch (the
+``partial_write`` hint lets delete/overwrite phases tolerate the partial
+dataset the interrupted write left behind, workers/shared.py
+``partial_dataset``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+
+from .config.args import ConfigError
+from .phases import BenchPhase
+
+#: journal record types (the ``rec`` key of each JSONL line)
+REC_RUN_START = "run_start"
+REC_PHASE_START = "phase_start"
+REC_PHASE_FINISH = "phase_finish"
+REC_PHASE_INTERRUPTED = "phase_interrupted"
+REC_RESUME = "resume"
+REC_RUN_COMPLETE = "run_complete"
+
+#: config fields excluded from the fingerprint: outputs, observability,
+#: and control-plane resilience knobs shape how a run is *watched*, not
+#: what data it produces — changing them between the original run and a
+#: --resume must not invalidate the journal. Everything else (workload
+#: geometry, access pattern, backends, TPU path, hosts) is
+#: parity-relevant: finished-phase records only transfer to an
+#: identical workload.
+FINGERPRINT_EXCLUDE = frozenset({
+    # the journal/resume machinery itself
+    "journal_file_path", "resume_run",
+    # result/observability outputs
+    "res_file_path", "csv_file_path", "json_file_path", "no_csv_labels",
+    "live_csv_file_path", "live_json_file_path", "live_csv_extended",
+    "live_json_extended", "live_stats_interval_ms",
+    "use_single_line_live_stats", "single_line_live_stats_no_erase",
+    "disable_live_stats", "show_latency", "show_latency_histogram",
+    "show_latency_percentiles", "num_latency_percentile_9s",
+    "show_all_elapsed", "show_cpu_util", "show_svc_elapsed",
+    "show_svc_ping", "ignore_0usec_errors", "log_level",
+    "ops_log_path", "ops_log_lock", "telemetry", "telemetry_port",
+    "trace_file_path", "trace_sample", "tpu_profile_dir",
+    # control-plane resilience knobs (retry shape, not data shape)
+    "svc_num_retries", "svc_retry_budget_secs", "svc_stalled_secs",
+    "svc_tolerant_hosts", "svc_lease_secs", "svc_update_interval_ms",
+    "svc_wait_secs", "svc_password_file",
+    # role/oneshot flags a resumed master run never carries differently
+    "run_as_service", "run_service_in_foreground", "quit_services",
+    "interrupt_services", "do_dry_run", "config_file_path",
+    # hosts ship as the DERIVED list below, not the raw spellings
+    "hosts_str", "hosts_file_path",
+})
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable hash of the parity-relevant effective config. Derived from
+    the post-derive() state so ``--hosts a,b`` and a hosts file listing
+    the same hosts fingerprint identically, and POSIX bench paths are
+    absolutized so ``data.bin`` and ``/cwd/data.bin`` name the same
+    dataset (while the same relative spelling from a DIFFERENT cwd — a
+    genuinely different dataset — correctly mismatches)."""
+    from .phases import BenchMode
+    vals: "dict[str, object]" = {}
+    for f in dataclasses.fields(cfg):
+        if f.name in FINGERPRINT_EXCLUDE:
+            continue
+        vals[f.name] = getattr(cfg, f.name)
+    paths = list(getattr(cfg, "paths", []))
+    if getattr(cfg, "bench_mode", None) == BenchMode.POSIX \
+            and not getattr(cfg, "hosts", []):
+        # master-mode paths live on the service hosts — absolutizing
+        # against the MASTER's cwd would be meaningless there
+        paths = [os.path.abspath(p) for p in paths]
+    vals["paths"] = paths
+    vals["hosts"] = list(getattr(cfg, "hosts", []))
+    blob = json.dumps(vals, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class RunJournal:
+    """Append-only JSONL writer; every record is fsync'd before the run
+    proceeds so a later --resume can trust what it reads."""
+
+    def __init__(self, path: str, cfg):
+        self.path = path
+        self.cfg = cfg
+        self.fingerprint = config_fingerprint(cfg)
+        self._fh = None
+
+    # -- low-level append ---------------------------------------------------
+
+    def _append(self, rec_type: str, **fields) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        rec = {"rec": rec_type,
+               "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"), **fields}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    # -- lifecycle records --------------------------------------------------
+
+    def start_fresh(self, phases, iterations: int) -> None:
+        """Begin a NEW journaled run at this path. An existing journal
+        holding an INCOMPLETE run is refused (it is a restart point —
+        resume it with --resume or remove the file); a completed one is
+        truncated. Appending a second run's records after a first would
+        poison every later --resume replay (stale run_complete /
+        phase_finish records masquerading as the new run's)."""
+        if os.path.exists(self.path) and os.path.getsize(self.path):
+            try:
+                records = read_journal(self.path)
+            except ConfigError:
+                raise ConfigError(
+                    f"--journal: {self.path} exists but is not a journal "
+                    f"(undecodable lines); refusing to overwrite it — "
+                    f"remove the file or pick another path") from None
+            if records and not any(r.get("rec") == REC_RUN_COMPLETE
+                                   for r in records):
+                raise ConfigError(
+                    f"--journal: {self.path} holds an INCOMPLETE run — "
+                    f"resume it with --resume, or remove the file to "
+                    f"start over")
+            os.truncate(self.path, 0)
+        self.run_start(phases, iterations)
+
+    def run_start(self, phases, iterations: int) -> None:
+        from . import __version__
+        from .phases import phase_name
+        self._append(REC_RUN_START,
+                     fingerprint=self.fingerprint,
+                     version=__version__,
+                     label=self.cfg.bench_label,
+                     iterations=iterations,
+                     phases=[{"code": int(p), "name": phase_name(p)}
+                             for p in phases])
+
+    def resume(self, num_skipped: int) -> None:
+        self._append(REC_RESUME, fingerprint=self.fingerprint,
+                     skipped_phases=num_skipped)
+
+    def phase_start(self, iteration: int, idx: int,
+                    phase: BenchPhase) -> None:
+        from .phases import phase_name
+        self._append(REC_PHASE_START, iteration=iteration, index=idx,
+                     code=int(phase), name=phase_name(phase))
+
+    def phase_finish(self, iteration: int, idx: int, phase: BenchPhase,
+                     host_summaries: "dict[str, dict]") -> None:
+        from .phases import phase_name
+        self._append(REC_PHASE_FINISH, iteration=iteration, index=idx,
+                     code=int(phase), name=phase_name(phase),
+                     hosts=host_summaries)
+
+    def phase_interrupted(self, iteration: int, idx: int,
+                          phase: BenchPhase, reason: str) -> None:
+        from .phases import phase_name
+        self._append(REC_PHASE_INTERRUPTED, iteration=iteration, index=idx,
+                     code=int(phase), name=phase_name(phase), reason=reason)
+
+    def run_complete(self) -> None:
+        self._append(REC_RUN_COMPLETE, fingerprint=self.fingerprint)
+
+
+# ---------------------------------------------------------------------------
+# resume replay
+# ---------------------------------------------------------------------------
+
+#: phases whose interruption leaves the dataset partial: an unfinished
+#: write leaves missing entries behind, an unfinished delete leaves
+#: already-deleted ones — either way the re-run must tolerate absences
+_PARTIAL_DATASET_PHASES = frozenset({
+    int(BenchPhase.CREATEFILES), int(BenchPhase.DELETEFILES),
+    int(BenchPhase.DELETEDIRS), int(BenchPhase.MULTIDELOBJ),
+})
+
+
+@dataclasses.dataclass
+class ResumePlan:
+    """What a --resume run skips and what it must tolerate."""
+
+    #: (iteration, phase index) pairs with a phase_finish record
+    finished: "set[tuple[int, int]]"
+    #: a write or delete phase started (or was interrupted) without
+    #: finishing: the dataset on disk is partial, so the re-run's
+    #: delete/overwrite work must tolerate missing entries
+    #: (workers/shared.py partial_dataset latch)
+    partial_dataset: bool
+    #: terminal run_complete record present — nothing to resume
+    run_complete: bool
+
+    @property
+    def num_finished(self) -> int:
+        return len(self.finished)
+
+
+def read_journal(path: str) -> "list[dict]":
+    """All records of a journal file; a torn final line (crash mid-append)
+    is dropped rather than failing the whole replay."""
+    records: "list[dict]" = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # only the LAST line may legitimately be torn; garbage in
+                # the middle means the file is not a journal
+                records.append(None)
+    while records and records[-1] is None:
+        records.pop()
+    if any(r is None for r in records):
+        raise ConfigError(
+            f"--resume: {path} contains undecodable lines before the "
+            f"end — not a journal written by --journal?")
+    return records
+
+
+def load_resume_plan(path: str, cfg) -> ResumePlan:
+    """Replay a journal for --resume. Hard ConfigError when the file is
+    missing/empty or the config fingerprint mismatches: resuming a
+    different workload would silently mix incompatible datasets."""
+    if not os.path.exists(path):
+        raise ConfigError(f"--resume: journal file not found: {path}")
+    records = read_journal(path)
+    if not records:
+        raise ConfigError(f"--resume: journal file is empty: {path}")
+    start = next((r for r in records if r.get("rec") == REC_RUN_START), None)
+    if start is None:
+        raise ConfigError(
+            f"--resume: {path} has no {REC_RUN_START} record")
+    want = config_fingerprint(cfg)
+    got = start.get("fingerprint", "")
+    if got != want:
+        raise ConfigError(
+            f"--resume: config fingerprint mismatch — the journal was "
+            f"written for a different workload (journal {got[:16]}..., "
+            f"current {want[:16]}...). Re-run with the original "
+            f"arguments, or start a fresh journal.")
+    finished: "set[tuple[int, int]]" = set()
+    started: "set[tuple[int, int]]" = set()
+    started_code: "dict[tuple[int, int], int]" = {}
+    complete = False
+    for rec in records:
+        key = (rec.get("iteration", 0), rec.get("index", 0))
+        if rec.get("rec") == REC_PHASE_FINISH:
+            finished.add(key)
+        elif rec.get("rec") == REC_PHASE_START:
+            started.add(key)
+            started_code[key] = rec.get("code", 0)
+        elif rec.get("rec") == REC_RUN_COMPLETE:
+            complete = True
+    # a write/delete phase that started (or was interrupted) without
+    # finishing left a partial dataset behind
+    partial_dataset = any(
+        started_code.get(key) in _PARTIAL_DATASET_PHASES
+        for key in started - finished)
+    return ResumePlan(finished=finished, partial_dataset=partial_dataset,
+                      run_complete=complete)

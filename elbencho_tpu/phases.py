@@ -72,6 +72,16 @@ PHASE_NAMES = {
     BenchPhase.TPUBENCH: "TPUBENCH",
 }
 
+#: phases the run journal (--journal) does NOT record: the sync/dropcaches
+#: interleave is cheap, idempotent, and its effect (kernel cache state)
+#: does not survive a crash anyway — a --resume re-runs it around the
+#: first re-run phase instead of trusting stale records
+UNJOURNALED_PHASES = frozenset({
+    BenchPhase.IDLE, BenchPhase.TERMINATE,
+    BenchPhase.SYNC, BenchPhase.DROPCACHES,
+})
+
+
 # bucket-flavored names used in S3 mode (reference: MKBUCKETS/RMBUCKETS/...)
 PHASE_NAMES_S3 = {
     BenchPhase.CREATEDIRS: "MKBUCKETS",

@@ -115,6 +115,13 @@ CONTROL_AUDIT_COUNTERS = (
     ("svc_retries", "SvcRetries", "sum"),
     ("svc_consec_retries_hwm", "SvcConsecRetriesHwm", "max"),
     ("svc_heartbeat_age_hwm_usec", "SvcHeartbeatAgeHwmUsec", "max"),
+    # master liveness lease (--svcleasesecs): observed SERVICE-side and
+    # shipped back over the wire (http_service lease counters ingested
+    # by RemoteWorker) — service-lifetime values, so a master that
+    # returns after a crash sees how often its predecessors orphaned
+    # the host. Appended entries, never reordered (wire/JSON schema).
+    ("svc_lease_expiries", "SvcLeaseExpiries", "sum"),
+    ("svc_lease_age_hwm_usec", "SvcLeaseAgeHwmUsec", "max"),
 )
 
 

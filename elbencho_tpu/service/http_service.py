@@ -19,7 +19,9 @@ import fcntl
 import getpass
 import json
 import os
+import shutil
 import sys
+import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, HTTPServer
@@ -49,27 +51,54 @@ class ServiceState:
         self.pw_hash = ""
         if base_cfg.svc_password_file:
             self.pw_hash = proto.read_pw_file(base_cfg.svc_password_file)
+        # worker-pool mutation guard: the server itself is single-threaded,
+        # but the lease watchdog thread (--svcleasesecs) may tear down the
+        # pool concurrently with an HTTP request — RLock so teardown can
+        # nest under prepare/orphan recovery (single-shot semantics live
+        # in teardown_workers itself)
+        self._teardown_lock = threading.RLock()
+        # master liveness lease (--svcleasesecs): armed per /preparephase,
+        # renewed by every authorized master request, watched by a daemon
+        # thread. Counters are SERVICE-lifetime (they survive pool
+        # rebuilds) and ship over the wire as SvcLeaseExpiries (sum) /
+        # SvcLeaseAgeHwmUsec (MAX) — fault_tolerance.CONTROL_AUDIT_COUNTERS
+        self._lease_secs = 0
+        self._lease_last_contact = time.monotonic()
+        self._lease_stop = threading.Event()
+        self._lease_thread: "threading.Thread | None" = None
+        self.lease_expiries = 0
+        self.lease_age_hwm_usec = 0
         # /metrics piggyback (telemetry subsystem): one sampler for the
         # service lifetime; the provider indirection follows the worker
         # pool across /preparephase rebuilds
         from ..telemetry.registry import BenchTelemetry
         self._telemetry = BenchTelemetry(
             base_cfg, lambda: (self.statistics, self.manager),
-            role="service")
+            role="service", extra_control=self.lease_counters)
 
     def teardown_workers(self) -> None:
-        if self.manager is not None:
-            self.manager.interrupt_and_notify_workers()
+        """Single-shot + concurrency-safe: the HTTP handler (interrupt
+        with quit, /preparephase rebuild) and the lease watchdog may both
+        reach here; whoever swaps the manager out first tears it down,
+        everyone else sees None and returns."""
+        with self._teardown_lock:
+            manager, self.manager = self.manager, None
+            self.statistics = None
+            if manager is None:
+                return
+            manager.interrupt_and_notify_workers()
             try:
-                self.manager.join_all_threads()
+                manager.join_all_threads()
             except Exception:  # noqa: BLE001 - teardown is best effort
                 pass
-            self.manager = None
-            self.statistics = None
 
     def prepare_phase(self, cfg_dict: dict) -> dict:
         """Kill+rebuild the worker pool from the master's config JSON;
         reply with bench path info + error history."""
+        with self._teardown_lock:
+            return self._prepare_phase_locked(cfg_dict)
+
+    def _prepare_phase_locked(self, cfg_dict: dict) -> dict:
         self.teardown_workers()
         logger.clear_error_history()
         version = cfg_dict.get(proto.KEY_PROTOCOL_VERSION)
@@ -106,7 +135,13 @@ class ServiceState:
         self.manager = WorkerManager(cfg)
         self.statistics = Statistics(cfg, self.manager)
         self.manager.prepare_threads()
-        return {
+        # arm the master liveness lease: the master's flag arrived on the
+        # config wire (its /preparephase IS the lease advertisement); a
+        # service started with its own --svcleasesecs uses that as the
+        # default for masters that don't set one
+        lease_secs = cfg.svc_lease_secs or self.base_cfg.svc_lease_secs
+        self._arm_lease(lease_secs)
+        reply = {
             proto.KEY_BENCH_PATH_TYPE: int(cfg.bench_path_type),
             proto.KEY_NUM_BENCH_PATHS: len(cfg.paths),
             "FileSize": cfg.file_size,
@@ -114,6 +149,109 @@ class ServiceState:
             "RandomAmount": cfg.random_amount,
             proto.KEY_ERROR_HISTORY: logger.get_error_history(),
         }
+        if lease_secs:
+            reply[proto.KEY_SVC_LEASE_SECS] = lease_secs
+        return reply
+
+    # -- master liveness lease (--svcleasesecs) -----------------------------
+
+    def lease_counters(self) -> dict:
+        return {"SvcLeaseExpiries": self.lease_expiries,
+                "SvcLeaseAgeHwmUsec": self.lease_age_hwm_usec}
+
+    def touch_lease(self) -> None:
+        """Every authorized master request renews the lease (the /status
+        poll cadence is the natural heartbeat). Also tracks the largest
+        gap between contacts as a high-water mark, so a lease that came
+        CLOSE to expiring is visible even without an expiry."""
+        now = time.monotonic()
+        if self._lease_secs:
+            age_usec = int((now - self._lease_last_contact) * 1e6)
+            if age_usec > self.lease_age_hwm_usec:
+                self.lease_age_hwm_usec = age_usec
+        self._lease_last_contact = now
+
+    def release_lease(self) -> None:
+        """Disarm without orphan recovery: the master deliberately let go
+        (/interruptphase at run end / teardown), which must not count as
+        a crashed master."""
+        self._lease_secs = 0
+
+    def _arm_lease(self, lease_secs: int) -> None:
+        self._lease_last_contact = time.monotonic()
+        self._lease_secs = max(lease_secs, 0)
+        if not self._lease_secs:
+            return
+        if self._lease_thread is None or not self._lease_thread.is_alive():
+            self._lease_stop.clear()
+            self._lease_thread = threading.Thread(
+                target=self._lease_watch_loop, name="svc-lease-watchdog",
+                daemon=True)
+            self._lease_thread.start()
+
+    def _lease_watch_loop(self) -> None:
+        while not self._lease_stop.wait(0.2):
+            with self._teardown_lock:
+                secs = self._lease_secs
+                if not secs or self.manager is None:
+                    continue
+                # the expiry clock runs only while a phase is ACTIVE on
+                # this host: once our workers finished (or before the
+                # first /startphase) the master legitimately goes silent
+                # here — it is polling the straggler hosts, sleeping
+                # --phasedelay, or printing results — and an idle-at-
+                # barrier pool is not the storage-hammering hazard the
+                # lease exists to stop (a new master's /preparephase
+                # rebuilds it anyway)
+                shared = self.manager.shared
+                busy = shared.current_phase not in (
+                    BenchPhase.IDLE, BenchPhase.TERMINATE) \
+                    and not self.manager.all_workers_done()
+                if not busy:
+                    self._lease_last_contact = time.monotonic()
+                    continue
+                age = time.monotonic() - self._lease_last_contact
+                if age < secs:
+                    continue
+                self._orphan_recover(age, secs)
+
+    def _orphan_recover(self, age: float, secs: int) -> None:
+        """Lease expired with a worker pool alive: the master is gone.
+        Interrupt the workers, drop the pool, clear the bench UUID, and
+        return to idle — the host is immediately reusable instead of
+        hammering storage until someone notices. Called under the
+        teardown lock (watchdog thread)."""
+        self.lease_expiries += 1
+        age_usec = int(age * 1e6)
+        if age_usec > self.lease_age_hwm_usec:
+            self.lease_age_hwm_usec = age_usec
+        self._lease_secs = 0  # disarm until the next /preparephase
+        logger.log_error(
+            f"ORPHANED — master lease expired: no master contact for "
+            f"{age:.1f}s (--svcleasesecs {secs}); interrupting workers "
+            f"and returning to idle")
+        shared = self.manager.shared
+        self.interrupt()
+        self.teardown_workers()
+        shared.clear_bench_uuid()
+        self._cleanup_run_temp_files()
+
+    def _cleanup_run_temp_files(self) -> None:
+        """Drop this service's per-run upload dir (treefiles etc.) so an
+        orphaned/quit service leaves no stale per-host temp state behind;
+        the next master re-uploads its prep files at /preparefile."""
+        d = os.path.join(SVC_TMP_DIR,
+                         f"elbencho_tpu_{getpass.getuser()}"
+                         f"_p{self.base_cfg.service_port}")
+        shutil.rmtree(d, ignore_errors=True)
+
+    def close(self) -> None:
+        """Service shutdown: stop the lease watchdog, drop the pool."""
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=5)
+            self._lease_thread = None
+        self.teardown_workers()
 
     def _uploaded_file_path(self, name: str) -> str:
         d = os.path.join(SVC_TMP_DIR,
@@ -142,19 +280,26 @@ class ServiceState:
         return (200, "phase started")
 
     def status(self) -> dict:
-        if self.statistics is None:
+        # snapshot once: the lease watchdog may null these concurrently
+        statistics, manager, cfg = self.statistics, self.manager, self.cfg
+        if statistics is None:
             return {proto.KEY_PHASE_CODE: int(BenchPhase.IDLE),
-                    proto.KEY_NUM_WORKERS_DONE: 0}
-        if self.manager is not None and self.cfg is not None:
-            self.manager.check_phase_time_limit(self.phase_start_monotonic)
-        return self.statistics.get_live_stats_dict()
+                    proto.KEY_NUM_WORKERS_DONE: 0,
+                    **self.lease_counters()}
+        if manager is not None and cfg is not None:
+            manager.check_phase_time_limit(self.phase_start_monotonic)
+        stats = statistics.get_live_stats_dict()
+        stats.update(self.lease_counters())
+        return stats
 
     def bench_result(self) -> dict:
-        if self.statistics is None:
-            return {}
-        result = self.statistics.get_bench_result_dict()
+        statistics, manager = self.statistics, self.manager
+        if statistics is None:
+            return self.lease_counters()
+        result = statistics.get_bench_result_dict()
         result[proto.KEY_ERROR_HISTORY] = logger.get_error_history()
-        tracer = self.manager.shared.tracer if self.manager else None
+        result.update(self.lease_counters())
+        tracer = manager.shared.tracer if manager else None
         if tracer is not None:
             try:  # phase is over: persist the span ring for Perfetto
                 tracer.write()
@@ -167,9 +312,14 @@ class ServiceState:
         return self._telemetry.render()
 
     def interrupt(self) -> None:
-        if self.manager is not None:
-            self.manager.shared.request_interrupt()
-            self.manager.interrupt_and_notify_workers()
+        """Concurrency-safe with the lease watchdog's teardown: reads the
+        manager once under the lock; the manager calls themselves are
+        flag-sets + notifies, safe against a concurrent join."""
+        with self._teardown_lock:
+            manager = self.manager
+        if manager is not None:
+            manager.shared.request_interrupt()
+            manager.interrupt_and_notify_workers()
 
 
 def _make_handler(state: ServiceState, server_holder: dict):
@@ -212,6 +362,30 @@ def _make_handler(state: ServiceState, server_holder: dict):
             self._reply(401, {"Error": "authorization required"})
             return False
 
+        #: routes whose mere use proves the owning master is alive;
+        #: /status needs the run's bench UUID (observers don't have it)
+        #: and /metrics + info/version probes never renew
+        _LEASE_RENEWING_ROUTES = frozenset({
+            proto.PATH_PREPARE_PHASE, proto.PATH_PREPARE_FILE,
+            proto.PATH_START_PHASE, proto.PATH_BENCH_RESULT,
+        })
+
+        def _touch_lease_for(self, route: str, params: dict) -> None:
+            """Master-liveness lease renewal (--svcleasesecs), route-aware:
+            an observer polling /status (dashboard, readiness probe) must
+            NOT keep an orphaned service alive — only the owning master's
+            polls, marked with the current bench UUID, count."""
+            if route in self._LEASE_RENEWING_ROUTES:
+                state.touch_lease()
+                return
+            if route == proto.PATH_STATUS:
+                bench_id = params.get(proto.KEY_BENCH_ID, "")
+                manager = state.manager
+                uuid = manager.shared.bench_uuid \
+                    if manager is not None else ""
+                if bench_id and uuid and bench_id == uuid:
+                    state.touch_lease()
+
         # -- GET endpoints ---------------------------------------------------
 
         def do_GET(self):  # noqa: N802 (http.server API)
@@ -219,6 +393,7 @@ def _make_handler(state: ServiceState, server_holder: dict):
             route = urllib.parse.urlparse(self.path).path
             if not self._check_auth(params):
                 return
+            self._touch_lease_for(route, params)
             try:
                 if route == proto.PATH_INFO:
                     self._reply(200, {
@@ -241,11 +416,15 @@ def _make_handler(state: ServiceState, server_holder: dict):
                         params.get(proto.KEY_BENCH_ID, ""))
                     self._reply(code, {"Message": msg})
                 elif route == proto.PATH_INTERRUPT_PHASE:
+                    # a deliberate interrupt is the master LETTING GO —
+                    # never an expiry, so disarm before the workers stop
+                    state.release_lease()
                     state.interrupt()
                     quit_requested = proto.KEY_INTERRUPT_QUIT in params
                     self._reply(200, {"Message": "interrupted"})
                     if quit_requested:
                         state.teardown_workers()
+                        state._cleanup_run_temp_files()
                         server_holder["shutdown"] = True
                 else:
                     self._reply(404, {"Error": f"unknown path {route}"})
@@ -260,6 +439,7 @@ def _make_handler(state: ServiceState, server_holder: dict):
             route = urllib.parse.urlparse(self.path).path
             if not self._check_auth(params):
                 return
+            self._touch_lease_for(route, params)
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b""
             try:
@@ -319,32 +499,108 @@ class HTTPService:
         except KeyboardInterrupt:
             pass
         finally:
-            state.teardown_workers()
+            state.close()  # lease watchdog + worker pool
             server.server_close()
         return 0
 
     def _daemonize(self) -> None:
-        """Double-fork daemonization with logfile + single-instance flock
-        (reference: HTTPService::daemonize, HTTPService.cpp:32-110)."""
+        """Double-fork daemonization with logfile + single-instance lock
+        (reference: HTTPService::daemonize, HTTPService.cpp:32-110). The
+        lock file doubles as a pidfile so a SIGKILL'd instance's leftover
+        is detected and reclaimed instead of refusing to start."""
         log_path = os.path.join(
             SVC_TMP_DIR,
             f"elbencho_tpu_{getpass.getuser()}_p{self.cfg.service_port}.log")
         lock_path = log_path + ".lock"
-        lock_fd = os.open(lock_path, os.O_WRONLY | os.O_CREAT, 0o644)
-        try:
-            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except BlockingIOError:
-            print(f"ERROR: another service instance holds {lock_path}",
-                  file=sys.stderr)
-            raise SystemExit(1)
+        lock_fd = claim_instance_lock(lock_path)
         if os.fork() > 0:
             os._exit(0)
         os.setsid()
         if os.fork() > 0:
             os._exit(0)
+        # record the daemon's FINAL pid (post-double-fork) so the next
+        # start can tell a live instance from a dead leftover
+        write_lock_pid(lock_fd)
         log_fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                          0o644)
         os.dup2(log_fd, 1)
         os.dup2(log_fd, 2)
         devnull = os.open(os.devnull, os.O_RDONLY)
         os.dup2(devnull, 0)
+
+
+# ---------------------------------------------------------------------------
+# single-instance lock with stale-pid reclaim (satellite of the crash-safe
+# run lifecycle: a SIGKILL'd service must not brick its port's lock)
+# ---------------------------------------------------------------------------
+
+def pid_alive(pid: int) -> bool:
+    """Is the pid a live process we could signal? EPERM means alive but
+    foreign — treated as alive (never reclaim someone else's lock)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def read_lock_pid(lock_fd: int) -> int:
+    try:
+        os.lseek(lock_fd, 0, os.SEEK_SET)
+        data = os.read(lock_fd, 32)
+        return int(data.decode().strip() or "0")
+    except (OSError, ValueError):
+        return 0
+
+
+def write_lock_pid(lock_fd: int) -> None:
+    try:
+        os.ftruncate(lock_fd, 0)
+        os.lseek(lock_fd, 0, os.SEEK_SET)
+        os.write(lock_fd, f"{os.getpid()}\n".encode())
+    except OSError:
+        pass  # lock still held via flock; the pid is advisory detail
+
+
+def claim_instance_lock(lock_path: str) -> int:
+    """Acquire the single-instance lock, reclaiming a stale leftover.
+
+    The flock is authoritative for liveness (the kernel releases it when
+    the holder dies, however it dies); the pid recorded in the file tells
+    apart the two ways an acquire can go:
+
+    - flock HELD by someone: a live instance — refuse, naming its pid.
+    - flock free but a pid is recorded: the previous instance was
+      SIGKILL'd (a clean shutdown has no chance to run either) — log the
+      reclaim and start up; refusing here would brick the port until an
+      operator deletes the file by hand.
+    """
+    lock_fd = os.open(lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except BlockingIOError:
+        holder = read_lock_pid(lock_fd)
+        os.close(lock_fd)
+        detail = f" (pid {holder})" if holder else ""
+        print(f"ERROR: another service instance{detail} holds {lock_path}",
+              file=sys.stderr)
+        raise SystemExit(1) from None
+    stale = read_lock_pid(lock_fd)
+    if stale and stale != os.getpid():
+        if pid_alive(stale):
+            # flock free but the recorded pid lives: pid reuse after a
+            # reboot, or an instance that closed its lock fd — the flock
+            # is authoritative, so proceed, but say what happened
+            logger.log(0, f"NOTE: service lock {lock_path} recorded live "
+                          f"pid {stale} without holding the lock "
+                          f"(pid reuse?); proceeding under flock")
+        else:
+            logger.log_error(
+                f"reclaiming stale service lock {lock_path}: previous "
+                f"instance (pid {stale}) is dead (SIGKILL'd?)")
+    write_lock_pid(lock_fd)
+    return lock_fd

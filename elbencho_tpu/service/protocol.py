@@ -39,6 +39,12 @@ KEY_NUM_BENCH_PATHS = "NumBenchPaths"
 KEY_FILE_NAME = "FileName"
 KEY_AUTHORIZATION = "PwHash"
 KEY_INTERRUPT_QUIT = "quit"
+# master liveness lease (ours; no reference equivalent): /preparephase
+# reply echoes the armed lease so the master can log/verify it, and the
+# service-observed lease counters ride /status + /benchresult
+KEY_SVC_LEASE_SECS = "SvcLeaseSecs"
+KEY_SVC_LEASE_EXPIRIES = "SvcLeaseExpiries"
+KEY_SVC_LEASE_AGE_HWM = "SvcLeaseAgeHwmUsec"
 
 
 def make_pw_hash(secret: str) -> str:

@@ -237,10 +237,14 @@ class RemoteWorker(Worker):
         self.last_ping_usec = 0  # --svcping: last /status RTT
         self.cpu_util_pct = 0.0  # last /status CPUUtil (telemetry gauge)
         self.degraded = False    # --svctolerant: host lost mid-run
-        # control-plane audit counters (CONTROL_AUDIT_COUNTERS schema)
+        # control-plane audit counters (CONTROL_AUDIT_COUNTERS schema);
+        # the lease pair mirrors SERVICE-observed values (--svcleasesecs,
+        # service-lifetime) ingested from /status + /benchresult
         self.svc_retries = 0
         self.svc_consec_retries_hwm = 0
         self.svc_heartbeat_age_hwm_usec = 0
+        self.svc_lease_expiries = 0
+        self.svc_lease_age_hwm_usec = 0
         pw_hash = ""
         if self.cfg.svc_password_file:
             pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
@@ -259,6 +263,8 @@ class RemoteWorker(Worker):
         self.svc_retries = 0
         self.svc_consec_retries_hwm = 0
         self.svc_heartbeat_age_hwm_usec = 0
+        self.svc_lease_expiries = 0
+        self.svc_lease_age_hwm_usec = 0
         if self.degraded:
             # a lost host stays excluded from all later phase results
             self.got_phase_work = False
@@ -391,8 +397,15 @@ class RemoteWorker(Worker):
                 else None
             t0 = time.monotonic()
             try:
+                # the bench UUID marks this poll as the owning master's
+                # heartbeat: the service's --svcleasesecs lease renews on
+                # it, while observer /status polls (dashboards, probes)
+                # deliberately cannot keep an orphaned service alive
                 status, stats = self.client.get_json(
-                    proto.PATH_STATUS, timeout=poll_timeout,
+                    proto.PATH_STATUS,
+                    {proto.KEY_BENCH_ID: self._expected_bench_id}
+                    if self._expected_bench_id else None,
+                    timeout=poll_timeout,
                     deadline=deadline)
             except WorkerRemoteException as err:
                 if stalled_secs \
@@ -459,6 +472,7 @@ class RemoteWorker(Worker):
         final /benchresult ingest overwrites all of these."""
         from ..tpu.device import PATH_AUDIT_COUNTERS
         self.cpu_util_pct = stats.get("CPUUtil", 0.0)
+        self._ingest_lease_counters(stats)
         if "TpuHbmBytes" not in stats:
             return  # pre-telemetry service replied (tests with old stubs)
         self.tpu_transfer_bytes = stats.get("TpuHbmBytes", 0)
@@ -471,6 +485,16 @@ class RemoteWorker(Worker):
                 stats["IOLatHisto"])
             self.entries_latency_histo = LatencyHistogram.from_dict(
                 stats.get("EntLatHisto", {}))
+
+    def _ingest_lease_counters(self, reply: dict) -> None:
+        """Mirror the service-observed lease counters (--svcleasesecs;
+        service-lifetime values) so the fleet merge — SvcLeaseExpiries
+        sums, SvcLeaseAgeHwmUsec MAXes across hosts — and the /metrics
+        view pick them up like every CONTROL_AUDIT_COUNTERS entry."""
+        if proto.KEY_SVC_LEASE_EXPIRIES in reply:
+            self.svc_lease_expiries = reply[proto.KEY_SVC_LEASE_EXPIRIES]
+            self.svc_lease_age_hwm_usec = reply.get(
+                proto.KEY_SVC_LEASE_AGE_HWM, 0)
 
     def _replay_error_history(self, reply: dict) -> "list[str]":
         """Log the service's error-history lines under this host's prefix
@@ -507,6 +531,7 @@ class RemoteWorker(Worker):
             raise WorkerRemoteException(
                 f"result fetch from {self.host} failed ({status})")
         lines = self._replay_error_history(result)
+        self._ingest_lease_counters(result)
         if result.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
             detail = f": {self._strip_log_prefix(lines[-1])}" if lines \
                 else ""

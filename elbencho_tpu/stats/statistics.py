@@ -102,6 +102,7 @@ class Statistics:
         self._header_printed = False
         self._live_csv_fh = None
         self._live_json_fh = None
+        self._live_rows = 0      # data rows written to the live streams
         self._live_started = 0.0
         self._fullscreen_active = False
         # --telemetry: BenchTelemetry bound by the coordinator; the live
@@ -366,6 +367,8 @@ class Statistics:
                     {"Rank": w.rank, **w.live_ops.as_dict()}
                     for w in self.manager.workers]
             print(json.dumps(rec), file=self._live_json_fh, flush=True)
+        if cfg.live_csv_file_path or cfg.live_json_file_path:
+            self._live_rows += 1
         self._flush_live_files()
 
     def _flush_live_files(self) -> None:
@@ -662,6 +665,11 @@ class Statistics:
             "TraceEvents": (self.manager.shared.tracer.num_recorded
                             if self.manager.shared.tracer is not None
                             else 0),
+            # crash-safe run lifecycle (JSON-only): number of finished
+            # phases a --resume run skipped per its journal — non-zero
+            # marks every record of a resumed run so the summarize tool
+            # can banner it (0 = fresh run)
+            "Resumed": getattr(self.cfg, "resumed_skipped_phases", 0),
         }
         # unconditional so CSV rows keep a fixed column count
         rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
@@ -736,8 +744,9 @@ class Statistics:
         rec.pop("DegradedHosts")  # list is JSON-only; the count stays CSV
         for _attr, key, _mode in CONTROL_AUDIT_COUNTERS:  # JSON-only keys
             rec.pop(key)
-        for key in ("HostCPUUtil", "TelemetryScrapes", "TraceEvents"):
-            rec.pop(key)  # telemetry keys are JSON-only
+        for key in ("HostCPUUtil", "TelemetryScrapes", "TraceEvents",
+                    "Resumed"):
+            rec.pop(key)  # telemetry + lifecycle keys are JSON-only
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
         path = self.cfg.csv_file_path
@@ -896,3 +905,29 @@ class Statistics:
         for fh in (self._live_csv_fh, self._live_json_fh):
             if fh is not None and fh is not sys.stdout:
                 fh.close()
+        self._live_csv_fh = self._live_json_fh = None
+
+    def abort_cleanup(self) -> None:
+        """Master-side abort hygiene: close the live streams and remove
+        live-stats files this run opened but never wrote a data row to —
+        a back-to-back run must not inherit a stale header-only artifact
+        (run lifecycle satellite, docs/fault-tolerance.md)."""
+        self.close()
+        if self._live_rows:
+            return  # real data: keep the files
+        for path in (self.cfg.live_csv_file_path,
+                     self.cfg.live_json_file_path):
+            if not path or path == "stdout":
+                continue
+            try:
+                # the streams open in append mode: an earlier run's rows
+                # may live in the same file — remove only empty or
+                # header-only leftovers
+                with open(path) as f:
+                    head = f.readline()
+                    more = f.readline()
+                if more or (head and not head.startswith("ISODate")):
+                    continue
+                os.unlink(path)
+            except OSError:
+                pass

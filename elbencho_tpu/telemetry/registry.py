@@ -161,10 +161,16 @@ class BenchTelemetry:
     the CURRENT (statistics, manager) — host rotation and /preparephase
     rebuild both, so the exporter must never cache them."""
 
-    def __init__(self, cfg, provider, role: str = "local"):
+    def __init__(self, cfg, provider, role: str = "local",
+                 extra_control=None):
         self.cfg = cfg
         self.provider = provider
         self.role = role
+        # optional zero-arg callable returning extra CONTROL_AUDIT_COUNTERS
+        # values keyed by wire name, merged by each counter's mode: the
+        # service role's lease counters live on ServiceState (outside the
+        # worker pool this sampler walks), not on any worker
+        self.extra_control = extra_control
         self.registry = MetricRegistry()
         # tracer hookup for the trace-event drop/record gauges (optional)
         self.tracer = None
@@ -253,6 +259,15 @@ class BenchTelemetry:
             put("trace_events_total", tracer.num_recorded)
             put("trace_events_overwritten_total", tracer.num_overwritten)
         if manager is None:
+            # idle service (incl. after lease-orphan recovery dropped the
+            # pool): the service-lifetime lease counters must still show
+            if self.extra_control is not None:
+                extra = self.extra_control()
+                for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
+                    if key in extra:
+                        name = snake_case(key) \
+                            + ("" if mode == "max" else "_total")
+                        put(name, extra[key])
             reg.commit(up)
             return
         shared = manager.shared
@@ -291,6 +306,13 @@ class BenchTelemetry:
         put("tpu_dispatch_usec_total", tpu_dispatch)
         put("tpu_transfer_usec_total", tpu_usec)
         ctl_totals = merge_control_audit_counters(workers)
+        if self.extra_control is not None:
+            extra = self.extra_control()
+            for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
+                if key in extra:
+                    ctl_totals[key] = (max(ctl_totals[key], extra[key])
+                                       if mode == "max"
+                                       else ctl_totals[key] + extra[key])
         for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
             name = snake_case(key) + ("" if mode == "max" else "_total")
             put(name, ctl_totals[key])

@@ -112,6 +112,24 @@ class WorkersSharedData:
             self.cond.notify_all()
             return self.bench_uuid
 
+    def clear_bench_uuid(self) -> None:
+        """Forget the current master's run id. Used by the service-side
+        lease watchdog after orphan recovery (--svcleasesecs): the next
+        /startphase from any master must look like a fresh run, never a
+        duplicate-start of the orphaned one."""
+        with self.cond:
+            self.bench_uuid = ""
+            self.cond.notify_all()
+
+    def mark_partial_dataset(self) -> None:
+        """Latch the partial-dataset tolerance up front. A --resume run
+        whose journal shows an unfinished write phase re-runs it over
+        whatever the interrupted run left on disk — delete/overwrite of
+        missing entries is expected there, exactly like after an in-run
+        aborted write."""
+        with self.cond:
+            self.partial_dataset = True
+
     # -- worker side --------------------------------------------------------
 
     def wait_for_phase_change(self, last_uuid: str) -> "tuple[BenchPhase, str]":

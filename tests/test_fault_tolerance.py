@@ -172,6 +172,10 @@ def test_control_audit_counter_merge_modes():
     assert merged["SvcRetries"] == 7               # sum
     assert merged["SvcConsecRetriesHwm"] == 3      # max
     assert merged["SvcHeartbeatAgeHwmUsec"] == 8000  # max
+    # run-lifecycle lease counters (--svcleasesecs) joined the schema:
+    # workers without the attributes merge as 0 (old stubs stay valid)
+    assert merged["SvcLeaseExpiries"] == 0
+    assert merged["SvcLeaseAgeHwmUsec"] == 0
 
 
 # ---------------------------------------------------------------------------

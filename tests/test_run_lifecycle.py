@@ -1,0 +1,615 @@
+"""Chaos suite: crash-safe run lifecycle through real master/service paths.
+
+Covers the three coupled pieces of the lifecycle layer
+(docs/fault-tolerance.md "Run lifecycle"):
+
+- master liveness lease (--svcleasesecs): a SIGKILL'd master orphans its
+  service within the lease; the service logs ORPHANED, returns to idle,
+  and accepts a new run — whose JSON results carry the service-lifetime
+  SvcLeaseExpiries counter;
+- run journal (--journal) + resume (--resume): finished phases skip,
+  the first incomplete phase re-runs from scratch, fingerprint mismatch
+  is a hard error;
+- two-stage signal shutdown: the first SIGINT/SIGTERM writes the
+  journal's phase_interrupted record on the way out.
+
+Loopback only, short leases/timeouts (tier-1-safe); the `chaos` marker
+lets `-m 'not chaos'` skip the whole suite.
+"""
+
+import contextlib
+import fcntl
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+from elbencho_tpu.config.args import ConfigError, parse_cli
+from elbencho_tpu.journal import (RunJournal, config_fingerprint,
+                                  load_resume_plan)
+from elbencho_tpu.phases import BenchPhase
+from elbencho_tpu.testing.service_harness import (REPO_DIR, default_env,
+                                                  free_ports, wait_ready)
+
+pytestmark = pytest.mark.chaos
+
+
+def _cfg(extra=(), paths=("/tmp/_rl_x",)):
+    cfg, _ = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                        *extra, *paths])
+    cfg.derive(probe_paths=False)
+    return cfg
+
+
+def _master(args):
+    from elbencho_tpu.cli import main
+    return main(args + ["--nolive"])
+
+
+def _json_recs(path):
+    return [json.loads(ln) for ln in path.read_text().splitlines()]
+
+
+def _journal_recs(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# unit layer: fingerprint / journal replay
+# ---------------------------------------------------------------------------
+
+def test_config_fingerprint_ignores_observability_but_not_workload():
+    base = config_fingerprint(_cfg())
+    # observability/retry knobs must not invalidate a journal
+    same = config_fingerprint(_cfg(extra=[
+        "--jsonfile", "/tmp/_rl_r.json", "--journal", "/tmp/_rl_j.jsonl",
+        "--svcretries", "9", "--telemetry", "--lat"]))
+    assert same == base
+    # workload shape must
+    assert config_fingerprint(_cfg(extra=["-t", "2"])) != base
+    assert config_fingerprint(_cfg(extra=["-b", "1K"])) != base
+    assert config_fingerprint(_cfg(paths=("/tmp/_rl_other",))) != base
+    # path spelling must NOT: "data.bin" from /cwd == "/cwd/data.bin"
+    rel = os.path.relpath("/tmp/_rl_x")
+    assert config_fingerprint(_cfg(paths=(rel,))) == base
+
+
+def test_journal_replay_skips_finished_and_detects_partials(tmp_path):
+    cfg = _cfg()
+    path = str(tmp_path / "j.jsonl")
+    j = RunJournal(path, cfg)
+    j.run_start([BenchPhase.CREATEFILES, BenchPhase.READFILES,
+                 BenchPhase.DELETEFILES], iterations=1)
+    j.phase_start(0, 0, BenchPhase.CREATEFILES)
+    j.phase_finish(0, 0, BenchPhase.CREATEFILES,
+                   {"local": {"entries": 4, "bytes": 16384, "iops": 4,
+                              "elapsed_usec": 100}})
+    j.phase_start(0, 1, BenchPhase.READFILES)
+    j.phase_interrupted(0, 1, BenchPhase.READFILES, "KeyboardInterrupt")
+    j.close()
+    plan = load_resume_plan(path, cfg)
+    assert plan.finished == {(0, 0)}
+    assert not plan.run_complete
+    # an unfinished READ leaves no partial dataset
+    assert not plan.partial_dataset
+    # ...but an unfinished WRITE or DELETE does
+    j2 = RunJournal(path, cfg)
+    j2.phase_start(0, 2, BenchPhase.DELETEFILES)
+    j2.close()
+    assert load_resume_plan(path, cfg).partial_dataset
+    # terminal record wins
+    j3 = RunJournal(path, cfg)
+    j3.run_complete()
+    j3.close()
+    assert load_resume_plan(path, cfg).run_complete
+
+
+def test_journal_replay_hard_fails_on_mismatch_and_bad_files(tmp_path):
+    cfg = _cfg()
+    missing = str(tmp_path / "nope.jsonl")
+    with pytest.raises(ConfigError, match="not found"):
+        load_resume_plan(missing, cfg)
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(ConfigError, match="empty"):
+        load_resume_plan(str(empty), cfg)
+    path = str(tmp_path / "j.jsonl")
+    j = RunJournal(path, _cfg(extra=["-t", "2"]))  # different workload
+    j.run_start([BenchPhase.CREATEFILES], 1)
+    j.close()
+    with pytest.raises(ConfigError, match="fingerprint mismatch"):
+        load_resume_plan(path, cfg)
+
+
+def test_journal_tolerates_torn_final_line_only(tmp_path):
+    cfg = _cfg()
+    path = str(tmp_path / "j.jsonl")
+    j = RunJournal(path, cfg)
+    j.run_start([BenchPhase.CREATEFILES], 1)
+    j.phase_start(0, 0, BenchPhase.CREATEFILES)
+    j.phase_finish(0, 0, BenchPhase.CREATEFILES, {})
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"rec": "phase_sta')  # crash mid-append
+    plan = load_resume_plan(path, cfg)  # torn tail dropped
+    assert plan.finished == {(0, 0)}
+    # garbage in the MIDDLE is not a journal
+    lines = open(path).read().splitlines()
+    lines.insert(1, "NOT JSON")
+    open(path, "w").write("\n".join(lines) + "\n")
+    with pytest.raises(ConfigError, match="undecodable"):
+        load_resume_plan(path, cfg)
+
+
+# ---------------------------------------------------------------------------
+# unit layer: idempotent teardown + lease accounting + stale lock
+# ---------------------------------------------------------------------------
+
+class _FakeManager:
+    """WorkerManager stand-in counting teardown calls."""
+
+    def __init__(self, busy=True):
+        self.interrupts = 0
+        self.joins = 0
+        self.busy = busy
+        self.shared = types.SimpleNamespace(
+            request_interrupt=lambda: None,
+            clear_bench_uuid=lambda: None, bench_uuid="x",
+            current_phase=BenchPhase.CREATEFILES)
+
+    def all_workers_done(self):
+        return not self.busy
+
+    def interrupt_and_notify_workers(self):
+        self.interrupts += 1
+        time.sleep(0.01)  # widen the race window
+
+    def join_all_threads(self):
+        self.joins += 1
+
+
+def _service_state():
+    from elbencho_tpu.service.http_service import ServiceState
+    cfg, _ = parse_cli(["--service", "--foreground"])
+    cfg.derive(probe_paths=False)
+    return ServiceState(cfg)
+
+
+def test_teardown_workers_is_single_shot_under_concurrency():
+    state = _service_state()
+    mgr = _FakeManager()
+    state.manager = mgr
+    threads = [threading.Thread(target=state.teardown_workers)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert mgr.interrupts == 1, "teardown must run exactly once"
+    assert mgr.joins == 1
+    assert state.manager is None
+    state.teardown_workers()  # idempotent afterwards
+    assert mgr.joins == 1
+    # interrupt() after teardown is a safe no-op
+    state.interrupt()
+
+
+def test_lease_touch_tracks_age_hwm_and_release_disarms():
+    state = _service_state()
+    state._arm_lease(5)
+    state._lease_last_contact -= 0.05  # pretend 50ms since last contact
+    state.touch_lease()
+    assert state.lease_age_hwm_usec >= 40_000
+    assert state.lease_expiries == 0
+    state.release_lease()
+    assert state._lease_secs == 0
+    state._lease_stop.set()
+
+
+def test_orphan_recover_interrupts_clears_uuid_and_counts():
+    state = _service_state()
+    mgr = _FakeManager()
+    cleared = []
+    mgr.shared.clear_bench_uuid = lambda: cleared.append(True)
+    state.manager = mgr
+    state._arm_lease(3)
+    state._orphan_recover(age=3.5, secs=3)
+    # interrupt() notifies once, teardown_workers() notifies again before
+    # the single join — what matters is exactly ONE teardown
+    assert mgr.interrupts >= 1
+    assert mgr.joins == 1
+    assert state.manager is None
+    assert cleared, "orphan recovery must clear the bench UUID"
+    assert state.lease_expiries == 1
+    assert state.lease_age_hwm_usec >= 3_500_000
+    assert state._lease_secs == 0, "disarmed until the next /preparephase"
+    # counters surface through the service status/result replies
+    assert state.status()["SvcLeaseExpiries"] == 1
+    assert state.bench_result()["SvcLeaseExpiries"] == 1
+    state._lease_stop.set()
+
+
+def test_lease_clock_only_runs_while_a_phase_is_active():
+    """The expiry clock pauses on an idle-at-barrier host: a straggler
+    sibling (or --phasedelay) legitimately silences the master here, and
+    an idle pool is not the hazard the lease exists to stop."""
+    state = _service_state()
+    mgr = _FakeManager(busy=False)  # workers done, waiting at the barrier
+    state.manager = mgr
+    state._arm_lease(1)
+    state._lease_last_contact -= 10  # way past the lease
+    time.sleep(1.5)  # watchdog thread runs; idle => clock keeps resetting
+    assert state.lease_expiries == 0
+    assert state.manager is mgr, "idle pool must never be orphaned"
+    # the moment the phase is live again, silence counts
+    mgr.busy = True
+    state._lease_last_contact -= 10
+    deadline = time.monotonic() + 5
+    while state.manager is not None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert state.manager is None, "busy pool with expired lease orphans"
+    assert state.lease_expiries == 1
+    state._lease_stop.set()
+
+
+def test_fresh_journal_refuses_incomplete_and_truncates_complete(
+        tmp_path):
+    cfg = _cfg()
+    path = str(tmp_path / "j.jsonl")
+    j = RunJournal(path, cfg)
+    j.start_fresh([BenchPhase.CREATEFILES], 1)
+    j.phase_start(0, 0, BenchPhase.CREATEFILES)
+    j.close()
+    # incomplete journal: a fresh run must refuse (it is a restart point)
+    with pytest.raises(ConfigError, match="INCOMPLETE"):
+        RunJournal(path, cfg).start_fresh([BenchPhase.CREATEFILES], 1)
+    # complete journal: truncated, not appended — a later --resume must
+    # only ever see ONE run's records
+    j2 = RunJournal(path, cfg)
+    j2.phase_finish(0, 0, BenchPhase.CREATEFILES, {})
+    j2.run_complete()
+    j2.close()
+    j3 = RunJournal(path, cfg)
+    j3.start_fresh([BenchPhase.CREATEFILES], 1)
+    j3.close()
+    recs = _journal_recs(path)
+    assert [r["rec"] for r in recs] == ["run_start"]
+    plan = load_resume_plan(path, cfg)
+    assert not plan.run_complete and not plan.finished
+
+
+def test_claim_instance_lock_reclaims_dead_pid(tmp_path, capsys):
+    from elbencho_tpu.service.http_service import (claim_instance_lock,
+                                                   read_lock_pid)
+    lock_path = str(tmp_path / "svc.log.lock")
+    # a pid that is certainly dead: a reaped child
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    with open(lock_path, "w") as f:
+        f.write(f"{child.pid}\n")
+    fd = claim_instance_lock(lock_path)  # must NOT refuse
+    try:
+        assert read_lock_pid(fd) == os.getpid()
+    finally:
+        os.close(fd)
+
+
+def test_claim_instance_lock_refuses_live_holder(tmp_path):
+    from elbencho_tpu.service.http_service import claim_instance_lock
+    lock_path = str(tmp_path / "svc.log.lock")
+    holder = os.open(lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        os.write(holder, f"{os.getpid()}\n".encode())
+        with pytest.raises(SystemExit):
+            claim_instance_lock(lock_path)
+    finally:
+        os.close(holder)
+
+
+def test_control_audit_schema_gained_lease_counters_appended():
+    """New wire/JSON keys append to CONTROL_AUDIT_COUNTERS — existing
+    entries keep their positions (consumers rely on the order)."""
+    from elbencho_tpu.service.fault_tolerance import (
+        CONTROL_AUDIT_COUNTERS, merge_control_audit_counters)
+    keys = [key for _attr, key, _mode in CONTROL_AUDIT_COUNTERS]
+    assert keys[:3] == ["SvcRetries", "SvcConsecRetriesHwm",
+                        "SvcHeartbeatAgeHwmUsec"]
+    assert keys[3:] == ["SvcLeaseExpiries", "SvcLeaseAgeHwmUsec"]
+    w1 = types.SimpleNamespace(svc_lease_expiries=2,
+                               svc_lease_age_hwm_usec=5000)
+    w2 = types.SimpleNamespace(svc_lease_expiries=1,
+                               svc_lease_age_hwm_usec=9000)
+    merged = merge_control_audit_counters([w1, w2])
+    assert merged["SvcLeaseExpiries"] == 3       # sum
+    assert merged["SvcLeaseAgeHwmUsec"] == 9000  # max
+
+
+def test_abort_cleanup_removes_only_headeronly_live_files(tmp_path):
+    from elbencho_tpu.stats.statistics import Statistics
+    csv_path = tmp_path / "live.csv"
+    json_path = tmp_path / "live.json"
+    csv_path.write_text("ISODate,Label,Phase,Seconds,Entries,Bytes,IOPS\n")
+    json_path.write_text("")
+    kept = tmp_path / "kept.csv"
+    kept.write_text("ISODate,Label\n2026-01-01,x\n")  # has a data row
+    cfg = types.SimpleNamespace(live_csv_file_path=str(csv_path),
+                                live_json_file_path=str(json_path))
+    stats = Statistics.__new__(Statistics)
+    stats.cfg = cfg
+    stats._live_csv_fh = stats._live_json_fh = None
+    stats._live_rows = 0
+    stats.abort_cleanup()
+    assert not csv_path.exists(), "header-only live CSV must be removed"
+    assert not json_path.exists(), "empty live JSON must be removed"
+    cfg.live_csv_file_path = str(kept)
+    cfg.live_json_file_path = ""
+    stats._live_csv_fh = stats._live_json_fh = None
+    stats.abort_cleanup()
+    assert kept.exists(), "a live file with data rows must survive"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: master killed mid-phase => services self-recover within the
+# lease, log ORPHANED, and accept a new run (whose results carry the
+# service-lifetime lease counters)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _logged_service(port, env):
+    """One --service --foreground subprocess whose log file WE keep, so
+    the ORPHANED line is assertable (the shared harness discards logs
+    of successful runs)."""
+    log_path = f"/tmp/elbencho-rl-svc-{port}.log"
+    with open(log_path, "wb") as log_fh:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "--service",
+             "--foreground", "--port", str(port)],
+            env=env, cwd=REPO_DIR, stdout=log_fh,
+            stderr=subprocess.STDOUT)
+        try:
+            wait_ready(port)
+            yield proc, log_path
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            with contextlib.suppress(OSError):
+                os.unlink(log_path)
+
+
+def _status(port, timeout=2):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_for(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_master_crash_orphans_service_and_host_is_reusable(tmp_path):
+    lease_secs = 2
+    env = default_env()
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    port = free_ports(1)[0]
+    with _logged_service(port, env) as (svc, log_path):
+        # master as a SUBPROCESS so it can be SIGKILL'd mid-phase
+        master = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "-w", "-s", "64K",
+             "-b", "4K", "--infloop", "--timelimit", "60", "--nolive",
+             "--hosts", f"127.0.0.1:{port}",
+             "--svcleasesecs", str(lease_secs), "--svcupint", "100",
+             str(tmp_path / "data.bin")],
+            env=env, cwd=REPO_DIR, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_for(lambda: (
+                _status(port).get("PhaseCode")
+                == int(BenchPhase.CREATEFILES)
+                and _status(port).get("NumBytesDone", 0) > 0),
+                timeout=30, what="write phase live on the service")
+            master.kill()  # SIGKILL: no goodbye /interruptphase
+            master.wait()
+            t0 = time.monotonic()
+            _wait_for(lambda: (_status(port).get("PhaseCode")
+                               == int(BenchPhase.IDLE)),
+                      timeout=lease_secs + 10,
+                      what="service self-recovery to IDLE")
+            recovery = time.monotonic() - t0
+            # recovered via the lease, not via some 30s+ backstop
+            assert recovery < lease_secs + 8, \
+                f"recovery took {recovery:.1f}s"
+            with open(log_path) as f:
+                assert "ORPHANED" in f.read(), \
+                    "service must log the orphan recovery"
+            assert svc.poll() is None, "service process must stay alive"
+            # the host is immediately reusable: a NEW run on the same
+            # service completes, and its records expose the lease expiry
+            # (service-lifetime counter) through the wire merge
+            jsonfile = tmp_path / "res.json"
+            rc = _master(["-w", "-t", "1", "-s", "16K", "-b", "16K",
+                          "--hosts", f"127.0.0.1:{port}",
+                          "--jsonfile", str(jsonfile),
+                          str(tmp_path / "data2.bin")])
+            assert rc == 0, "orphaned service must accept a new run"
+            recs = _json_recs(jsonfile)
+            assert recs and all(
+                r.get("SvcLeaseExpiries", 0) >= 1 for r in recs), \
+                "lease expiry must surface in the new run's records"
+            assert all(r.get("SvcLeaseAgeHwmUsec", 0)
+                       >= lease_secs * 1_000_000 for r in recs)
+        finally:
+            if master.poll() is None:
+                master.kill()
+                master.wait()
+
+
+def test_lease_unset_keeps_service_running_after_master_kill(tmp_path):
+    """Default (--svcleasesecs 0) parity: a killed master leaves the
+    service mid-phase — no watchdog, no ORPHANED, byte-for-byte the old
+    behavior."""
+    env = default_env()
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    port = free_ports(1)[0]
+    with _logged_service(port, env) as (svc, log_path):
+        master = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "-w", "-s", "64K",
+             "-b", "4K", "--infloop", "--timelimit", "60", "--nolive",
+             "--hosts", f"127.0.0.1:{port}", "--svcupint", "100",
+             str(tmp_path / "data.bin")],
+            env=env, cwd=REPO_DIR, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_for(lambda: (
+                _status(port).get("PhaseCode")
+                == int(BenchPhase.CREATEFILES)
+                and _status(port).get("NumBytesDone", 0) > 0),
+                timeout=30, what="write phase live on the service")
+            master.kill()
+            master.wait()
+            time.sleep(4)  # longer than the other test's whole lease
+            st = _status(port)
+            assert st.get("PhaseCode") == int(BenchPhase.CREATEFILES), \
+                "without a lease the phase must keep running"
+            assert st.get("SvcLeaseExpiries", 0) == 0
+            with open(log_path) as f:
+                assert "ORPHANED" not in f.read()
+        finally:
+            if master.poll() is None:
+                master.kill()
+                master.wait()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: journaled runs resume; fingerprint mismatch hard-fails
+# ---------------------------------------------------------------------------
+
+def _local_args(tmp_path, journal, jsonfile, extra=()):
+    bench = tmp_path / "bench"
+    bench.mkdir(exist_ok=True)
+    return ["-w", "-r", "-F", "-d", "-t", "2", "-n", "1", "-N", "2",
+            "-s", "4K", "-b", "4K", "--journal", str(journal),
+            "--jsonfile", str(jsonfile), *extra, str(bench)]
+
+
+def test_resume_executes_only_unfinished_phases(tmp_path):
+    journal = tmp_path / "j.jsonl"
+    res1 = tmp_path / "res1.json"
+    rc = _master(_local_args(tmp_path, journal, res1))
+    assert rc == 0
+    recs = _journal_recs(journal)
+    assert [r["rec"] for r in recs] == [
+        "run_start", "phase_start", "phase_finish", "phase_start",
+        "phase_finish", "phase_start", "phase_finish", "phase_start",
+        "phase_finish", "run_complete"]
+    # simulate a crash between READ finish and RMFILES finish: drop the
+    # RMFILES finish + run_complete, keep its phase_start (k = 3 of 4)
+    lines = journal.read_text().splitlines()
+    journal.write_text("\n".join(lines[:-2]) + "\n")
+    res2 = tmp_path / "res2.json"
+    rc = _master(_local_args(tmp_path, journal, res2, extra=["--resume"]))
+    assert rc == 0
+    recs2 = _json_recs(res2)
+    # only the incomplete RMFILES re-ran (MKDIRS/WRITE/READ skipped), and
+    # every record is marked Resumed with the skip count
+    assert [r["Phase"] for r in recs2] == ["RMFILES"]
+    assert all(r["Resumed"] == 3 for r in recs2)
+    # the journal now ends with the re-run's records + run_complete
+    tail = _journal_recs(journal)
+    assert tail[-1]["rec"] == "run_complete"
+    assert tail[-2]["rec"] == "phase_finish"
+    assert tail[-2]["name"] == "RMFILES"
+    # resuming a COMPLETE journal is a no-op success
+    rc = _master(_local_args(tmp_path, journal, res2, extra=["--resume"]))
+    assert rc == 0
+    assert [r["Phase"] for r in _json_recs(res2)] == ["RMFILES"], \
+        "no phases may re-run against a run_complete journal"
+
+
+def test_resume_rejects_config_fingerprint_mismatch(tmp_path):
+    journal = tmp_path / "j.jsonl"
+    res1 = tmp_path / "res1.json"
+    assert _master(_local_args(tmp_path, journal, res1)) == 0
+    lines = journal.read_text().splitlines()
+    journal.write_text("\n".join(lines[:-2]) + "\n")  # incomplete again
+    # same journal, different workload geometry => hard error, nothing runs
+    res2 = tmp_path / "res2.json"
+    args = _local_args(tmp_path, journal, res2, extra=["--resume"])
+    args[args.index("-N") + 1] = "8"  # 2 -> 8 files per dir
+    rc = _master(args)
+    assert rc != 0, "fingerprint mismatch must fail the run"
+    assert not res2.exists(), "no phase may run on a mismatched resume"
+
+
+def test_first_signal_writes_interrupted_journal_record(tmp_path):
+    """SIGTERM (stage one of the two-stage shutdown) interrupts the run
+    gracefully and the journal records the cut phase."""
+    env = default_env()
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELBENCHO_TPU_NO_DEFAULT_RESFILES"] = "1"
+    journal = tmp_path / "j.jsonl"
+    master = subprocess.Popen(
+        [sys.executable, "-m", "elbencho_tpu", "-w", "-s", "64K",
+         "-b", "4K", "--infloop", "--timelimit", "60", "--nolive",
+         "--journal", str(journal), str(tmp_path / "data.bin")],
+        env=env, cwd=REPO_DIR, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        _wait_for(lambda: journal.exists() and any(
+            r["rec"] == "phase_start" for r in _journal_recs(journal)),
+            timeout=30, what="journaled write phase start")
+        time.sleep(0.3)  # let some I/O happen
+        master.send_signal(signal.SIGTERM)
+        rc = master.wait(timeout=30)
+        assert rc == 3, f"graceful-interrupt exit code expected, got {rc}"
+        recs = _journal_recs(journal)
+        kinds = [r["rec"] for r in recs]
+        assert "phase_interrupted" in kinds
+        assert kinds[-1] != "run_complete"
+        cut = next(r for r in recs if r["rec"] == "phase_interrupted")
+        assert cut["name"] == "WRITE"
+    finally:
+        if master.poll() is None:
+            master.kill()
+            master.wait()
+
+
+def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
+    """LeaseExp/Resumed append AFTER every pre-existing column (never
+    reordered) and a resumed record triggers the RESUMED banner."""
+    import subprocess as sp
+    rec = {"Phase": "WRITE", "EntriesLast": 1, "SvcLeaseExpiries": 2,
+           "Resumed": 3}
+    f = tmp_path / "r.json"
+    f.write_text(json.dumps(rec) + "\n")
+    res = sp.run([sys.executable,
+                  os.path.join(REPO_DIR, "tools",
+                               "elbencho-tpu-summarize-json"),
+                  str(f), "--csv"], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    header = res.stdout.splitlines()[0].split(",")
+    assert header[-2:] == ["LeaseExp", "Resumed"]
+    assert header.index("Stalls") < header.index("LeaseExp")
+    row = res.stdout.splitlines()[1].split(",")
+    assert row[-2:] == ["2", "3"]
+    assert "RESUMED" in res.stderr
