@@ -3,7 +3,8 @@
 # engine lives in csrc/)
 
 .PHONY: all native native-tsan native-asan tsan asan check test \
-	test-fast test-chaos test-examples fuzz bench docs clean deb rpm docker
+	test-fast test-chaos test-scale test-examples fuzz bench docs clean \
+	deb rpm docker
 
 all: native
 
@@ -82,7 +83,15 @@ test-fast: native
 test-chaos: native
 	python -m pytest tests/test_fault_tolerance.py \
 		tests/test_io_fault_tolerance.py tests/test_run_lifecycle.py \
-		-q -m chaos
+		tests/test_svc_stream.py -q -m chaos
+
+# control-plane scale gate: a simulated 64-host in-process loopback
+# fleet proving --svcstream --svcfanout holds O(fanout) master
+# connections and cuts request count / per-tick control-plane bytes
+# >= 10x vs polling (pytest marker `scale`; docs/control-plane.md)
+test-scale:
+	env JAX_PLATFORMS=cpu ELBENCHO_TPU_NO_NATIVE=1 \
+		python -m pytest tests/test_stream_scale.py -q -m scale
 
 # end-to-end example suite against real resources (loopdevs, services)
 test-examples: native

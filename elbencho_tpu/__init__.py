@@ -24,4 +24,4 @@ __version__ = "0.1.0"
 
 # Messaging protocol version for master<->service compatibility checks.
 # (Reference: HTTP_PROTOCOLVERSION, source/Common.h:91 — exact match required.)
-HTTP_PROTOCOL_VERSION = "tpu-0.2"  # 0.2: explicitness keys + service-side default recompute
+HTTP_PROTOCOL_VERSION = "tpu-0.3"  # 0.3: /livestream streaming control plane

@@ -314,6 +314,23 @@ FLAG_DEFS = [
      "interrupts its workers, logs ORPHANED, and returns to idle so the "
      "host is immediately reusable by a new run (0 = off, the default; "
      "must exceed --svcupint when set)"),
+    ("svcstream", None, "svc_stream", "bool", False, "dist",
+     "Replace master-mode /status polling with one persistent "
+     "server-push live-stats stream per attached host (chunked HTTP, "
+     "delta-encoded frames, sequence-checked with full-snapshot "
+     "resync). Falls back LOUDLY to per-request polling per host when "
+     "a stream cannot serve it (stream -> poll, like the data path's "
+     "uring -> AIO -> Python ladder). Default off = per-request "
+     "polling parity"),
+    ("svcfanout", None, "svc_fanout", "int", 0, "dist",
+     "Arrange the service hosts into an aggregation tree with this "
+     "fanout: the master streams from only N root services; interior "
+     "services aggregate their subtree's live stats with the wire "
+     "merge rules (sum/MAX) before forwarding, so the master holds "
+     "O(fanout) connections instead of O(hosts). Subtree failures "
+     "fall back to direct attachment. 0 = flat (every host attached "
+     "directly). Requires --svcstream; --interrupt/--quit also walk "
+     "the tree so teardown is O(fanout)"),
     ("rotatehosts", None, "rotate_hosts_num", "int", 0, "dist",
      "Rotate hosts list by this many positions between phases"),
     ("datasetthreads", None, "num_dataset_threads_override", "int", 0, "dist",
@@ -1304,6 +1321,20 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--svctolerant is incompatible with --netbench (the "
                 "client/server topology cannot lose hosts mid-run)")
+        if self.svc_fanout < 0:
+            raise ConfigError("--svcfanout must be >= 0")
+        if self.svc_fanout and not (self.svc_stream or self.quit_services
+                                    or self.interrupt_services):
+            raise ConfigError(
+                "--svcfanout shapes the --svcstream aggregation tree "
+                "(or the --interrupt/--quit fan-out) — it does nothing "
+                "for the polling control plane")
+        # NOTE: per-host stream state is keyed by host label; duplicate
+        # --hosts entries are already rejected for everyone at derive()
+        if self.svc_stream and self.run_netbench:
+            raise ConfigError(
+                "--svcstream is not supported with netbench phases "
+                "(the client/server topology polls its own cadence)")
         if self.svc_lease_secs < 0:
             raise ConfigError("--svcleasesecs must be >= 0")
         if self.svc_lease_secs \
@@ -1419,6 +1450,11 @@ class BenchConfig(BenchConfigBase):
         # trip host-count validation against the stripped hosts list)
         d["svc_tolerant_hosts"] = 0
         d["svc_stalled_secs"] = 0
+        # the streaming plane is master-side transport; services learn
+        # their tree role per /livestream request (Subtree/Fanout params),
+        # never from the config wire
+        d["svc_stream"] = False
+        d["svc_fanout"] = 0
         # result files are written by the master only (the reference never
         # serializes resFilePath* to services)
         d["res_file_path"] = d["csv_file_path"] = d["json_file_path"] = ""

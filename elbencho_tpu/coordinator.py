@@ -46,8 +46,11 @@ class Coordinator:
             return HTTPService(cfg).start()
         if cfg.quit_services or cfg.interrupt_services:
             from .service.remote_worker import send_interrupt_to_hosts
+            # --svcfanout: interrupt/quit walk the aggregation tree, so
+            # tearing down a large fleet is O(fanout) requests here too
             send_interrupt_to_hosts(cfg.hosts, cfg.service_port,
-                                    quit=cfg.quit_services)
+                                    quit=cfg.quit_services,
+                                    fanout=cfg.svc_fanout)
             return 0
         return self._run_master_or_local()
 

@@ -70,6 +70,9 @@ FINGERPRINT_EXCLUDE = frozenset({
     "svc_num_retries", "svc_retry_budget_secs", "svc_stalled_secs",
     "svc_tolerant_hosts", "svc_lease_secs", "svc_update_interval_ms",
     "svc_wait_secs", "svc_password_file",
+    # streaming control plane: pure transport (polling parity when off),
+    # so a --resume may freely flip stream/tree shape
+    "svc_stream", "svc_fanout",
     # role/oneshot flags a resumed master run never carries differently
     "run_as_service", "run_service_in_foreground", "quit_services",
     "interrupt_services", "do_dry_run", "config_file_path",

@@ -122,6 +122,25 @@ CONTROL_AUDIT_COUNTERS = (
     # the host. Appended entries, never reordered (wire/JSON schema).
     ("svc_lease_expiries", "SvcLeaseExpiries", "sum"),
     ("svc_lease_age_hwm_usec", "SvcLeaseAgeHwmUsec", "max"),
+    # streaming control plane (--svcstream/--svcfanout), MASTER-observed:
+    # the polling-vs-streaming A/B evidence. SvcRequests counts every
+    # HTTP request the master sent a host this phase (poll mode: O(ticks)
+    # per host; stream mode: the per-phase setup handful); SvcCtlBytes is
+    # every control-plane payload byte the master received (poll replies
+    # + stream frames); the Svc{StreamFrames,StreamBytes,DeltaSavedBytes}
+    # trio measures the stream itself (DeltaSaved = full-snapshot size
+    # minus delta size, summed — what delta encoding kept off the wire);
+    # SvcAggDepthHwm is the deepest aggregation tree observed in frames
+    # (flat stream = 1, polling = 0); SvcConnHwm samples the master's
+    # open control-plane sockets (streams + keep-alive request conns) —
+    # the O(fanout)-connections proof. Appended entries, never reordered.
+    ("svc_requests", "SvcRequests", "sum"),
+    ("svc_ctl_bytes", "SvcCtlBytes", "sum"),
+    ("svc_stream_frames", "SvcStreamFrames", "sum"),
+    ("svc_stream_bytes", "SvcStreamBytes", "sum"),
+    ("svc_delta_saved_bytes", "SvcDeltaSavedBytes", "sum"),
+    ("svc_agg_depth_hwm", "SvcAggDepthHwm", "max"),
+    ("svc_conn_hwm", "SvcConnHwm", "max"),
 )
 
 

@@ -1,4 +1,4 @@
-"""Service role: HTTP server exposing the 8 control-plane endpoints.
+"""Service role: HTTP server exposing the control-plane endpoints.
 
 Reference: source/HTTPServiceSWS.{h,cpp} + HTTPService.{h,cpp} — a
 deliberately **single-threaded** HTTP server (invariant documented at
@@ -8,6 +8,14 @@ with endpoints /info /protocolversion /status /benchresult /preparefile
 daemonization with logfile + instance lock (HTTPService.cpp:32-110),
 duplicate /startphase idempotency via bench-UUID compare (:543-554), and
 strict protocol-version handshake (:280-293).
+
+Streaming control plane (ours; docs/control-plane.md): the server is a
+ThreadingHTTPServer so the long-lived `/livestream` push connections
+(--svcstream) and keep-alive request connections cannot block each
+other — but every OTHER route still runs under one route_lock, which
+preserves the reference's no-concurrent-pool-mutation invariant exactly
+(requests serialize as if single-threaded; only the read-only stream
+sessions run beside them).
 
 The control plane rides DCN between TPU-VM hosts; benchmark traffic never
 crosses it (SURVEY.md section 2.3).
@@ -24,7 +32,7 @@ import sys
 import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import HTTP_PROTOCOL_VERSION, __version__
 from ..config.args import BenchConfig, ConfigError
@@ -51,12 +59,21 @@ class ServiceState:
         self.pw_hash = ""
         if base_cfg.svc_password_file:
             self.pw_hash = proto.read_pw_file(base_cfg.svc_password_file)
-        # worker-pool mutation guard: the server itself is single-threaded,
-        # but the lease watchdog thread (--svcleasesecs) may tear down the
-        # pool concurrently with an HTTP request — RLock so teardown can
-        # nest under prepare/orphan recovery (single-shot semantics live
-        # in teardown_workers itself)
+        # worker-pool mutation guard: request handling is serialized by
+        # route_lock, but the lease watchdog thread (--svcleasesecs) may
+        # tear down the pool concurrently with an HTTP request — RLock so
+        # teardown can nest under prepare/orphan recovery (single-shot
+        # semantics live in teardown_workers itself)
         self._teardown_lock = threading.RLock()
+        # route serialization: the server is threaded (so /livestream
+        # push sessions and parked keep-alive connections cannot block
+        # the control plane), but all stateful routes run one at a time
+        # under this lock — the reference's single-threaded invariant,
+        # kept by construction
+        self.route_lock = threading.Lock()
+        # streaming control plane: session shutdown signal (stream
+        # sessions are read-only and run OUTSIDE route_lock)
+        self.stream_shutdown = threading.Event()
         # master liveness lease (--svcleasesecs): armed per /preparephase,
         # renewed by every authorized master request, watched by a daemon
         # thread. Counters are SERVICE-lifetime (they survive pool
@@ -177,6 +194,31 @@ class ServiceState:
         a crashed master."""
         self._lease_secs = 0
 
+    def cheap_live_signature(self) -> tuple:
+        """Completion-relevant snapshot for the stream session's tick
+        loop: plain attribute reads (GIL-safe like every live counter),
+        no stats walk, no JSON — cheap enough for dozens of concurrent
+        25ms tickers."""
+        manager = self.manager
+        if manager is None:
+            return (None,)
+        shared = manager.shared
+        return (shared.bench_uuid, int(shared.current_phase),
+                shared.num_workers_done,
+                shared.num_workers_done_with_error)
+
+    def stream_pushed(self, bench_id: str) -> None:
+        """Route-aware lease renewal for the streaming plane: a pushed
+        frame renews the lease ONLY when the stream was opened with the
+        run's CURRENT bench UUID — the stream analogue of the /status
+        rule (observer streams can never keep an orphaned service alive,
+        and a stream that dies mid-phase stops renewing, so orphan
+        recovery still fires)."""
+        manager = self.manager
+        uuid = manager.shared.bench_uuid if manager is not None else ""
+        if bench_id and uuid and bench_id == uuid:
+            self.touch_lease()
+
     def _arm_lease(self, lease_secs: int) -> None:
         self._lease_last_contact = time.monotonic()
         self._lease_secs = max(lease_secs, 0)
@@ -246,7 +288,9 @@ class ServiceState:
         shutil.rmtree(d, ignore_errors=True)
 
     def close(self) -> None:
-        """Service shutdown: stop the lease watchdog, drop the pool."""
+        """Service shutdown: stop the lease watchdog, end every live
+        stream session, drop the pool."""
+        self.stream_shutdown.set()
         self._lease_stop.set()
         if self._lease_thread is not None:
             self._lease_thread.join(timeout=5)
@@ -393,6 +437,22 @@ def _make_handler(state: ServiceState, server_holder: dict):
             route = urllib.parse.urlparse(self.path).path
             if not self._check_auth(params):
                 return
+            if route == proto.PATH_LIVE_STREAM:
+                # the server-push stream session (--svcstream) blocks for
+                # the connection's lifetime and only READS benchmark
+                # state — it runs beside the lock-serialized routes; its
+                # lease renewal is per-push (ServiceState.stream_pushed)
+                from .stream import StreamSession
+                try:
+                    StreamSession(state, self, params,
+                                  state.base_cfg.service_port).serve()
+                except Exception as err:  # noqa: BLE001 - log, drop conn
+                    logger.log_error(f"live stream session failed: {err}")
+                return
+            with state.route_lock:
+                self._do_get_locked(route, params)
+
+        def _do_get_locked(self, route, params):
             self._touch_lease_for(route, params)
             try:
                 if route == proto.PATH_INFO:
@@ -416,6 +476,11 @@ def _make_handler(state: ServiceState, server_holder: dict):
                         params.get(proto.KEY_BENCH_ID, ""))
                     self._reply(code, {"Message": msg})
                 elif route == proto.PATH_INTERRUPT_PHASE:
+                    # O(fanout) teardown: forward to this node's subtree
+                    # children FIRST (bounded, best-effort) so a --quit
+                    # that shuts us down cannot strand the tree below us
+                    from .stream import forward_interrupt
+                    forward_interrupt(state, params)
                     # a deliberate interrupt is the master LETTING GO —
                     # never an expiry, so disarm before the workers stop
                     state.release_lease()
@@ -439,9 +504,13 @@ def _make_handler(state: ServiceState, server_holder: dict):
             route = urllib.parse.urlparse(self.path).path
             if not self._check_auth(params):
                 return
-            self._touch_lease_for(route, params)
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) if length else b""
+            with state.route_lock:
+                self._do_post_locked(route, params, body)
+
+        def _do_post_locked(self, route, params, body):
+            self._touch_lease_for(route, params)
             try:
                 if route == proto.PATH_PREPARE_PHASE:
                     reply = state.prepare_phase(json.loads(body))
@@ -469,6 +538,23 @@ def _make_handler(state: ServiceState, server_holder: dict):
     return Handler
 
 
+def create_service_server(cfg: BenchConfig, bind_host: str = "0.0.0.0"
+                          ) -> "tuple[ThreadingHTTPServer, ServiceState, dict]":
+    """Build the (server, state, shutdown-holder) triple one service
+    instance runs on. Shared by HTTPService.start and the in-process
+    fleet harness (testing/service_harness.in_process_services) that the
+    scale suite spins 64+ of inside one test process. Threaded so stream
+    sessions cannot block the request routes; daemon threads so a live
+    stream can never hang shutdown."""
+    state = ServiceState(cfg)
+    holder = {"shutdown": False}
+    handler = _make_handler(state, holder)
+    server = ThreadingHTTPServer((bind_host, cfg.service_port), handler)
+    server.daemon_threads = True
+    server.timeout = 0.5
+    return server, state, holder
+
+
 class HTTPService:
     """Service-role entry (reference: Coordinator::main :42-62 +
     HTTPService::startServer)."""
@@ -481,16 +567,12 @@ class HTTPService:
         logger.enable_error_history(True)
         if not cfg.run_service_in_foreground:
             self._daemonize()
-        state = ServiceState(cfg)
-        holder = {"shutdown": False}
-        handler = _make_handler(state, holder)
         try:
-            server = HTTPServer(("0.0.0.0", cfg.service_port), handler)
+            server, state, holder = create_service_server(cfg)
         except OSError as err:
             print(f"ERROR: cannot bind service port {cfg.service_port}: "
                   f"{err}", file=sys.stderr)
             return 1
-        server.timeout = 0.5
         logger.log(0, f"elbencho-tpu service listening on port "
                       f"{cfg.service_port}")
         try:

@@ -21,6 +21,10 @@ PATH_INTERRUPT_PHASE = "/interruptphase"
 # telemetry extension (ours; no reference equivalent): Prometheus
 # text-format metrics piggybacked onto the service route table
 PATH_METRICS = "/metrics"
+# streaming control plane (ours; no reference equivalent): server-push
+# live-stats stream of delta-encoded ndjson frames (--svcstream), also
+# the parent->child attachment point of the --svcfanout aggregation tree
+PATH_LIVE_STREAM = "/livestream"
 
 # transferred parameter keys (reference: XFER_*, Common.h:251-298)
 KEY_PROTOCOL_VERSION = "ProtocolVersion"
@@ -45,6 +49,16 @@ KEY_INTERRUPT_QUIT = "quit"
 KEY_SVC_LEASE_SECS = "SvcLeaseSecs"
 KEY_SVC_LEASE_EXPIRIES = "SvcLeaseExpiries"
 KEY_SVC_LEASE_AGE_HWM = "SvcLeaseAgeHwmUsec"
+# streaming control plane (--svcstream/--svcfanout): /livestream query
+# params — desired push cadence, tree fanout, the comma-separated host
+# subtree this node aggregates, and the resync marker a consumer sets
+# when it reconnects after a missed/garbled frame (the first frame of
+# any stream is a full snapshot; Resync makes the intent auditable).
+# /interruptphase reuses Subtree/Fanout for O(fanout) teardown fan-out.
+KEY_STREAM_INTERVAL_MS = "IntervalMs"
+KEY_STREAM_FANOUT = "Fanout"
+KEY_STREAM_SUBTREE = "Subtree"
+KEY_STREAM_RESYNC = "Resync"
 
 
 def make_pw_hash(secret: str) -> str:

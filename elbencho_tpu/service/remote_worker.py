@@ -41,6 +41,7 @@ from .fault_tolerance import (ConnectFailedError, GarbageReplyError,
                               RetryBudget, RetryPolicy,
                               TRANSIENT_EXCEPTIONS, TRANSIENT_HTTP_STATUSES,
                               is_connect_level_error, is_transient_error)
+from .stream import StreamDetachedError, plan_tree
 
 DEFAULT_PORT = 1611
 CONNECT_TIMEOUT_SECS = 10
@@ -63,11 +64,29 @@ def split_host_port(host: str, default_port: int = DEFAULT_PORT
 class ServiceClient:
     """HTTP/JSON client for one service host with transient-failure
     retries (shared idiom with the S3 data plane's retry strategy,
-    s3_tk.S3Client.request)."""
+    s3_tk.S3Client.request).
+
+    Streaming-control-plane core (docs/control-plane.md): ONE persistent
+    keep-alive connection per host, reused across requests with a
+    transparent one-shot reconnect when a parked connection turns out
+    stale (the service times idle connections out) — per-request
+    connection churn used to cost a TCP handshake per /status tick per
+    host. `open_stream` opens the separate long-lived /livestream
+    connection. The class-level `open_connections` gauge counts every
+    control-plane socket this process believes open; the master samples
+    it into the SvcConnHwm audit counter (the O(fanout) proof)."""
+
+    #: open MASTER-side control-plane sockets process-wide (requests +
+    #: streams). Interior-node clients (a service's child aggregators,
+    #: interrupt forwarding) opt out via gauge=False: their sockets live
+    #: on the service hosts and must not pollute the master's SvcConnHwm
+    #: — which also keeps the in-process test fleet honest.
+    open_connections = 0
+    _conn_gauge_lock = threading.Lock()
 
     def __init__(self, host: str, default_port: int, pw_hash: str = "",
                  retry_policy: "RetryPolicy | None" = None,
-                 interrupt_check=None):
+                 interrupt_check=None, gauge: bool = True):
         self.hostname, self.port = split_host_port(host, default_port)
         self.pw_hash = pw_hash
         self.retry_policy = retry_policy or RetryPolicy(num_retries=0,
@@ -76,10 +95,15 @@ class ServiceClient:
         self.interrupt_check = interrupt_check
         # deterministic per-host jitter stream (reproducible chaos runs)
         self._rng = random.Random(f"{self.hostname}:{self.port}")
+        # the persistent keep-alive connection
+        self._conn: "http.client.HTTPConnection | None" = None
+        self._gauge = gauge
         # control-plane audit counters (fault_tolerance.py schema)
         self.total_retries = 0
         self.consec_retries = 0
         self.consec_retries_hwm = 0
+        self.total_requests = 0  # SvcRequests: HTTP requests actually sent
+        self.total_rx_bytes = 0  # SvcCtlBytes: response payload bytes
 
     def reset_phase_accounting(self) -> None:
         """New phase: fresh retry budget + per-phase counters."""
@@ -87,36 +111,156 @@ class ServiceClient:
         self.total_retries = 0
         self.consec_retries = 0
         self.consec_retries_hwm = 0
+        self.total_requests = 0
+        self.total_rx_bytes = 0
+
+    def rebind(self, pw_hash: str, retry_policy: "RetryPolicy",
+               interrupt_check) -> None:
+        """Re-home an adopted client (e.g. one kept warm by the
+        wait_for_services_ready probe) onto its RemoteWorker's policy."""
+        self.pw_hash = pw_hash
+        self.retry_policy = retry_policy
+        self.retry_budget = RetryBudget(retry_policy.budget_secs)
+        self.interrupt_check = interrupt_check
+        self.reset_phase_accounting()
 
     def _host_label(self) -> str:
         return f"{self.hostname}:{self.port}"
 
+    # -- connection lifecycle ----------------------------------------------
+
+    def _conn_opened(self) -> None:
+        if self._gauge:
+            with ServiceClient._conn_gauge_lock:
+                ServiceClient.open_connections += 1
+
+    def _conn_closed(self) -> None:
+        if self._gauge:
+            with ServiceClient._conn_gauge_lock:
+                ServiceClient.open_connections -= 1
+
+    def _connect(self, timeout: float) -> "http.client.HTTPConnection":
+        conn = http.client.HTTPConnection(self.hostname, self.port,
+                                          timeout=timeout)
+        try:
+            conn.connect()
+        except OSError as err:
+            raise ConnectFailedError(
+                f"connect to {self._host_label()} failed: {err}") from err
+        self._conn_opened()
+        return conn
+
+    def drop_connection(self) -> None:
+        """Close the persistent request connection (stream mode parks the
+        master between phase-control bursts; holding an idle socket per
+        host would defeat the O(fanout) steady state)."""
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._conn_closed()
+
+    def close(self) -> None:
+        self.drop_connection()
+
     def _request(self, method: str, path: str, params: "dict | None" = None,
                  body: "bytes | None" = None,
-                 timeout: float = CONNECT_TIMEOUT_SECS):
-        """One raw exchange. A failure to even reach the service raises
-        ConnectFailedError so the retry layer knows the request was never
-        sent (safe to retry non-idempotent requests)."""
+                 timeout: float = CONNECT_TIMEOUT_SECS,
+                 allow_reuse: bool = True):
+        """One raw exchange over the persistent connection. A failure to
+        even reach the service raises ConnectFailedError so the retry
+        layer knows the request was never sent (safe to retry
+        non-idempotent requests). A failure on a REUSED connection is
+        transparently retried once on a fresh one — the service closes
+        idle keep-alive connections, and that stale-socket case must not
+        surface as a spurious transient error. Non-idempotent callers
+        pass allow_reuse=False: their request always rides a provably
+        fresh connection, so the stale-retry ambiguity (was it
+        processed?) cannot arise for them."""
         params = dict(params or {})
         if self.pw_hash:
             params[proto.KEY_AUTHORIZATION] = self.pw_hash
         if params:
             path = path + "?" + urllib.parse.urlencode(params)
-        conn = http.client.HTTPConnection(self.hostname, self.port,
-                                          timeout=timeout)
-        try:
+        if not allow_reuse:
+            self.drop_connection()
+        for _attempt in (0, 1):
+            conn = self._conn
+            reused = conn is not None
+            if conn is None:
+                conn = self._connect(timeout)
+                self._conn = conn
             try:
-                conn.connect()
-            except OSError as err:
-                raise ConnectFailedError(
-                    f"connect to {self._host_label()} failed: {err}") \
-                    from err
-            conn.request(method, path, body=body)
-            resp = conn.getresponse()
-            data = resp.read()
+                if reused and conn.sock is not None:
+                    # per-request timeout on the reused socket; EBADF
+                    # here means the parked socket died — the stale-
+                    # retry below handles it like any reuse failure
+                    conn.sock.settimeout(timeout)
+                conn.request(method, path, body=body)
+                resp = conn.getresponse()
+                data = resp.read()
+            except TRANSIENT_EXCEPTIONS:
+                self.drop_connection()
+                if reused:
+                    continue  # stale keep-alive socket: one fresh retry
+                raise
+            self.total_requests += 1
+            self.total_rx_bytes += len(data)
+            if resp.will_close:
+                self.drop_connection()
             return resp.status, data
-        finally:
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def open_stream(self, bench_id: str, interval_ms: int, fanout: int = 0,
+                    subtree: "list[str] | tuple" = (),
+                    read_timeout: float = 10.0, resync: bool = False):
+        """Open the /livestream server-push connection (--svcstream);
+        returns a stream.StreamHandle whose rtt_usec is the open round
+        trip (the streaming --svcping source). The stream rides its OWN
+        connection — a chunked response would monopolize the request
+        one."""
+        from .stream import StreamHandle
+        params = {proto.KEY_STREAM_INTERVAL_MS: int(interval_ms)}
+        if bench_id:
+            params[proto.KEY_BENCH_ID] = bench_id
+        if fanout:
+            params[proto.KEY_STREAM_FANOUT] = int(fanout)
+        if subtree:
+            params[proto.KEY_STREAM_SUBTREE] = ",".join(subtree)
+        if resync:
+            params[proto.KEY_STREAM_RESYNC] = 1
+        if self.pw_hash:
+            params[proto.KEY_AUTHORIZATION] = self.pw_hash
+        path = proto.PATH_LIVE_STREAM + "?" + urllib.parse.urlencode(params)
+        t0 = time.monotonic()
+        conn = self._connect(CONNECT_TIMEOUT_SECS)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+        except TRANSIENT_EXCEPTIONS as err:
             conn.close()
+            self._conn_closed()
+            raise WorkerRemoteException(
+                f"live stream open on {self._host_label()} failed: "
+                f"{type(err).__name__}: {err}") from err
+        rtt_usec = int((time.monotonic() - t0) * 1e6)
+        self.total_requests += 1
+        if resp.status != 200:
+            try:
+                detail = resp.read(512).decode(errors="replace")
+            except TRANSIENT_EXCEPTIONS:
+                detail = ""
+            conn.close()
+            self._conn_closed()
+            raise WorkerRemoteException(
+                f"live stream open on {self._host_label()} failed "
+                f"(HTTP {resp.status}): {detail}")
+        if conn.sock is not None:
+            conn.sock.settimeout(read_timeout)
+        return StreamHandle(conn, resp, rtt_usec, self._host_label(),
+                            on_close=self._conn_closed)
 
     # -- retrying core ------------------------------------------------------
 
@@ -146,8 +290,12 @@ class ServiceClient:
             err: "BaseException | None" = None
             status, payload = 0, {}
             try:
+                # non-idempotent requests always ride a provably fresh
+                # connection (no stale-keep-alive ambiguity about whether
+                # the service processed them)
                 status, data = self._request(method, path, params, body,
-                                             timeout=timeout)
+                                             timeout=timeout,
+                                             allow_reuse=idempotent)
                 if parse_json:
                     try:
                         payload = json.loads(data) if data else {}
@@ -245,13 +393,33 @@ class RemoteWorker(Worker):
         self.svc_heartbeat_age_hwm_usec = 0
         self.svc_lease_expiries = 0
         self.svc_lease_age_hwm_usec = 0
+        # streaming control plane audit (--svcstream; master-observed,
+        # CONTROL_AUDIT_COUNTERS schema — docs/control-plane.md)
+        self.svc_requests = 0
+        self.svc_ctl_bytes = 0
+        self.svc_stream_frames = 0
+        self.svc_stream_bytes = 0
+        self.svc_delta_saved_bytes = 0
+        self.svc_agg_depth_hwm = 0
+        self.svc_conn_hwm = 0
         pw_hash = ""
         if self.cfg.svc_password_file:
             pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
-        self.client = ServiceClient(
-            host, self.cfg.service_port, pw_hash,
-            retry_policy=RetryPolicy.from_config(self.cfg),
-            interrupt_check=self.check_interruption_flag_only)
+        # adopt the persistent client the wait_for_services_ready probe
+        # already holds an open connection on, instead of building a
+        # throwaway one (duplicated --hosts entries: only the first
+        # worker adopts; the rest get fresh clients)
+        client = adopt_probed_client(*split_host_port(
+            host, self.cfg.service_port))
+        if client is not None:
+            client.rebind(pw_hash, RetryPolicy.from_config(self.cfg),
+                          self.check_interruption_flag_only)
+        else:
+            client = ServiceClient(
+                host, self.cfg.service_port, pw_hash,
+                retry_policy=RetryPolicy.from_config(self.cfg),
+                interrupt_check=self.check_interruption_flag_only)
+        self.client = client
         self.num_remote_threads = self.cfg.num_threads
         self._expected_bench_id = ""
 
@@ -265,6 +433,13 @@ class RemoteWorker(Worker):
         self.svc_heartbeat_age_hwm_usec = 0
         self.svc_lease_expiries = 0
         self.svc_lease_age_hwm_usec = 0
+        self.svc_requests = 0
+        self.svc_ctl_bytes = 0
+        self.svc_stream_frames = 0
+        self.svc_stream_bytes = 0
+        self.svc_delta_saved_bytes = 0
+        self.svc_agg_depth_hwm = 0
+        self.svc_conn_hwm = 0
         if self.degraded:
             # a lost host stays excluded from all later phase results
             self.got_phase_work = False
@@ -272,8 +447,19 @@ class RemoteWorker(Worker):
     def _sync_control_counters(self) -> None:
         self.svc_retries = self.client.total_retries
         self.svc_consec_retries_hwm = self.client.consec_retries_hwm
+        self.svc_requests = self.client.total_requests
+        # SvcCtlBytes = every control-plane payload byte this phase:
+        # request/poll replies plus live-stream frames
+        self.svc_ctl_bytes = self.client.total_rx_bytes \
+            + self.svc_stream_bytes
 
     def run(self) -> None:
+        try:
+            self._run_phases()
+        finally:
+            self.client.close()  # drop the persistent connection
+
+    def _run_phases(self) -> None:
         self._check_protocol_version()
         self._prepare_remote_files()
         self._prepare_phase_remote()
@@ -288,7 +474,7 @@ class RemoteWorker(Worker):
                 continue
             try:
                 self._start_remote_phase(phase, last_uuid)
-                self._poll_until_done(phase)
+                self._live_until_done(phase)
                 self._finish_phase_remote()
                 self._sync_control_counters()
                 self.shared.inc_num_workers_done()
@@ -367,6 +553,230 @@ class RemoteWorker(Worker):
             raise WorkerRemoteException(
                 f"phase start on {self.host} failed: "
                 f"{reply.get('Message', reply)}")
+        if getattr(self.shared, "stream_control", None) is not None:
+            # streaming mode: live stats ride the stream connection; an
+            # idle parked request socket per host would defeat the
+            # O(fanout) steady state the tree buys
+            self.client.drop_connection()
+
+    # -- live-stats ingestion: streaming plane with polling fallback --------
+
+    def _live_until_done(self, phase: BenchPhase) -> None:
+        """Dispatch the phase's live-stats wait onto the streaming
+        control plane (--svcstream) when it is active for this run,
+        falling back LOUDLY one rung (stream -> poll) when the stream
+        cannot serve this host — the control-plane analogue of the
+        uring -> AIO -> Python ladder of the data path."""
+        sc = getattr(self.shared, "stream_control", None)
+        if sc is None:
+            self._poll_until_done(phase)
+            return
+        sc.ensure_phase(self._expected_bench_id)
+        sc.note_entered()
+        try:
+            subtree = sc.subtree_of(self.host)
+            if subtree is not None:
+                self._run_root_stream(phase, sc, subtree)
+            else:
+                self._wait_stream_host(phase, sc)
+            return
+        except StreamDetachedError as err:
+            sc.detach_host(self.host)
+            logger.log_error(
+                f"SVCSTREAM FALLBACK: {self.host}: {err}; falling back "
+                f"to /status polling for this phase (stream -> poll)")
+        self._poll_until_done(phase)
+
+    def _account_stream_frame(self, nbytes: int, state: dict,
+                              is_full: bool, now: float,
+                              last_frame: float) -> None:
+        """Per-frame audit: frames/bytes received, bytes delta encoding
+        kept off the wire, the deepest aggregation tree seen, and the
+        inter-frame heartbeat gap.
+
+        SvcDeltaSavedBytes is an estimate priced against the size of the
+        most recent FULL frame on this stream (every stream starts with
+        one) — re-serializing the merged state per frame just to price
+        the delta would re-create a slice of the very per-tick cost the
+        stream removes."""
+        from .stream import KEY_AGG_DEPTH
+        self.svc_stream_frames += 1
+        self.svc_stream_bytes += nbytes
+        if is_full:
+            self._stream_full_frame_bytes = nbytes
+        else:
+            self.svc_delta_saved_bytes += max(
+                getattr(self, "_stream_full_frame_bytes", 0) - nbytes, 0)
+        self.svc_agg_depth_hwm = max(self.svc_agg_depth_hwm,
+                                     int(state.get(KEY_AGG_DEPTH, 1)))
+        self.svc_heartbeat_age_hwm_usec = max(
+            self.svc_heartbeat_age_hwm_usec,
+            int((now - last_frame) * 1e6))
+
+    #: how long a root stream may deliver only non-matching frames after
+    #: /startphase succeeded before the master stops waiting (persistent
+    #: foreign UUID = hijack; persistent idle = fall to the polling rung)
+    NO_MATCH_GRACE_SECS = 5.0
+
+    def _run_root_stream(self, phase: BenchPhase, sc,
+                         subtree: "list[str]") -> None:
+        """Attached-root duty: own the subtree's /livestream, distribute
+        per-host frame entries into the fleet's host states and worker
+        mirrors, ingest the subtree-aggregated telemetry into THIS
+        worker (the detach logic guarantees no host contributes twice),
+        and stay on the wire until every subtree host is resolved."""
+        from .stream import KEY_FULL, StreamProtocolError, apply_delta, \
+            check_seq, stream_read_timeout
+        interval_ms = max(self.cfg.svc_update_interval_ms, 25)
+        read_timeout = stream_read_timeout(interval_ms)
+        stalled_secs = max(self.cfg.svc_stalled_secs, 0)
+
+        def reopen(resync: bool):
+            try:
+                return self.client.open_stream(
+                    self._expected_bench_id, interval_ms,
+                    fanout=sc.fanout, subtree=subtree,
+                    read_timeout=read_timeout, resync=resync)
+            except (WorkerRemoteException, *TRANSIENT_EXCEPTIONS) as err:
+                raise StreamDetachedError(
+                    f"cannot open live stream: {err}") from err
+
+        handle = None
+        state: dict = {}
+        last_seq = 0
+        matched = False
+        resyncs = 0
+        agg_zeroed = False
+        no_match_since = time.monotonic()
+        last_frame = time.monotonic()
+        normal_exit = False
+        try:
+            handle = reopen(resync=False)
+            self.last_ping_usec = handle.rtt_usec
+            while True:
+                self.check_interruption_request(force=True)
+                try:
+                    frame = handle.read_frame()
+                    last_seq = check_seq(last_seq, frame)
+                except (StreamProtocolError,
+                        *TRANSIENT_EXCEPTIONS) as err:
+                    # missed/garbled frame or a dead socket: ONE resync
+                    # reconnect (the new stream's first frame is a full
+                    # snapshot), then give the poll rung the phase
+                    if resyncs >= 1:
+                        raise StreamDetachedError(
+                            f"live stream failed twice: {err}") from err
+                    resyncs += 1
+                    handle.close()
+                    handle = reopen(resync=True)
+                    last_seq = 0
+                    state = {}
+                    continue
+                state = apply_delta(
+                    {} if frame.get(KEY_FULL) else state, frame)
+                frame_id = state.get(proto.KEY_BENCH_ID, "")
+                if frame_id == self._expected_bench_id:
+                    matched = True
+                elif matched and frame_id:
+                    self._raise_host_failure("hijacked")
+                if not matched:
+                    # stale pre-/startphase frames get a short grace; a
+                    # stream that NEVER matches must not hang the phase
+                    # on heartbeats — a persistent foreign UUID is a
+                    # hijack (polling would raise on its first reply),
+                    # persistent idle/empty falls to the polling rung
+                    if time.monotonic() - no_match_since \
+                            <= self.NO_MATCH_GRACE_SECS:
+                        continue
+                    if frame_id:
+                        self._raise_host_failure("hijacked")
+                    raise StreamDetachedError(
+                        f"no frame matched this run's bench UUID within "
+                        f"{self.NO_MATCH_GRACE_SECS:.0f}s")
+                now = time.monotonic()
+                self._account_stream_frame(handle.last_frame_bytes, state,
+                                           bool(frame.get(KEY_FULL)),
+                                           now, last_frame)
+                last_frame = now
+                # SvcConnHwm censuses STEADY-STATE connections: after
+                # every worker is past its /startphase burst and before
+                # the first finisher reopens for /benchresult — the
+                # window where "master holds O(fanout) connections" is
+                # the claim being audited
+                if sc.all_entered() \
+                        and not state.get(proto.KEY_NUM_WORKERS_DONE, 0):
+                    self.svc_conn_hwm = max(self.svc_conn_hwm,
+                                            ServiceClient.open_connections)
+                sc.ingest_frame(self.host, state)
+                # subtree-aggregated TPU/path-audit/lease telemetry lands
+                # on the ROOT worker; the fleet sum/MAX over workers then
+                # equals the flat merge (satellite: /metrics harvests
+                # from stream frames — zero extra service requests).
+                # Gated on the whole subtree still riding the tree: a
+                # detached-then-recovered host would otherwise appear in
+                # the aggregate AND in its own polling ingest. On the
+                # first detach the already-ingested aggregate (which
+                # baked in the lost host's pre-detach share) is zeroed —
+                # mid-run /metrics under-counts the subtree rather than
+                # double-counting; finals are exact either way
+                # (/benchresult overwrites)
+                if sc.subtree_fully_attached(self.host):
+                    self._ingest_live_telemetry(state)
+                elif not agg_zeroed:
+                    agg_zeroed = True
+                    self._reset_live_telemetry()
+                st = sc.state_of(self.host)
+                if st.hijacked:
+                    self._raise_host_failure("hijacked")
+                if st.err:
+                    self._raise_host_failure("err")
+                if stalled_secs and not self.shared.stonewall_triggered \
+                        and now - st.last_change >= stalled_secs:
+                    self._raise_host_failure("stalled", stalled_secs)
+                if sc.subtree_satisfied(self.host,
+                                        self.num_remote_threads):
+                    normal_exit = True
+                    return
+        finally:
+            if handle is not None:
+                handle.close()
+            if not normal_exit:
+                # abnormal root exit: the subtree loses its aggregator;
+                # still-waiting hosts detach and fall back to polling
+                sc.detach_subtree(self.host)
+
+    def _wait_stream_host(self, phase: BenchPhase, sc) -> None:
+        """Non-root duty: wait on this host's stream-fed state until it
+        is done — or raise the exact exception the polling loop would
+        (error/hijack/stall), or detach when the tree stops covering this
+        host (root died / subtree reported unreachable)."""
+        st = sc.state_of(self.host)
+        stalled_secs = max(self.cfg.svc_stalled_secs, 0)
+        while True:
+            self.check_interruption_request(force=True)
+            action = None
+            with sc.cond:
+                if st.hijacked:
+                    action = "hijacked"
+                elif st.err:
+                    action = "err"
+                elif st.done >= self.num_remote_threads:
+                    return
+                elif st.unreachable or not st.attached \
+                        or sc.root_worker_lost(self.host):
+                    action = "detached"
+                elif stalled_secs \
+                        and not self.shared.stonewall_triggered \
+                        and time.monotonic() - st.last_change \
+                        >= stalled_secs:
+                    action = "stalled"
+                else:
+                    sc.cond.wait(0.1)
+                    continue
+            if action in ("hijacked", "err", "stalled"):
+                self._raise_host_failure(action, stalled_secs)
+            raise StreamDetachedError(
+                "aggregation tree no longer covers this host")
 
     def _poll_until_done(self, phase: BenchPhase) -> None:
         """Poll /status, mirroring remote live totals into this worker's
@@ -419,6 +829,8 @@ class RemoteWorker(Worker):
             # --svcping: the /status round-trip IS the service ping
             # (reference fullscreen shows per-service latency, --svcping)
             self.last_ping_usec = int((now - t0) * 1e6)
+            self.svc_conn_hwm = max(self.svc_conn_hwm,
+                                    ServiceClient.open_connections)
             # heartbeat age: gap between successive successful polls
             self.svc_heartbeat_age_hwm_usec = max(
                 self.svc_heartbeat_age_hwm_usec,
@@ -430,9 +842,7 @@ class RemoteWorker(Worker):
             got_id = stats.get(proto.KEY_BENCH_ID, "")
             if got_id and self._expected_bench_id \
                     and got_id != self._expected_bench_id:
-                raise WorkerHijackedException(
-                    f"service {self.host} was hijacked by another master "
-                    f"(bench UUID mismatch)")  # reference: :199-202
+                self._raise_host_failure("hijacked")  # reference: :199-202
             self.live_ops.num_entries_done = \
                 stats.get(proto.KEY_NUM_ENTRIES_DONE, 0)
             self.live_ops.num_bytes_done = \
@@ -441,9 +851,7 @@ class RemoteWorker(Worker):
                 stats.get(proto.KEY_NUM_IOPS_DONE, 0)
             self._ingest_live_telemetry(stats)
             if stats.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0):
-                raise WorkerRemoteException(
-                    f"worker error on service {self.host}"
-                    + self._fetch_remote_error_detail())
+                self._raise_host_failure("err")
             done = stats.get(proto.KEY_NUM_WORKERS_DONE, 0)
             if done >= self.num_remote_threads:
                 return
@@ -458,9 +866,7 @@ class RemoteWorker(Worker):
                 # counters froze while the service still answers; with a
                 # stonewall in effect straggler counters may legitimately
                 # idle, so the static-counter trip is gated on it
-                raise WorkerStalledException(
-                    f"service {self.host} stalled: live counters static "
-                    f"for {stalled_secs}s (--svcstalledsecs)")
+                self._raise_host_failure("stalled", stalled_secs)
             time.sleep(interval)
             interval = min(interval * 2, max_interval)
 
@@ -485,6 +891,33 @@ class RemoteWorker(Worker):
                 stats["IOLatHisto"])
             self.entries_latency_histo = LatencyHistogram.from_dict(
                 stats.get("EntLatHisto", {}))
+
+    def _reset_live_telemetry(self) -> None:
+        """Zero every mirror _ingest_live_telemetry can set — incl. the
+        conditionally-ingested histograms and lease counters. Lives next
+        to the ingest so a new conditional key added there is visibly a
+        key to reset here too (the stream plane zeroes a root's stale
+        subtree aggregate when a member detaches to polling)."""
+        self._ingest_live_telemetry({
+            "TpuHbmBytes": 0, "IOLatHisto": {}, "EntLatHisto": {},
+            proto.KEY_SVC_LEASE_EXPIRIES: 0,
+            proto.KEY_SVC_LEASE_AGE_HWM: 0})
+
+    def _raise_host_failure(self, kind: str, stalled_secs: int = 0):
+        """The per-host failure exceptions, shared by the polling loop
+        and both streaming waiters so the two planes can never drift in
+        semantics or wording."""
+        if kind == "hijacked":
+            raise WorkerHijackedException(
+                f"service {self.host} was hijacked by another master "
+                f"(bench UUID mismatch)")  # reference: :199-202
+        if kind == "err":
+            raise WorkerRemoteException(
+                f"worker error on service {self.host}"
+                + self._fetch_remote_error_detail())
+        raise WorkerStalledException(
+            f"service {self.host} stalled: live counters static "
+            f"for {stalled_secs}s (--svcstalledsecs)")
 
     def _ingest_lease_counters(self, reply: dict) -> None:
         """Mirror the service-observed lease counters (--svcleasesecs;
@@ -580,6 +1013,8 @@ class RemoteWorker(Worker):
             int(chip): (v.get("Bytes", 0), v.get("USec", 0))
             for chip, v in result.get("TpuPerChip", {}).items()}
         self.got_phase_work = bool(self.elapsed_usec_vec)
+        if getattr(self.shared, "stream_control", None) is not None:
+            self.client.drop_connection()  # back to the parked steady state
 
     def _interrupt_remote(self, quit_service: bool) -> None:
         """Best effort, deliberately BELOW the retry layer: the service may
@@ -600,11 +1035,35 @@ class RemoteWorker(Worker):
 # master-side helpers (reference: Coordinator::waitForServicesReady :165-227)
 # ---------------------------------------------------------------------------
 
+#: clients the ready-probe left with a warm persistent connection, keyed
+#: by (hostname, port) for adoption by the host's RemoteWorker — the
+#: probe used to build throwaway clients whose sockets were wasted
+_probed_clients: "dict[tuple[str, int], ServiceClient]" = {}
+_probed_clients_lock = threading.Lock()
+
+
+def _register_probed_client(client: ServiceClient) -> None:
+    key = (client.hostname, client.port)
+    with _probed_clients_lock:
+        old = _probed_clients.pop(key, None)
+        _probed_clients[key] = client
+    if old is not None:
+        old.close()
+
+
+def adopt_probed_client(hostname: str, port: int) -> "ServiceClient | None":
+    with _probed_clients_lock:
+        return _probed_clients.pop((hostname, port), None)
+
+
 def wait_for_services_ready(hosts: "list[str]", default_port: int,
                             wait_secs: int) -> None:
     """Probe all hosts CONCURRENTLY against the shared --svcwait deadline
     (a slow first host used to eat the whole budget of the hosts after
-    it) and report every unreachable host at once."""
+    it) and report every unreachable host at once. Each successful
+    probe's client — connection still open — is parked for adoption by
+    that host's RemoteWorker (persistent-connection reuse instead of
+    throwaway probe clients)."""
     deadline = time.monotonic() + max(wait_secs, 0)
     unreachable: "dict[str, str]" = {}
     lock = threading.Lock()
@@ -616,6 +1075,7 @@ def wait_for_services_ready(hosts: "list[str]", default_port: int,
             try:
                 status, _ = client.get_json(proto.PATH_STATUS, timeout=3)
                 if status in (200, 401):
+                    _register_probed_client(client)
                     return
                 last_err = f"HTTP {status}"
             except WorkerRemoteException as err:
@@ -623,6 +1083,7 @@ def wait_for_services_ready(hosts: "list[str]", default_port: int,
             if time.monotonic() >= deadline:
                 with lock:
                     unreachable[host] = last_err
+                client.close()
                 return
             time.sleep(1)
 
@@ -642,16 +1103,39 @@ def wait_for_services_ready(hosts: "list[str]", default_port: int,
 
 
 def send_interrupt_to_hosts(hosts: "list[str]", default_port: int,
-                            quit: bool = False) -> None:
+                            quit: bool = False, fanout: int = 0) -> None:
     """--interrupt / --quit handling (reference: Coordinator service
-    control paths)."""
-    for host in hosts:
+    control paths). With --svcfanout the interrupt walks the same
+    aggregation tree the live stats ride: the master contacts only the
+    roots, each root forwards to its children with their sub-subtrees
+    (stream.forward_interrupt), so teardown is O(fanout) too."""
+    verb = "quit" if quit else "interrupt"
+
+    def send_one(host: str, subtree: "list[str]") -> None:
         client = ServiceClient(host, default_port)
         params = {proto.KEY_INTERRUPT_QUIT: "1"} if quit else {}
+        if subtree:
+            params[proto.KEY_STREAM_SUBTREE] = ",".join(subtree)
+            params[proto.KEY_STREAM_FANOUT] = fanout
         try:
             client.get_json(proto.PATH_INTERRUPT_PHASE, params)
-            logger.log(0, f"sent {'quit' if quit else 'interrupt'} to {host}")
+            via = f" (+{len(subtree)} host(s) via tree)" if subtree else ""
+            logger.log(0, f"sent {verb} to {host}{via}")
         except (WorkerRemoteException, *TRANSIENT_EXCEPTIONS) as err:
             # OSError alone used to let a half-closed socket's malformed
             # status line (HTTPException) escape and mask the real failure
             logger.log_error(f"could not reach {host}: {err}")
+            if subtree:
+                # the same direct-attachment fallback the live-stats
+                # plane has: a dead root must not strand its subtree
+                # with workers still running
+                logger.log_error(
+                    f"{verb} fan-out: root {host} unreachable — sending "
+                    f"directly to its {len(subtree)} subtree host(s)")
+                for sub_host in subtree:
+                    send_one(sub_host, [])
+        finally:
+            client.close()
+
+    for host, subtree in plan_tree(hosts, max(fanout, 0)):
+        send_one(host, subtree)

@@ -1,0 +1,894 @@
+"""Streaming control plane: server-push live stats, aggregation tree, deltas.
+
+ROADMAP item 3 / ISSUE 8 tentpole. The master used to drive every service
+with per-request HTTP — a fresh TCP connection and a full /status JSON
+serialization per host per live-stats tick, so control-plane cost grew
+O(hosts) and capped fleet size long before the data path did. PAPERS.md
+"RPC Considered Harmful" (arXiv 1805.08430) is the blueprint: the
+per-request RPC idiom, not the network, is the bottleneck. Three layers,
+all opt-in via ``--svcstream`` (default off = per-request polling parity):
+
+1. **Persistent server-push stream** (`/livestream`): one chunked-HTTP
+   connection per attached host carrying newline-delimited JSON frames at
+   the ``--svcupint`` cadence, pushed early whenever a completion-relevant
+   value changes (worker done/error counts, phase identity) so
+   end-of-phase detection is no slower than the 25ms poll ramp.
+2. **Hierarchical aggregation** (``--svcfanout N``): the master attaches
+   only N root services; each root re-streams its assigned subtree
+   (heap-shaped, passed down via the ``Subtree`` query param) after
+   merging child frames with the existing wire merge rules (sum, except
+   the documented MAX-merged high-water marks). Per-host detail survives
+   in the frame's ``Hosts`` map. A failed child drops its whole
+   sub-subtree into ``Unreach``; the master then re-attaches those hosts
+   directly (stream -> poll fallback ladder, logged LOUDLY).
+3. **Delta encoding**: frames carry only the keys that changed since the
+   previously sent frame, with a periodic full snapshot (every
+   ``FULL_FRAME_EVERY`` frames), a mandatory full first frame, and
+   sequence numbers so a consumer that misses a frame reconnects with
+   ``Resync=1`` instead of applying a delta to the wrong base.
+
+Lease semantics (docs/fault-tolerance.md, --svcleasesecs) carry over
+route-aware: a stream opened WITH the run's bench UUID renews the
+service's master-liveness lease on every pushed frame; observer streams
+(no/stale UUID) never do, and a stream that dies mid-phase stops
+renewing, so orphan recovery still fires.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..stats.latency_histogram import LatencyHistogram
+from ..toolkits import logger
+from ..tpu.device import PATH_AUDIT_MAX_KEYS
+from . import protocol as proto
+from .fault_tolerance import CONTROL_AUDIT_COUNTERS
+
+#: content type of the frame stream (newline-delimited JSON objects)
+NDJSON_CONTENT_TYPE = "application/x-ndjson"
+
+#: a full snapshot replaces the delta every Nth frame — belt-and-braces
+#: against silent state drift (a MISSED frame is caught immediately by
+#: the sequence check and answered with a resync reconnect)
+FULL_FRAME_EVERY = 64
+
+#: server-side change-detection granularity between pushes; mirrors the
+#: 25ms fast-poll floor of the polling ladder (POLL_MIN_SECS) so phase
+#: completion is detected just as promptly without per-request cost
+TICK_SECS = 0.025
+MIN_INTERVAL_MS = 25
+
+#: a push into a dead/stalled peer must not hang the session thread
+SEND_TIMEOUT_SECS = 10.0
+
+#: per-node cap on how long an interrupt fan-out waits for its forwards
+#: (each node replies within this no matter what lives below it)
+FORWARD_JOIN_SECS = 5.0
+
+
+def stream_read_timeout(interval_ms: int) -> float:
+    """Consumer-side no-frame timeout: generous multiples of the push
+    cadence — frames heartbeat every interval, but a loaded aggregation
+    node may clump pushes, and a spurious timeout costs a resync (and on
+    its second strike, the whole stream falls back to polling)."""
+    return max(interval_ms / 1000.0 * 8, 5.0)
+
+#: a single frame larger than this is line noise, not a frame
+MAX_FRAME_BYTES = 16 << 20
+
+# frame meta keys (everything else is the live-stats dict schema)
+KEY_SEQ = "Seq"
+KEY_FULL = "Full"
+KEY_HOSTS = "Hosts"
+KEY_AGG_DEPTH = "AggDepth"
+KEY_UNREACH = "Unreach"
+
+#: a service does not know the host label its parent addresses it by;
+#: it files its own entry under this sentinel and the parent rewrites it
+SELF_LABEL = ""
+
+# per-host entry keys inside the Hosts map (short on purpose: with
+# thousands of hosts these names dominate frame size)
+HOST_DONE = "D"          # NumWorkersDone of that host
+HOST_ERR = "E"           # NumWorkersDoneWithError of that host
+HOST_ENTRIES = "Ent"     # live entries done
+HOST_BYTES = "B"         # live bytes done
+HOST_IOPS = "I"          # live iops done
+HOST_CPU = "C"           # CPU util percent
+HOST_RTT = "Rtt"         # stream-open round trip usec (measured upstream)
+HOST_HIJACKED = "Hij"    # bench UUID mismatch AFTER a first match
+
+#: top-level keys excluded from the numeric subtree merge: identity and
+#: frame plumbing stay the aggregating node's own
+MERGE_EXCLUDED_KEYS = frozenset({
+    KEY_SEQ, KEY_FULL, KEY_HOSTS, KEY_AGG_DEPTH, KEY_UNREACH,
+    proto.KEY_BENCH_ID, proto.KEY_PHASE_CODE, proto.KEY_PHASE_NAME,
+    "CPUUtil",
+})
+
+#: keys that MAX-merge across a subtree instead of summing — exactly the
+#: wire protocol's documented high-water marks, derived from the same
+#: schemas so the tree can never diverge from the flat merge
+MERGE_MAX_KEYS = PATH_AUDIT_MAX_KEYS | {
+    key for _attr, key, mode in CONTROL_AUDIT_COUNTERS if mode == "max"}
+
+#: mergeable latency histograms (bucket-wise sum via LatencyHistogram)
+MERGE_HISTO_KEYS = frozenset({"IOLatHisto", "EntLatHisto"})
+
+
+class StreamProtocolError(Exception):
+    """A frame violated the stream contract (sequence gap, delta without
+    a base, undecodable line). The consumer reconnects with Resync=1."""
+
+
+class StreamDetachedError(Exception):
+    """This host can no longer be served by the streaming plane for the
+    current phase; the caller falls back one rung (stream -> poll)."""
+
+
+# ---------------------------------------------------------------------------
+# delta codec
+# ---------------------------------------------------------------------------
+
+def encode_delta(prev: dict, cur: dict) -> dict:
+    """Frame carrying only the keys of ``cur`` that differ from ``prev``.
+    The ``Hosts`` map deltas per host entry (an unchanged host is simply
+    absent). Keys never disappear mid-stream; the periodic full snapshot
+    covers any drift."""
+    out: dict = {}
+    for key, val in cur.items():
+        if key == KEY_HOSTS:
+            prev_hosts = prev.get(KEY_HOSTS, {})
+            changed = {h: e for h, e in val.items()
+                       if prev_hosts.get(h) != e}
+            if changed:
+                out[KEY_HOSTS] = changed
+        elif prev.get(key, _MISSING) != val:
+            out[key] = val
+    return out
+
+
+_MISSING = object()
+
+
+def apply_delta(state: dict, frame: dict) -> dict:
+    """New state dict from ``state`` + a delta (or full) frame. Pure —
+    re-applying the same frame is idempotent. Frame meta keys (Seq/Full)
+    are dropped from the result."""
+    new = dict(state)
+    for key, val in frame.items():
+        if key in (KEY_SEQ, KEY_FULL):
+            continue
+        if key == KEY_HOSTS:
+            hosts = dict(new.get(KEY_HOSTS, {}))
+            hosts.update(val)
+            new[KEY_HOSTS] = hosts
+        else:
+            new[key] = val
+    return new
+
+
+def check_seq(last_seq: int, frame: dict) -> int:
+    """Enforce the gap-free sequence contract; returns the new last_seq.
+    A full frame re-anchors the sequence (that is its whole point)."""
+    seq = frame.get(KEY_SEQ, 0)
+    if not isinstance(seq, int) or seq <= 0:
+        raise StreamProtocolError(f"bad frame sequence number {seq!r}")
+    if frame.get(KEY_FULL):
+        return seq
+    if last_seq and seq != last_seq + 1:
+        raise StreamProtocolError(
+            f"frame sequence gap ({last_seq} -> {seq}); resync required")
+    if not last_seq:
+        raise StreamProtocolError("delta frame before any full snapshot")
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# aggregation-tree planning
+# ---------------------------------------------------------------------------
+
+def plan_subtree(hosts: "list[str]", fanout: int
+                 ) -> "list[tuple[str, list[str]]]":
+    """Split a host list into ``(child, sub_subtree)`` pairs: the first
+    ``fanout`` hosts become direct children, the remainder is dealt
+    round-robin so depth stays balanced (heap-shaped N-ary forest)."""
+    if not hosts:
+        return []
+    if fanout <= 0:
+        fanout = len(hosts)
+    children = hosts[:fanout]
+    rest = hosts[fanout:]
+    return [(child, rest[i::fanout]) for i, child in enumerate(children)]
+
+
+def plan_tree(hosts: "list[str]", fanout: int
+              ) -> "list[tuple[str, list[str]]]":
+    """The master's attachment plan: with ``--svcfanout 0`` every host is
+    a root with an empty subtree (flat streaming); otherwise the first
+    ``fanout`` hosts are roots, each aggregating its assigned subtree."""
+    if fanout <= 0:
+        return [(h, []) for h in hosts]
+    return plan_subtree(hosts, fanout)
+
+
+def tree_depth(num_hosts: int, fanout: int) -> int:
+    """Expected AggDepth for a clean tree (used by tests/sizing docs)."""
+    depth, layer, covered = 0, fanout if fanout > 0 else num_hosts, 0
+    while covered < num_hosts:
+        depth += 1
+        covered += layer
+        layer *= fanout if fanout > 0 else 1
+    return max(depth, 1)
+
+
+# ---------------------------------------------------------------------------
+# subtree merge (service side)
+# ---------------------------------------------------------------------------
+
+def merge_subtree_frame(dst: dict, src: dict) -> dict:
+    """Merge a child's applied frame state into ``dst`` with the wire
+    merge rules: numeric keys sum, the documented high-water marks MAX,
+    latency histograms merge bucket-wise, identity/meta keys stay own."""
+    for key, val in src.items():
+        if key in MERGE_EXCLUDED_KEYS:
+            continue
+        if key in MERGE_HISTO_KEYS:
+            if isinstance(val, dict):
+                merged = LatencyHistogram.from_dict(dst.get(key) or {})
+                merged.merge(LatencyHistogram.from_dict(val))
+                dst[key] = merged.to_dict()
+        elif isinstance(val, bool):
+            continue
+        elif isinstance(val, (int, float)):
+            if key in MERGE_MAX_KEYS:
+                dst[key] = max(dst.get(key, 0), val)
+            else:
+                dst[key] = dst.get(key, 0) + val
+    return dst
+
+
+def live_host_entry(stats: dict) -> dict:
+    """A node's own per-host entry for the frame's Hosts map, derived
+    from its live-stats dict (statistics.get_live_stats_dict schema)."""
+    return {
+        HOST_DONE: stats.get(proto.KEY_NUM_WORKERS_DONE, 0),
+        HOST_ERR: stats.get(proto.KEY_NUM_WORKERS_DONE_WITH_ERROR, 0),
+        HOST_ENTRIES: stats.get(proto.KEY_NUM_ENTRIES_DONE, 0),
+        HOST_BYTES: stats.get(proto.KEY_NUM_BYTES_DONE, 0),
+        HOST_IOPS: stats.get(proto.KEY_NUM_IOPS_DONE, 0),
+        HOST_CPU: stats.get("CPUUtil", 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# consumer-side stream handle (shared by master and interior aggregators)
+# ---------------------------------------------------------------------------
+
+class StreamHandle:
+    """One open /livestream response: reads ndjson frames incrementally.
+    ``rtt_usec`` is the open round trip (connect -> response headers) —
+    the streaming replacement for the --svcping /status RTT."""
+
+    def __init__(self, conn, resp, rtt_usec: int, label: str,
+                 on_close=None):
+        self._conn = conn
+        self._resp = resp
+        self._on_close = on_close
+        self.rtt_usec = rtt_usec
+        self.label = label
+        self.last_frame_bytes = 0
+        self._closed = False
+
+    def read_frame(self) -> dict:
+        """Next frame dict. Raises OSError on EOF/timeout (the socket
+        state is unreliable after either — reconnect, never resume) and
+        StreamProtocolError on an undecodable or truncated line."""
+        line = self._resp.readline(MAX_FRAME_BYTES)
+        if not line:
+            raise OSError(f"live stream from {self.label} ended")
+        if not line.endswith(b"\n"):
+            raise StreamProtocolError(
+                f"oversized/truncated frame from {self.label}")
+        self.last_frame_bytes = len(line)
+        try:
+            frame = json.loads(line)
+        except json.JSONDecodeError as err:
+            raise StreamProtocolError(
+                f"undecodable frame from {self.label}: {err}") from err
+        if not isinstance(frame, dict):
+            raise StreamProtocolError(f"non-object frame from {self.label}")
+        return frame
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+        if self._on_close is not None:
+            self._on_close()
+
+
+# ---------------------------------------------------------------------------
+# interior node: child aggregation (service side)
+# ---------------------------------------------------------------------------
+
+class ChildAggregator:
+    """Parent side of one child's stream: a daemon thread that keeps the
+    child's latest applied frame state, reconnecting with backoff. A
+    ``None`` snapshot means the child (and therefore its whole assigned
+    sub-subtree) is currently unreachable."""
+
+    RECONNECT_MIN_SECS = 0.2
+    RECONNECT_MAX_SECS = 5.0
+
+    def __init__(self, label: str, subtree: "list[str]", bench_id: str,
+                 interval_ms: int, fanout: int, pw_hash: str,
+                 default_port: int):
+        self.label = label
+        self.subtree = list(subtree)
+        self.bench_id = bench_id
+        self.interval_ms = interval_ms
+        self.fanout = fanout
+        self.pw_hash = pw_hash
+        self.default_port = default_port
+        self.rtt_usec = 0
+        self.hijacked = False
+        self._matched = False
+        self._state: "dict | None" = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._handle: "StreamHandle | None" = None
+        self._thread: "threading.Thread | None" = None
+        self._last_logged_err = ""
+        # a child is only REPORTED unreachable after this long without a
+        # frame: the aggregator thread needs a moment to connect at
+        # session start, a blip must ride out one reconnect-backoff
+        # cycle, and premature reporting is costly — the master's
+        # detachment is one-way for the phase
+        self.unreach_grace_secs = max(6.0, interval_ms / 1000.0 * 8)
+        self._down_since: "float | None" = None
+        # cheap completion signal for the parent's tick loop: recomputed
+        # per APPLIED frame (not per tick), so idle ticks cost nothing
+        self.done_err_sig: tuple = ()
+
+    def start(self) -> None:
+        self._down_since = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name=f"svc-agg-{self.label}", daemon=True)
+        self._thread.start()
+
+    def down_for_secs(self) -> float:
+        """Seconds this child has been without an applied frame (0 while
+        it is streaming)."""
+        down_since = self._down_since
+        return 0.0 if down_since is None \
+            else time.monotonic() - down_since
+
+    def stop(self) -> None:
+        """Tear the child stream down; once the parent stream is gone the
+        child must stop seeing lease renewals (orphan recovery depends on
+        the whole chain dying together)."""
+        self._stop.set()
+        handle = self._handle
+        if handle is not None:
+            handle.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def snapshot(self) -> "dict | None":
+        with self._lock:
+            return self._state
+
+    def _check_hijack(self, state: dict) -> None:
+        """Grace-then-strict UUID tracking: frames sent before the child
+        processed /startphase legitimately carry a stale/empty UUID; only
+        a DIFFERENT non-empty UUID after a first match is a hijack."""
+        if not self.bench_id:
+            return
+        frame_id = state.get(proto.KEY_BENCH_ID, "")
+        if frame_id == self.bench_id:
+            self._matched = True
+        elif self._matched and frame_id:
+            self.hijacked = True
+
+    def _run(self) -> None:
+        from .remote_worker import ServiceClient  # lazy: no import cycle
+        backoff = self.RECONNECT_MIN_SECS
+        read_timeout = stream_read_timeout(self.interval_ms)
+        while not self._stop.is_set():
+            client = ServiceClient(self.label, self.default_port,
+                                   self.pw_hash, gauge=False)
+            handle = None
+            try:
+                handle = client.open_stream(
+                    self.bench_id, self.interval_ms, fanout=self.fanout,
+                    subtree=self.subtree, read_timeout=read_timeout,
+                    resync=True)
+                self._handle = handle
+                self.rtt_usec = handle.rtt_usec
+                backoff = self.RECONNECT_MIN_SECS
+                last_seq = 0
+                state: dict = {}
+                while not self._stop.is_set():
+                    frame = handle.read_frame()
+                    last_seq = check_seq(last_seq, frame)
+                    state = apply_delta(
+                        {} if frame.get(KEY_FULL) else state, frame)
+                    self._check_hijack(state)
+                    if self.bench_id and not self._matched:
+                        # never merge frames that haven't matched this
+                        # run's UUID: a child serving ANOTHER master's
+                        # run must not feed its done counts/byte totals
+                        # into our aggregate — it stays "warming" until
+                        # the grace expires and Unreach hands it to the
+                        # master's direct-attachment ladder (where the
+                        # polling rung raises the hijack properly)
+                        continue
+                    sig = tuple(sorted(
+                        (h, e.get(HOST_DONE, 0), e.get(HOST_ERR, 0),
+                         e.get(HOST_HIJACKED, 0))
+                        for h, e in state.get(KEY_HOSTS, {}).items()))
+                    with self._lock:
+                        self._state = state
+                        self._down_since = None
+                        self.done_err_sig = sig
+                        self._last_logged_err = ""
+            except Exception as err:  # noqa: BLE001 - failure=unreachable
+                # LOUD fallback contract: a child that cannot be
+                # aggregated must be diagnosable HERE (e.g. an HTTP 401
+                # from a password mismatch), not only as the master's
+                # generic tree-no-longer-covers fallback. Logged once
+                # per distinct cause, not per reconnect attempt.
+                msg = f"{type(err).__name__}: {err}"
+                if self._stop.is_set():
+                    pass  # deliberate teardown closed the stream
+                elif msg != self._last_logged_err:
+                    self._last_logged_err = msg
+                    logger.log_error(
+                        f"subtree aggregator: stream from child "
+                        f"{self.label} failed: {msg} (reconnecting; the "
+                        f"child falls to Unreach after "
+                        f"{self.unreach_grace_secs:.0f}s)")
+            finally:
+                self._handle = None
+                if handle is not None:
+                    handle.close()
+                client.close()
+            with self._lock:
+                self._state = None
+                if self._down_since is None:
+                    self._down_since = time.monotonic()
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, self.RECONNECT_MAX_SECS)
+
+
+# ---------------------------------------------------------------------------
+# server-side stream session
+# ---------------------------------------------------------------------------
+
+class StreamSession:
+    """One /livestream connection: builds merged frames from this node's
+    own live stats plus its child aggregators, delta-encodes, and pushes
+    chunked ndjson until the peer goes away or the service shuts down.
+
+    Push policy: a frame goes out when the configured interval elapsed
+    (heartbeat — an empty delta still carries Seq, which doubles as the
+    consumer's liveness signal) or IMMEDIATELY when a completion-relevant
+    value changes (per-host done/error counts, phase identity, subtree
+    reachability), checked every TICK_SECS."""
+
+    def __init__(self, state, handler, params: dict, default_port: int):
+        self.state = state
+        self.handler = handler
+        self.bench_id = params.get(proto.KEY_BENCH_ID, "")
+        try:
+            interval_ms = int(params.get(proto.KEY_STREAM_INTERVAL_MS,
+                                         500) or 500)
+        except ValueError:
+            interval_ms = 500
+        self.interval_ms = max(interval_ms, MIN_INTERVAL_MS)
+        try:
+            self.fanout = max(int(params.get(proto.KEY_STREAM_FANOUT, 0)
+                                  or 0), 0)
+        except ValueError:
+            self.fanout = 0
+        subtree = [h for h in
+                   (params.get(proto.KEY_STREAM_SUBTREE, "") or "")
+                   .split(",") if h]
+        self.aggs = [
+            ChildAggregator(child, chunk, self.bench_id, self.interval_ms,
+                            self.fanout, state.pw_hash, default_port)
+            for child, chunk in plan_subtree(subtree, self.fanout)]
+
+    def build_frame(self) -> dict:
+        """Current merged state: own live stats + every reachable child's
+        subtree state, per-host detail in Hosts, unreachable sub-subtrees
+        listed in Unreach for the master's direct-attachment fallback."""
+        stats = self.state.status()
+        merged = dict(stats)
+        hosts = {SELF_LABEL: live_host_entry(stats)}
+        unreach: "list[str]" = []
+        depth = 1
+        for agg in self.aggs:
+            snap = agg.snapshot()
+            if snap is None:
+                if agg.down_for_secs() >= agg.unreach_grace_secs:
+                    # past the warm-up/blip grace: the child and its
+                    # whole assigned sub-subtree fall to the master's
+                    # direct-attachment ladder
+                    unreach.append(agg.label)
+                    unreach.extend(agg.subtree)
+                continue
+            depth = max(depth, 1 + snap.get(KEY_AGG_DEPTH, 1))
+            merge_subtree_frame(merged, snap)
+            for hlabel, entry in snap.get(KEY_HOSTS, {}).items():
+                if hlabel == SELF_LABEL:
+                    entry = dict(entry)
+                    entry[HOST_RTT] = agg.rtt_usec
+                    if agg.hijacked:
+                        entry[HOST_HIJACKED] = 1
+                    hosts[agg.label] = entry
+                else:
+                    hosts[hlabel] = entry
+            unreach.extend(snap.get(KEY_UNREACH, []))
+        merged[KEY_HOSTS] = hosts
+        merged[KEY_AGG_DEPTH] = depth
+        merged[KEY_UNREACH] = sorted(set(unreach))
+        return merged
+
+    def _tick_signature(self) -> tuple:
+        """Cheap completion-relevant signal computed WITHOUT building a
+        frame: the node's own phase/done/error state, each child's
+        per-applied-frame done/err signature, and each child's
+        reachability verdict. A change here pushes immediately; full
+        frame builds otherwise happen only at the interval cadence —
+        idle 25ms ticks must stay near-free (dozens of sessions tick
+        concurrently on an interior node)."""
+        return (
+            self.state.cheap_live_signature(),
+            tuple(agg.done_err_sig for agg in self.aggs),
+            tuple(agg.snapshot() is None
+                  and agg.down_for_secs() >= agg.unreach_grace_secs
+                  for agg in self.aggs),
+        )
+
+    def serve(self) -> None:
+        h = self.handler
+        h.send_response(200)
+        h.send_header("Content-Type", NDJSON_CONTENT_TYPE)
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+        h.close_connection = True
+        try:
+            h.connection.settimeout(SEND_TIMEOUT_SECS)
+        except OSError:
+            pass
+        for agg in self.aggs:
+            agg.start()
+        interval = self.interval_ms / 1000.0
+        prev: dict = {}
+        seq = 0
+        last_push = 0.0
+        last_sig = None
+        try:
+            while not self.state.stream_shutdown.is_set():
+                sig = self._tick_signature()
+                now = time.monotonic()
+                if seq and sig == last_sig and now - last_push < interval:
+                    time.sleep(TICK_SECS)
+                    continue
+                cur = self.build_frame()
+                seq += 1
+                full = seq == 1 or seq % FULL_FRAME_EVERY == 0
+                payload = dict(cur) if full else encode_delta(prev, cur)
+                payload[KEY_SEQ] = seq
+                if full:
+                    payload[KEY_FULL] = 1
+                data = (json.dumps(payload, separators=(",", ":"))
+                        + "\n").encode()
+                h.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                prev = cur
+                last_push = now
+                last_sig = sig
+                # route-aware lease renewal: only a stream carrying the
+                # run's CURRENT bench UUID proves the owning master
+                # alive — and only when the peer is actually DRAINING
+                # the stream: a black-holed master (partition, preempted
+                # VM) leaves our small frames piling up in the kernel
+                # send queue, and counting those buffered writes as
+                # liveness would delay orphan recovery far past
+                # --svcleasesecs
+                if self._send_queue_drained(h.connection):
+                    self.state.stream_pushed(self.bench_id)
+                time.sleep(TICK_SECS)
+        except (OSError, ValueError):
+            pass  # peer went away; the session dies with it
+        finally:
+            for agg in self.aggs:
+                agg.stop()
+            try:
+                h.wfile.write(b"0\r\n\r\n")
+            except (OSError, ValueError):
+                pass
+
+    #: unsent bytes allowed in the peer's direction before a push stops
+    #: counting as a lease renewal (a few frames of slack for a busy but
+    #: alive master)
+    SEND_QUEUE_SLACK_BYTES = 8192
+
+    @staticmethod
+    def _send_queue_drained(sock) -> bool:
+        """True when the connection's kernel send queue holds (nearly)
+        nothing — i.e. the peer has been ACKing what we push. Falls back
+        to True where the TIOCOUTQ ioctl is unavailable (non-Linux):
+        renewal then degrades to write-success semantics."""
+        try:
+            import fcntl as _fcntl
+            import struct
+            import termios
+            buf = _fcntl.ioctl(sock.fileno(), termios.TIOCOUTQ,
+                               struct.pack("i", 0))
+            return struct.unpack("i", buf)[0] \
+                <= StreamSession.SEND_QUEUE_SLACK_BYTES
+        except (ImportError, OSError, AttributeError):
+            return True
+
+
+# ---------------------------------------------------------------------------
+# interrupt fan-out along the tree (teardown is O(fanout) too)
+# ---------------------------------------------------------------------------
+
+def forward_interrupt(state, params: dict) -> None:
+    """/interruptphase carrying a Subtree param: forward the interrupt to
+    this node's direct children (each with ITS sub-subtree) concurrently,
+    best-effort and bounded — a dead child must not stall teardown."""
+    subtree = [h for h in (params.get(proto.KEY_STREAM_SUBTREE, "") or "")
+               .split(",") if h]
+    if not subtree:
+        return
+    try:
+        fanout = max(int(params.get(proto.KEY_STREAM_FANOUT, 0) or 0), 0)
+    except ValueError:
+        fanout = 0
+    quit_param = proto.KEY_INTERRUPT_QUIT in params
+    from .remote_worker import ServiceClient
+
+    # every node bounds its OWN forwards by this join deadline, so a
+    # child always replies within ~FORWARD_JOIN_SECS no matter how deep
+    # (or dead) the tree below it is — which is why the per-request read
+    # timeout must EXCEED it, or a parent would declare a healthy child
+    # unreachable merely for waiting on ITS dead descendants
+    forward_timeout = FORWARD_JOIN_SECS + 3
+
+    def send_one(target: str, chunk: "list[str]") -> None:
+        client = ServiceClient(target, state.base_cfg.service_port,
+                               state.pw_hash, gauge=False)
+        fwd_params = {}
+        if quit_param:
+            fwd_params[proto.KEY_INTERRUPT_QUIT] = "1"
+        if chunk:
+            fwd_params[proto.KEY_STREAM_SUBTREE] = ",".join(chunk)
+            fwd_params[proto.KEY_STREAM_FANOUT] = fanout
+        try:
+            client._request("GET", proto.PATH_INTERRUPT_PHASE, fwd_params,
+                            timeout=forward_timeout)
+        except Exception:  # noqa: BLE001 - best effort, like teardown
+            logger.log_error(f"interrupt forward to {target} failed"
+                             + (f"; sending directly to its {len(chunk)} "
+                                f"sub-subtree host(s)" if chunk else ""))
+            # a dead child must not strand its sub-subtree with workers
+            # still running: degrade to direct sends (the teardown
+            # analogue of the Unreach -> direct-attachment ladder)
+            for sub_host in chunk:
+                send_one(sub_host, [])
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=send_one, args=(child, chunk),
+                                daemon=True,
+                                name=f"svc-int-fwd-{child}")
+               for child, chunk in plan_subtree(subtree, fanout)]
+    for t in threads:
+        t.start()
+    # one shared deadline for ALL forwards: this runs under the route
+    # lock, and a row of dead children must not stall the control plane
+    # for fanout x timeout
+    deadline = time.monotonic() + FORWARD_JOIN_SECS
+    for t in threads:
+        t.join(timeout=max(deadline - time.monotonic(), 0))
+
+
+# ---------------------------------------------------------------------------
+# master side: per-run streaming state
+# ---------------------------------------------------------------------------
+
+class HostStreamState:
+    """Per-host live view fed by root stream readers; waited on by the
+    host's RemoteWorker under StreamControl.cond."""
+
+    __slots__ = ("done", "err", "entries", "bytes", "iops", "cpu", "rtt",
+                 "hijacked", "unreachable", "attached", "last_change")
+
+    def __init__(self):
+        self.reset(time.monotonic())
+
+    def reset(self, now: float) -> None:
+        self.done = 0
+        self.err = 0
+        self.entries = 0
+        self.bytes = 0
+        self.iops = 0
+        self.cpu = 0.0
+        self.rtt = 0
+        self.hijacked = False
+        self.unreachable = False
+        self.attached = True
+        self.last_change = now
+
+
+class StreamControl:
+    """Master-side streaming bookkeeping for one run: the attachment plan
+    (roots + their subtrees), per-host live state distributed from root
+    stream frames, and the detach logic that keeps the invariant: a
+    host's live contribution reaches the master EITHER via the tree
+    (attached) OR via its own /status polling (detached), never both."""
+
+    def __init__(self, cfg, hosts: "list[str]"):
+        self.cfg = cfg
+        self.fanout = max(getattr(cfg, "svc_fanout", 0), 0)
+        self.hosts = list(hosts)
+        self.plan = dict(plan_tree(self.hosts, self.fanout))
+        self.cond = threading.Condition()
+        self.states = {h: HostStreamState() for h in self.hosts}
+        self.workers_by_host: dict = {}
+        self._phase_uuid: "str | None" = None
+        self._entered = 0  # workers past /startphase, into live-waiting
+        # reverse tree map: which root's stream serves each host (a root
+        # serves itself) — waiters consult it to notice a root whose
+        # WORKER is gone (degraded in an earlier phase) and can
+        # therefore never stream nor detach them
+        self.root_of: "dict[str, str]" = {}
+        for root, subtree in self.plan.items():
+            self.root_of[root] = root
+            for member in subtree:
+                self.root_of[member] = root
+
+    def register_workers(self, workers) -> None:
+        self.workers_by_host = {
+            w.host: w for w in workers if getattr(w, "host", None)}
+
+    def subtree_of(self, host: str) -> "list[str] | None":
+        """The subtree a root host aggregates; None for non-root hosts."""
+        return self.plan.get(host)
+
+    def ensure_phase(self, bench_id: str) -> None:
+        """First worker entering a new phase resets the per-host states
+        (idempotent for the others — keyed by the phase's bench UUID)."""
+        with self.cond:
+            if self._phase_uuid == bench_id:
+                return
+            self._phase_uuid = bench_id
+            self._entered = 0
+            now = time.monotonic()
+            for st in self.states.values():
+                st.reset(now)
+
+    def state_of(self, host: str) -> HostStreamState:
+        return self.states[host]
+
+    def note_entered(self) -> None:
+        """A worker finished /startphase and is now live-waiting; once
+        ALL active workers are past that point the master's steady-state
+        connection census (SvcConnHwm) becomes meaningful — during the
+        start burst, per-host request connections are legitimately still
+        open."""
+        with self.cond:
+            self._entered += 1
+
+    def all_entered(self) -> bool:
+        active = sum(1 for w in self.workers_by_host.values()
+                     if not getattr(w, "degraded", False))
+        with self.cond:
+            return self._entered >= active > 0
+
+    def detach_host(self, host: str) -> None:
+        """The host leaves the streaming plane for this phase (its worker
+        falls back to direct polling); later tree frames must no longer
+        mirror into its worker, or its contribution would double."""
+        with self.cond:
+            st = self.states.get(host)
+            if st is not None:
+                st.attached = False
+            self.cond.notify_all()
+
+    def detach_subtree(self, root_host: str) -> None:
+        """Root stream died: every still-attached, still-waiting host of
+        its subtree becomes unreachable so the waiters fall back too."""
+        with self.cond:
+            for label in (root_host, *self.plan.get(root_host, ())):
+                st = self.states.get(label)
+                if st is not None and st.attached:
+                    st.unreachable = True
+            self.cond.notify_all()
+
+    def ingest_frame(self, root_host: str, state: dict) -> None:
+        """Distribute a root frame's per-host entries into the host
+        states and the per-host RemoteWorker mirrors (live_ops for the
+        master's live display, CPU gauge, stream-open RTT as the
+        --svcping value)."""
+        with self.cond:
+            now = time.monotonic()
+            for label, entry in state.get(KEY_HOSTS, {}).items():
+                if label == SELF_LABEL:
+                    label = root_host
+                st = self.states.get(label)
+                if st is None or not st.attached:
+                    continue
+                prog = (entry.get(HOST_ENTRIES, 0),
+                        entry.get(HOST_BYTES, 0),
+                        entry.get(HOST_IOPS, 0),
+                        entry.get(HOST_DONE, 0))
+                if prog != (st.entries, st.bytes, st.iops, st.done):
+                    st.last_change = now
+                st.entries, st.bytes, st.iops, st.done = prog
+                st.err = entry.get(HOST_ERR, 0)
+                st.cpu = entry.get(HOST_CPU, 0.0)
+                st.rtt = entry.get(HOST_RTT, st.rtt)
+                if entry.get(HOST_HIJACKED):
+                    st.hijacked = True
+                worker = self.workers_by_host.get(label)
+                if worker is not None:
+                    worker.live_ops.num_entries_done = st.entries
+                    worker.live_ops.num_bytes_done = st.bytes
+                    worker.live_ops.num_iops_done = st.iops
+                    worker.cpu_util_pct = st.cpu
+                    if st.rtt:
+                        worker.last_ping_usec = st.rtt
+            for label in state.get(KEY_UNREACH, ()):
+                st = self.states.get(label)
+                if st is not None:
+                    st.unreachable = True
+            self.cond.notify_all()
+
+    def root_worker_lost(self, host: str) -> bool:
+        """True when the worker that would stream for this host no
+        longer exists or was degraded out of the run (--svctolerant):
+        it can never open the subtree stream NOR run the detach in
+        _run_root_stream's finally, so its waiters must detach
+        themselves instead of holding the phase barrier forever."""
+        root_worker = self.workers_by_host.get(
+            self.root_of.get(host, host))
+        return root_worker is None \
+            or getattr(root_worker, "degraded", False)
+
+    def subtree_fully_attached(self, root_host: str) -> bool:
+        """True while every host of the root's subtree is still served by
+        the tree. The moment ANY member detaches to polling, the root
+        must stop ingesting the subtree-aggregated telemetry: the
+        interior aggregator keeps retrying the lost child forever, so a
+        recovered child would re-enter the aggregate while its own
+        polling worker also reports it — the one way a host could count
+        twice. Detachment is one-way per phase, so this latches False."""
+        with self.cond:
+            return all(self.states[label].attached
+                       for label in (root_host,
+                                     *self.plan.get(root_host, ())))
+
+    def subtree_satisfied(self, root_host: str, num_threads: int) -> bool:
+        """True when no attached subtree host (incl. the root itself) is
+        still mid-phase: each is done, errored, hijacked, unreachable, or
+        already detached to polling."""
+        with self.cond:
+            for label in (root_host, *self.plan.get(root_host, ())):
+                st = self.states[label]
+                if st.attached and not st.unreachable and not st.hijacked \
+                        and not st.err and st.done < num_threads:
+                    return False
+            return True

@@ -15,6 +15,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.request
 
@@ -117,6 +118,64 @@ def service_procs(ports: "list[int]", env: "dict | None" = None,
                 _print_log_tail(port, path)
             with contextlib.suppress(OSError):
                 os.unlink(path)
+
+
+@contextlib.contextmanager
+def in_process_services(num: int, extra_argv: "list[str] | None" = None):
+    """``num`` threaded service instances INSIDE this process — no
+    subprocess (or jax re-import) per host, which is what lets the scale
+    suite stand up a 64-host loopback fleet in seconds. Yields the port
+    list. Each instance is a full ServiceState + ThreadingHTTPServer on
+    an ephemeral localhost port, serving the real route table (incl.
+    /livestream), so a master run against them exercises the genuine
+    control plane."""
+    from elbencho_tpu.config.args import parse_cli
+    from elbencho_tpu.service.http_service import create_service_server
+
+    # NOTE: the real service role enables the global logger error
+    # history; in-process instances deliberately do NOT — the master
+    # shares this process, and its own error lines would echo back
+    # through every /benchresult history replay (and re-enter the
+    # history, cascading). Error-history semantics stay covered by the
+    # subprocess-based suites.
+    ports = free_ports(num)
+    servers = []  # (server, state, holder, thread) per instance
+    threads = []
+
+    def serve(server, holder):
+        while not holder["shutdown"]:
+            try:
+                server.handle_request()
+            except OSError:  # server_close raced the accept loop
+                return
+
+    try:
+        for port in ports:
+            cfg, _ns = parse_cli(["--service", "--foreground",
+                                  "--port", str(port)]
+                                 + list(extra_argv or []))
+            cfg.derive(probe_paths=False)
+            cfg.check()
+            server, state, holder = create_service_server(
+                cfg, bind_host="127.0.0.1")
+            t = threading.Thread(target=serve, args=(server, holder),
+                                 name=f"inproc-svc-{port}", daemon=True)
+            t.start()
+            servers.append((server, state, holder))
+            threads.append(t)
+        for port in ports:
+            wait_ready(port, timeout=30)
+        yield ports
+    finally:
+        for _server, _state, holder in servers:
+            holder["shutdown"] = True
+        for t in threads:
+            t.join(timeout=5)
+        for server, state, _holder in servers:
+            with contextlib.suppress(Exception):
+                state.close()
+            with contextlib.suppress(OSError):
+                server.server_close()
 
 
 def _print_log_tail(port: int, path: str, max_bytes: int = 8192) -> None:

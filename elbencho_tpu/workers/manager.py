@@ -43,6 +43,10 @@ class WorkerManager:
             for host_idx, host in enumerate(self.cfg.hosts):
                 worker = RemoteWorker(self.shared, host_idx, host)
                 self.workers.append(worker)
+            if self.shared.stream_control is not None:
+                # --svcstream: root stream readers mirror per-host frame
+                # entries straight into these workers' live counters
+                self.shared.stream_control.register_workers(self.workers)
         else:
             for rank in range(self.cfg.num_threads):
                 worker = LocalWorker(self.shared,

@@ -76,6 +76,15 @@ class WorkersSharedData:
         # (None when tracing is off — instrumentation stays no-op)
         from ..telemetry.tracer import make_tracer
         self.tracer = make_tracer(config)
+        # --svcstream: master-side streaming control plane bookkeeping
+        # (tree plan + per-host live states fed by root stream readers);
+        # None = per-request polling, byte-for-byte parity
+        self.stream_control = None
+        if getattr(config, "svc_stream", False) \
+                and getattr(config, "hosts", None) \
+                and not getattr(config, "run_as_service", False):
+            from ..service.stream import StreamControl
+            self.stream_control = StreamControl(config, config.hosts)
         # --rwmixthrpct byte-ratio balancer, shared by all workers
         # (reference: RateLimiterRWMixThreads static atomics)
         self.rwmix_balancer = None

@@ -322,7 +322,12 @@ def test_control_audit_schema_gained_lease_counters_appended():
     keys = [key for _attr, key, _mode in CONTROL_AUDIT_COUNTERS]
     assert keys[:3] == ["SvcRetries", "SvcConsecRetriesHwm",
                         "SvcHeartbeatAgeHwmUsec"]
-    assert keys[3:] == ["SvcLeaseExpiries", "SvcLeaseAgeHwmUsec"]
+    # the lease pair keeps its appended positions; later additions (the
+    # streaming-control-plane block) may only append AFTER it
+    assert keys[3:5] == ["SvcLeaseExpiries", "SvcLeaseAgeHwmUsec"]
+    assert keys[5:] == ["SvcRequests", "SvcCtlBytes", "SvcStreamFrames",
+                        "SvcStreamBytes", "SvcDeltaSavedBytes",
+                        "SvcAggDepthHwm", "SvcConnHwm"]
     w1 = types.SimpleNamespace(svc_lease_expiries=2,
                                svc_lease_age_hwm_usec=5000)
     w2 = types.SimpleNamespace(svc_lease_expiries=1,
@@ -608,8 +613,10 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
                   str(f), "--csv"], capture_output=True, text=True)
     assert res.returncode == 0, res.stderr
     header = res.stdout.splitlines()[0].split(",")
-    assert header[-2:] == ["LeaseExp", "Resumed"]
+    # the streaming-control-plane trio appends after the lifecycle pair
+    # (never reordered)
+    assert header[-5:-3] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-2:] == ["2", "3"]
+    assert row[-5:-3] == ["2", "3"]
     assert "RESUMED" in res.stderr

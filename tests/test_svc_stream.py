@@ -1,0 +1,686 @@
+"""Streaming control plane tests (--svcstream/--svcfanout, ISSUE 8).
+
+Layers under test, bottom-up:
+- delta codec properties: encode/apply round trips, re-apply idempotence,
+  sequence-gap detection, full-snapshot resync after a dropped frame
+- aggregation-tree planning (partition/shape) and merge equivalence:
+  tree-merged totals == flat-merged totals for every sum and MAX counter
+- lease-over-stream semantics: the owner stream renews, observer streams
+  never do, and orphan recovery fires when the stream dies mid-phase
+- ServiceClient persistent connections (reuse + stale-socket reconnect)
+- end-to-end master runs against in-process service fleets: streaming
+  results match polling results, audit counters prove the stream ran,
+  and the stream -> poll fallback ladder engages LOUDLY when forced
+"""
+
+import json
+import random
+import threading
+import time
+import types
+
+import pytest
+
+from elbencho_tpu.config.args import ConfigError, parse_cli
+from elbencho_tpu.phases import BenchPhase
+from elbencho_tpu.service import protocol as proto
+from elbencho_tpu.service import stream
+from elbencho_tpu.service.stream import (
+    HOST_BYTES, HOST_DONE, HOST_ENTRIES, HOST_IOPS, KEY_AGG_DEPTH,
+    KEY_FULL, KEY_HOSTS, KEY_SEQ, SELF_LABEL, StreamProtocolError,
+    apply_delta, check_seq, encode_delta, merge_subtree_frame,
+    plan_subtree, plan_tree, tree_depth)
+from elbencho_tpu.testing.service_harness import in_process_services
+
+
+# ---------------------------------------------------------------------------
+# delta codec properties
+# ---------------------------------------------------------------------------
+
+def _random_state(rng, hosts):
+    state = {
+        "BenchID": rng.choice(["u1", "u2", ""]),
+        "PhaseCode": rng.randint(0, 20),
+        "NumEntriesDone": rng.randint(0, 10_000),
+        "NumBytesDone": rng.randint(0, 1 << 40),
+        "NumIOPSDone": rng.randint(0, 10_000),
+        "TpuPipeInflightHwm": rng.randint(0, 64),
+        "SvcLeaseAgeHwmUsec": rng.randint(0, 1_000_000),
+        "CPUUtil": round(rng.random() * 100, 1),
+    }
+    state[KEY_HOSTS] = {
+        h: {HOST_DONE: rng.randint(0, 4), HOST_ENTRIES: rng.randint(0, 99),
+            HOST_BYTES: rng.randint(0, 1 << 30), HOST_IOPS: rng.randint(0, 99)}
+        for h in hosts}
+    return state
+
+
+def _mutate(rng, state, hosts):
+    new = json.loads(json.dumps(state))  # deep copy via the wire format
+    for key in ("NumEntriesDone", "NumBytesDone", "NumIOPSDone"):
+        if rng.random() < 0.7:
+            new[key] += rng.randint(0, 1000)
+    if rng.random() < 0.3:
+        new["BenchID"] = rng.choice(["u1", "u2", "u3"])
+    for h in hosts:
+        if rng.random() < 0.5:
+            new[KEY_HOSTS][h][HOST_ENTRIES] += rng.randint(1, 9)
+            new[KEY_HOSTS][h][HOST_BYTES] += rng.randint(1, 1 << 20)
+    return new
+
+
+def test_delta_roundtrip_over_random_sequences():
+    """apply(encode(prev, cur)) onto prev reproduces cur exactly, across
+    long random mutation chains (the consumer's whole correctness)."""
+    rng = random.Random(1612)
+    hosts = [f"h{i}:161{i}" for i in range(5)]
+    for _round in range(20):
+        cur = _random_state(rng, hosts)
+        applied = dict(cur)  # consumer starts from a full snapshot
+        for _step in range(30):
+            nxt = _mutate(rng, cur, hosts)
+            delta = encode_delta(cur, nxt)
+            applied = apply_delta(applied, delta)
+            assert applied == nxt
+            cur = nxt
+
+
+def test_delta_reapply_is_idempotent():
+    rng = random.Random(7)
+    hosts = ["a:1", "b:2"]
+    cur = _random_state(rng, hosts)
+    nxt = _mutate(rng, cur, hosts)
+    delta = encode_delta(cur, nxt)
+    once = apply_delta(cur, delta)
+    twice = apply_delta(once, delta)
+    assert once == nxt and twice == nxt
+
+
+def test_unchanged_state_encodes_to_empty_delta():
+    """The steady-state heartbeat frame carries nothing but its Seq."""
+    rng = random.Random(3)
+    cur = _random_state(rng, ["a:1"])
+    assert encode_delta(cur, json.loads(json.dumps(cur))) == {}
+
+
+def test_seq_gap_detected_and_full_frame_resyncs():
+    """A dropped frame breaks the sequence contract; a full snapshot
+    re-anchors and reproduces the direct state (resync semantics)."""
+    rng = random.Random(99)
+    hosts = ["a:1", "b:2", "c:3"]
+    states = [_random_state(rng, hosts)]
+    for _ in range(5):
+        states.append(_mutate(rng, states[-1], hosts))
+    frames = []
+    for i, st in enumerate(states):
+        frame = dict(st) if i == 0 else encode_delta(states[i - 1], st)
+        frame[KEY_SEQ] = i + 1
+        if i == 0:
+            frame[KEY_FULL] = 1
+        frames.append(frame)
+    # clean replay
+    last_seq, applied = 0, {}
+    for f in frames:
+        last_seq = check_seq(last_seq, f)
+        applied = apply_delta({} if f.get(KEY_FULL) else applied, f)
+    assert applied == states[-1]
+    # drop frame 3: the gap must be detected, not silently mis-applied
+    last_seq, applied = 0, {}
+    for f in frames[:2]:
+        last_seq = check_seq(last_seq, f)
+        applied = apply_delta({} if f.get(KEY_FULL) else applied, f)
+    with pytest.raises(StreamProtocolError):
+        check_seq(last_seq, frames[3])
+    # resync: a fresh full snapshot equals the direct state
+    resync = dict(states[-1])
+    resync[KEY_SEQ] = 1
+    resync[KEY_FULL] = 1
+    assert apply_delta({}, resync) == states[-1]
+
+
+def test_delta_before_any_full_snapshot_rejected():
+    with pytest.raises(StreamProtocolError):
+        check_seq(0, {KEY_SEQ: 2})
+    with pytest.raises(StreamProtocolError):
+        check_seq(0, {KEY_SEQ: "x"})
+
+
+# ---------------------------------------------------------------------------
+# tree planning + merge equivalence
+# ---------------------------------------------------------------------------
+
+def _tree_covers_all(hosts, fanout):
+    """Every host appears exactly once across the whole recursive plan."""
+    seen = []
+
+    def walk(sub):
+        for child, chunk in plan_subtree(sub, fanout):
+            seen.append(child)
+            walk(chunk)
+
+    roots = plan_tree(hosts, fanout)
+    for root, sub in roots:
+        seen.append(root)
+        walk(sub)
+    return sorted(seen) == sorted(hosts)
+
+
+@pytest.mark.parametrize("num_hosts,fanout", [
+    (1, 0), (5, 0), (3, 2), (7, 2), (64, 8), (100, 3), (8, 8), (9, 8)])
+def test_plan_tree_partitions_every_host_once(num_hosts, fanout):
+    hosts = [f"h{i}:1611" for i in range(num_hosts)]
+    assert _tree_covers_all(hosts, fanout)
+    roots = plan_tree(hosts, fanout)
+    assert len(roots) == (min(fanout, num_hosts) if fanout else num_hosts)
+
+
+def test_tree_depth_shapes():
+    assert tree_depth(64, 8) == 2   # 8 roots + 8 children each
+    assert tree_depth(8, 8) == 1
+    assert tree_depth(9, 2) == 3    # 2 + 4 + ... covers 9 hosts at depth 3
+    assert tree_depth(5, 0) == 1    # flat
+
+
+def _fake_live_dict(rng):
+    """A live-stats-shaped dict with sum counters, MAX hwm counters, and
+    a mergeable histogram."""
+    from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+    h = LatencyHistogram()
+    for _ in range(rng.randint(0, 20)):
+        h.add_latency(rng.randint(1, 100_000))
+    return {
+        "BenchID": "u1", "PhaseCode": 3, "PhaseName": "WRITE",
+        "NumWorkersDone": rng.randint(0, 4),
+        "NumWorkersDoneWithError": rng.randint(0, 1),
+        "NumEntriesDone": rng.randint(0, 9999),
+        "NumBytesDone": rng.randint(0, 1 << 33),
+        "NumIOPSDone": rng.randint(0, 9999),
+        "CPUUtil": round(rng.random() * 100, 1),
+        "TpuHbmBytes": rng.randint(0, 1 << 30),
+        "TpuH2dDirectOps": rng.randint(0, 500),
+        "TpuPipeInflightHwm": rng.randint(0, 64),       # MAX-merged
+        "PoolOccupancyHwm": rng.randint(0, 32),          # MAX-merged
+        "SvcLeaseExpiries": rng.randint(0, 3),           # sum
+        "SvcLeaseAgeHwmUsec": rng.randint(0, 10 ** 7),   # MAX-merged
+        "IOLatHisto": h.to_dict(),
+    }
+
+
+def test_tree_merge_equals_flat_merge():
+    """Merging per-host stats up an arbitrary tree must give the same
+    totals as merging them flat, for every sum counter, every MAX
+    counter, and the histograms — otherwise the master's fleet view
+    would depend on the tree shape."""
+    rng = random.Random(42)
+    for fanout in (2, 3, 8):
+        stats = {f"h{i}": _fake_live_dict(rng) for i in range(17)}
+        hosts = list(stats)
+
+        def tree_merge(node, subtree):
+            merged = dict(stats[node])
+            for child, chunk in plan_subtree(subtree, fanout):
+                merge_subtree_frame(merged, tree_merge(child, chunk))
+            return merged
+
+        # flat: fold every host into the first
+        flat = dict(stats[hosts[0]])
+        for h in hosts[1:]:
+            merge_subtree_frame(flat, stats[h])
+        # tree: roots merged into the first root (the master's own fold)
+        roots = plan_tree(hosts, fanout)
+        tree = tree_merge(roots[0][0], roots[0][1])
+        for root, sub in roots[1:]:
+            merge_subtree_frame(tree, tree_merge(root, sub))
+        for key in flat:
+            if key in stream.MERGE_EXCLUDED_KEYS:
+                continue
+            assert tree[key] == flat[key], f"{key} diverges under fanout " \
+                                           f"{fanout}"
+
+
+# ---------------------------------------------------------------------------
+# lease-over-stream semantics
+# ---------------------------------------------------------------------------
+
+class _FakeManager:
+    def __init__(self, busy=True, uuid="run-uuid-1"):
+        self.busy = busy
+        self.shared = types.SimpleNamespace(
+            request_interrupt=lambda: None,
+            clear_bench_uuid=lambda: None, bench_uuid=uuid,
+            current_phase=BenchPhase.CREATEFILES)
+
+    def all_workers_done(self):
+        return not self.busy
+
+    def interrupt_and_notify_workers(self):
+        pass
+
+    def join_all_threads(self):
+        pass
+
+
+def _service_state():
+    from elbencho_tpu.service.http_service import ServiceState
+    cfg, _ = parse_cli(["--service", "--foreground"])
+    cfg.derive(probe_paths=False)
+    return ServiceState(cfg)
+
+
+def test_stream_push_renews_owner_never_observer():
+    """stream_pushed is the stream analogue of the route-aware /status
+    rule: only a push on a stream opened with the run's CURRENT bench
+    UUID renews the lease."""
+    state = _service_state()
+    state.manager = _FakeManager(uuid="run-uuid-1")
+    state._arm_lease(30)
+    state._lease_last_contact -= 10
+    state.stream_pushed("")  # observer stream: no UUID
+    assert time.monotonic() - state._lease_last_contact > 5
+    state.stream_pushed("some-other-master")  # stale/foreign UUID
+    assert time.monotonic() - state._lease_last_contact > 5
+    state.stream_pushed("run-uuid-1")  # the owner
+    assert time.monotonic() - state._lease_last_contact < 5
+    state._lease_stop.set()
+
+
+def test_orphan_recovery_fires_when_stream_dies_mid_phase():
+    """A live owner stream keeps the service leased; the moment it dies
+    (pushes stop), the watchdog orphans the busy pool — an observer
+    stream pushing all along must not prevent it."""
+    state = _service_state()
+    mgr = _FakeManager(busy=True, uuid="u-stream")
+    state.manager = mgr
+    state._arm_lease(1)
+
+    stop_owner = threading.Event()
+
+    def owner_stream():
+        while not stop_owner.is_set():
+            state.stream_pushed("u-stream")
+            time.sleep(0.1)
+
+    stop_observer = threading.Event()
+
+    def observer_stream():
+        while not stop_observer.is_set():
+            state.stream_pushed("")  # dashboards etc. never renew
+            time.sleep(0.05)
+
+    t_owner = threading.Thread(target=owner_stream, daemon=True)
+    t_obs = threading.Thread(target=observer_stream, daemon=True)
+    t_owner.start()
+    t_obs.start()
+    try:
+        time.sleep(2.0)  # well past the 1s lease: owner pushes held it
+        assert state.lease_expiries == 0
+        assert state.manager is mgr
+        stop_owner.set()  # the owner stream dies mid-phase
+        t_owner.join()
+        deadline = time.monotonic() + 6
+        while state.manager is not None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert state.manager is None, \
+            "orphan recovery must fire once the owner stream dies"
+        assert state.lease_expiries == 1
+    finally:
+        stop_owner.set()
+        stop_observer.set()
+        t_obs.join()
+        state._lease_stop.set()
+
+
+# ---------------------------------------------------------------------------
+# persistent connections + raw stream consumption (one in-process service)
+# ---------------------------------------------------------------------------
+
+def test_persistent_connection_reuse_and_stale_reconnect():
+    from elbencho_tpu.service.remote_worker import ServiceClient
+    with in_process_services(1) as ports:
+        client = ServiceClient("127.0.0.1", ports[0])
+        try:
+            status, _ = client.get_json(proto.PATH_STATUS)
+            assert status == 200
+            conn = client._conn
+            assert conn is not None, "connection must persist"
+            status, _ = client.get_json(proto.PATH_STATUS)
+            assert status == 200
+            assert client._conn is conn, "second request must reuse it"
+            # stale keep-alive socket (service idle-timeout closed it, or
+            # it broke): the next request reconnects transparently
+            conn.sock.close()
+            status, _ = client.get_json(proto.PATH_STATUS)
+            assert status == 200
+            assert client._conn is not None and client._conn is not conn
+        finally:
+            client.close()
+        assert ServiceClient.open_connections == 0, \
+            "closed clients must not leak gauge counts"
+
+
+def test_observer_stream_frames_full_then_delta():
+    from elbencho_tpu.service.remote_worker import ServiceClient
+    with in_process_services(1) as ports:
+        client = ServiceClient("127.0.0.1", ports[0])
+        handle = client.open_stream("", interval_ms=50, read_timeout=5.0)
+        try:
+            first = handle.read_frame()
+            assert first.get(KEY_FULL) == 1 and first[KEY_SEQ] == 1
+            assert SELF_LABEL in first[KEY_HOSTS]
+            assert first[KEY_AGG_DEPTH] == 1  # leaf: no children below
+            last_seq = check_seq(0, first)
+            state = apply_delta({}, first)
+            for _ in range(3):  # idle heartbeats: tiny deltas, gap-free
+                frame = handle.read_frame()
+                last_seq = check_seq(last_seq, frame)
+                state = apply_delta(state, frame)
+            assert state.get(proto.KEY_PHASE_CODE) == int(BenchPhase.IDLE)
+        finally:
+            handle.close()
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end master runs against in-process fleets
+# ---------------------------------------------------------------------------
+
+def _run_master(args):
+    from elbencho_tpu.cli import main
+    return main(args + ["--nolive"])
+
+
+def _load_jsonl(path):
+    return [json.loads(ln) for ln in path.read_text().splitlines()]
+
+
+def _workload(hosts, bench_dir, jsonfile, extra):
+    return (["-w", "-d", "-t", "2", "-n", "1", "-N", "4", "-s", "8K",
+             "-b", "8K", "--hosts", hosts, "--jsonfile", str(jsonfile),
+             str(bench_dir)] + extra)
+
+
+def test_stream_run_matches_polling_and_proves_itself(tmp_path):
+    """Same workload, polling vs streaming+tree: identical results, and
+    the audit counters prove the stream carried the live stats (frames
+    flowed, the tree reached depth 2, fewer master requests)."""
+    with in_process_services(3) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        poll_json = tmp_path / "poll.json"
+        bench = tmp_path / "bench-poll"
+        bench.mkdir()
+        assert _run_master(_workload(hosts, bench, poll_json, [])) == 0
+        stream_json = tmp_path / "stream.json"
+        bench2 = tmp_path / "bench-stream"
+        bench2.mkdir()
+        assert _run_master(_workload(
+            hosts, bench2, stream_json,
+            ["--svcstream", "--svcfanout", "2"])) == 0
+    polls = {r["Phase"]: r for r in _load_jsonl(poll_json)}
+    streams = {r["Phase"]: r for r in _load_jsonl(stream_json)}
+    assert set(polls) == set(streams)
+    for phase, ps in polls.items():
+        ss = streams[phase]
+        # results identical: the final /benchresult ingest is authoritative
+        assert ss["EntriesLast"] == ps["EntriesLast"]
+        assert ss["BytesLast"] == ps["BytesLast"]
+        assert ss["NumWorkers"] == ps["NumWorkers"]
+        # the stream proved itself
+        assert ss["SvcStreamFrames"] > 0
+        assert ss["SvcStreamBytes"] > 0
+        assert ss["SvcAggDepthHwm"] == 2
+        assert ss["SvcRequests"] < ps["SvcRequests"]
+        assert ss["SvcCtlBytes"] > 0
+        # polling mode never streams
+        assert ps["SvcStreamFrames"] == 0
+        assert ps["SvcAggDepthHwm"] == 0
+
+
+def test_stream_fallback_to_polling_is_loud(tmp_path, capsys, monkeypatch):
+    """Force every stream open to fail: the run must complete over the
+    polling rung and say so LOUDLY (stream -> poll ladder)."""
+    from elbencho_tpu.service.remote_worker import ServiceClient
+    from elbencho_tpu.workers.shared import WorkerRemoteException
+
+    def broken_open_stream(self, *a, **kw):
+        raise WorkerRemoteException("stream open disabled by test")
+
+    monkeypatch.setattr(ServiceClient, "open_stream", broken_open_stream)
+    with in_process_services(2) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        out_json = tmp_path / "out.json"
+        bench = tmp_path / "bench"
+        bench.mkdir()
+        rc = _run_master(_workload(hosts, bench, out_json,
+                                   ["--svcstream"]))
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "SVCSTREAM FALLBACK" in err
+    recs = _load_jsonl(out_json)
+    assert all(r["SvcStreamFrames"] == 0 for r in recs)
+    assert all(r["EntriesLast"] for r in recs if r["Phase"] == "WRITE")
+
+
+def test_quit_fanout_walks_the_tree(tmp_path):
+    """--quit with --svcfanout contacts only the roots; the interrupt
+    forward chain must still bring every service down."""
+    from elbencho_tpu.testing.service_harness import (default_env,
+                                                      free_ports,
+                                                      service_procs)
+    from elbencho_tpu.service.remote_worker import send_interrupt_to_hosts
+    env = default_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    ports = free_ports(3)
+    with service_procs(ports, env=env) as procs:
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        # fanout 1: master -> hosts[0] -> hosts[1] -> hosts[2] (a chain —
+        # the worst case for forwarding correctness)
+        send_interrupt_to_hosts(hosts, 1611, quit=True, fanout=1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.2)
+        assert all(p.poll() is not None for p in procs), \
+            "tree-forwarded quit must reach every service"
+
+
+def test_quit_fanout_survives_dead_root(tmp_path):
+    """A dead root must not strand its subtree: the fan-out degrades to
+    direct sends (the teardown analogue of the Unreach ladder)."""
+    from elbencho_tpu.testing.service_harness import (default_env,
+                                                      free_ports,
+                                                      service_procs)
+    from elbencho_tpu.service.remote_worker import send_interrupt_to_hosts
+    env = default_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    ports = free_ports(3)
+    with service_procs(ports, env=env) as procs:
+        hosts = [f"127.0.0.1:{p}" for p in ports]
+        procs[0].kill()  # the only root under fanout 1
+        procs[0].wait(timeout=10)
+        send_interrupt_to_hosts(hosts, 1611, quit=True, fanout=1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in procs[1:]):
+                break
+            time.sleep(0.2)
+        assert all(p.poll() is not None for p in procs[1:]), \
+            "subtree of a dead root must still receive the quit"
+
+
+# ---------------------------------------------------------------------------
+# master-side waiter: a dead/degraded root must not hang its subtree
+# ---------------------------------------------------------------------------
+
+def test_subtree_waiter_detaches_when_root_worker_degraded(tmp_path):
+    """--svctolerant can degrade a ROOT's worker out of the run before
+    it ever opens the subtree stream; its subtree waiters must detach
+    (and fall back to polling) instead of holding the phase barrier
+    forever."""
+    from elbencho_tpu.service.remote_worker import RemoteWorker
+    from elbencho_tpu.service.stream import StreamDetachedError
+    from elbencho_tpu.workers.shared import WorkersSharedData
+
+    cfg, _ = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                        "--hosts", "h1:1611,h2:1611",
+                        "--svcstream", "--svcfanout", "1",
+                        str(tmp_path / "f")])
+    cfg.derive(probe_paths=False)
+    shared = WorkersSharedData(cfg)
+    sc = shared.stream_control
+    assert sc is not None
+    root = RemoteWorker(shared, 0, "h1:1611")      # root of the chain
+    member = RemoteWorker(shared, 1, "h2:1611")    # its subtree host
+    sc.register_workers([root, member])
+    assert sc.root_of["h2:1611"] == "h1:1611"
+    sc.ensure_phase("uuid-1")
+    member._expected_bench_id = "uuid-1"
+    root.degraded = True  # --svctolerant dropped the root mid-run
+    t0 = time.monotonic()
+    with pytest.raises(StreamDetachedError):
+        member._wait_stream_host(BenchPhase.CREATEFILES, sc)
+    assert time.monotonic() - t0 < 5, "detach must be prompt, not a hang"
+
+
+# ---------------------------------------------------------------------------
+# summarize tool: streaming columns append, never reorder
+# ---------------------------------------------------------------------------
+
+def test_summarize_json_stream_columns(tmp_path):
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rec = {"Phase": "WRITE", "EntriesLast": 4, "SvcStreamBytes": 123,
+           "SvcDeltaSavedBytes": 456, "SvcAggDepthHwm": 2}
+    jf = tmp_path / "r.json"
+    jf.write_text(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "tools", "elbencho-tpu-summarize-json"),
+         str(jf), "--csv"],
+        capture_output=True, text=True, check=True)
+    header = out.stdout.splitlines()[0].split(",")
+    row = out.stdout.splitlines()[1].split(",")
+    assert header[-3:] == ["StreamB", "DeltaSave", "AggDepth"]
+    assert row[-3:] == ["123", "456", "2"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: stream mode under host loss (rides `make test-chaos`)
+# ---------------------------------------------------------------------------
+
+def _when_write_active(port, action, timeout=30.0):
+    """Background thread: poll a service's /status until the WRITE phase
+    is live, then run action() (the fault-injection idiom of
+    test_fault_tolerance, replicated to keep this file standalone)."""
+    import urllib.request
+
+    def watch():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status", timeout=2) as r:
+                    st = json.loads(r.read())
+                if st.get("PhaseCode") == int(BenchPhase.CREATEFILES) \
+                        and st.get("NumBytesDone", 0) > 0:
+                    action()
+                    return
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return t
+
+
+@pytest.mark.chaos
+def test_stream_tolerant_run_completes_degraded(tmp_path, capsys):
+    """--svcstream + --svctolerant: a host SIGKILLed mid-phase falls off
+    the streaming plane (stream -> poll fallback), the polling rung then
+    fails too, and the run STILL completes degraded with the survivors —
+    the whole fault-tolerance ladder under the new transport."""
+    from elbencho_tpu.testing.service_harness import (default_env,
+                                                      free_ports,
+                                                      service_procs)
+    env = default_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    ports = free_ports(2)
+    jsonfile = tmp_path / "res.json"
+    with service_procs(ports, env=env) as procs:
+        victim = procs[1]
+        watcher = _when_write_active(ports[1], victim.kill)
+        try:
+            rc = _run_master(
+                ["-w", "-s", "64K", "-b", "4K", "--infloop",
+                 "--timelimit", "5",
+                 "--hosts", ",".join(f"127.0.0.1:{p}" for p in ports),
+                 "--svcstream", "--svcretries", "1",
+                 "--svcretrybudget", "2", "--svctolerant", "1",
+                 "--jsonfile", str(jsonfile),
+                 str(tmp_path / "data.bin")])
+        finally:
+            watcher.join(timeout=5)
+    assert rc == 0, "lost host within --svctolerant must not fail the run"
+    recs = _load_jsonl(jsonfile)
+    write_rec = next(r for r in recs if r["Phase"] == "WRITE")
+    assert write_rec["DegradedHosts"] == [f"127.0.0.1:{ports[1]}"]
+    assert write_rec["NumHostsDegraded"] == 1
+    assert write_rec["SvcStreamFrames"] > 0, \
+        "the surviving host's stream must have carried the phase"
+
+
+@pytest.mark.chaos
+def test_stream_run_with_journal_resumes_as_noop(tmp_path):
+    """--journal + --svcstream: a completed journaled run resumes as an
+    exit-0 no-op — the crash-safe lifecycle is orthogonal to the
+    live-stats transport."""
+    with in_process_services(2) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        journal = tmp_path / "run.journal"
+        bench = tmp_path / "bench"
+        bench.mkdir()
+        args = _workload(hosts, bench, tmp_path / "out.json",
+                         ["--svcstream", "--journal", str(journal)])
+        assert _run_master(args) == 0
+        assert _run_master(args + ["--resume"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+def _check_cfg(argv):
+    cfg, _ = parse_cli(argv)
+    cfg.derive(probe_paths=False)
+    cfg.check()
+    return cfg
+
+
+def test_svcfanout_requires_svcstream(tmp_path):
+    with pytest.raises(ConfigError, match="svcfanout"):
+        _check_cfg(["-w", "-t", "1", "-s", "4K", "--hosts", "h1,h2",
+                    "--svcfanout", "2", str(tmp_path / "f")])
+    # ... but shapes the --interrupt/--quit fan-out without --svcstream
+    cfg = _check_cfg(["--quit", "--hosts", "h1,h2", "--svcfanout", "2"])
+    assert cfg.svc_fanout == 2
+
+
+def test_svcstream_rejects_duplicate_hosts(tmp_path):
+    """Per-host stream state is keyed by host label; the generic
+    duplicate-hosts rejection must hold for streaming runs too."""
+    with pytest.raises(ConfigError, match="duplicates"):
+        _check_cfg(["-w", "-t", "1", "-s", "4K", "--hosts", "h1,h1",
+                    "--svcstream", str(tmp_path / "f")])
+
+
+def test_svcfanout_negative_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="svcfanout"):
+        _check_cfg(["-w", "-t", "1", "-s", "4K", "--hosts", "h1,h2",
+                    "--svcstream", "--svcfanout", "-1",
+                    str(tmp_path / "f")])

@@ -324,10 +324,11 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     header, row = proc.stdout.strip().splitlines()[:2]
     cols = header.split(",")
     # appended, never reordered: the telemetry columns keep their order,
-    # with the (later) data-plane fault-tolerance, staging-pool and
-    # run-lifecycle columns after them
-    assert cols[-13:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    # with the (later) data-plane fault-tolerance, staging-pool,
+    # run-lifecycle, and streaming-control-plane columns after them
+    assert cols[-16:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                           "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                           "PoolReuse", "RegOps", "SqpollOps",
-                          "LeaseExp", "Resumed"]
-    assert row.split(",")[-13:-8] == ["3", "7", "2", "5", "11"]
+                          "LeaseExp", "Resumed", "StreamB", "DeltaSave",
+                          "AggDepth"]
+    assert row.split(",")[-16:-11] == ["3", "7", "2", "5", "11"]
