@@ -3,8 +3,8 @@
 # engine lives in csrc/)
 
 .PHONY: all native native-tsan native-asan tsan asan check test \
-	test-fast test-chaos test-scale test-examples fuzz bench docs clean \
-	deb rpm docker
+	test-fast test-chaos test-scale test-mesh test-examples fuzz bench \
+	docs clean deb rpm docker
 
 all: native
 
@@ -84,6 +84,15 @@ test-chaos: native
 	python -m pytest tests/test_fault_tolerance.py \
 		tests/test_io_fault_tolerance.py tests/test_run_lifecycle.py \
 		tests/test_svc_stream.py -q -m chaos
+
+# pod-slice gate: the --tpuslice mesh suite on an 8-device virtual CPU
+# mesh (mesh factory edge cases, fingerprint-exact ingest/redistribute
+# equivalence, interrupt/chip-loss behavior, Ici counter merge rules,
+# service-wire merge, MULTICHIP capture; pytest marker `mesh`;
+# docs/pod-slice.md)
+test-mesh: native
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_pod_slice.py \
+		-q -m mesh
 
 # control-plane scale gate: a simulated 64-host in-process loopback
 # fleet proving --svcstream --svcfanout holds O(fanout) master

@@ -676,7 +676,93 @@ def _run_fallback_ladder(probe_err) -> int:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def capture_multichip(n_devices: int = 8,
+                      file_size: str = "16M",
+                      block_size: str = "512K") -> dict:
+    """Measured pod-slice capture for the MULTICHIP artifact: run the
+    REAL --tpuslice phase (striped ingest across every chip + ICI
+    redistribution, workers/tpuslice.py) on a virtual n-device CPU mesh
+    and return its measured bandwidths as a labeled dict. The tier label
+    leads the metric name AND a machine-readable key so a virtual-mesh
+    number can never be cached or read as TPU evidence — the same
+    masquerade rule as the host-path fallback ladder.
+
+    Called by __graft_entry__._dryrun_multichip_impl (the driver's
+    multichip round artifact captures this via its stdout tail) and by
+    `python bench.py --multichip [N]` directly."""
+    import shutil
+    env = _axon_mitigation.sanitized_env(n_devices)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELBENCHO_TPU_NO_DEFAULT_RESFILES"] = "1"
+    tmpdir = tempfile.mkdtemp(prefix="elbencho_tpu_multichip_")
+    target = os.path.join(tmpdir, "slicefile")
+    jf = os.path.join(tmpdir, "slice.json")
+    try:
+        cmd = [sys.executable, "-m", "elbencho_tpu", "--nolive",
+               "-w", "--tpuslice", "-t", "2", "-s", file_size,
+               "-b", block_size, "--jsonfile", jf, target]
+        # run twice: the first pass warms the persistent jit cache (the
+        # slice phase compiles its SPMD steps in-phase), the second is
+        # the measured capture — otherwise the tiny virtual-mesh
+        # workload's ingest bandwidth mostly measures XLA compile time
+        subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=420, cwd=REPO)
+        open(jf, "w").close()
+        res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=420, cwd=REPO)
+        if res.returncode != 0:
+            return {"metric": "MULTICHIP pod-slice (virtual CPU mesh, "
+                              "NOT TPU): sharded ingest + ICI "
+                              "redistribution",
+                    "tier": "virtual_cpu_mesh", "n_devices": n_devices,
+                    "value": None,
+                    "error": res.stderr[-1200:] or "slice run failed"}
+        with open(jf) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        rec = next(r for r in recs if r["Phase"] == "TPUSLICE")
+        redist_usec = rec.get("IciRedistUSec", 0)
+        redist_mib = rec.get("IciRedistMiB", 0)
+        return {
+            # tier leads the metric so the number can never masquerade
+            # as a real-slice capture downstream
+            "metric": "MULTICHIP pod-slice (virtual CPU mesh, NOT TPU): "
+                      "sharded ingest + ICI redistribution",
+            "tier": "virtual_cpu_mesh",
+            "n_devices": n_devices,
+            # headline: shard-ingest bandwidth (storage -> per-chip HBM
+            # across the whole mesh, phase wall time incl. in-phase jit)
+            "value": rec.get("TpuHbmMiBPerSec", 0),
+            "unit": "MiB/s",
+            "shard_ingest_mib": rec.get("ShardIngestMiB", 0),
+            "ici_redist_mib": redist_mib,
+            "ici_redist_usec": redist_usec,
+            # redistribution bandwidth over the ICI-busy window alone
+            "ici_redist_mibs": round(redist_mib / (redist_usec / 1e6), 1)
+            if redist_usec else 0,
+            "ici_gbps_hwm": rec.get("IciGbpsHwm", 0),
+            "redist_spec": "alltoall",
+            "stripes": rec.get("EntriesLast", 0),
+            "per_chip_bytes": {k: v.get("Bytes", 0) for k, v in
+                               rec.get("TpuPerChip", {}).items()},
+            "utc": _utc_now(),
+        }
+    except (subprocess.TimeoutExpired, OSError, ValueError,
+            StopIteration) as err:
+        return {"metric": "MULTICHIP pod-slice (virtual CPU mesh, NOT "
+                          "TPU): sharded ingest + ICI redistribution",
+                "tier": "virtual_cpu_mesh", "n_devices": n_devices,
+                "value": None, "error": str(err)[-800:]}
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--multichip":
+        # measured pod-slice capture (virtual mesh tier): one JSON line,
+        # never null-crashing — failures carry {"value": null, "error"}
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        print(json.dumps(capture_multichip(n)), flush=True)
+        return 0
     _install_signal_handlers()
     _STATE["stage"] = "tpu_probe"
     try:

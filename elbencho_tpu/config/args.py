@@ -416,6 +416,23 @@ FLAG_DEFS = [
      "TPU bench pattern: h2d|d2h|both|ici|allgather|reducescatter|"
      "alltoall|psum (ici = ring ppermute; the rest time one XLA "
      "collective per step over all chips, NCCL-perf-test style)"),
+    ("tpuslice", None, "run_tpu_slice", "bool", False, "tpu",
+     "Run the pod-slice phase: stripe the dataset off storage across "
+     "every chip of the mesh (each worker feeds its chips' shards "
+     "through the staging pool + transfer pipeline), then redistribute "
+     "each stripe over ICI with JAX collectives (--redistspec), "
+     "overlapping the next stripe's storage ingest with the previous "
+     "stripe's redistribution — the sharded-checkpoint-restore shape "
+     "(docs/pod-slice.md)"),
+    ("meshshape", None, "mesh_shape_str", "str", "", "tpu",
+     "HOSTSxCHIPS mesh geometry for --tpuslice (e.g. 2x4); default: "
+     "process boundaries on a real pod, else the most balanced 2D "
+     "factorization of the device count"),
+    ("redistspec", None, "redist_spec", "str", "alltoall", "tpu",
+     "--tpuslice redistribution target layout: alltoall (row-sharded -> "
+     "column-sharded reshard, memory-constant; default) | host "
+     "(all-gather within each host's chips) | chip (reshard onto the "
+     "chip axis, replicated across hosts) | replicate (full all-gather)"),
     ("podhosts", None, "use_pod_hosts", "bool", False, "tpu",
      "Derive --hosts from this TPU pod slice's worker VMs "
      "(TPU_WORKER_HOSTNAMES env or GCE metadata; each worker must run "
@@ -948,9 +965,11 @@ class BenchConfig(BenchConfigBase):
         Must run BEFORE the random_amount default so the amount matches the
         reduced dataset size (reference order: :1664 before :1680)."""
         if (self.use_direct_io or self.use_random_offsets
-                or self.do_strided_access) and self.file_size \
+                or self.do_strided_access or self.run_tpu_slice) \
+                and self.file_size \
                 and self.block_size \
-                and (self.run_create_files or self.run_read_files) \
+                and (self.run_create_files or self.run_read_files
+                     or self.run_tpu_slice) \
                 and self.file_size % self.block_size:
             new_size = self.file_size - (self.file_size % self.block_size)
             from ..toolkits.logger import LOG_NORMAL, log
@@ -961,6 +980,11 @@ class BenchConfig(BenchConfigBase):
             self.file_size = new_size
 
     def _apply_implicit_values(self) -> None:
+        if self.run_tpu_slice and not self.file_size:
+            # BEFORE the block-multiple trim below: a defaulted dataset
+            # must honor the same stripe geometry as an explicit one (a
+            # shard block straddling a file boundary would short-read)
+            self.file_size = 256 << 20
         if self.file_size and 0 < self.file_size < self.block_size:
             # reference reduces blocksize to filesize (also before the
             # reductions below; check() re-applies for non-derive callers)
@@ -1183,17 +1207,59 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError("--tpubudget must be >= 0 (0 = no budget)")
         if (self.tpu_depth or self.tpu_dispatch_budget_usec) \
                 and not self.tpu_ids_str and not self.tpu_ids \
-                and not self.run_tpu_bench:
+                and not self.run_tpu_bench and not self.run_tpu_slice:
             raise ConfigError(
                 "--tpudepth/--tpubudget tune the TPU transfer pipeline — "
-                "they need --tpuids (or --tpubench)")
+                "they need --tpuids (or --tpubench/--tpuslice)")
+        if self.run_tpu_slice:
+            if self.bench_mode != BenchMode.POSIX:
+                raise ConfigError(
+                    "--tpuslice stripes POSIX file/blockdev paths over "
+                    "the chip mesh; it does not apply to "
+                    "S3/HDFS/netbench modes")
+            if self.bench_path_type == BenchPathType.DIR \
+                    and not self.hosts:
+                # master mode defers to the services' probed path type
+                # (_check_service_bench_path_infos re-runs check() with
+                # it; each service validates its own probe too)
+                raise ConfigError(
+                    "--tpuslice requires file/blockdev bench paths (a "
+                    "directory tree is not striped over chips)")
+            if self.use_mmap:
+                raise ConfigError(
+                    "--tpuslice reads shards through the staging pool; "
+                    "incompatible with --mmap")
+            if self.block_size % 4:
+                raise ConfigError(
+                    "--tpuslice shards are uint32 arrays: --block must "
+                    "be a multiple of 4 bytes")
+        from ..parallel.slice_phase import REDIST_SPEC_NAMES
+        if self.redist_spec not in REDIST_SPEC_NAMES:
+            raise ConfigError(
+                f"--redistspec must be one of "
+                f"{'|'.join(REDIST_SPEC_NAMES)}")
+        if self.redist_spec != "alltoall" and not self.run_tpu_slice:
+            raise ConfigError(
+                "--redistspec shapes the --tpuslice redistribution "
+                "target — it does nothing without --tpuslice")
+        if self.mesh_shape_str:
+            if not self.run_tpu_slice:
+                raise ConfigError(
+                    "--meshshape shapes the --tpuslice mesh — it does "
+                    "nothing without --tpuslice")
+            from ..parallel.slice_phase import (MeshShapeError,
+                                                parse_mesh_shape)
+            try:  # geometry vs device count is checked at phase time
+                parse_mesh_shape(self.mesh_shape_str)
+            except MeshShapeError as err:
+                raise ConfigError(str(err)) from None
         if self.tpu_stream not in ("auto", "on", "off"):
             raise ConfigError("--tpustream must be auto|on|off")
         if self.tpu_stream == "on" and not self.tpu_ids_str \
-                and not self.tpu_ids:
+                and not self.tpu_ids and not self.run_tpu_slice:
             raise ConfigError(
-                "--tpustream on requires --tpuids (the fused loop streams "
-                "storage into TPU staging slots)")
+                "--tpustream on requires --tpuids or --tpuslice (the "
+                "fused loop streams storage into TPU staging slots)")
         if self.tpu_stream == "on" and self.run_tpu_bench:
             # --tpubench does synthetic HBM transfers only and never
             # reaches the block loop: "on" would silently pass green
@@ -1330,11 +1396,11 @@ class BenchConfig(BenchConfigBase):
                 "(or the --interrupt/--quit fan-out) — it does nothing "
                 "for the polling control plane")
         # NOTE: per-host stream state is keyed by host label; duplicate
-        # --hosts entries are already rejected for everyone at derive()
-        if self.svc_stream and self.run_netbench:
-            raise ConfigError(
-                "--svcstream is not supported with netbench phases "
-                "(the client/server topology polls its own cadence)")
+        # --hosts entries are already rejected for everyone at derive().
+        # Netbench topologies ride --svcstream like any other phase (the
+        # client/server roles only shape the DATA plane; live stats flow
+        # over /livestream unchanged) — the former rejection is lifted
+        # (ROADMAP item 3 leftover; tests/test_netbench.py covers it).
         if self.svc_lease_secs < 0:
             raise ConfigError("--svcleasesecs must be >= 0")
         if self.svc_lease_secs \
@@ -1404,6 +1470,10 @@ class BenchConfig(BenchConfigBase):
             p.append(BenchPhase.LISTOBJPARALLEL)
         if self.run_read_files:
             p.append(BenchPhase.READFILES)
+        if self.run_tpu_slice:
+            # after the read phase, before deletes: the slice phase reads
+            # the striped dataset the write phase of this run created
+            p.append(BenchPhase.TPUSLICE)
         if self.run_s3_object_tagging and self.run_delete_files:
             p.append(BenchPhase.DEL_OBJ_MD)
         if self.run_multi_delete_num:

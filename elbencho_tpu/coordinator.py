@@ -340,7 +340,7 @@ class Coordinator:
     #: reads, HBM-originated fills on writes, the fabric bench itself) —
     #: metadata phases (mkdir/stat/delete) never touch the device
     _TPU_PROFILE_PHASES = (BenchPhase.CREATEFILES, BenchPhase.READFILES,
-                           BenchPhase.TPUBENCH)
+                           BenchPhase.TPUBENCH, BenchPhase.TPUSLICE)
 
     def _start_tpu_profile(self, phase: BenchPhase) -> bool:
         """--tpuprofile DIR: bracket each TPU-touching measured phase with
@@ -351,7 +351,7 @@ class Coordinator:
         cfg = self.cfg
         if not cfg.tpu_profile_dir:
             return False
-        if not (cfg.tpu_ids or cfg.run_tpu_bench):
+        if not (cfg.tpu_ids or cfg.run_tpu_bench or cfg.run_tpu_slice):
             return False
         if phase not in self._TPU_PROFILE_PHASES:
             return False

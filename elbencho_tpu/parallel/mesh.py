@@ -9,13 +9,21 @@ the master's stats aggregation for on-device reductions.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .slice_phase import MeshShapeError, parse_mesh_shape  # noqa: F401
+# (re-exported: the mesh factory raises MeshShapeError; the definitions
+# live in the jax-free slice_phase module so config validation can use
+# them without initializing jax)
 
-_multihost_lock = __import__("threading").Lock()
+
+_multihost_lock = threading.Lock()
 _multihost_initialized = False
+_multihost_spec: "str | None" = None
 
 
 def init_multihost(spec: str = "auto") -> bool:
@@ -29,8 +37,17 @@ def init_multihost(spec: str = "auto") -> bool:
     initialization ran, False when this process already joined. Real
     init failures (unreachable coordinator etc.) propagate — a silent
     single-host fallback would publish wrong pod-wide numbers.
+
+    Idempotence is lock-safe under the threaded service harness: any
+    number of worker threads (possibly of several in-process service
+    instances) may race here during prepare; exactly one performs the
+    initialize() call, the rest return False without touching jax. A
+    failed initialize leaves the latch clear so the next prepare can
+    retry. A runtime that was already initialized by another component
+    ("already initialized" RuntimeError from jax) is adopted as joined
+    instead of failing the phase.
     """
-    global _multihost_initialized
+    global _multihost_initialized, _multihost_spec
     kwargs = {}
     if spec and spec != "auto":
         parts = spec.split(",")
@@ -41,34 +58,74 @@ def init_multihost(spec: str = "auto") -> bool:
             kwargs["process_id"] = int(parts[2])
     with _multihost_lock:  # worker threads prep concurrently
         if _multihost_initialized:
+            if _multihost_spec != spec:
+                from ..toolkits.logger import LOG_NORMAL, log
+                log(LOG_NORMAL,
+                    f"NOTE: --tpumultihost {spec!r} ignored — this process "
+                    f"already joined the multi-host runtime with "
+                    f"{_multihost_spec!r} (one runtime per process)")
             return False
-        jax.distributed.initialize(**kwargs)
+        ran = True
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as err:
+            if "already" not in str(err).lower():
+                raise
+            # another component (e.g. a prior in-process service run)
+            # initialized the runtime; adopt it as joined
+            ran = False
         _multihost_initialized = True
-        return True
+        _multihost_spec = spec
+        return ran
 
 
 def make_ingest_mesh(devices: "list | None" = None,
-                     num_hosts: "int | None" = None) -> Mesh:
+                     num_hosts: "int | None" = None,
+                     shape: "tuple[int, int] | None" = None) -> Mesh:
     """2D ("host", "chip") mesh over the given devices.
 
     On a real pod slice the "host" axis matches process boundaries
     (jax.process_count()); on a flat single-host set (or the virtual CPU
     mesh) the devices are factored into the most balanced 2D grid so both
-    axes are exercised.
+    axes are exercised. An explicit ``shape`` (hosts, chips) — the
+    --meshshape knob — must cover the device count exactly; a
+    non-divisible geometry raises MeshShapeError naming the offending
+    axis instead of surfacing as an XLA reshape error deep in the phase.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if num_hosts is None:
-        num_hosts = jax.process_count() if jax.process_count() > 1 else None
-    if num_hosts is None:
-        # most balanced factorization h*c == n with h <= c
-        num_hosts = 1
-        for h in range(int(np.sqrt(n)), 0, -1):
-            if n % h == 0:
-                num_hosts = h
-                break
-    chips_per_host = n // num_hosts
+    if shape is not None:
+        num_hosts, chips_per_host = shape
+        if num_hosts * chips_per_host != n:
+            # name the axis that cannot be satisfied so the error is
+            # actionable: the host axis when it alone exceeds/misfits
+            # the device count, else the chip axis
+            if n % num_hosts:
+                axis, size = "host", num_hosts
+            else:
+                axis, size = "chip", chips_per_host
+            raise MeshShapeError(
+                f"--meshshape {num_hosts}x{chips_per_host} does not fit "
+                f"{n} device(s): the \"{axis}\" axis of size {size} "
+                f"requires hosts*chips == {n}")
+    else:
+        if num_hosts is None:
+            num_hosts = jax.process_count() if jax.process_count() > 1 \
+                else None
+        if num_hosts is None:
+            # most balanced factorization h*c == n with h <= c
+            num_hosts = 1
+            for h in range(int(np.sqrt(n)), 0, -1):
+                if n % h == 0:
+                    num_hosts = h
+                    break
+        if n % num_hosts:
+            raise MeshShapeError(
+                f"device count {n} is not divisible by the \"host\" axis "
+                f"({num_hosts} processes): every host must own the same "
+                f"number of chips for the (\"host\", \"chip\") mesh")
+        chips_per_host = n // num_hosts
     grid = np.array(devices[:num_hosts * chips_per_host]).reshape(
         num_hosts, chips_per_host)
     return Mesh(grid, axis_names=("host", "chip"))

@@ -1,0 +1,254 @@
+"""Pod-slice redistribution step: the SPMD core of the --tpuslice phase.
+
+The phase models what a sharded-checkpoint restore actually does to a pod
+slice (ROADMAP item 2; PAPERS.md arXiv 2112.01075 "Memory-efficient array
+redistribution through portable collective communication"):
+
+  1. every host STRIPES the dataset off storage and feeds each chip of
+     the mesh its shard (storage -> staging pool -> HBM DMA, the same
+     StagingPool + TransferPipeline data path the single-chip phases
+     use — workers/tpuslice.py drives that part);
+  2. the mesh then RESHARDS the stripe over ICI with one jitted identity
+     step whose input and output shardings differ — XLA lowers the
+     sharding change to the minimal collective schedule (all-gather /
+     all-to-all style layouts per the --redistspec target);
+  3. a second jitted step fingerprints the redistributed stripe fully
+     on-device (uint32 sum + xor over the global array) so the phase can
+     prove bytes survived ingest + redistribution exactly.
+
+A stripe's global array has shape (n_devices, words_per_shard), uint32,
+laid out P(("host", "chip"), None): row d lives on mesh device
+``mesh.devices.flat[d]`` — one contiguous block of the stripe per chip,
+so byte->shard mapping stays trivially auditable. The fingerprints are
+order-independent (wrapping sum + xor), so they compare exactly against
+the host-side numpy fingerprints of the bytes that were read, regardless
+of target layout.
+
+Redistribution targets (--redistspec):
+
+  alltoall   P(None, ("host","chip")) — row-sharded -> column-sharded:
+             every chip exchanges a slice with every other chip (the
+             all-to-all reshard; memory per chip stays constant).
+             The default.
+  host       P("host", None) — chips of one host all-gather their
+             host's rows over intra-host ICI (replicate-within-host,
+             the optimizer-state restore layout).
+  chip       P("chip", None) — rows resharded onto the chip axis and
+             replicated across hosts (cross-host all-gather on top of
+             an all-to-all).
+  replicate  P(None, None) — full all-gather: every chip materializes
+             the whole stripe (memory x n_devices; sized workloads only).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+#: valid --redistspec names (the PartitionSpec instances are created in
+#: _target_spec so importing this module stays jax-free — config
+#: validation reads this tuple without initializing jax)
+REDIST_SPEC_NAMES = ("alltoall", "host", "chip", "replicate")
+
+
+class MeshShapeError(ValueError):
+    """Mesh geometry does not fit the device count / is malformed; the
+    offending axis is named in the message. Converted to ConfigError at
+    the config seam and to WorkerException at phase time. Lives here
+    (not mesh.py) so config validation can parse --meshshape without
+    importing jax."""
+
+
+def parse_mesh_shape(spec: str) -> "tuple[int, int]":
+    """"HxC" (hosts x chips, e.g. "2x4") -> (hosts, chips)."""
+    parts = spec.lower().replace("*", "x").split("x")
+    if len(parts) != 2:
+        raise MeshShapeError(
+            f"--meshshape must be HOSTSxCHIPS (e.g. 2x4), got {spec!r}")
+    try:
+        h, c = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise MeshShapeError(
+            f"--meshshape axes must be integers, got {spec!r}") from None
+    if h < 1 or c < 1:
+        raise MeshShapeError(
+            f"--meshshape axes must be >= 1, got {spec!r}")
+    return h, c
+
+
+class SliceFingerprintError(RuntimeError):
+    """On-device fingerprint of the redistributed stripe diverged from
+    the host fingerprint of the ingested bytes — data corrupted on the
+    ingest or redistribution path."""
+
+
+def _target_spec(name: str):
+    from jax.sharding import PartitionSpec as P
+    if name == "alltoall":
+        return P(None, ("host", "chip"))
+    if name == "host":
+        return P("host", None)
+    if name == "chip":
+        return P("chip", None)
+    if name == "replicate":
+        return P(None, None)
+    raise ValueError(
+        f"unknown --redistspec {name!r} ({'|'.join(REDIST_SPEC_NAMES)})")
+
+
+class SliceRunner:
+    """Jitted redistribute + fingerprint steps over one mesh, reused for
+    every stripe of the phase (compile once, outside the timed loop via
+    warmup()). Driven by the driver worker only — in a multi-host
+    runtime every process's driver must construct the same runner over
+    the same global mesh and call the steps in lockstep (single SPMD
+    program, like workers/tpubench.CollectiveBench)."""
+
+    def __init__(self, mesh, redist_spec: str, words_per_shard: int):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.n_devices = int(mesh.devices.size)
+        self.words_per_shard = words_per_shard
+        self.shard_bytes = words_per_shard * 4
+        self.stripe_bytes = self.n_devices * self.shard_bytes
+        self.redist_spec = redist_spec
+        if redist_spec == "alltoall" and words_per_shard % self.n_devices:
+            raise ValueError(
+                f"--redistspec alltoall cuts each shard into "
+                f"{self.n_devices} slices: block size {self.shard_bytes} "
+                f"must be a multiple of {4 * self.n_devices} bytes "
+                f"(4-byte words x {self.n_devices} devices)")
+        self.src_sharding = NamedSharding(mesh, P(("host", "chip"), None))
+        self.dst_sharding = NamedSharding(mesh, _target_spec(redist_spec))
+        self.global_shape = (self.n_devices, words_per_shard)
+        # device indices THIS process can place shards on: everything in
+        # single-process runs, only the local chips of a multi-host pod
+        # (each process supplies its addressable shards; jax stitches
+        # the global array across processes)
+        proc = jax.process_index()
+        self.local_device_indices = [
+            i for i, dev in enumerate(mesh.devices.flat)
+            if dev.process_index == proc]
+
+        @jax.jit
+        def _fingerprint(x):
+            import jax.numpy as jnp
+            total = jnp.sum(x, dtype=jnp.uint32)
+            # xor across shards via bit parity: xor of N words == per-bit
+            # parity of the set-bit count, and ADD reductions lower to
+            # collectives on every backend (a raw cross-shard xor
+            # reduction is UNIMPLEMENTED on some, e.g. CPU) — the same
+            # reason parallel/ingest.py all-gathers its per-shard xors
+            xor = jnp.uint32(0)
+            for b in range(32):
+                parity = jnp.sum((x >> jnp.uint32(b)) & jnp.uint32(1),
+                                 dtype=jnp.uint32) & jnp.uint32(1)
+                xor = xor | (parity << jnp.uint32(b))
+            return total, xor
+
+        # identity whose output sharding differs from the input's: XLA
+        # lowers the sharding change itself to the collective schedule
+        # (the "redistribution as compilation" route of arXiv 2112.01075)
+        self._redist = jax.jit(lambda x: x,
+                               out_shardings=self.dst_sharding)
+        self._fingerprint_fn = _fingerprint
+        self._block_until_ready = jax.block_until_ready
+
+    def assemble(self, shard_arrays: "dict[int, object]"):
+        """Per-device shard arrays (device index -> (1, words) array on
+        mesh.devices.flat[d]) -> the global sharded stripe array. Each
+        process supplies exactly its ADDRESSABLE shards (all of them in
+        a single-process run). The shards may still have transfers in
+        flight — assembly is metadata-only and stays async."""
+        import jax
+        if sorted(shard_arrays) != self.local_device_indices:
+            raise ValueError(
+                f"stripe assembly needs one shard per addressable "
+                f"device: got {sorted(shard_arrays)}, expected "
+                f"{self.local_device_indices}")
+        arrays = [shard_arrays[d] for d in self.local_device_indices]
+        return jax.make_array_from_single_device_arrays(
+            self.global_shape, self.src_sharding, arrays)
+
+    def launch(self, global_arr) -> dict:
+        """Dispatch the redistribution asynchronously; complete() waits
+        and accounts. The returned handle carries the dispatch cost so
+        --tpubudget can cover the SPMD path too.
+
+        Timing: the driver deliberately completes stripe s only after
+        stripe s+1's storage ingest (the overlap this phase measures),
+        so dispatch->complete() wall time would charge the whole ingest
+        window to ICI whenever storage is the slower leg. A watcher
+        thread therefore stamps the moment the redistributed array
+        actually materializes (block_until_ready releases the GIL, so
+        the feeders keep running) — that dispatch->materialized window
+        is what IciRedistUSec and the tpu_ici trace span record."""
+        import threading
+        t0 = time.perf_counter_ns()
+        out = self._redist(global_arr)
+        t1 = time.perf_counter_ns()
+        handle = {"out": out, "t_submit_ns": t1,
+                  "dispatch_usec": (t1 - t0) // 1000, "t_done_ns": 0}
+
+        def _stamp_done():
+            self._block_until_ready(out)
+            handle["t_done_ns"] = time.perf_counter_ns()
+
+        watcher = threading.Thread(target=_stamp_done, daemon=True,
+                                   name="slice-ici-watch")
+        handle["watcher"] = watcher
+        watcher.start()
+        return handle
+
+    def complete(self, handle: dict) -> "tuple[int, int, int]":
+        """Block until the redistribution drained, THEN fingerprint the
+        redistributed stripe on-device; returns (device_sum, device_xor,
+        wall_usec of the redistribution alone — dispatch to
+        materialized, stamped by the launch watcher). The fingerprint's
+        32-reduction sweep is a verify step, not interconnect traffic,
+        so it stays out of the IciRedistUSec accounting."""
+        handle["watcher"].join()
+        usec = max((handle["t_done_ns"] - handle["t_submit_ns"]) // 1000,
+                   1)
+        total, xor = self._fingerprint_fn(handle["out"])
+        return int(total), int(xor), usec
+
+    def warmup(self) -> None:
+        """Compile both steps outside any timed loop (persistent jit
+        cache makes this cheap across short-lived bench processes).
+        Built shard-by-shard like a real stripe so it works in a
+        multi-host runtime too (a plain device_put with a sharding
+        spanning non-addressable devices would not)."""
+        import jax
+        shard = np.zeros((1, self.words_per_shard), dtype=np.uint32)
+        zeros = self.assemble({
+            d: jax.device_put(shard, self.mesh.devices.flat[d])
+            for d in self.local_device_indices})
+        handle = self.launch(zeros)
+        self.complete(handle)
+
+    def verify(self, handle_sum: int, handle_xor: int,
+               host_sum: int, host_xor: int, stripe_idx: int) -> None:
+        """Fingerprint-exact check: the on-device (sum, xor) of the
+        redistributed stripe vs the host fingerprints of the bytes read
+        off storage. Only callable where the host side saw every shard
+        (single-process runs; multi-host drivers log instead)."""
+        if handle_sum != host_sum or handle_xor != host_xor:
+            raise SliceFingerprintError(
+                f"stripe {stripe_idx}: redistributed fingerprint "
+                f"(sum={handle_sum:#x}, xor={handle_xor:#x}) != host "
+                f"fingerprint of the ingested bytes (sum={host_sum:#x}, "
+                f"xor={host_xor:#x}) — data corrupted on the "
+                f"ingest/redistribution path")
+
+
+def host_fingerprint(block_u32: np.ndarray) -> "tuple[int, int]":
+    """Order-independent (wrapping uint32 sum, xor) of a host block —
+    the reference side of the fingerprint-exact verify."""
+    total = int(block_u32.sum(dtype=np.uint64) & 0xFFFFFFFF)
+    xor = int(np.bitwise_xor.reduce(block_u32.reshape(-1))) \
+        if block_u32.size else 0
+    return total, xor

@@ -39,6 +39,7 @@ class BenchPhase(enum.IntEnum):
     S3MPUCOMPLETE = 24
     NETBENCH = 25
     TPUBENCH = 26  # TPU-native: host<->HBM / ICI transfer benchmark
+    TPUSLICE = 27  # pod-slice: sharded storage ingest + ICI redistribution
 
 
 # human-readable phase names (reference: PHASENAME_*, Common.h:43-74)
@@ -70,6 +71,7 @@ PHASE_NAMES = {
     BenchPhase.S3MPUCOMPLETE: "MPUCOMPL",
     BenchPhase.NETBENCH: "NETBENCH",
     BenchPhase.TPUBENCH: "TPUBENCH",
+    BenchPhase.TPUSLICE: "TPUSLICE",
 }
 
 #: phases the run journal (--journal) does NOT record: the sync/dropcaches

@@ -72,6 +72,18 @@ PATH_AUDIT_COUNTERS = (
     ("pool_occupancy_hwm", "PoolOccupancyHwm", "pool_occupancy_hwm"),
     ("pool_registered_ops", "PoolRegisteredOps", "pool_registered_ops"),
     ("pool_sqpoll_ops", "PoolSqpollOps", "pool_sqpoll_ops"),
+    # pod-slice phase (--tpuslice; workers/tpuslice.py): striped storage
+    # ingest across every chip of the mesh + ICI redistribution. All four
+    # live on the WORKER (the slice phase runs with or without a
+    # per-worker TpuWorkerContext): ShardIngestMiB counts each worker's
+    # shard bytes fed onto the mesh, the Ici trio is recorded by the
+    # driver worker that runs the SPMD redistribution step. IciGbpsHwm is
+    # a high-water mark (best single-stripe redistribution rate) and
+    # MAX-merges like the other hwm counters.
+    ("shard_ingest_mib", "ShardIngestMiB", "shard_ingest_mib"),
+    ("ici_redist_mib", "IciRedistMiB", "ici_redist_mib"),
+    ("ici_redist_usec", "IciRedistUSec", "ici_redist_usec"),
+    ("ici_gbps_hwm", "IciGbpsHwm", "ici_gbps_hwm"),
 )
 
 #: counters owned by the Worker object itself rather than the
@@ -81,7 +93,8 @@ PATH_AUDIT_COUNTERS = (
 PATH_AUDIT_WORKER_ATTRS = frozenset({
     "io_retries", "io_retry_usec", "io_timeouts",
     "pool_buf_reuses", "pool_occupancy_hwm", "pool_registered_ops",
-    "pool_sqpoll_ops"})
+    "pool_sqpoll_ops", "shard_ingest_mib", "ici_redist_mib",
+    "ici_redist_usec", "ici_gbps_hwm"})
 
 #: counters owned by the worker's StagingPool: the merge reads them
 #: from worker._staging_pool when one is attached (local workers), and
@@ -97,7 +110,7 @@ PATH_AUDIT_POOL_ATTRS = frozenset({
 #: loss by the worker count — MAX reports the deepest failover chain any
 #: single worker ran (~ chips lost along the worst path).
 PATH_AUDIT_MAX_KEYS = frozenset({"TpuPipeInflightHwm", "TpuChipFailovers",
-                                 "PoolOccupancyHwm"})
+                                 "PoolOccupancyHwm", "IciGbpsHwm"})
 
 
 def sum_path_audit_counters(workers) -> dict:

@@ -97,6 +97,17 @@ class Worker:
         self.pool_occupancy_hwm = 0
         self.pool_registered_ops = 0
         self.pool_sqpoll_ops = 0
+        # pod-slice phase audit (--tpuslice; PATH_AUDIT_WORKER_ATTRS):
+        # per-worker shard-ingest MiB plus the driver worker's ICI
+        # redistribution counters (workers/tpuslice.py keeps the raw byte
+        # totals in _shard_ingest_bytes/_ici_redist_bytes and mirrors the
+        # MiB floor here so the wire stays integer-MiB)
+        self.shard_ingest_mib = 0
+        self.ici_redist_mib = 0
+        self.ici_redist_usec = 0
+        self.ici_gbps_hwm = 0
+        self._shard_ingest_bytes = 0
+        self._ici_redist_bytes = 0
 
     def oplog(self, op_name: str, entry_name: str = "", offset: int = 0,
               length: int = 0):
@@ -138,6 +149,12 @@ class Worker:
         self.pool_occupancy_hwm = 0
         self.pool_registered_ops = 0
         self.pool_sqpoll_ops = 0
+        self.shard_ingest_mib = 0
+        self.ici_redist_mib = 0
+        self.ici_redist_usec = 0
+        self.ici_gbps_hwm = 0
+        self._shard_ingest_bytes = 0
+        self._ici_redist_bytes = 0
 
     def create_stonewall_stats_if_triggered(self) -> None:
         """Snapshot current counters when the first worker finished

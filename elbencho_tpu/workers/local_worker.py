@@ -62,6 +62,9 @@ class LocalWorker(Worker):
         self._rate_limiter_write: "RateLimiter | None" = None
         self._tpu = None           # TpuWorkerContext when --tpuids given
         self._numa_zone = None     # set when --zones bound this worker
+        # --tpuslice: per-chip ingest bytes of a context-less mesh feeder
+        # (statistics reads this when _tpu is None, the RemoteWorker idiom)
+        self.tpu_per_chip: "dict[int, tuple[int, int]]" = {}
         self._ops_log = None
         self._num_iops_submitted = 0  # rwmix modulo counter
         self._prepared = False
@@ -79,6 +82,7 @@ class LocalWorker(Worker):
     def reset_stats(self) -> None:
         super().reset_stats()
         self._native_interrupt.value = 0
+        self.tpu_per_chip = {}
         self._stream_mode_logged = False  # log the mode once per phase
         self._tolerate_note_logged = False
         if self._io_retrier is not None:
@@ -101,9 +105,10 @@ class LocalWorker(Worker):
                 or cfg.bench_mode in (BenchMode.NETBENCH, BenchMode.S3):
             self._alloc_io_buffer()
         self._s3_client = None  # created lazily by workers/s3_worker.py
-        if cfg.tpu_multihost and cfg.tpu_ids:
+        if cfg.tpu_multihost and (cfg.tpu_ids or cfg.run_tpu_slice):
             # join the pod-wide runtime BEFORE first device use so jax
-            # meshes span every host (idempotent across re-preps)
+            # meshes span every host (idempotent + lock-safe across
+            # concurrently-prepping worker threads and re-preps)
             from ..parallel.mesh import init_multihost
             init_multihost(cfg.tpu_multihost)
         if cfg.tpu_ids:
@@ -381,6 +386,9 @@ class LocalWorker(Worker):
         elif phase == BenchPhase.TPUBENCH:
             from .tpubench import run_tpubench_phase
             run_tpubench_phase(self, phase)
+        elif phase == BenchPhase.TPUSLICE:
+            from .tpuslice import run_tpu_slice_phase
+            run_tpu_slice_phase(self, phase)
         elif cfg.bench_mode == BenchMode.S3:
             from .s3_worker import dispatch_s3_phase
             dispatch_s3_phase(self, phase)

@@ -216,12 +216,32 @@ class WorkerManager:
             except Exception:  # noqa: BLE001 - teardown is best effort
                 pass
 
+    # -- pod-slice rank->shard assignment (--tpuslice) ----------------------
+
+    @staticmethod
+    def slice_shard_assignment(n_devices: int, n_workers: int,
+                               local_rank: int) -> "list[int]":
+        """Mesh device indices fed by the worker at local_rank: devices
+        are dealt round-robin over this process's workers (device d ->
+        worker d % n_workers), so every chip of the mesh has exactly one
+        feeder and the per-worker load differs by at most one shard.
+        The single authority for the slice phase's rank->shard map —
+        workers/tpuslice.py and the tests both read it from here."""
+        n_workers = max(n_workers, 1)
+        return [d for d in range(n_devices)
+                if d % n_workers == local_rank % n_workers]
+
     # -- per-phase work accounting (reference: getPhaseNumEntriesAndBytes) --
 
     def get_phase_num_entries_and_bytes(self, phase: BenchPhase
                                         ) -> "tuple[int, int]":
         cfg = self.cfg
         nthreads = cfg.num_threads * max(1, len(cfg.hosts) or 1)
+        if phase == BenchPhase.TPUSLICE:
+            # striped over chips: the whole dataset crosses storage->HBM
+            # once, then again over ICI (entries = stripes, unknown until
+            # the mesh size is probed — report bytes only)
+            return (0, cfg.file_size * max(1, len(cfg.paths)))
         if phase in (BenchPhase.CREATEDIRS, BenchPhase.DELETEDIRS,
                      BenchPhase.STATDIRS):
             return (nthreads * cfg.num_dirs, 0)

@@ -43,3 +43,29 @@ def test_netbench_requires_hosts():
     from elbencho_tpu.cli import main
     rc = main(["--netbench", "-t", "1", "--nolive"])
     assert rc == 1  # clear config error, not a crash
+
+
+def test_netbench_rides_svcstream(tmp_path):
+    """ROADMAP item 3 leftover: netbench topologies ride the streaming
+    control plane — live stats arrive over /livestream push frames
+    instead of /status polls, and the client/server data plane is
+    untouched. The former config-level rejection is lifted."""
+    import json as json_mod
+
+    from elbencho_tpu.cli import main
+    env = default_env()
+    env["JAX_PLATFORMS"] = "cpu"
+    ports = free_ports(2)
+    with service_procs(ports, env=env):
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        jsonfile = tmp_path / "out.json"
+        rc = main(["--netbench", "-t", "2", "-s", "1M", "-b", "64K",
+                   "--respsize", "4K", "--hosts", hosts, "--svcstream",
+                   "--jsonfile", str(jsonfile), "--nolive"])
+        assert rc == 0
+    recs = [json_mod.loads(ln)
+            for ln in jsonfile.read_text().splitlines()]
+    nb = next(r for r in recs if r["Phase"] == "NETBENCH")
+    assert nb["BytesLast"] >= 2 * (1 << 20)
+    # proof the stream plane actually served the phase's live stats
+    assert nb.get("SvcStreamFrames", 0) > 0, nb

@@ -613,10 +613,10 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
                   str(f), "--csv"], capture_output=True, text=True)
     assert res.returncode == 0, res.stderr
     header = res.stdout.splitlines()[0].split(",")
-    # the streaming-control-plane trio appends after the lifecycle pair
-    # (never reordered)
-    assert header[-5:-3] == ["LeaseExp", "Resumed"]
+    # the streaming-control-plane trio + pod-slice trio append after the
+    # lifecycle pair (never reordered)
+    assert header[-8:-6] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-5:-3] == ["2", "3"]
+    assert row[-8:-6] == ["2", "3"]
     assert "RESUMED" in res.stderr

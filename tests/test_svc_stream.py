@@ -564,8 +564,9 @@ def test_summarize_json_stream_columns(tmp_path):
         capture_output=True, text=True, check=True)
     header = out.stdout.splitlines()[0].split(",")
     row = out.stdout.splitlines()[1].split(",")
-    assert header[-3:] == ["StreamB", "DeltaSave", "AggDepth"]
-    assert row[-3:] == ["123", "456", "2"]
+    # the pod-slice trio appends after the streaming trio
+    assert header[-6:-3] == ["StreamB", "DeltaSave", "AggDepth"]
+    assert row[-6:-3] == ["123", "456", "2"]
 
 
 # ---------------------------------------------------------------------------

@@ -325,10 +325,11 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     cols = header.split(",")
     # appended, never reordered: the telemetry columns keep their order,
     # with the (later) data-plane fault-tolerance, staging-pool,
-    # run-lifecycle, and streaming-control-plane columns after them
-    assert cols[-16:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    # run-lifecycle, streaming-control-plane, and pod-slice columns
+    # after them
+    assert cols[-19:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                           "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                           "PoolReuse", "RegOps", "SqpollOps",
                           "LeaseExp", "Resumed", "StreamB", "DeltaSave",
-                          "AggDepth"]
-    assert row.split(",")[-16:-11] == ["3", "7", "2", "5", "11"]
+                          "AggDepth", "ShardMiB", "IciMiB", "IciGbps"]
+    assert row.split(",")[-19:-14] == ["3", "7", "2", "5", "11"]
