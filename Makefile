@@ -3,8 +3,8 @@
 # engine lives in csrc/)
 
 .PHONY: all native native-tsan native-asan tsan asan check test \
-	test-fast test-chaos test-scale test-mesh test-examples fuzz bench \
-	docs clean deb rpm docker
+	test-fast test-chaos test-scale test-mesh test-obs test-examples \
+	fuzz bench docs clean deb rpm docker
 
 all: native
 
@@ -101,6 +101,14 @@ test-mesh: native
 test-scale:
 	env JAX_PLATFORMS=cpu ELBENCHO_TPU_NO_NATIVE=1 \
 		python -m pytest tests/test_stream_scale.py -q -m scale
+
+# observability gate: the telemetry + flight-recorder + run-doctor
+# suites (/metrics scrape-under-load, trace schema, flightrec codec
+# round-trip/torn-tail/merge properties, doctor verdicts, the no-op
+# overhead guards; pytest marker `obs`; docs/telemetry.md)
+test-obs:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+		tests/test_flightrec.py -q -m obs
 
 # end-to-end example suite against real resources (loopdevs, services)
 test-examples: native

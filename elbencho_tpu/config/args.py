@@ -263,6 +263,15 @@ FLAG_DEFS = [
      "Keep only this fraction of spans in the --tracefile ring (0..1; "
      "applies to op spans and the per-op tpu/stream sub-spans; phase "
      "markers are always kept)"),
+    ("flightrec", None, "flightrec_file_path", "str", "", "misc",
+     "Record per-tick fleet + per-host counter deltas (live ops, the "
+     "TPU dispatch-vs-DMA split, the path/control audit counters) into "
+     "this append-only flight recording on the live-stats cadence, and "
+     "attach the run doctor's bottleneck verdict (Analysis block) to "
+     "the JSON results; in master mode the recorder taps the live "
+     "frames the master already ingests, so services pay zero extra "
+     "requests; post-process with tools/elbencho-tpu-doctor "
+     "(docs/telemetry.md)"),
 
     # distribution
     ("hosts", None, "hosts_str", "str", "", "dist",
@@ -1337,6 +1346,11 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--tracesample tunes the --tracefile span recorder — "
                 "give --tracefile PATH")
+        if self.flightrec_file_path and self.run_as_service:
+            raise ConfigError(
+                "--flightrec records at the master/local coordinator "
+                "(service counters already reach it over the existing "
+                "wire) — arm --flightrec on the master instead")
         if self.io_num_retries < 0:
             raise ConfigError("--ioretries must be >= 0")
         if self.io_retry_budget_secs < 0:
@@ -1528,6 +1542,10 @@ class BenchConfig(BenchConfigBase):
         # result files are written by the master only (the reference never
         # serializes resFilePath* to services)
         d["res_file_path"] = d["csv_file_path"] = d["json_file_path"] = ""
+        # the flight recorder is master-side only: the master samples the
+        # live frames it already ingests, so services never record (and
+        # pay zero extra requests for a recorded run)
+        d["flightrec_file_path"] = ""
         # the run journal is the MASTER's restart point; services never
         # journal (svc_lease_secs deliberately stays on the wire — it IS
         # the lease advertisement the service watchdog arms on)

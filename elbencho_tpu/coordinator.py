@@ -32,6 +32,7 @@ class Coordinator:
         self._old_handlers = []  # (signum, previous handler) pairs
         self._telemetry = None   # BenchTelemetry when --telemetry
         self._exporter = None    # its /metrics HTTP server
+        self._flightrec = None   # FlightRecorder when --flightrec
         self._journal = None     # RunJournal when --journal
         self._resume_plan = None  # ResumePlan when --resume
 
@@ -68,6 +69,7 @@ class Coordinator:
                 logger.log_error(str(err))
                 return 1
             self._start_telemetry()
+            self._start_flightrec()
             if cfg.hosts:
                 from .service.remote_worker import wait_for_services_ready
                 wait_for_services_ready(cfg.hosts, cfg.service_port,
@@ -99,6 +101,10 @@ class Coordinator:
                 self.manager.join_all_threads()
             except Exception:  # noqa: BLE001 - teardown must not mask errors
                 pass
+            if self._flightrec is not None:
+                # flush the ring so even an aborted run leaves a
+                # loadable (torn-tail-tolerated) recording
+                self._flightrec.close()
             self.statistics.close()
             if self._journal is not None:
                 self._journal.close()
@@ -177,6 +183,20 @@ class Coordinator:
                 f"--telemetry: cannot bind --telemetryport "
                 f"{cfg.telemetry_port}: {err}") from err
         self._exporter = exporter
+
+    def _start_flightrec(self) -> None:
+        """--flightrec: arm the flight recorder (telemetry/flightrec.py).
+        An unwritable recording path fails BEFORE any phase runs, like
+        the journal — a run asked to explain itself must not silently
+        lose its recording."""
+        from .telemetry.flightrec import make_flightrec
+        try:
+            self._flightrec = make_flightrec(self.cfg)
+        except OSError as err:
+            raise WorkerException(
+                f"--flightrec: cannot open "
+                f"{self.cfg.flightrec_file_path}: {err}") from err
+        self.statistics.flightrec = self._flightrec
 
     def _wait_for_sync_start(self) -> None:
         """--start: cross-host synchronized start (reference: :150-159;
@@ -410,6 +430,7 @@ class Coordinator:
             self.manager.shared.tracer = old_tracer
         self.statistics = Statistics(cfg, self.manager)
         self.statistics.telemetry = self._telemetry  # follow the rebuild
+        self.statistics.flightrec = self._flightrec  # keep recording
         self.manager.prepare_threads()
 
     # ------------------------------------------------------------------

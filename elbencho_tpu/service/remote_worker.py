@@ -427,6 +427,14 @@ class RemoteWorker(Worker):
 
     def reset_stats(self) -> None:
         super().reset_stats()
+        # zero EVERY live-ingest mirror, incl. the TPU-context path-audit
+        # attrs _ingest_live_telemetry setattr'd last phase (base reset
+        # only covers the worker-owned ones): a stale mirror would leak
+        # the previous phase's totals into the next phase's first
+        # /metrics view and flight-recorder tick
+        from ..tpu.device import PATH_AUDIT_COUNTERS
+        for _attr, _key, ingest_attr in PATH_AUDIT_COUNTERS:
+            setattr(self, ingest_attr, 0)
         self.client.reset_phase_accounting()
         self.svc_retries = 0
         self.svc_consec_retries_hwm = 0
@@ -891,6 +899,20 @@ class RemoteWorker(Worker):
                 stats["IOLatHisto"])
             self.entries_latency_histo = LatencyHistogram.from_dict(
                 stats.get("EntLatHisto", {}))
+        elif "SumIOLatUSec" in stats:
+            # no bucket view on the wire, but every live reply/frame
+            # carries the latency SUMS — mirror them so the flight
+            # recorder's per-host IoBusyUSec (storage busy time) is live
+            # mid-run; the final /benchresult ingest overwrites with the
+            # full histograms
+            io_histo = LatencyHistogram()
+            io_histo.num_values = stats.get("NumIOLatUSec", 0)
+            io_histo.sum_micro = stats.get("SumIOLatUSec", 0)
+            self.iops_latency_histo = io_histo
+            ent_histo = LatencyHistogram()
+            ent_histo.num_values = stats.get("NumEntLatUSec", 0)
+            ent_histo.sum_micro = stats.get("SumEntLatUSec", 0)
+            self.entries_latency_histo = ent_histo
 
     def _reset_live_telemetry(self) -> None:
         """Zero every mirror _ingest_live_telemetry can set — incl. the

@@ -93,6 +93,9 @@ class PhaseResults:
         self.degraded_hosts: "list[str]" = []
         # control-plane audit (fault_tolerance.CONTROL_AUDIT_COUNTERS)
         self.control_counters: "dict[str, int]" = {}
+        # --flightrec: the run doctor's verdict for this phase
+        # (telemetry/doctor.py; JSON-only "Analysis" block)
+        self.analysis: "dict | None" = None
 
 
 class Statistics:
@@ -109,6 +112,9 @@ class Statistics:
         # loop samples it at its cadence so scrapes between intervals
         # read a warm snapshot
         self.telemetry = None
+        # --flightrec: FlightRecorder bound by the coordinator; None =
+        # recording off, every hook is a single `is None` test
+        self.flightrec = None
         # dedicated CPU meter for /status replies (primed, rate-limited;
         # see SampledCPUUtil for why the shared phase meter is off limits)
         from .cpu_util import SampledCPUUtil
@@ -139,6 +145,9 @@ class Statistics:
         interval = max(cfg.live_stats_interval_ms, 50) / 1000.0
         use_line = not cfg.disable_live_stats
         is_tty = sys.stdout.isatty()
+        if self.flightrec is not None:
+            self.flightrec.phase_start(
+                phase_name(phase, cfg.bench_mode == BenchMode.S3))
         self._live_started = time.monotonic()
         last_bytes = last_iops = 0
         last_t = self._live_started
@@ -163,6 +172,8 @@ class Statistics:
             self._write_live_files(phase, entries, num_bytes, iops, elapsed)
             if self.telemetry is not None:
                 self.telemetry.sample()  # live-stats-cadence sampling
+            if self.flightrec is not None:
+                self.flightrec.sample(self)  # same cadence, same counters
             if not use_line:
                 continue
             unit, div = ("MB", 1000 ** 2) if cfg.use_base10_units \
@@ -473,6 +484,12 @@ class Statistics:
 
     def print_phase_results(self, phase: BenchPhase) -> PhaseResults:
         res = self.generate_phase_results(phase)
+        if self.flightrec is not None:
+            # run doctor: final sample + phase_end row + bottleneck
+            # verdict — computed AFTER the barrier (RemoteWorkers have
+            # ingested their final /benchresult, so totals are exact)
+            # and BEFORE rendering so the text/JSON outputs carry it
+            res.analysis = self.flightrec.finish_phase(self, res)
         self._render_result_rows(res)
         if self.cfg.csv_file_path:
             self._write_csv(res)
@@ -581,6 +598,18 @@ class Statistics:
                                  f"{_fmt_elapsed_usec(max(w.elapsed_usec_vec))}")
             if parts:
                 rows.append(f"{'':12}Service elapsed  : {', '.join(parts)}")
+        if res.analysis is not None:
+            # --flightrec run doctor: where the wall time went + the
+            # named bottleneck, right under the numbers it explains
+            ana = res.analysis
+            busy = "  ".join(
+                f"{name}={pct:g}%" for name, pct in ana["StagePct"].items()
+                if pct)
+            if busy:
+                rows.append(f"{'':12}{'Stage time % :':<20}{busy}")
+            first = f" ({ana['Evidence'][0]})" if ana["Evidence"] else ""
+            rows.append(f"{'':12}{'Bottleneck :':<20}"
+                        f"{ana['Verdict']}{first}")
         if res.degraded_hosts:
             # loud, unmissable: these numbers exclude lost hosts and must
             # never be read as a clean run (--svctolerant)
@@ -665,6 +694,12 @@ class Statistics:
             "TraceEvents": (self.manager.shared.tracer.num_recorded
                             if self.manager.shared.tracer is not None
                             else 0),
+            # spans the --tracefile ring LOST (sampled out by
+            # --tracesample + overwritten before a write) — so a sampled
+            # trace is honest about what it dropped (JSON-only)
+            "TraceDropped": (self.manager.shared.tracer.num_dropped
+                             if self.manager.shared.tracer is not None
+                             else 0),
             # crash-safe run lifecycle (JSON-only): number of finished
             # phases a --resume run skipped per its journal — non-zero
             # marks every record of a resumed run so the summarize tool
@@ -745,7 +780,7 @@ class Statistics:
         for _attr, key, _mode in CONTROL_AUDIT_COUNTERS:  # JSON-only keys
             rec.pop(key)
         for key in ("HostCPUUtil", "TelemetryScrapes", "TraceEvents",
-                    "Resumed"):
+                    "TraceDropped", "Resumed"):
             rec.pop(key)  # telemetry + lifecycle keys are JSON-only
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()
@@ -768,6 +803,11 @@ class Statistics:
         rec["ElapsedUSecList"] = res.elapsed_usec_vec
         rec["IOLatHisto"] = res.iops_histo.to_dict()
         rec["EntLatHisto"] = res.entries_histo.to_dict()
+        if res.analysis is not None:
+            # --flightrec run doctor: stage decomposition + bottleneck
+            # verdict (docs/result-columns.md Analysis block); absent
+            # without --flightrec so the off path stays byte-identical
+            rec["Analysis"] = res.analysis
         with open(self.cfg.json_file_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
 
@@ -780,8 +820,13 @@ class Statistics:
         lat_sums = {"NumIOLatUSec": 0, "SumIOLatUSec": 0,
                     "NumEntLatUSec": 0, "SumEntLatUSec": 0}
         for w in workers:
-            lat_sums["NumIOLatUSec"] += w.iops_latency_histo.num_values
-            lat_sums["SumIOLatUSec"] += w.iops_latency_histo.sum_micro
+            # rwmix reads fold into the io sums, matching the live
+            # bucket view (merge_live_latency_histos) — the master's
+            # flight-recorder IoBusyUSec must not undercount rwmix runs
+            lat_sums["NumIOLatUSec"] += w.iops_latency_histo.num_values \
+                + w.iops_latency_histo_rwmix.num_values
+            lat_sums["SumIOLatUSec"] += w.iops_latency_histo.sum_micro \
+                + w.iops_latency_histo_rwmix.sum_micro
             lat_sums["NumEntLatUSec"] += w.entries_latency_histo.num_values
             lat_sums["SumEntLatUSec"] += w.entries_latency_histo.sum_micro
         tpu_bytes, tpu_usec, tpu_dispatch_usec = \

@@ -21,6 +21,17 @@ harness. This package adds both, without touching the workers' hot paths:
                --tracesample) with Chrome trace-event JSON output
                loadable in Perfetto; instrumentation resolves to no-ops
                when tracing is off.
+  flightrec.py flight recorder (--flightrec): per-tick fleet + per-host
+               counter deltas sampled on the live-stats cadence into a
+               schema-versioned append-only recording — in master mode
+               from the /livestream frames or /status polls the master
+               already ingests, so services pay zero extra requests.
+  doctor.py    run doctor: post-processes a recording plus the phase's
+               audit counters into a stage-time decomposition (storage
+               vs HBM dispatch vs DMA vs ICI vs retry vs stalls),
+               overlap efficiencies, and a named bottleneck verdict —
+               the run JSON "Analysis" block, the text summary's
+               Bottleneck row, and tools/elbencho-tpu-doctor.
 """
 
 from __future__ import annotations

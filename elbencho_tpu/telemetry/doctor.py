@@ -1,0 +1,321 @@
+"""Run doctor: stage-time decomposition + automatic bottleneck attribution.
+
+Turns the counters the benchmark already records — the storage-op latency
+sums, the TransferPipeline's dispatch-vs-DMA split (TpuHbmDispatchUSec /
+TpuHbmUSec), the pod-slice ICI redistribution time (IciRedistUSec), the
+data-plane retry/backoff account (IoRetryUsec), the pipeline-full stall
+counter and the control-plane audit — into a per-phase verdict: WHERE the
+wall time went, how well the overlapped legs actually overlapped, and
+which stage bounds the phase.
+
+The decomposition follows the overlap-efficiency model of "The DMA
+Streaming Framework" (arXiv 2603.10030: submit vs DMA vs reap legs) and
+the time-resolved stage accounting argued for by "Optimizing
+High-Throughput Distributed Data Pipelines" (arXiv 2604.21275). All busy
+times are SUMS across workers; the denominator is worker-time (phase wall
+x workers), so a share reads as "fraction of the fleet's worker-seconds
+spent in this stage" and overlapped stages can sum past 100% of wall.
+
+Used three ways:
+- in-run: Statistics attaches the verdict as the run JSON's ``Analysis``
+  block and a "Bottleneck" line in the text summary when ``--flightrec``
+  is armed (flightrec.FlightRecorder.finish_phase);
+- ``tools/elbencho-tpu-doctor RUN.rec``: post-mortem analysis of a
+  recording (recomputed from the recorded totals, so old recordings
+  benefit from newer verdict logic);
+- ``tools/elbencho-tpu-doctor A.rec B.rec``: regression diff between two
+  recordings of the same workload.
+"""
+
+from __future__ import annotations
+
+#: analysis block schema version (run JSON "Analysis" + phase_end rows)
+ANALYSIS_SCHEMA = 1
+
+#: (stage, totals wire key, human description) — the decomposition the
+#: counters support today; appended, never reordered
+STAGE_KEYS = (
+    ("storage", "IoBusyUSec", "storage submit/reap (per-op I/O latency)"),
+    ("tpu_dispatch", "TpuHbmDispatchUSec",
+     "host->HBM transfer dispatch (submit cost)"),
+    ("tpu_dma", "TpuHbmUSec", "TPU DMA wall (submit -> ready)"),
+    ("ici_redist", "IciRedistUSec", "ICI redistribution (--tpuslice)"),
+    ("io_retry", "IoRetryUsec", "storage retry/backoff (--ioretries)"),
+)
+
+#: verdict name per dominant stage
+STAGE_VERDICTS = {
+    "storage": "storage-bound",
+    "tpu_dispatch": "dispatch-bound",
+    "tpu_dma": "dma-bound",
+    "ici_redist": "ici-bound",
+    "io_retry": "retry-bound",
+}
+
+#: TPU transfer-op counters (denominator of the stall ratio)
+TPU_OP_KEYS = ("TpuH2dDirectOps", "TpuH2dStagedOps",
+               "TpuD2hDirectOps", "TpuD2hStagedOps")
+
+#: pipe-full stalls per TPU op at/above which the phase is declared
+#: stall-bound (the producer kept finding the transfer ring full: the
+#: in-flight window, not any single stage's speed, bounds the phase)
+STALL_RATIO_BOUND = 0.5
+
+#: minimum worker-time share for a stage to be named the bottleneck
+DOMINANT_SHARE_PCT = 15.0
+
+
+def _overlap_eff(a_usec: float, b_usec: float, wall_usec: float
+                 ) -> "float | None":
+    """Overlap efficiency of two per-worker busy legs against the
+    observed wall: 1.0 = the smaller leg is fully hidden inside the
+    larger (serial sum a+b compressed to max(a,b)), 0.0 = no overlap
+    observable (wall >= a+b). None when either leg never ran."""
+    if a_usec <= 0 or b_usec <= 0 or wall_usec <= 0:
+        return None
+    return round(min(max((a_usec + b_usec - wall_usec)
+                         / min(a_usec, b_usec), 0.0), 1.0), 3)
+
+
+def _series_cum(series, key: str) -> "list[tuple[float, int]]":
+    """(t, cumulative value) points of one sum-merged counter over a
+    phase's fleet delta series."""
+    out = []
+    cum = 0
+    for t, d in series or ():
+        cum += d.get(key, 0)
+        out.append((t, cum))
+    return out
+
+
+def rising_after(series, key: str) -> "float | None":
+    """Trend evidence: the phase-relative second after which ``key``
+    started rising (first tick at/above 10% of its final total). None
+    when the counter never moved or there is no series."""
+    points = _series_cum(series, key)
+    if not points or points[-1][1] <= 0:
+        return None
+    final = points[-1][1]
+    for t, cum in points:
+        if cum >= final * 0.1:
+            return round(t, 1)
+    return None
+
+
+def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
+                  num_workers: int, series=None) -> dict:
+    """One phase's stage decomposition + bottleneck verdict.
+
+    ``totals`` is the fleet-merged cumulative counter state at phase end
+    (flightrec wire keys: IoBusyUSec/TpuHbmDispatchUSec/TpuHbmUSec/...);
+    ``series`` is the phase's fleet delta series [(t_rel, deltas)] for
+    trend evidence, optional."""
+    workers = max(num_workers, 1)
+    wall = max(int(elapsed_usec), 0)
+    worker_usec = wall * workers
+    stage_usec = {name: int(totals.get(key, 0))
+                  for name, key, _desc in STAGE_KEYS}
+    shares = {name: round(100.0 * usec / worker_usec, 1)
+              if worker_usec else 0.0
+              for name, usec in stage_usec.items()}
+    tpu_ops = sum(int(totals.get(k, 0)) for k in TPU_OP_KEYS)
+    stalls = int(totals.get("TpuPipeFullStalls", 0))
+    stall_ratio = round(stalls / tpu_ops, 3) if tpu_ops else 0.0
+    evidence: "list[str]" = []
+
+    # overlap efficiencies over PER-WORKER averages vs the phase wall
+    per_worker = {n: u / workers for n, u in stage_usec.items()}
+    ingest_pw = (per_worker["storage"] + per_worker["tpu_dispatch"]
+                 + per_worker["tpu_dma"])
+    overlap = {
+        # fused ring / transfer pipeline: storage leg vs the HBM leg
+        "StorageVsHbm": _overlap_eff(
+            per_worker["storage"],
+            per_worker["tpu_dispatch"] + per_worker["tpu_dma"], wall),
+        # pod-slice: stripe ingest vs ICI redistribution of the previous
+        # stripe (--tpuslice overlap timeline, docs/pod-slice.md)
+        "IngestVsIci": _overlap_eff(ingest_pw, per_worker["ici_redist"],
+                                    wall),
+    }
+
+    # -- verdict -------------------------------------------------------------
+    verdict = "inconclusive"
+    bottleneck = ""
+    if stalls and stall_ratio >= STALL_RATIO_BOUND:
+        # the producer kept hitting a full transfer ring: the in-flight
+        # window bounds the phase, not any single stage's raw speed
+        verdict = "stall-bound"
+        bottleneck = "pipeline"
+        evidence.append(
+            f"pipe_full_stalls {stalls} (~{stall_ratio:.2f} per TPU "
+            f"transfer op): producer kept finding the transfer ring "
+            f"full — raise --tpudepth/--iodepth")
+        t_rise = rising_after(series, "TpuPipeFullStalls")
+        if t_rise is not None:
+            evidence.append(f"pipe_full_stalls rising after "
+                            f"t={t_rise:g}s")
+    else:
+        dominant = max(stage_usec, key=lambda n: stage_usec[n]) \
+            if any(stage_usec.values()) else ""
+        if dominant and shares[dominant] >= DOMINANT_SHARE_PCT:
+            verdict = STAGE_VERDICTS[dominant]
+            bottleneck = dominant
+            desc = next(d for n, _k, d in STAGE_KEYS if n == dominant)
+            evidence.append(f"{shares[dominant]:g}% of worker time in "
+                            f"{desc}")
+            runner = sorted((n for n in stage_usec if n != dominant),
+                            key=lambda n: stage_usec[n])[-1]
+            if stage_usec[runner]:
+                evidence.append(f"next stage: {runner} at "
+                                f"{shares[runner]:g}%")
+        elif int(totals.get("SvcRequests", 0)) \
+                and not int(totals.get("Bytes", 0)) \
+                and not int(totals.get("Entries", 0)):
+            # no payload AND no entry work: a metadata phase that did
+            # real entries stays out of this bucket — only a phase whose
+            # sole traffic was control-plane requests lands here
+            verdict = "control-bound"
+            bottleneck = "control_plane"
+            evidence.append(
+                f"no payload bytes or entries completed while the "
+                f"master exchanged {totals.get('SvcRequests', 0)} "
+                f"control-plane requests "
+                f"({totals.get('SvcCtlBytes', 0)} bytes)")
+        else:
+            evidence.append(
+                "no instrumented stage reaches "
+                f"{DOMINANT_SHARE_PCT:g}% of worker time — the phase is "
+                "bounded outside the measured stages (page cache, CPU, "
+                "metadata syscalls)")
+    if verdict not in ("stall-bound",) and stalls:
+        evidence.append(f"pipe_full_stalls {stalls} "
+                        f"(~{stall_ratio:.2f}/op, below the "
+                        f"{STALL_RATIO_BOUND:g} stall-bound threshold)")
+    if int(totals.get("IoRetries", 0)):
+        evidence.append(f"storage retries: {totals.get('IoRetries', 0)} "
+                        f"({stage_usec['io_retry']} us backoff)")
+    if overlap["StorageVsHbm"] is not None:
+        evidence.append(f"storage/HBM overlap efficiency "
+                        f"{overlap['StorageVsHbm']:.0%}")
+    if overlap["IngestVsIci"] is not None:
+        evidence.append(f"ingest/ICI overlap efficiency "
+                        f"{overlap['IngestVsIci']:.0%}")
+
+    return {
+        "Schema": ANALYSIS_SCHEMA,
+        "Phase": phase_name,
+        "Verdict": verdict,
+        "BottleneckStage": bottleneck,
+        "Evidence": evidence,
+        "WallUSec": wall,
+        "NumWorkers": workers,
+        "WorkerUSec": worker_usec,
+        "StageUSec": stage_usec,
+        "StagePct": shares,
+        "PipeFullStalls": stalls,
+        "StallsPerTpuOp": stall_ratio,
+        "OverlapEff": overlap,
+        "Control": {
+            "SvcRequests": int(totals.get("SvcRequests", 0)),
+            "SvcCtlBytes": int(totals.get("SvcCtlBytes", 0)),
+            "SvcStreamFrames": int(totals.get("SvcStreamFrames", 0)),
+        },
+    }
+
+
+def analyze_recording(rec: dict) -> "list[dict]":
+    """Analyses for every completed phase of a read_recording() result.
+    Recomputed from the recorded totals (not the stored Analysis block)
+    so old recordings get current verdict logic."""
+    out = []
+    for phase in rec["phases"]:
+        end = phase["end"]
+        if end is None:
+            continue
+        series = list(zip(phase["sample_ts"], phase["samples"]))
+        t0 = phase.get("start_t", 0.0)
+        series = [(round(t - t0, 3), d) for t, d in series]
+        out.append(analyze_phase(phase["name"], end.get("Totals", {}),
+                                 end.get("ElapsedUSec", 0),
+                                 end.get("Workers", 0), series=series))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regression diff (elbencho-tpu-doctor A.rec B.rec)
+# ---------------------------------------------------------------------------
+
+#: throughput drop (fraction) at/above which a phase is flagged
+REGRESSION_RATE_DROP = 0.10
+#: stage-share growth (percentage points) at/above which a stage is
+#: flagged as the likely culprit
+REGRESSION_SHARE_PTS = 10.0
+
+
+def _phase_rate_mibs(end: dict) -> float:
+    wall_s = max(end.get("ElapsedUSec", 0), 1) / 1e6
+    return end.get("Totals", {}).get("Bytes", 0) / (1 << 20) / wall_s
+
+
+def diff_recordings(rec_a: dict, rec_b: dict) -> "list[dict]":
+    """Per-phase regression report between recording A (baseline) and B
+    (candidate). Phases pair by (name, occurrence index). Each entry:
+    {"Phase", "RateA", "RateB", "RateRatio", "Regressed", "Causes",
+    "AnalysisA", "AnalysisB"}."""
+    def ends(rec):
+        seen: "dict[str, int]" = {}
+        out = {}
+        for phase in rec["phases"]:
+            if phase["end"] is None:
+                continue
+            idx = seen.get(phase["name"], 0)
+            seen[phase["name"]] = idx + 1
+            out[(phase["name"], idx)] = phase
+        return out
+
+    a_ends, b_ends = ends(rec_a), ends(rec_b)
+    analyses_a = {(x["Phase"], i): x for i, x in _indexed(
+        analyze_recording(rec_a))}
+    analyses_b = {(x["Phase"], i): x for i, x in _indexed(
+        analyze_recording(rec_b))}
+    report = []
+    for key in a_ends:
+        if key not in b_ends:
+            continue
+        end_a, end_b = a_ends[key]["end"], b_ends[key]["end"]
+        rate_a, rate_b = _phase_rate_mibs(end_a), _phase_rate_mibs(end_b)
+        # None = undefined (baseline moved no bytes): float('inf') would
+        # serialize as the non-JSON token Infinity in --json mode
+        ratio = round(rate_b / rate_a, 3) if rate_a > 0 \
+            else (1.0 if rate_b == 0 else None)
+        ana_a, ana_b = analyses_a.get(key), analyses_b.get(key)
+        causes = []
+        if ana_a is not None and ana_b is not None:
+            for name, _k, desc in STAGE_KEYS:
+                grew = ana_b["StagePct"][name] - ana_a["StagePct"][name]
+                if grew >= REGRESSION_SHARE_PTS:
+                    causes.append(f"{name} share grew "
+                                  f"{ana_a['StagePct'][name]:g}% -> "
+                                  f"{ana_b['StagePct'][name]:g}%")
+            if ana_b["Verdict"] != ana_a["Verdict"]:
+                causes.append(f"verdict changed {ana_a['Verdict']} -> "
+                              f"{ana_b['Verdict']}")
+        regressed = rate_a > 0 and ratio is not None \
+            and ratio <= (1.0 - REGRESSION_RATE_DROP)
+        report.append({
+            "Phase": key[0], "Occurrence": key[1],
+            "RateA": round(rate_a, 1), "RateB": round(rate_b, 1),
+            "RateRatio": ratio,
+            "Regressed": regressed,
+            "Causes": causes,
+            "AnalysisA": ana_a, "AnalysisB": ana_b,
+        })
+    return report
+
+
+def _indexed(analyses):
+    seen: "dict[str, int]" = {}
+    for ana in analyses:
+        idx = seen.get(ana["Phase"], 0)
+        seen[ana["Phase"]] = idx + 1
+        yield idx, ana

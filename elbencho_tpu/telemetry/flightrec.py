@@ -1,0 +1,431 @@
+"""Flight recorder: per-tick fleet time-series capture (--flightrec).
+
+A finished run used to leave only sums and high-water marks — the per-tick
+fleet state that already flows through the live-stats loop (local mode) and
+the /livestream frames / /status polls the master ingests anyway (service
+mode) evaporated at phase end. The flight recorder samples that state into
+a compact append-only JSONL artifact so the run doctor (doctor.py) and the
+``elbencho-tpu-doctor`` CLI can answer "was this run storage-bound,
+DMA-bound, or stalled on the pipeline — and when?" after the fact.
+
+Design contract (mirrors the tracer/telemetry rules):
+
+- **Off by default, zero overhead off.** A FlightRecorder exists iff
+  ``--flightrec FILE`` was given; every hook is a single ``is None`` test
+  (the overhead guard in tests/test_flightrec.py pins this).
+- **Zero extra service requests.** The recorder samples the SAME worker
+  counters the live-stats loop already reads: local workers' live counters
+  directly, RemoteWorkers' ingest mirrors that /livestream frames
+  (--svcstream) or /status polls already populate. Arming it changes no
+  wire traffic (asserted against SvcRequests in the scale-style test).
+- **Per-host and fleet-merged rows, same wire rules.** The fleet row is by
+  construction the sum/MAX merge (PATH_AUDIT_MAX_KEYS + the control
+  counters' merge modes) of the per-host rows — property-tested.
+- **Bounded memory.** Rows buffer in a capped ring and flush+fsync
+  periodically; overflow drops the OLDEST rows and counts them
+  (RowsDropped in phase_end records), so a recording is honest about loss.
+- **Schema-versioned header** so readers can refuse a future format
+  instead of misparsing it; the reader tolerates a torn final line (a
+  crashed run still leaves a loadable recording) but rejects mid-file
+  garbage like the run journal does.
+
+Row formats (one JSON object per line):
+
+  {"Type":"header","Schema":1,...,"SumKeys":[...],"MaxKeys":[...]}
+  {"Type":"phase_start","Phase":"WRITE","T":1.50}
+  {"Type":"s","T":2.00,"D":{"Bytes":1048576,...}}            # fleet row
+  {"Type":"s","T":2.00,"Host":"node1:1611","D":{...}}        # per-host row
+  {"Type":"phase_end","Phase":"WRITE","T":9.51,"ElapsedUSec":...,
+   "Workers":N,"Totals":{...},"Analysis":{...}|null,"RowsDropped":0}
+
+Sample rows are DELTA-encoded: sum-merged counters carry the change since
+the entity's previous row (zero changes are omitted, idle entities emit no
+row), MAX-merged high-water marks carry the absolute value when it moved.
+Cumulative state is reconstructed by ``accumulate_rows``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .. import __version__
+from ..service.fault_tolerance import (CONTROL_AUDIT_COUNTERS,
+                                       merge_control_audit_counters)
+from ..tpu.device import (PATH_AUDIT_COUNTERS, PATH_AUDIT_MAX_KEYS,
+                          sum_path_audit_counters)
+
+#: bump when the row format changes incompatibly; readers refuse unknown
+SCHEMA_VERSION = 1
+
+#: key for the fleet-merged entity in the per-entity snapshot maps
+FLEET = ""
+
+#: buffered rows are flushed+fsync'd at whichever comes first
+FLUSH_ROWS = 64
+FLUSH_SECS = 1.0
+
+#: pending-row ring bound: beyond this the oldest buffered rows are
+#: dropped (and counted) instead of growing without bound when the
+#: target filesystem stalls
+RING_CAP = 8192
+
+#: in-memory per-phase fleet series bound (doctor trend evidence); when
+#: full, adjacent ticks are coalesced so the window keeps covering the
+#: whole phase at half the resolution
+SERIES_CAP = 4096
+
+
+def counter_schema() -> "tuple[tuple[str, str], ...]":
+    """(wire key, merge mode) for every recorded counter: the live-ops
+    triple, the TPU transfer split, the whole PATH_AUDIT / CONTROL_AUDIT
+    schemas, and the storage-op busy time. Modes are the SAME sum/MAX
+    rules the service wire protocol merges by."""
+    rows: "list[tuple[str, str]]" = [
+        ("Entries", "sum"), ("Bytes", "sum"), ("Iops", "sum"),
+        ("TpuHbmBytes", "sum"), ("TpuHbmUSec", "sum"),
+        ("TpuHbmDispatchUSec", "sum"),
+        # storage-op busy time: per-op latencies summed across workers
+        # (the sum_micro of the io histograms) — the "storage submit/
+        # reap" leg of the doctor's stage decomposition
+        ("IoBusyUSec", "sum"),
+    ]
+    for _attr, key, _ingest in PATH_AUDIT_COUNTERS:
+        rows.append((key, "max" if key in PATH_AUDIT_MAX_KEYS else "sum"))
+    for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
+        rows.append((key, mode))
+    return tuple(rows)
+
+
+def max_keys() -> "frozenset[str]":
+    return frozenset(k for k, mode in counter_schema() if mode == "max")
+
+
+def snapshot_fleet(statistics) -> dict:
+    """Absolute fleet-merged counter snapshot, read from the same
+    worker-owned plain ints the live-stats loop sums (local workers'
+    counters, RemoteWorkers' ingest mirrors) — never a wire request."""
+    from ..stats.statistics import sum_tpu_transfer_totals
+    entries, num_bytes, iops, _done = statistics._sum_live_ops()
+    workers = statistics.manager.workers
+    tpu_bytes, tpu_usec, tpu_dispatch = sum_tpu_transfer_totals(workers)
+    snap = {"Entries": entries, "Bytes": num_bytes, "Iops": iops,
+            "TpuHbmBytes": tpu_bytes, "TpuHbmUSec": tpu_usec,
+            "TpuHbmDispatchUSec": tpu_dispatch,
+            "IoBusyUSec": sum(w.iops_latency_histo.sum_micro
+                              + w.iops_latency_histo_rwmix.sum_micro
+                              for w in workers)}
+    snap.update(sum_path_audit_counters(workers))
+    snap.update(merge_control_audit_counters(workers))
+    return snap
+
+
+def snapshot_host(worker) -> dict:
+    """Absolute per-host snapshot of one RemoteWorker's ingest mirrors
+    (populated by the /livestream or /status ingest the master already
+    performs). Fleet == merge(hosts) by construction: every key here is
+    exactly one addend/operand of the snapshot_fleet merge."""
+    snap = {
+        "Entries": (worker.live_ops.num_entries_done
+                    + worker.live_ops_rwmix_read.num_entries_done),
+        "Bytes": (worker.live_ops.num_bytes_done
+                  + worker.live_ops_rwmix_read.num_bytes_done),
+        "Iops": (worker.live_ops.num_iops_done
+                 + worker.live_ops_rwmix_read.num_iops_done),
+        "TpuHbmBytes": worker.tpu_transfer_bytes,
+        "TpuHbmUSec": worker.tpu_transfer_usec,
+        "TpuHbmDispatchUSec": worker.tpu_dispatch_usec,
+        "IoBusyUSec": (worker.iops_latency_histo.sum_micro
+                       + worker.iops_latency_histo_rwmix.sum_micro),
+    }
+    for _attr, key, ingest_attr in PATH_AUDIT_COUNTERS:
+        snap[key] = getattr(worker, ingest_attr, 0)
+    for attr, key, _mode in CONTROL_AUDIT_COUNTERS:
+        snap[key] = getattr(worker, attr, 0)
+    return snap
+
+
+def delta_row(prev: dict, cur: dict, maxed: "frozenset[str]") -> dict:
+    """Compact delta between two absolute snapshots: sum keys as change
+    (omitted when 0; a counter reset — new phase — re-bases to the
+    absolute value), MAX keys as absolute value when it moved."""
+    out = {}
+    for key, val in cur.items():
+        if key in maxed:
+            if val != prev.get(key, 0):
+                out[key] = val
+        else:
+            d = val - prev.get(key, 0)
+            if d < 0:  # per-phase counter reset: re-base
+                d = val
+            if d:
+                out[key] = d
+    return out
+
+
+def accumulate_rows(rows, maxed: "frozenset[str]") -> dict:
+    """Reconstruct the cumulative counter state from delta rows
+    (``D`` dicts): sum keys add up, MAX keys keep the last (and largest
+    — high-water marks are monotonic within a phase) value."""
+    out: dict = {}
+    for d in rows:
+        for key, val in d.items():
+            if key in maxed:
+                out[key] = max(out.get(key, 0), val)
+            else:
+                out[key] = out.get(key, 0) + val
+    return out
+
+
+def merge_entities(cums: "list[dict]", maxed: "frozenset[str]") -> dict:
+    """Merge per-entity cumulative states with the wire rules (sum,
+    except MAX keys) — the property the fleet row must equal. The fold
+    is the same one delta accumulation uses, so the two can never
+    drift."""
+    return accumulate_rows(cums, maxed)
+
+
+class FlightRecorder:
+    """Append-only recorder driven from the live-stats loop. All methods
+    run on the coordinator thread (the same thread that renders live
+    stats), so no locking is needed."""
+
+    def __init__(self, path: str, cfg, role: str = "local"):
+        self.path = path
+        self.cfg = cfg
+        self.role = role
+        self._maxed = max_keys()
+        self._fh = open(path, "w")
+        self._t0 = time.monotonic()
+        self._pending: "list[str]" = []
+        self._last_flush = self._t0
+        self.rows_dropped = 0
+        self.rows_written = 0
+        self._dead_err: "str | None" = None
+        # per-entity absolute snapshots of the CURRENT phase ("" = fleet);
+        # doubles as the delta baseline and the cumulative state
+        self._prev: "dict[str, dict]" = {}
+        # current phase bookkeeping for the doctor
+        self._phase: "str | None" = None
+        self._phase_t0 = self._t0
+        self._series: "list[tuple[float, dict]]" = []
+        schema = counter_schema()
+        self._append({
+            "Type": "header", "Schema": SCHEMA_VERSION,
+            "Tool": "elbencho-tpu", "Version": __version__,
+            "Role": role, "Label": getattr(cfg, "bench_label", ""),
+            "IntervalMs": getattr(cfg, "live_stats_interval_ms", 0),
+            "Hosts": list(getattr(cfg, "hosts", []) or []),
+            "SumKeys": [k for k, m in schema if m == "sum"],
+            "MaxKeys": [k for k, m in schema if m == "max"],
+            "UtcStart": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
+        self.flush(force=True)
+
+    # -- write path ----------------------------------------------------------
+
+    def _now(self) -> float:
+        return round(time.monotonic() - self._t0, 3)
+
+    def _append(self, rec: dict) -> None:
+        if self._dead_err is not None:
+            return
+        if len(self._pending) >= RING_CAP:
+            # bounded memory: drop the OLDEST buffered row, honestly
+            self._pending.pop(0)
+            self.rows_dropped += 1
+        self._pending.append(json.dumps(rec, separators=(",", ":")))
+
+    def flush(self, force: bool = False) -> None:
+        """Flush+fsync the pending ring when a bound is hit (or forced).
+        A failing recording disables itself LOUDLY once instead of
+        failing the benchmark — the run's results outrank its telemetry."""
+        if self._dead_err is not None or self._fh is None:
+            return
+        now = time.monotonic()
+        if not force and len(self._pending) < FLUSH_ROWS \
+                and now - self._last_flush < FLUSH_SECS:
+            return
+        if not self._pending:
+            self._last_flush = now
+            return
+        try:
+            self._fh.write("\n".join(self._pending) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except (OSError, ValueError) as err:
+            self._dead_err = str(err)
+            from ..toolkits.logger import log_error
+            log_error(f"--flightrec: recording to {self.path} failed "
+                      f"({err}); flight recording DISABLED for the rest "
+                      f"of the run")
+        else:
+            self.rows_written += len(self._pending)
+        self._pending = []
+        self._last_flush = now
+
+    # -- sampling hooks (live-stats loop / coordinator) ----------------------
+
+    def phase_start(self, phase_label: str) -> None:
+        """New phase: per-phase counters reset with the workers, so the
+        delta baselines and the doctor's trend series reset too."""
+        self._phase = phase_label
+        self._phase_t0 = time.monotonic()
+        self._prev = {}
+        self._series = []
+        self._append({"Type": "phase_start", "Phase": phase_label,
+                      "T": self._now()})
+        self.flush()
+
+    def sample(self, statistics) -> None:
+        """One tick: fleet row + per-host rows (master mode), delta
+        encoded against each entity's previous snapshot."""
+        t = self._now()
+        fleet = snapshot_fleet(statistics)
+        self._record_entity(FLEET, fleet, t)
+        for w in statistics.manager.workers:
+            host = getattr(w, "host", None)
+            if host is not None:
+                self._record_entity(host, snapshot_host(w), t)
+        self.flush()
+
+    def _record_entity(self, entity: str, snap: dict, t: float) -> None:
+        d = delta_row(self._prev.get(entity, {}), snap, self._maxed)
+        self._prev[entity] = snap
+        if not d:
+            return  # idle tick: no row (delta compaction)
+        row = {"Type": "s", "T": t, "D": d}
+        if entity != FLEET:
+            row["Host"] = entity
+        self._append(row)
+        if entity == FLEET:
+            self._series_push(round(t - (self._phase_t0 - self._t0), 3), d)
+
+    def _series_push(self, t_rel: float, d: dict) -> None:
+        if len(self._series) >= SERIES_CAP:
+            # halve resolution, keep whole-phase coverage
+            halved = []
+            for i in range(0, len(self._series) - 1, 2):
+                ta, da = self._series[i]
+                _tb, db = self._series[i + 1]
+                merged = dict(da)
+                for key, val in db.items():
+                    if key in self._maxed:
+                        merged[key] = max(merged.get(key, 0), val)
+                    else:
+                        merged[key] = merged.get(key, 0) + val
+                halved.append((ta, merged))
+            if len(self._series) % 2:
+                halved.append(self._series[-1])
+            self._series = halved
+        self._series.append((t_rel, d))
+
+    def finish_phase(self, statistics, res) -> "dict | None":
+        """Final tick + phase_end record + doctor analysis. Called after
+        the phase barrier (RemoteWorkers have ingested their final
+        /benchresult by then, so the totals are exact). Returns the
+        Analysis dict for the run JSON / text summary."""
+        if self._phase is None:
+            return None
+        self.sample(statistics)
+        totals = dict(self._prev.get(FLEET, {}))
+        from .doctor import analyze_phase
+        analysis = analyze_phase(res.phase_name, totals,
+                                 res.last_done_usec, res.num_workers,
+                                 series=self._series)
+        self._append({
+            "Type": "phase_end", "Phase": self._phase, "T": self._now(),
+            "ElapsedUSec": res.last_done_usec,
+            "Workers": res.num_workers,
+            "Totals": totals,
+            "Analysis": analysis,
+            "RowsDropped": self.rows_dropped,
+        })
+        self._phase = None
+        self.flush(force=True)
+        return analysis
+
+    def close(self) -> None:
+        if self._fh is None:
+            return
+        self.flush(force=True)
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+
+
+def make_flightrec(cfg) -> "FlightRecorder | None":
+    """The single arming point: a FlightRecorder exists iff --flightrec
+    was given AND this process is the master/local coordinator (services
+    never record — the master taps the frames it already ingests, so the
+    fleet pays zero extra requests)."""
+    path = getattr(cfg, "flightrec_file_path", "")
+    if not path or getattr(cfg, "run_as_service", False):
+        return None
+    return FlightRecorder(path, cfg,
+                          role="master" if getattr(cfg, "hosts", None)
+                          else "local")
+
+
+# ---------------------------------------------------------------------------
+# reading side (doctor CLI / chart tool / tests)
+# ---------------------------------------------------------------------------
+
+class RecordingError(ValueError):
+    """Unreadable/incompatible flight recording."""
+
+
+def read_recording(path: str) -> dict:
+    """Parse a recording into {"header", "phases": [...]}. The final
+    line may be torn (crashed run mid-append) and is dropped; garbage
+    anywhere else is an error — a recording that lies in the middle
+    must not be silently half-trusted. Each phase entry:
+    {"name", "start_t", "samples": [fleet D rows], "host_samples":
+    {host: [D rows]}, "end": phase_end record or None}."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    records = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError as err:
+            if i == len(lines) - 1:
+                break  # torn tail: tolerated
+            raise RecordingError(
+                f"{path}:{i + 1}: corrupt mid-file record: {err}") from err
+    if not records or records[0].get("Type") != "header":
+        raise RecordingError(f"{path}: not a flight recording "
+                             f"(missing header)")
+    header = records[0]
+    if header.get("Schema", 0) > SCHEMA_VERSION:
+        raise RecordingError(
+            f"{path}: schema {header.get('Schema')} is newer than this "
+            f"reader (supports <= {SCHEMA_VERSION})")
+    phases: "list[dict]" = []
+    cur: "dict | None" = None
+    for rec in records[1:]:
+        rtype = rec.get("Type")
+        if rtype == "phase_start":
+            cur = {"name": rec.get("Phase", "?"),
+                   "start_t": rec.get("T", 0.0),
+                   "samples": [], "sample_ts": [],
+                   "host_samples": {}, "end": None}
+            phases.append(cur)
+        elif rtype == "s" and cur is not None:
+            host = rec.get("Host")
+            if host is None:
+                cur["samples"].append(rec.get("D", {}))
+                cur["sample_ts"].append(rec.get("T", 0.0))
+            else:
+                cur["host_samples"].setdefault(host, []).append(
+                    rec.get("D", {}))
+        elif rtype == "phase_end" and cur is not None \
+                and rec.get("Phase") == cur["name"]:
+            cur["end"] = rec
+            cur = None
+    return {"header": header, "phases": phases}

@@ -234,6 +234,9 @@ class BenchTelemetry:
         reg.counter("trace_events_overwritten_total",
                     "Ring-buffer spans overwritten before the trace "
                     "file was written (raise the ring or --tracesample)")
+        reg.counter("trace_events_dropped_total",
+                    "Spans the trace LOST: sampled out by --tracesample "
+                    "plus ring overwrites (TraceDropped in JSON)")
 
     # -- sampling ------------------------------------------------------------
 
@@ -258,6 +261,7 @@ class BenchTelemetry:
         if tracer is not None:
             put("trace_events_total", tracer.num_recorded)
             put("trace_events_overwritten_total", tracer.num_overwritten)
+            put("trace_events_dropped_total", tracer.num_dropped)
         if manager is None:
             # idle service (incl. after lease-orphan recovery dropped the
             # pool): the service-lifetime lease counters must still show

@@ -45,6 +45,11 @@ class Tracer:
         self._idx = 0
         self.num_recorded = 0
         self.num_overwritten = 0
+        # spans rejected by --tracesample (never entered the ring);
+        # num_dropped = these + overwritten, so a sampled trace is
+        # honest about everything it lost (TraceDropped in JSON,
+        # trace_events_dropped_total on /metrics)
+        self.num_sampled_out = 0
         self._lock = threading.Lock()
         self._rng = random.Random(0xe1be0 + rank_offset)
         self._t0_ns = time.perf_counter_ns()
@@ -62,6 +67,7 @@ class Tracer:
         phase markers pass sampled=False and are always kept."""
         if sampled and self.sample < 1.0 \
                 and self._rng.random() >= self.sample:
+            self.num_sampled_out += 1
             return
         event = {
             "name": name,
@@ -92,6 +98,12 @@ class Tracer:
         self.record(op, "io", start_ns, dur_usec, rank=rank, sampled=True,
                     **args)
 
+    @property
+    def num_dropped(self) -> int:
+        """Spans this trace LOST: sampled out by --tracesample plus
+        overwritten in the ring before a write."""
+        return self.num_sampled_out + self.num_overwritten
+
     # -- output --------------------------------------------------------------
 
     def snapshot_events(self) -> "list[dict]":
@@ -119,6 +131,8 @@ class Tracer:
                 "sample": self.sample,
                 "numRecorded": self.num_recorded,
                 "numOverwritten": self.num_overwritten,
+                "numSampledOut": self.num_sampled_out,
+                "numDropped": self.num_dropped,
             },
         }
         tmp = f"{self.path}.tmp{os.getpid()}"

@@ -31,6 +31,14 @@ def _last_json_line(stdout: str) -> dict:
 
 
 def _run_bench(env, timeout):
+    # keep the doctor rider's persisted recording out of the repo tree
+    # (the real driver wants it next to bench.py; tests do not)
+    env = dict(env)
+    import tempfile
+    env.setdefault(
+        "ELBENCHO_TPU_BENCH_FLIGHTREC",
+        os.path.join(tempfile.gettempdir(),
+                     f"bench_flightrec_{os.getpid()}.rec"))
     return subprocess.run(
         [sys.executable, BENCH], env=env, capture_output=True,
         text=True, timeout=timeout)
@@ -67,6 +75,12 @@ def test_unreachable_tpu_degrades_to_host_path_ladder():
         assert "utc" in entry and "outcome" in entry
     # the A/B slot contract is machine-written in EVERY record
     assert "pipeline_ab" in rec and rec["pipeline_ab"] is None
+    # the doctor rider: a tier-labeled verdict over the median pass's
+    # flight recording, so the artifact records WHY, not just what
+    doctor = rec["doctor"]
+    assert doctor["tier"] == rec["fallback_tier"]
+    assert doctor.get("verdict"), doctor
+    assert os.path.exists(doctor["flightrec"])
 
 
 def test_unreachable_tpu_hard_fail_record_with_ladder_disabled():

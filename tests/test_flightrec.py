@@ -1,0 +1,556 @@
+"""Flight recorder + run doctor suite (ISSUE 10, pytest marker `obs`).
+
+Codec properties (round-trip, torn-tail tolerance, fleet row == wire
+merge of the per-host rows), doctor verdicts on constructed workloads
+(storage-bound / dispatch-bound / stall-bound), the regression diff of
+`elbencho-tpu-doctor a.rec b.rec`, the flightrec-off no-op overhead
+guard, and e2e through the real local and master paths with --svcstream
+on and off (recording a fleet adds ZERO extra service requests,
+asserted via the existing SvcRequests audit counter)."""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import _axon_mitigation  # noqa: E402,F401
+
+pytestmark = pytest.mark.obs
+
+DOCTOR = os.path.join(REPO, "tools", "elbencho-tpu-doctor")
+
+
+# ---------------------------------------------------------------------------
+# fake fleet harness: duck-typed workers/statistics, enough for the
+# snapshot helpers (the real paths are covered by the e2e tests below)
+# ---------------------------------------------------------------------------
+
+def _fake_worker(host=None):
+    from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+    w = types.SimpleNamespace(
+        host=host,
+        live_ops=types.SimpleNamespace(num_entries_done=0,
+                                       num_bytes_done=0, num_iops_done=0),
+        live_ops_rwmix_read=types.SimpleNamespace(
+            num_entries_done=0, num_bytes_done=0, num_iops_done=0),
+        iops_latency_histo=LatencyHistogram(),
+        iops_latency_histo_rwmix=LatencyHistogram(),
+        tpu_transfer_bytes=0, tpu_transfer_usec=0, tpu_dispatch_usec=0,
+    )
+    return w
+
+
+class _FakeStats:
+    def __init__(self, workers):
+        self.manager = types.SimpleNamespace(workers=workers)
+
+    def _sum_live_ops(self):
+        entries = num_bytes = iops = 0
+        for w in self.manager.workers:
+            entries += (w.live_ops.num_entries_done
+                        + w.live_ops_rwmix_read.num_entries_done)
+            num_bytes += (w.live_ops.num_bytes_done
+                          + w.live_ops_rwmix_read.num_bytes_done)
+            iops += (w.live_ops.num_iops_done
+                     + w.live_ops_rwmix_read.num_iops_done)
+        return entries, num_bytes, iops, 0
+
+
+def _fake_cfg():
+    return types.SimpleNamespace(bench_label="t",
+                                 live_stats_interval_ms=500,
+                                 hosts=["h1:1611", "h2:1611"])
+
+
+def _recorder(path):
+    from elbencho_tpu.telemetry.flightrec import FlightRecorder
+    return FlightRecorder(str(path), _fake_cfg(), role="master")
+
+
+def _phase_res(name="WRITE", elapsed=1_000_000, workers=2):
+    return types.SimpleNamespace(phase_name=name, last_done_usec=elapsed,
+                                 num_workers=workers)
+
+
+def _advance(w, num_bytes, iops, io_usec, inflight_hwm=0, stalls=0):
+    w.live_ops.num_bytes_done += num_bytes
+    w.live_ops.num_iops_done += iops
+    w.iops_latency_histo.num_values += iops
+    w.iops_latency_histo.sum_micro += io_usec
+    if inflight_hwm:
+        w.tpu_pipe_inflight_hwm = max(
+            getattr(w, "tpu_pipe_inflight_hwm", 0), inflight_hwm)
+    if stalls:
+        w.tpu_pipe_full_stalls = getattr(w, "tpu_pipe_full_stalls", 0) \
+            + stalls
+
+
+# ---------------------------------------------------------------------------
+# schema + codec units
+# ---------------------------------------------------------------------------
+
+def test_counter_schema_covers_the_audit_counters():
+    """The recording schema carries every path/control audit counter
+    with the exact merge mode the service wire uses — adding a counter
+    to either table auto-plumbs it into recordings too."""
+    from elbencho_tpu.service.fault_tolerance import CONTROL_AUDIT_COUNTERS
+    from elbencho_tpu.telemetry.flightrec import counter_schema, max_keys
+    from elbencho_tpu.tpu.device import (PATH_AUDIT_COUNTERS,
+                                         PATH_AUDIT_MAX_KEYS)
+    schema = dict(counter_schema())
+    for _attr, key, _ingest in PATH_AUDIT_COUNTERS:
+        assert schema[key] == ("max" if key in PATH_AUDIT_MAX_KEYS
+                               else "sum")
+    for _attr, key, mode in CONTROL_AUDIT_COUNTERS:
+        assert schema[key] == mode
+    assert max_keys() == {k for k, m in schema.items() if m == "max"}
+
+
+def test_delta_codec_roundtrip_units():
+    from elbencho_tpu.telemetry.flightrec import (accumulate_rows,
+                                                  delta_row)
+    maxed = frozenset({"Hwm"})
+    snaps = [{"A": 3, "Hwm": 2}, {"A": 10, "Hwm": 2}, {"A": 10, "Hwm": 7}]
+    rows, prev = [], {}
+    for snap in snaps:
+        rows.append(delta_row(prev, snap, maxed))
+        prev = snap
+    assert rows == [{"A": 3, "Hwm": 2}, {"A": 7}, {"Hwm": 7}]
+    assert accumulate_rows(rows, maxed) == {"A": 10, "Hwm": 7}
+    # a per-phase counter reset re-bases instead of going negative
+    assert delta_row({"A": 10}, {"A": 4}, maxed) == {"A": 4}
+
+
+def test_recording_roundtrip_and_wire_merge_property(tmp_path):
+    """Write a synthetic 2-host recording through the real recorder,
+    read it back, and prove (a) the cumulative reconstruction equals the
+    recorded phase totals and (b) the fleet row is the sum/MAX wire
+    merge of the per-host rows — the same rules the service protocol
+    merges by."""
+    from elbencho_tpu.telemetry import flightrec as fr
+    w1, w2 = _fake_worker("h1:1611"), _fake_worker("h2:1611")
+    stats = _FakeStats([w1, w2])
+    rec = _recorder(tmp_path / "run.rec")
+    rec.phase_start("WRITE")
+    _advance(w1, 1 << 20, 16, 4000, inflight_hwm=3)
+    _advance(w2, 2 << 20, 32, 9000, inflight_hwm=5)
+    rec.sample(stats)
+    _advance(w1, 4 << 20, 64, 20000, inflight_hwm=4)  # hwm stays 4 < 5
+    rec.sample(stats)
+    _advance(w2, 1 << 20, 16, 5000, inflight_hwm=9)
+    rec.finish_phase(stats, _phase_res())
+    rec.close()
+
+    doc = fr.read_recording(str(tmp_path / "run.rec"))
+    assert doc["header"]["Schema"] == fr.SCHEMA_VERSION
+    assert doc["header"]["Hosts"] == ["h1:1611", "h2:1611"]
+    (phase,) = doc["phases"]
+    assert phase["name"] == "WRITE"
+    assert phase["end"] is not None
+    maxed = fr.max_keys()
+    fleet_cum = fr.accumulate_rows(phase["samples"], maxed)
+    host_cums = [fr.accumulate_rows(rows, maxed)
+                 for rows in phase["host_samples"].values()]
+    assert set(phase["host_samples"]) == {"h1:1611", "h2:1611"}
+    merged = fr.merge_entities(host_cums, maxed)
+    # fleet row == wire merge of the per-host rows, key for key
+    assert merged == fleet_cum
+    # cumulative reconstruction == the recorded phase totals
+    totals = phase["end"]["Totals"]
+    for key, val in fleet_cum.items():
+        assert totals[key] == val, key
+    assert totals["Bytes"] == 8 << 20
+    assert totals["TpuPipeInflightHwm"] == 9   # MAX, not 3+5+4+9
+    assert totals["IoBusyUSec"] == 38000
+    assert phase["end"]["RowsDropped"] == 0
+
+
+def test_recording_torn_tail_tolerated_midfile_garbage_rejected(tmp_path):
+    from elbencho_tpu.telemetry.flightrec import (RecordingError,
+                                                  read_recording)
+    stats = _FakeStats([_fake_worker("h1:1611")])
+    rec = _recorder(tmp_path / "run.rec")
+    rec.phase_start("READ")
+    _advance(stats.manager.workers[0], 1 << 20, 16, 1000)
+    rec.finish_phase(stats, _phase_res("READ"))
+    rec.close()
+    path = tmp_path / "run.rec"
+    whole = path.read_text()
+    # torn final line (crashed mid-append): reader drops it silently
+    path.write_text(whole + '{"Type":"s","T":9.9,"D":{"Byt')
+    doc = read_recording(str(path))
+    assert doc["phases"][0]["end"] is not None
+    # garbage in the MIDDLE is a hard error, not a silent half-read
+    lines = whole.splitlines()
+    lines.insert(2, '{"Type": CORRUPT')
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(RecordingError, match="corrupt"):
+        read_recording(str(path))
+    # a future schema is refused instead of misparsed
+    hdr = json.loads(whole.splitlines()[0])
+    hdr["Schema"] = 99
+    path.write_text(json.dumps(hdr) + "\n"
+                    + "\n".join(whole.splitlines()[1:]) + "\n")
+    with pytest.raises(RecordingError, match="schema 99"):
+        read_recording(str(path))
+
+
+def test_recorder_bounded_ring_drops_oldest_and_counts(tmp_path,
+                                                       monkeypatch):
+    from elbencho_tpu.telemetry import flightrec as fr
+    monkeypatch.setattr(fr, "RING_CAP", 4)
+    rec = _recorder(tmp_path / "run.rec")
+    # block flushing so the ring actually fills
+    rec._last_flush = rec._t0 + 10_000
+    monkeypatch.setattr(fr, "FLUSH_ROWS", 1000)
+    for i in range(10):
+        rec._append({"Type": "s", "T": float(i), "D": {"Bytes": 1}})
+    assert len(rec._pending) == 4
+    assert rec.rows_dropped == 6
+    rec.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor verdicts on constructed workloads
+# ---------------------------------------------------------------------------
+
+def _totals(**kw):
+    base = {"Entries": 100, "Bytes": 1 << 30, "Iops": 1000}
+    base.update(kw)
+    return base
+
+
+def test_doctor_names_storage_bound():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    ana = analyze_phase("READ", _totals(IoBusyUSec=8_000_000),
+                        1_000_000, 10)
+    assert ana["Verdict"] == "storage-bound"
+    assert ana["BottleneckStage"] == "storage"
+    assert ana["StagePct"]["storage"] == 80.0
+    assert any("80% of worker time" in ev for ev in ana["Evidence"])
+
+
+def test_doctor_names_dispatch_bound():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    ana = analyze_phase("READ", _totals(
+        IoBusyUSec=500_000, TpuHbmDispatchUSec=6_000_000,
+        TpuHbmUSec=1_000_000, TpuH2dStagedOps=1000),
+        1_000_000, 10)
+    assert ana["Verdict"] == "dispatch-bound"
+    assert ana["StagePct"]["tpu_dispatch"] == 60.0
+
+
+def test_doctor_names_stall_bound_with_trend_evidence():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    # stalls dominate: 2 per TPU op; the series shows them arriving
+    # only in the second half of the phase
+    series = [(float(t), {"TpuPipeFullStalls": 0 if t < 12 else 250})
+              for t in range(0, 20, 2)]
+    ana = analyze_phase("READ", _totals(
+        IoBusyUSec=9_000_000, TpuH2dStagedOps=500,
+        TpuPipeFullStalls=1000), 1_000_000, 10, series=series)
+    assert ana["Verdict"] == "stall-bound"
+    assert ana["BottleneckStage"] == "pipeline"
+    assert ana["StallsPerTpuOp"] == 2.0
+    assert any("rising after t=12s" in ev for ev in ana["Evidence"])
+    assert any("--tpudepth" in ev for ev in ana["Evidence"])
+
+
+def test_doctor_names_dma_and_ici_and_retry():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    assert analyze_phase("READ", _totals(
+        TpuHbmUSec=7_000_000), 1_000_000, 10)["Verdict"] == "dma-bound"
+    assert analyze_phase("TPUSLICE", _totals(
+        IciRedistUSec=7_000_000), 1_000_000, 10)["Verdict"] == "ici-bound"
+    assert analyze_phase("READ", _totals(
+        IoRetryUsec=7_000_000, IoRetries=50),
+        1_000_000, 10)["Verdict"] == "retry-bound"
+
+
+def test_doctor_overlap_efficiency():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    # per-worker: storage 1.0s + HBM 1.0s in a 1.0s wall => the smaller
+    # leg is fully hidden (eff 1.0)
+    ana = analyze_phase("READ", _totals(
+        IoBusyUSec=10_000_000, TpuHbmUSec=8_000_000,
+        TpuHbmDispatchUSec=2_000_000), 1_000_000, 10)
+    assert ana["OverlapEff"]["StorageVsHbm"] == 1.0
+    # serial: storage 0.6s then HBM 0.4s in a 1.0s wall => no overlap
+    ana = analyze_phase("READ", _totals(
+        IoBusyUSec=6_000_000, TpuHbmUSec=4_000_000), 1_000_000, 10)
+    assert ana["OverlapEff"]["StorageVsHbm"] == 0.0
+    # --tpuslice: ingest vs ICI overlap
+    ana = analyze_phase("TPUSLICE", _totals(
+        IoBusyUSec=5_000_000, TpuHbmUSec=5_000_000,
+        IciRedistUSec=5_000_000), 1_000_000, 10)
+    assert ana["OverlapEff"]["IngestVsIci"] == 1.0
+
+
+def test_doctor_inconclusive_when_nothing_dominates():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    ana = analyze_phase("STAT", _totals(IoBusyUSec=100_000),
+                        1_000_000, 10)
+    assert ana["Verdict"] == "inconclusive"
+
+
+# ---------------------------------------------------------------------------
+# doctor CLI: single-recording report + regression diff
+# ---------------------------------------------------------------------------
+
+def _write_synthetic_rec(path, bytes_done, io_usec, elapsed_usec,
+                         stalls=0):
+    stats = _FakeStats([_fake_worker("h1:1611")])
+    rec = _recorder(path)
+    rec.phase_start("READ")
+    w = stats.manager.workers[0]
+    _advance(w, bytes_done // 2, 100, io_usec // 2, stalls=stalls // 2)
+    rec.sample(stats)
+    _advance(w, bytes_done - bytes_done // 2, 100,
+             io_usec - io_usec // 2, stalls=stalls - stalls // 2)
+    rec.finish_phase(stats, _phase_res("READ", elapsed_usec, 1))
+    rec.close()
+
+
+def test_doctor_cli_report(tmp_path):
+    rec = tmp_path / "run.rec"
+    _write_synthetic_rec(rec, 1 << 30, 800_000, 1_000_000)
+    proc = subprocess.run([sys.executable, DOCTOR, str(rec)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "VERDICT: storage-bound" in proc.stdout
+    assert "phase READ" in proc.stdout
+    # machine-readable mode
+    proc = subprocess.run([sys.executable, DOCTOR, "--json", str(rec)],
+                          capture_output=True, text=True, timeout=60)
+    ana = json.loads(proc.stdout.splitlines()[0])
+    assert ana["Verdict"] == "storage-bound"
+
+
+def test_doctor_cli_diff_flags_injected_regression(tmp_path):
+    """elbencho-tpu-doctor a.rec b.rec: the candidate runs 2x slower
+    with its storage share blown up — the diff must say REGRESSION and
+    name the stage that grew."""
+    a, b = tmp_path / "a.rec", tmp_path / "b.rec"
+    _write_synthetic_rec(a, 1 << 30, 500_000, 1_000_000)
+    _write_synthetic_rec(b, 1 << 30, 1_900_000, 2_000_000)  # injected
+    proc = subprocess.run([sys.executable, DOCTOR, str(a), str(b)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "REGRESSION" in proc.stdout
+    assert "storage share grew" in proc.stdout
+    # same recording against itself: no regression, rc 0
+    proc = subprocess.run([sys.executable, DOCTOR, str(a), str(a)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "REGRESSION" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: flightrec off == no recorder, no per-tick work
+# ---------------------------------------------------------------------------
+
+def test_flightrec_off_path_is_noop(tmp_path, monkeypatch):
+    """Without --flightrec no FlightRecorder may even be CONSTRUCTED and
+    no hook may fire — the off path must resolve to a single `is None`
+    test per tick, exactly like the tracer."""
+    from elbencho_tpu.telemetry.flightrec import FlightRecorder
+
+    def boom(*_a, **_k):
+        raise AssertionError("flight recorder touched while off")
+
+    for name in ("__init__", "phase_start", "sample", "finish_phase"):
+        monkeypatch.setattr(FlightRecorder, name, boom)
+    from elbencho_tpu.config.args import parse_cli
+    from elbencho_tpu.coordinator import Coordinator
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    cfg, _ = parse_cli(["-w", "-d", "-t", "1", "-n", "1", "-N", "2",
+                        "-s", "8K", "-b", "4K", "--nolive", str(bench)])
+    cfg.derive()
+    cfg.check()
+    coord = Coordinator(cfg)
+    assert coord._run_master_or_local() == 0
+    assert coord._flightrec is None
+    assert coord.statistics.flightrec is None
+
+
+def test_config_rejects_service_flightrec(tmp_path):
+    from elbencho_tpu.config.args import ConfigError, parse_cli
+    cfg, _ = parse_cli(["--service", "--flightrec",
+                        str(tmp_path / "x.rec")])
+    with pytest.raises(ConfigError, match="flightrec"):
+        cfg.check()
+
+
+def test_remote_worker_reset_clears_path_audit_mirrors(tmp_path):
+    """Between phases every live-ingest mirror must zero — incl. the
+    TPU-context path-audit attrs only _ingest_live_telemetry sets
+    (base reset covers just the worker-owned ones). A stale mirror
+    would leak the previous phase's totals into the next phase's first
+    flight-recorder tick as a spurious delta spike."""
+    from elbencho_tpu.config.args import parse_cli
+    from elbencho_tpu.service.remote_worker import RemoteWorker
+    from elbencho_tpu.tpu.device import PATH_AUDIT_COUNTERS
+    from elbencho_tpu.workers.base import Worker
+    from elbencho_tpu.workers.shared import WorkersSharedData
+    cfg, _ = parse_cli([str(tmp_path / "x")])
+    cfg.derive()
+    w = RemoteWorker.__new__(RemoteWorker)
+    Worker.__init__(w, WorkersSharedData(cfg), rank=0)
+    w.client = types.SimpleNamespace(
+        reset_phase_accounting=lambda: None, total_retries=0,
+        consec_retries_hwm=0, total_requests=0, total_rx_bytes=0)
+    w.degraded = False
+    for attr in ("svc_retries", "svc_consec_retries_hwm",
+                 "svc_heartbeat_age_hwm_usec", "svc_lease_expiries",
+                 "svc_lease_age_hwm_usec", "svc_requests",
+                 "svc_ctl_bytes", "svc_stream_frames", "svc_stream_bytes",
+                 "svc_delta_saved_bytes", "svc_agg_depth_hwm",
+                 "svc_conn_hwm"):
+        setattr(w, attr, 0)
+    for _attr, _key, ingest_attr in PATH_AUDIT_COUNTERS:
+        setattr(w, ingest_attr, 7)  # a phase's ingested totals
+    w.reset_stats()
+    for _attr, _key, ingest_attr in PATH_AUDIT_COUNTERS:
+        assert getattr(w, ingest_attr) == 0, ingest_attr
+
+
+# ---------------------------------------------------------------------------
+# e2e: local run + Analysis block in the run JSON
+# ---------------------------------------------------------------------------
+
+def test_local_e2e_recording_and_analysis_block(tmp_path):
+    from elbencho_tpu.cli import main
+    bench = tmp_path / "data.bin"
+    rec = tmp_path / "run.rec"
+    jsonfile = tmp_path / "out.json"
+    rc = main(["-w", "-r", "-t", "2", "-s", "1M", "-b", "64K",
+               "--flightrec", str(rec), "--jsonfile", str(jsonfile),
+               "--liveint", "50", "--nolive", str(bench)])
+    assert rc == 0
+    from elbencho_tpu.telemetry.flightrec import read_recording
+    doc = read_recording(str(rec))
+    names = [p["name"] for p in doc["phases"]]
+    assert "WRITE" in names and "READ" in names
+    for phase in doc["phases"]:
+        if phase["name"] in ("WRITE", "READ"):
+            assert phase["end"] is not None
+            assert phase["end"]["Totals"]["Bytes"] == 1 << 20
+            assert phase["end"]["Analysis"]["Verdict"]
+    recs = [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+    read_rec = next(r for r in recs if r["Phase"] == "READ")
+    ana = read_rec["Analysis"]
+    assert ana["Schema"] == 1
+    assert ana["Verdict"]
+    assert set(ana["StageUSec"]) == {"storage", "tpu_dispatch", "tpu_dma",
+                                     "ici_redist", "io_retry"}
+    assert ana["WallUSec"] == read_rec["ElapsedUSecLast"]
+    # without --flightrec the JSON record must NOT carry the block
+    jsonfile2 = tmp_path / "out2.json"
+    rc = main(["-r", "-t", "2", "-s", "1M", "-b", "64K",
+               "--jsonfile", str(jsonfile2), "--nolive", str(bench)])
+    assert rc == 0
+    recs2 = [json.loads(ln) for ln in jsonfile2.read_text().splitlines()]
+    assert all("Analysis" not in r for r in recs2)
+
+
+def test_chart_renders_flightrec_lanes(tmp_path):
+    rec = tmp_path / "run.rec"
+    _write_synthetic_rec(rec, 1 << 30, 800_000, 1_000_000)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "elbencho-tpu-chart"),
+         "--flightrec", str(rec)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "READ MiB/s" in proc.stdout
+    assert "READ IOPS" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# e2e through the real master path: --svcstream on and off, and the
+# zero-extra-requests guarantee
+# ---------------------------------------------------------------------------
+
+NUM_HOSTS = 4
+
+
+def _master_run(hosts, bench_dir, jsonfile, extra):
+    from elbencho_tpu.cli import main
+    return main(["-w", "-d", "-t", "1", "-n", "1", "-N", "8", "-s", "256K",
+                 "-b", "64K", "--svcupint", "25",
+                 "--hosts", hosts, "--jsonfile", str(jsonfile),
+                 "--nolive", str(bench_dir)] + extra)
+
+
+def _write_rec_of(jsonfile):
+    recs = [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+    return next(r for r in recs if r["Phase"] == "WRITE")
+
+
+@pytest.mark.parametrize("stream", [True, False],
+                         ids=["svcstream", "poll"])
+def test_master_e2e_records_fleet(tmp_path, stream):
+    """The real master path: with --svcstream the recorder taps the
+    /livestream frames, in poll mode the /status ingests — either way
+    the recording carries per-host rows for every service, the fleet
+    totals match the run JSON, and the Analysis block is attached."""
+    from elbencho_tpu.telemetry import flightrec as fr
+    from elbencho_tpu.testing.service_harness import in_process_services
+    extra = ["--svcstream"] if stream else []
+    rec_path = tmp_path / "fleet.rec"
+    jsonfile = tmp_path / "out.json"
+    with in_process_services(NUM_HOSTS) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        bench = tmp_path / "bench"
+        bench.mkdir()
+        assert _master_run(hosts, bench, jsonfile,
+                           extra + ["--flightrec", str(rec_path)]) == 0
+        host_names = [f"127.0.0.1:{p}" for p in ports]
+    doc = fr.read_recording(str(rec_path))
+    assert doc["header"]["Role"] == "master"
+    write_phase = next(p for p in doc["phases"] if p["name"] == "WRITE")
+    assert write_phase["end"] is not None
+    # per-host rows for EVERY service host
+    assert set(write_phase["host_samples"]) == set(host_names)
+    maxed = fr.max_keys()
+    fleet_cum = fr.accumulate_rows(write_phase["samples"], maxed)
+    merged = fr.merge_entities(
+        [fr.accumulate_rows(rows, maxed)
+         for rows in write_phase["host_samples"].values()], maxed)
+    # fleet row == wire merge of the per-host rows, through the REAL path
+    assert merged["Bytes"] == fleet_cum["Bytes"]
+    assert merged["IoBusyUSec"] == fleet_cum["IoBusyUSec"]
+    json_rec = _write_rec_of(jsonfile)
+    assert write_phase["end"]["Totals"]["Bytes"] == json_rec["BytesLast"] \
+        == NUM_HOSTS * 8 * 256 * 1024
+    assert json_rec["Analysis"]["Verdict"]
+    if stream:
+        # the recording rode the stream: frames flowed
+        assert write_phase["end"]["Totals"]["SvcStreamFrames"] > 0
+
+
+def test_recording_adds_zero_service_requests_64_hosts(tmp_path):
+    """Acceptance: under --svcstream, arming the flight recorder on a
+    64-host in-process fleet (the `make test-scale` harness) adds ZERO
+    extra service requests — SvcRequests (the master-side count of every
+    HTTP request sent to hosts) is identical with recording on and off,
+    because the recorder only taps frames the master ingests anyway."""
+    from elbencho_tpu.testing.service_harness import in_process_services
+    counts = {}
+    with in_process_services(64) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        for label, extra in (
+                ("off", ["--svcstream"]),
+                ("on", ["--svcstream", "--flightrec",
+                        str(tmp_path / "on.rec")])):
+            bench = tmp_path / f"bench-{label}"
+            bench.mkdir()
+            jsonfile = tmp_path / f"{label}.json"
+            assert _master_run(hosts, bench, jsonfile, extra) == 0
+            counts[label] = _write_rec_of(jsonfile)["SvcRequests"]
+    assert counts["on"] == counts["off"], counts

@@ -497,14 +497,16 @@ def test_summarize_json_columns_and_degraded_tpu_banner(tmp_path):
     header = out.stdout.splitlines()[0].split(",")
     row = out.stdout.splitlines()[1].split(",")
     # appended after every pre-existing column, never reordered (the
-    # staging-pool, run-lifecycle, streaming-control-plane, and
-    # pod-slice columns append after the fault-tolerance block)
-    assert header[-19:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    # staging-pool, run-lifecycle, streaming-control-plane, pod-slice,
+    # and latency-percentile columns append after the fault-tolerance
+    # block)
+    assert header[-22:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                             "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                             "PoolReuse", "RegOps", "SqpollOps",
                             "LeaseExp", "Resumed", "StreamB", "DeltaSave",
-                            "AggDepth", "ShardMiB", "IciMiB", "IciGbps"]
-    assert row[-14:-11] == ["4", "2", "1"]
+                            "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
+                            "LatP50", "LatP99", "LatP99.9"]
+    assert row[-17:-14] == ["4", "2", "1"]
     assert "DEGRADED-TPU" in out.stderr
     # clean records: no banner
     jf.write_text(json.dumps({"Phase": "READ"}) + "\n")

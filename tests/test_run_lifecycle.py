@@ -615,8 +615,8 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     header = res.stdout.splitlines()[0].split(",")
     # the streaming-control-plane trio + pod-slice trio append after the
     # lifecycle pair (never reordered)
-    assert header[-8:-6] == ["LeaseExp", "Resumed"]
+    assert header[-11:-9] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-8:-6] == ["2", "3"]
+    assert row[-11:-9] == ["2", "3"]
     assert "RESUMED" in res.stderr

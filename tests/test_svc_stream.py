@@ -564,9 +564,10 @@ def test_summarize_json_stream_columns(tmp_path):
         capture_output=True, text=True, check=True)
     header = out.stdout.splitlines()[0].split(",")
     row = out.stdout.splitlines()[1].split(",")
-    # the pod-slice trio appends after the streaming trio
-    assert header[-6:-3] == ["StreamB", "DeltaSave", "AggDepth"]
-    assert row[-6:-3] == ["123", "456", "2"]
+    # the pod-slice and latency-percentile trios append after the
+    # streaming trio
+    assert header[-9:-6] == ["StreamB", "DeltaSave", "AggDepth"]
+    assert row[-9:-6] == ["123", "456", "2"]
 
 
 # ---------------------------------------------------------------------------

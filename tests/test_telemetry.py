@@ -19,6 +19,8 @@ import _axon_mitigation  # noqa: E402
 from elbencho_tpu.testing.service_harness import (  # noqa: E402
     default_env, free_ports, service_procs)
 
+pytestmark = pytest.mark.obs  # observability gate (`make test-obs`)
+
 
 def _scrape(url: str, timeout: float = 3.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -325,11 +327,12 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     cols = header.split(",")
     # appended, never reordered: the telemetry columns keep their order,
     # with the (later) data-plane fault-tolerance, staging-pool,
-    # run-lifecycle, streaming-control-plane, and pod-slice columns
-    # after them
-    assert cols[-19:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    # run-lifecycle, streaming-control-plane, pod-slice, and
+    # latency-percentile columns after them
+    assert cols[-22:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                           "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                           "PoolReuse", "RegOps", "SqpollOps",
                           "LeaseExp", "Resumed", "StreamB", "DeltaSave",
-                          "AggDepth", "ShardMiB", "IciMiB", "IciGbps"]
-    assert row.split(",")[-19:-14] == ["3", "7", "2", "5", "11"]
+                          "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
+                          "LatP50", "LatP99", "LatP99.9"]
+    assert row.split(",")[-22:-17] == ["3", "7", "2", "5", "11"]
