@@ -2,9 +2,9 @@
 # the C++ build; here the Python package needs no build and the native
 # engine lives in csrc/)
 
-.PHONY: all native native-tsan native-asan tsan asan check test \
-	test-fast test-chaos test-scale test-mesh test-obs test-examples \
-	fuzz bench docs clean deb rpm docker
+.PHONY: all native native-tsan native-asan tsan asan check check-schema \
+	test test-fast test-chaos test-scale test-mesh test-obs \
+	test-examples fuzz bench docs clean deb rpm docker
 
 all: native
 
@@ -69,7 +69,14 @@ check: native
 fuzz:
 	tools/fuzz-sweep
 
-test: native
+# append-only lint for the wire/JSON counter schemas (PATH_AUDIT /
+# CONTROL_AUDIT lists, CSV columns, summarize-json column tail) against
+# the previous commit — the "appended, never reordered" rule as a
+# mechanical gate instead of a convention
+check-schema:
+	tools/check-schema
+
+test: native check-schema
 	python -m pytest tests/ -q
 
 test-fast: native
@@ -102,13 +109,15 @@ test-scale:
 	env JAX_PLATFORMS=cpu ELBENCHO_TPU_NO_NATIVE=1 \
 		python -m pytest tests/test_stream_scale.py -q -m scale
 
-# observability gate: the telemetry + flight-recorder + run-doctor
-# suites (/metrics scrape-under-load, trace schema, flightrec codec
-# round-trip/torn-tail/merge properties, doctor verdicts, the no-op
-# overhead guards; pytest marker `obs`; docs/telemetry.md)
-test-obs:
+# observability gate: the telemetry + flight-recorder + run-doctor +
+# fleet-tracing suites (/metrics scrape-under-load, trace schema,
+# flightrec codec round-trip/torn-tail/merge properties, doctor
+# verdicts incl. straggler attribution, clock-skew estimator units,
+# fleet trace merge properties, the 8-host cross-host-flow e2e, the
+# no-op overhead guards; pytest marker `obs`; docs/telemetry.md)
+test-obs: check-schema
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
-		tests/test_flightrec.py -q -m obs
+		tests/test_flightrec.py tests/test_tracefleet.py -q -m obs
 
 # end-to-end example suite against real resources (loopdevs, services)
 test-examples: native

@@ -544,6 +544,7 @@ def _doctor_attach(rec_path, tier):
         out_path = None if _SELFTEST else FLIGHTREC_OUT
         if out_path is not None:
             shutil.copyfile(rec_path, out_path)
+        per_host = (ana.get("Straggler") or {}).get("PerHost", {})
         return {
             "tier": tier,
             "verdict": ana["Verdict"],
@@ -552,6 +553,59 @@ def _doctor_attach(rec_path, tier):
             "overlap_eff": ana["OverlapEff"],
             "evidence": ana["Evidence"][:4],
             "flightrec": out_path,
+            # fleet straggler evidence (null for local passes — becomes
+            # real once bench rounds run distributed): who lagged, the
+            # barrier-wait share, the worst estimated clock skew
+            "straggler": ana.get("Straggler"),
+            "max_clock_skew_usec": max(
+                (abs(e.get("ClockOffsetUsec", 0))
+                 for e in per_host.values()), default=0),
+        }
+    except Exception as err:  # noqa: BLE001 - rider must never kill a record
+        return {"tier": tier, "error": str(err)[-300:]}
+
+
+# the fleet trace of the traced rider pass, persisted next to bench.py
+# like the flight recording (auditable after the tmpdir is cleaned up)
+FLEET_TRACE_OUT = os.environ.get(
+    "ELBENCHO_TPU_BENCH_FLEET_TRACE",
+    os.path.join(REPO, ".bench_last_fleet_trace.json"))
+
+
+def _fleet_trace_attach(tmpdir, target, tier, extra_args=None,
+                        extra_env=None):
+    """Fleet-trace rider: one SHORT traced pass, separate from the
+    measured passes (tracing swaps the plain native block loop for the
+    instrumented Python loop, so the headline number is never traced),
+    merged through the same tracefleet path a --tracefleet master run
+    uses. A local bench round yields a single-lane merge with zero
+    skew; distributed rounds get per-host lanes + the skew report.
+    Tier-labeled like the doctor dict; failures are context, never
+    fatal."""
+    jf = os.path.join(tmpdir, "fleettrace.json")
+    tpath = os.path.join(tmpdir, "fleettrace_trace.json")
+    try:
+        _run_cli(["-r", "-t", THREADS, "-s", BLOCK_SIZE,
+                  "-b", BLOCK_SIZE, "--tracefile", tpath,
+                  "--tracefleet", "on", *(extra_args or []), target],
+                 jf, extra_env=extra_env, timeout=300)
+        # the traced subprocess already merged at coordinator teardown
+        # (<base>.fleet.json) — read THAT instead of re-merging
+        merged_path = os.path.join(tmpdir, "fleettrace_trace.fleet.json")
+        with open(merged_path) as f:
+            doc = json.load(f)
+        out_path = None if _SELFTEST else FLEET_TRACE_OUT
+        if out_path is not None:
+            import shutil
+            shutil.copyfile(merged_path, out_path)
+        other = doc["otherData"]
+        return {
+            "tier": tier,
+            "fleet_trace": out_path,
+            "lanes": other.get("numInputs", 0),
+            "max_abs_clock_offset_usec":
+                other.get("maxAbsClockOffsetUsec", 0),
+            "trace_events": len(doc.get("traceEvents", [])),
         }
     except Exception as err:  # noqa: BLE001 - rider must never kill a record
         return {"tier": tier, "error": str(err)[-300:]}
@@ -710,6 +764,13 @@ def _run_fallback_ladder(probe_err) -> int:
             # recording: the trajectory records WHY, not just what
             # (tier-labeled, like the headline metric)
             "doctor": _doctor_attach(med_recpath, tier),
+            # merged fleet trace of one short traced pass, tier-labeled
+            # like the doctor dict (single lane on a local fallback run)
+            "fleet_trace": _fleet_trace_attach(
+                tmpdir, target, tier,
+                extra_args=["--tpuids", "0"] if tier == "host_staging"
+                else [],
+                extra_env=_FALLBACK_ENV),
             "utc": _utc_now(),
         }
         if pass_errors:
@@ -987,6 +1048,13 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
                 med_recpath,
                 "tpu" if platform in TPU_PLATFORMS
                 else f"selftest_{platform}"),
+            # merged fleet trace of one short traced pass (straggler/
+            # skew evidence riding next to the verdict; tier-labeled)
+            "fleet_trace": _fleet_trace_attach(
+                tmpdir, target,
+                "tpu" if platform in TPU_PLATFORMS
+                else f"selftest_{platform}",
+                extra_args=["--tpuids", "0", "--tpudirect"]),
             "utc": _utc_now(),
         }
         if truncated:

@@ -24,4 +24,5 @@ __version__ = "0.1.0"
 
 # Messaging protocol version for master<->service compatibility checks.
 # (Reference: HTTP_PROTOCOLVERSION, source/Common.h:91 — exact match required.)
-HTTP_PROTOCOL_VERSION = "tpu-0.3"  # 0.3: /livestream streaming control plane
+HTTP_PROTOCOL_VERSION = "tpu-0.4"  # 0.4: fleet tracing (span-context
+# propagation, SvcClockUsec skew sampling, /benchresult trace shipping)

@@ -272,6 +272,22 @@ FLAG_DEFS = [
      "frames the master already ingests, so services pay zero extra "
      "requests; post-process with tools/elbencho-tpu-doctor "
      "(docs/telemetry.md)"),
+    ("tracefleet", None, "trace_fleet", "str", "auto", "misc",
+     "Fleet-wide trace collection+merge (auto|on|off; needs --tracefile): "
+     "a master-mode run stamps a run trace id + per-request span context "
+     "onto the control plane, estimates per-host clock offsets from the "
+     "exchanges it already performs (NTP-style RTT midpoint, min-RTT "
+     "filtered), collects each service's span ring at /benchresult, and "
+     "merges everything into ONE clock-aligned Chrome/Perfetto trace "
+     "(<tracefile base>.fleet.json) with cross-host RPC flow arrows and "
+     "a skew report; 'auto' (default) arms exactly when a master-mode "
+     "run traces at all; zero extra per-tick service requests "
+     "(docs/telemetry.md)"),
+    ("traceshipcap", None, "trace_ship_cap_mib", "int", 16, "misc",
+     "Max MiB of serialized span ring a service ships back at "
+     "/benchresult for the fleet trace merge; an over-cap ring is "
+     "refused LOUDLY on both ends (never fatal) and the host's lane "
+     "stays local-only"),
 
     # distribution
     ("hosts", None, "hosts_str", "str", "", "dist",
@@ -1351,6 +1367,14 @@ class BenchConfig(BenchConfigBase):
                 "--flightrec records at the master/local coordinator "
                 "(service counters already reach it over the existing "
                 "wire) — arm --flightrec on the master instead")
+        if self.trace_fleet not in ("auto", "on", "off"):
+            raise ConfigError("--tracefleet must be auto|on|off")
+        if self.trace_fleet == "on" and not self.trace_file_path:
+            raise ConfigError(
+                "--tracefleet merges --tracefile span rings — give "
+                "--tracefile PATH")
+        if self.trace_ship_cap_mib < 1:
+            raise ConfigError("--traceshipcap must be >= 1 (MiB)")
         if self.io_num_retries < 0:
             raise ConfigError("--ioretries must be >= 0")
         if self.io_retry_budget_secs < 0:

@@ -101,6 +101,11 @@ class Coordinator:
                 self.manager.join_all_threads()
             except Exception:  # noqa: BLE001 - teardown must not mask errors
                 pass
+            # fleet tracing: merge master + collected per-host traces
+            # into the one clock-aligned timeline (after the join wrote
+            # the master's final span ring); an aborted run merges
+            # whatever was collected before the abort
+            self._merge_fleet_trace()
             if self._flightrec is not None:
                 # flush the ring so even an aborted run leaves a
                 # loadable (torn-tail-tolerated) recording
@@ -149,6 +154,32 @@ class Coordinator:
             # later --resume replay
             self._journal.start_fresh(cfg.enabled_phases(), cfg.iterations)
         return False
+
+    def _merge_fleet_trace(self) -> None:
+        """--tracefleet: fold the master trace + the per-host rings
+        collected at /benchresult into ONE clock-aligned Chrome trace
+        (<tracefile base>.fleet<ext>) with a skew report. Best effort:
+        a failed merge is LOUD but never fails the run — the per-host
+        inputs stay on disk for tools/elbencho-tpu-trace."""
+        from .telemetry.tracefleet import (FleetTraceError,
+                                           fleet_trace_enabled,
+                                           merge_fleet_trace,
+                                           skew_report_text)
+        cfg = self.cfg
+        if not fleet_trace_enabled(cfg) \
+                or self.manager.shared.tracer is None \
+                or not os.path.exists(cfg.trace_file_path):
+            return
+        try:
+            doc = merge_fleet_trace(cfg.trace_file_path)
+        except (OSError, FleetTraceError) as err:
+            logger.log_error(f"fleet trace merge failed: {err} "
+                             f"(per-host inputs kept; retry with "
+                             f"tools/elbencho-tpu-trace)")
+            return
+        logger.log(0, f"fleet trace: {doc['outPath']}")
+        for line in skew_report_text(doc):
+            logger.log(1, line)
 
     def _abort_hygiene(self) -> None:
         """Master-side abort: close the telemetry exporter socket NOW and
@@ -337,6 +368,11 @@ class Coordinator:
                 from .service.fault_tolerance import \
                     merge_control_audit_counters
                 from .tpu.device import sum_path_audit_counters
+                # barrier decomposition BEFORE the marker is built, so
+                # StragglerSkewUsec/BarrierWaitUSec ride the marker like
+                # every control counter (recomputed harmlessly by
+                # generate_phase_results right after)
+                self.statistics._compute_barrier_skew()
                 audit = {k: v for k, v in sum_path_audit_counters(
                     self.manager.workers).items() if v}
                 # control-plane audit (retries, lease expiries/age) rides

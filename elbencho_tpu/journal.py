@@ -65,7 +65,8 @@ FINGERPRINT_EXCLUDE = frozenset({
     "show_all_elapsed", "show_cpu_util", "show_svc_elapsed",
     "show_svc_ping", "ignore_0usec_errors", "log_level",
     "ops_log_path", "ops_log_lock", "telemetry", "telemetry_port",
-    "trace_file_path", "trace_sample", "flightrec_file_path",
+    "trace_file_path", "trace_sample", "trace_fleet",
+    "trace_ship_cap_mib", "flightrec_file_path",
     "tpu_profile_dir",
     # control-plane resilience knobs (retry shape, not data shape)
     "svc_num_retries", "svc_retry_budget_secs", "svc_stalled_secs",

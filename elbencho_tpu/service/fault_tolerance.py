@@ -141,6 +141,17 @@ CONTROL_AUDIT_COUNTERS = (
     ("svc_delta_saved_bytes", "SvcDeltaSavedBytes", "sum"),
     ("svc_agg_depth_hwm", "SvcAggDepthHwm", "max"),
     ("svc_conn_hwm", "SvcConnHwm", "max"),
+    # fleet straggler attribution (docs/telemetry.md "Fleet tracing"),
+    # MASTER-computed after the phase barrier from per-host finish
+    # times: StragglerSkewUsec is each host's finish lag behind the
+    # FIRST host to finish (MAX-merge = the straggler's skew — the
+    # per-host phase start/finish spread a pod-scale barrier pays);
+    # BarrierWaitUSec is each host's idle wait for the LAST finisher
+    # (sum = fleet worker-seconds lost to the barrier; the doctor turns
+    # it into a barrier-wait share + straggler verdict). Both are zero
+    # for local runs and single-host fleets. Appended, never reordered.
+    ("straggler_skew_usec", "StragglerSkewUsec", "max"),
+    ("barrier_wait_usec", "BarrierWaitUSec", "sum"),
 )
 
 

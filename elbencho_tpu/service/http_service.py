@@ -85,6 +85,19 @@ class ServiceState:
         self._lease_thread: "threading.Thread | None" = None
         self.lease_expiries = 0
         self.lease_age_hwm_usec = 0
+        # per-host --tracefile paths this service wrote (fleet tracing):
+        # scrubbed together with the upload temp dir on quit/orphan so
+        # service hosts don't accumulate stale trace rings — but ONLY
+        # once a master provably holds the ring: attaching it to a
+        # /benchresult reply makes it PENDING, and the master's NEXT
+        # contact (it would not proceed without having processed the
+        # result) promotes pending -> shipped. A refused-over-cap ring,
+        # a master that crashed mid-response, or spans recorded after
+        # the last collection (a new /startphase clears both marks)
+        # leave the local file as the ONLY copy — the scrub spares it.
+        self._trace_files: "set[str]" = set()
+        self._trace_shipped: "set[str]" = set()
+        self._trace_ship_pending = ""
         # /metrics piggyback (telemetry subsystem): one sampler for the
         # service lifetime; the provider indirection follows the worker
         # pool across /preparephase rebuilds
@@ -146,6 +159,10 @@ class ServiceState:
             # rank offset so a shared filesystem can't clobber files
             base, ext = os.path.splitext(cfg.trace_file_path)
             cfg.trace_file_path = f"{base}.r{cfg.rank_offset}{ext}"
+            # remember it for the quit/orphan scrub: per-host trace
+            # files must not accumulate forever on service hosts
+            # (docs/telemetry.md "Fleet tracing" retention note)
+            self._trace_files.add(cfg.trace_file_path)
         cfg.derive()
         cfg.check()
         self.cfg = cfg
@@ -175,6 +192,15 @@ class ServiceState:
     def lease_counters(self) -> dict:
         return {"SvcLeaseExpiries": self.lease_expiries,
                 "SvcLeaseAgeHwmUsec": self.lease_age_hwm_usec}
+
+    def note_master_contact(self) -> None:
+        """A master request arriving AFTER a /benchresult that attached
+        the span ring proves that reply was received and processed —
+        promote the pending ship so the quit/orphan scrub may treat the
+        local ring file as a duplicate."""
+        if self._trace_ship_pending:
+            self._trace_shipped.add(self._trace_ship_pending)
+            self._trace_ship_pending = ""
 
     def touch_lease(self) -> None:
         """Every authorized master request renews the lease (the /status
@@ -279,13 +305,28 @@ class ServiceState:
         self._cleanup_run_temp_files()
 
     def _cleanup_run_temp_files(self) -> None:
-        """Drop this service's per-run upload dir (treefiles etc.) so an
+        """Drop this service's per-run upload dir (treefiles etc.) AND
+        the per-host ``.r<rankoffset>`` trace files it wrote, so an
         orphaned/quit service leaves no stale per-host temp state behind;
-        the next master re-uploads its prep files at /preparefile."""
+        the next master re-uploads its prep files at /preparefile (and
+        re-arms tracing per /preparephase). The master's COLLECTED
+        copies — the fleet-trace inputs — live on the master and are
+        untouched by this."""
         d = os.path.join(SVC_TMP_DIR,
                          f"elbencho_tpu_{getpass.getuser()}"
                          f"_p{self.base_cfg.service_port}")
         shutil.rmtree(d, ignore_errors=True)
+        trace_files, self._trace_files = self._trace_files, set()
+        shipped, self._trace_shipped = self._trace_shipped, set()
+        for path in trace_files & shipped:
+            # the master holds a collected copy — the local ring is a
+            # duplicate and must not accumulate. Never-shipped files
+            # (ring refused over --traceshipcap, master crashed before
+            # collection, --tracefleet off) are the only copy and stay.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass  # never written (tracing armed but no phase ran)
 
     def close(self) -> None:
         """Service shutdown: stop the lease watchdog, end every live
@@ -316,6 +357,10 @@ class ServiceState:
                 shared.current_phase not in (BenchPhase.IDLE,
                                              BenchPhase.TERMINATE):
             return (409, "workers still busy with another phase")
+        # a new phase records new spans the last collection cannot have
+        # covered: the local ring file is no longer a duplicate
+        self._trace_shipped.clear()
+        self._trace_ship_pending = ""
         phase = BenchPhase(phase_code)
         self.phase_start_monotonic = time.monotonic()
         self.manager.start_next_phase(phase)
@@ -336,20 +381,74 @@ class ServiceState:
         stats.update(self.lease_counters())
         return stats
 
-    def bench_result(self) -> dict:
+    def bench_result(self, params: "dict | None" = None) -> dict:
+        from ..telemetry.tracefleet import svc_wall_clock_usec
+        params = params or {}
         statistics, manager = self.statistics, self.manager
         if statistics is None:
-            return self.lease_counters()
+            reply = self.lease_counters()
+            reply[proto.KEY_SVC_CLOCK] = svc_wall_clock_usec(
+                self.base_cfg.service_port)
+            return reply
         result = statistics.get_bench_result_dict()
         result[proto.KEY_ERROR_HISTORY] = logger.get_error_history()
         result.update(self.lease_counters())
+        result[proto.KEY_SVC_CLOCK] = svc_wall_clock_usec(
+            self.base_cfg.service_port)
         tracer = manager.shared.tracer if manager else None
         if tracer is not None:
             try:  # phase is over: persist the span ring for Perfetto
                 tracer.write()
             except OSError as err:
                 logger.log_error(f"--tracefile write failed: {err}")
+        if tracer is not None and params.get(proto.KEY_SHIP_TRACE):
+            self._attach_trace_ring(result, tracer)
         return result
+
+    #: reply key carrying the PRE-SERIALIZED span ring from bench_result
+    #: to the handler, which splices it into the reply body — the ring
+    #: (up to --traceshipcap MiB) is serialized exactly once, and never
+    #: a second time inside the reply's own json.dumps under route_lock
+    TRACE_RING_JSON_KEY = "_TraceRingJson"
+
+    def _attach_trace_ring(self, result: dict, tracer) -> None:
+        """Fleet tracing: attach this host's span ring to the
+        /benchresult reply so the master can merge it — unless it
+        exceeds --traceshipcap, in which case the refusal is LOUD on
+        both ends but never fails the result exchange (the run's
+        numbers outrank its telemetry)."""
+        import json as json_mod
+        cap_mib = getattr(self.cfg, "trace_ship_cap_mib", 16)
+        ring = {
+            "traceEvents": tracer.snapshot_events(),
+            "otherData": {
+                "rankOffset": tracer.rank_offset,
+                "wallAnchorUsec": tracer.wall_anchor_usec,
+                "sample": tracer.sample,
+                "numRecorded": tracer.num_recorded,
+                "numDropped": tracer.num_dropped,
+                **tracer.extra_other_data,
+            },
+        }
+        ring_json = json_mod.dumps(ring, separators=(",", ":"))
+        if len(ring_json) > cap_mib << 20:
+            logger.log_error(
+                f"fleet trace: NOT shipping this host's span ring — "
+                f"{len(ring_json) >> 20} MiB serialized exceeds "
+                f"--traceshipcap {cap_mib} MiB; the local file "
+                f"{getattr(self.cfg, 'trace_file_path', '')!r} keeps "
+                f"the spans, the merged fleet trace will miss this lane "
+                f"(raise --traceshipcap or lower --tracesample)")
+            result[proto.KEY_TRACE_RING_REFUSED] = {
+                "Events": len(ring["traceEvents"]),
+                "Bytes": len(ring_json), "CapMiB": cap_mib}
+            return
+        result[self.TRACE_RING_JSON_KEY] = ring_json
+        # PENDING until the master's next contact proves the reply
+        # landed (note_master_contact); a master that dies mid-response
+        # must not cost the only copy of these spans
+        self._trace_ship_pending = getattr(self.cfg,
+                                           "trace_file_path", "")
 
     def metrics(self) -> str:
         """Prometheus text rendering of this service's live state."""
@@ -421,6 +520,9 @@ def _make_handler(state: ServiceState, server_holder: dict):
             polls, marked with the current bench UUID, count."""
             if route in self._LEASE_RENEWING_ROUTES:
                 state.touch_lease()
+                # ...and proves any pending /benchresult reply (the one
+                # carrying the span ring) was received: promote the ship
+                state.note_master_contact()
                 return
             if route == proto.PATH_STATUS:
                 bench_id = params.get(proto.KEY_BENCH_ID, "")
@@ -429,6 +531,7 @@ def _make_handler(state: ServiceState, server_holder: dict):
                     if manager is not None else ""
                 if bench_id and uuid and bench_id == uuid:
                     state.touch_lease()
+                    state.note_master_contact()
 
         # -- GET endpoints ---------------------------------------------------
 
@@ -452,8 +555,26 @@ def _make_handler(state: ServiceState, server_holder: dict):
             with state.route_lock:
                 self._do_get_locked(route, params)
 
+        def _record_handle_span(self, route, params, t0_ns) -> None:
+            # fleet tracing: handling span + flow-finish for a request
+            # stamped with a ParentSpan flow id (shared helper, also
+            # used by the /livestream open)
+            from ..telemetry.tracefleet import record_handle_span
+            record_handle_span(state.manager, route, params, t0_ns)
+
         def _do_get_locked(self, route, params):
             self._touch_lease_for(route, params)
+            t0_ns = time.perf_counter_ns()
+            recorded_early = False
+            if route == proto.PATH_BENCH_RESULT:
+                # record the handling span BEFORE bench_result snapshots
+                # and ships the span ring, or the /benchresult
+                # flow-finish would land strictly after the shipped
+                # snapshot and the master's rpc:/benchresult arrow would
+                # dangle in every merged fleet trace (the span is a
+                # handling-start marker, not a duration)
+                self._record_handle_span(route, params, t0_ns)
+                recorded_early = True
             try:
                 if route == proto.PATH_INFO:
                     self._reply(200, {
@@ -463,13 +584,34 @@ def _make_handler(state: ServiceState, server_holder: dict):
                     self._reply(200, HTTP_PROTOCOL_VERSION,
                                 content_type="text/plain")
                 elif route == proto.PATH_STATUS:
-                    self._reply(200, state.status())
+                    from ..telemetry.tracefleet import svc_wall_clock_usec
+                    stats = state.status()
+                    # clock stamp for the master's skew estimator — at
+                    # the handler layer, NOT in status(): stream frames
+                    # reuse status() and must not carry (or worse,
+                    # subtree-sum) a per-tick clock value
+                    stats[proto.KEY_SVC_CLOCK] = svc_wall_clock_usec(
+                        state.base_cfg.service_port)
+                    self._reply(200, stats)
                 elif route == proto.PATH_METRICS:
                     from ..telemetry.registry import PROMETHEUS_CONTENT_TYPE
                     self._reply(200, state.metrics(),
                                 content_type=PROMETHEUS_CONTENT_TYPE)
                 elif route == proto.PATH_BENCH_RESULT:
-                    self._reply(200, state.bench_result())
+                    result = state.bench_result(params)
+                    ring_json = result.pop(
+                        ServiceState.TRACE_RING_JSON_KEY, None)
+                    if ring_json is None:
+                        self._reply(200, result)
+                    else:
+                        # splice the pre-serialized ring in, so the
+                        # multi-MiB span payload is never dumps'd twice
+                        body = json.dumps(result)
+                        body = (body[:-1] + "," if body != "{}"
+                                else "{") \
+                            + f'"{proto.KEY_TRACE_RING}":' \
+                            + ring_json + "}"
+                        self._reply(200, body)
                 elif route == proto.PATH_START_PHASE:
                     code, msg = state.start_phase(
                         int(params.get(proto.KEY_PHASE_CODE, 0)),
@@ -483,6 +625,9 @@ def _make_handler(state: ServiceState, server_holder: dict):
                     forward_interrupt(state, params)
                     # a deliberate interrupt is the master LETTING GO —
                     # never an expiry, so disarm before the workers stop
+                    # (and it proves the master processed the last
+                    # /benchresult, ring included)
+                    state.note_master_contact()
                     state.release_lease()
                     state.interrupt()
                     quit_requested = proto.KEY_INTERRUPT_QUIT in params
@@ -496,6 +641,8 @@ def _make_handler(state: ServiceState, server_holder: dict):
             except Exception as err:  # noqa: BLE001 - reply errors over HTTP
                 logger.log_error(f"service request failed: {err}")
                 self._reply(500, {"Error": str(err)})
+            if not recorded_early:
+                self._record_handle_span(route, params, t0_ns)
 
         # -- POST endpoints --------------------------------------------------
 
@@ -511,6 +658,7 @@ def _make_handler(state: ServiceState, server_holder: dict):
 
         def _do_post_locked(self, route, params, body):
             self._touch_lease_for(route, params)
+            t0_ns = time.perf_counter_ns()
             try:
                 if route == proto.PATH_PREPARE_PHASE:
                     reply = state.prepare_phase(json.loads(body))
@@ -534,6 +682,7 @@ def _make_handler(state: ServiceState, server_holder: dict):
                 self._reply(500, {
                     "Error": str(err),
                     proto.KEY_ERROR_HISTORY: logger.get_error_history()})
+            self._record_handle_span(route, params, t0_ns)
 
     return Handler
 

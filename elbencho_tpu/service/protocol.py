@@ -59,6 +59,24 @@ KEY_STREAM_INTERVAL_MS = "IntervalMs"
 KEY_STREAM_FANOUT = "Fanout"
 KEY_STREAM_SUBTREE = "Subtree"
 KEY_STREAM_RESYNC = "Resync"
+# fleet tracing (ours; docs/telemetry.md "Fleet tracing"): the master
+# stamps the run's trace id + a per-request parent span (flow) id onto
+# /preparephase, /startphase, /benchresult and the /livestream open so
+# services can tag their handling spans and emit the matching Chrome
+# flow-finish events; ShipTrace on /benchresult asks the service to
+# attach its bounded span ring (size-capped by --traceshipcap — a
+# refusal is LOUD, never fatal); SvcClockUsec is the service wall-clock
+# stamp on /status + /benchresult replies (and the X-Svc-Clock-Usec
+# /livestream response header) feeding the master's NTP-style
+# clock-offset estimator — always present, so arming fleet tracing
+# never changes per-tick wire traffic
+KEY_TRACE_ID = "TraceId"
+KEY_PARENT_SPAN = "ParentSpan"
+KEY_SHIP_TRACE = "ShipTrace"
+KEY_SVC_CLOCK = "SvcClockUsec"
+KEY_TRACE_RING = "TraceRing"
+KEY_TRACE_RING_REFUSED = "TraceRingRefused"
+HDR_SVC_CLOCK = "X-Svc-Clock-Usec"
 
 
 def make_pw_hash(secret: str) -> str:

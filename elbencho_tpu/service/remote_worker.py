@@ -51,6 +51,10 @@ INTERRUPT_TIMEOUT_SECS = 3
 # adaptive /status cadence: start fast for short phases, back off to the
 # configured --svcupint (reference: 25ms -> 500ms, RemoteWorker.cpp:447+)
 POLL_MIN_SECS = 0.025
+# done-observation granularity of the streaming plane: completion pushes
+# ride the change-detection tick (stream.TICK_SECS, 25ms) plus frame
+# transit — two ticks bounds it honestly
+STREAM_DONE_OBS_QUANTUM_USEC = 50_000
 
 
 def split_host_port(host: str, default_port: int = DEFAULT_PORT
@@ -215,12 +219,15 @@ class ServiceClient:
 
     def open_stream(self, bench_id: str, interval_ms: int, fanout: int = 0,
                     subtree: "list[str] | tuple" = (),
-                    read_timeout: float = 10.0, resync: bool = False):
+                    read_timeout: float = 10.0, resync: bool = False,
+                    trace_params: "dict | None" = None):
         """Open the /livestream server-push connection (--svcstream);
         returns a stream.StreamHandle whose rtt_usec is the open round
-        trip (the streaming --svcping source). The stream rides its OWN
-        connection — a chunked response would monopolize the request
-        one."""
+        trip (the streaming --svcping source) and whose clock_* fields
+        carry the fleet-tracing skew sample (the open ping bracketed in
+        local wall clock + the service's X-Svc-Clock-Usec stamp). The
+        stream rides its OWN connection — a chunked response would
+        monopolize the request one."""
         from .stream import StreamHandle
         params = {proto.KEY_STREAM_INTERVAL_MS: int(interval_ms)}
         if bench_id:
@@ -231,10 +238,13 @@ class ServiceClient:
             params[proto.KEY_STREAM_SUBTREE] = ",".join(subtree)
         if resync:
             params[proto.KEY_STREAM_RESYNC] = 1
+        if trace_params:
+            params.update(trace_params)
         if self.pw_hash:
             params[proto.KEY_AUTHORIZATION] = self.pw_hash
         path = proto.PATH_LIVE_STREAM + "?" + urllib.parse.urlencode(params)
         t0 = time.monotonic()
+        t0_wall = time.time_ns() // 1000
         conn = self._connect(CONNECT_TIMEOUT_SECS)
         try:
             conn.request("GET", path)
@@ -246,6 +256,7 @@ class ServiceClient:
                 f"live stream open on {self._host_label()} failed: "
                 f"{type(err).__name__}: {err}") from err
         rtt_usec = int((time.monotonic() - t0) * 1e6)
+        t1_wall = time.time_ns() // 1000
         self.total_requests += 1
         if resp.status != 200:
             try:
@@ -257,10 +268,16 @@ class ServiceClient:
             raise WorkerRemoteException(
                 f"live stream open on {self._host_label()} failed "
                 f"(HTTP {resp.status}): {detail}")
+        try:
+            svc_clock = int(resp.getheader(proto.HDR_SVC_CLOCK, "") or 0)
+        except ValueError:
+            svc_clock = 0
         if conn.sock is not None:
             conn.sock.settimeout(read_timeout)
         return StreamHandle(conn, resp, rtt_usec, self._host_label(),
-                            on_close=self._conn_closed)
+                            on_close=self._conn_closed,
+                            clock_t0_usec=t0_wall, clock_t1_usec=t1_wall,
+                            svc_clock_usec=svc_clock)
 
     # -- retrying core ------------------------------------------------------
 
@@ -402,6 +419,25 @@ class RemoteWorker(Worker):
         self.svc_delta_saved_bytes = 0
         self.svc_agg_depth_hwm = 0
         self.svc_conn_hwm = 0
+        # fleet straggler attribution (CONTROL_AUDIT_COUNTERS schema):
+        # computed by Statistics after the phase barrier from each
+        # host's phase_done_monotonic finish stamp
+        self.straggler_skew_usec = 0
+        self.barrier_wait_usec = 0
+        self.phase_done_monotonic = 0.0
+        # how coarse the done observation was (usec): poll mode = the
+        # poll interval at detection time (ramped, up to --svcupint),
+        # stream mode = the push-on-change tick — the doctor scales its
+        # straggler-bound floor by it so sampling noise can't fabricate
+        # a verdict
+        self.done_obs_quantum_usec = 0
+        # fleet tracing: per-host clock-offset estimator fed by the
+        # exchanges this worker performs anyway (/status polls, the
+        # stream open, /benchresult)
+        from ..telemetry.tracefleet import (ClockSyncEstimator,
+                                            fleet_trace_enabled)
+        self.clock_sync = ClockSyncEstimator()
+        self._fleet_trace = fleet_trace_enabled(self.cfg)
         pw_hash = ""
         if self.cfg.svc_password_file:
             pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
@@ -448,6 +484,10 @@ class RemoteWorker(Worker):
         self.svc_delta_saved_bytes = 0
         self.svc_agg_depth_hwm = 0
         self.svc_conn_hwm = 0
+        self.straggler_skew_usec = 0
+        self.barrier_wait_usec = 0
+        self.phase_done_monotonic = 0.0
+        self.done_obs_quantum_usec = 0
         if self.degraded:
             # a lost host stays excluded from all later phase results
             self.got_phase_work = False
@@ -483,6 +523,11 @@ class RemoteWorker(Worker):
             try:
                 self._start_remote_phase(phase, last_uuid)
                 self._live_until_done(phase)
+                # straggler attribution: stamp when the live wait SAW
+                # this host's workers done — before the /benchresult
+                # fetch, whose duration (and, with fleet tracing, whose
+                # shipped span ring) must not fabricate skew
+                self.phase_done_monotonic = time.monotonic()
                 self._finish_phase_remote()
                 self._sync_control_counters()
                 self.shared.inc_num_workers_done()
@@ -511,6 +556,114 @@ class RemoteWorker(Worker):
                 self.shared.inc_num_workers_done_with_error(err)
 
     # ------------------------------------------------------------------
+
+    # -- fleet tracing: span-context propagation + clock-skew sampling ------
+
+    def _trace_params(self) -> "tuple[dict | None, int]":
+        """(extra request params, flow id) for one traced control-plane
+        request: a fleet-unique flow id as ParentSpan plus the run's
+        trace id. (None, 0) when fleet tracing is off — the wire stays
+        byte-identical then."""
+        tracer = self.shared.tracer
+        if tracer is None or not self._fleet_trace:
+            return None, 0
+        from ..telemetry.tracer import next_flow_id
+        flow_id = next_flow_id()
+        params = {proto.KEY_PARENT_SPAN: flow_id}
+        trace_id = tracer.extra_other_data.get("traceId", "")
+        if trace_id:
+            params[proto.KEY_TRACE_ID] = trace_id
+        return params, flow_id
+
+    def _record_rpc_span(self, path: str, flow_id: int, t0_ns: int) -> None:
+        """Master half of an RPC edge: the rpc:<path> span (tid = this
+        host's index, so each host's control traffic gets its own lane)
+        plus the Chrome flow-start event the service's handling span
+        finishes."""
+        tracer = self.shared.tracer
+        if tracer is None or not flow_id:
+            return
+        dur = max((tracer.now_ns() - t0_ns) // 1000, 1)
+        tracer.record_rpc(f"rpc:{path}", t0_ns, dur, rank=self.host_idx,
+                          flow_id=flow_id, side="out")
+
+    def _feed_clock_sample(self, t0_wall_usec: int, reply: dict) -> None:
+        """NTP-style offset sample from any reply carrying the service's
+        SvcClockUsec stamp, bracketed by local wall-clock reads. Always
+        fed when the key is present (the stamp is always on the wire) so
+        the estimate is warm before anything needs it."""
+        peer = reply.get(proto.KEY_SVC_CLOCK, 0) if isinstance(
+            reply, dict) else 0
+        if peer:
+            self.clock_sync.add_sample(t0_wall_usec,
+                                       time.time_ns() // 1000, peer)
+
+    def _host_clock_estimate(self) -> "tuple[int, int, bool]":
+        """(offset_usec, uncertainty_usec, known) of this host's clock
+        relative to the master. Two candidate estimates — the direct
+        estimator (for a fanout non-root host its only direct samples
+        are /benchresult exchanges, whose RTT the shipped span ring
+        inflates) and the aggregation-tree chain (master->root measured
+        here, root->host carried in stream frames, built from tight
+        stream-open pings) — and the one with the SMALLER uncertainty
+        wins: uncertainty ~ rtt/2, so a ring-inflated sample can never
+        displace a tight chained one."""
+        best: "tuple[int, int] | None" = None
+        if self.clock_sync.has_estimate:
+            best = (self.clock_sync.offset_usec,
+                    self.clock_sync.uncertainty_usec)
+        sc = getattr(self.shared, "stream_control", None)
+        if sc is not None:
+            st = sc.states.get(self.host)
+            root_worker = sc.workers_by_host.get(
+                sc.root_of.get(self.host, self.host))
+            if st is not None and st.has_clock \
+                    and root_worker is not None \
+                    and root_worker.clock_sync.has_estimate:
+                from ..telemetry.tracefleet import chain_offsets
+                chained = chain_offsets(
+                    root_worker.clock_sync.offset_usec,
+                    root_worker.clock_sync.uncertainty_usec,
+                    st.clock_off, st.clock_unc)
+                if best is None or chained[1] < best[1]:
+                    best = chained
+        if best is None:
+            return 0, 0, False
+        return best[0], best[1], True
+
+    def _collect_trace_ring(self, result: dict) -> None:
+        """Fleet tracing: persist the span ring a /benchresult reply
+        shipped as this host's per-host trace file next to the master's
+        --tracefile, stamped with the estimated clock offset. A refusal
+        (ring over --traceshipcap) and a write failure are LOUD, never
+        fatal."""
+        refused = result.get(proto.KEY_TRACE_RING_REFUSED)
+        if refused:
+            logger.log_error(
+                f"fleet trace: {self.host} refused to ship its span "
+                f"ring ({refused.get('Events', 0)} events, "
+                f"{refused.get('Bytes', 0)} bytes > --traceshipcap "
+                f"{refused.get('CapMiB', 0)} MiB) — its lane will be "
+                f"missing from the merged fleet trace")
+            return
+        ring = result.get(proto.KEY_TRACE_RING)
+        if not isinstance(ring, dict):
+            return
+        from ..telemetry.tracefleet import write_collected_ring
+        tracer = self.shared.tracer
+        trace_id = tracer.extra_other_data.get("traceId", "") \
+            if tracer is not None else ""
+        off, unc, _known = self._host_clock_estimate()
+        rank_offset = ring.get("otherData", {}).get(
+            "rankOffset",
+            self.cfg.rank_offset + self.host_idx * self.cfg.num_threads)
+        try:
+            write_collected_ring(self.cfg.trace_file_path, rank_offset,
+                                 ring, self.host, off, unc, trace_id)
+        except OSError as err:
+            logger.log_error(
+                f"fleet trace: cannot write collected trace for "
+                f"{self.host}: {err}")
 
     def _check_protocol_version(self) -> None:
         status, data = self.client.get_raw(proto.PATH_PROTOCOL_VERSION)
@@ -542,9 +695,15 @@ class RemoteWorker(Worker):
         pool): retried on connect-level failures only."""
         cfg_dict = self.cfg.to_service_dict(
             service_rank_offset=self.host_idx * self.cfg.num_threads)
+        trace_params, flow_id = self._trace_params()
+        tracer = self.shared.tracer
+        t0_ns = tracer.now_ns() if tracer is not None else 0
         status, reply = self.client.post_json(proto.PATH_PREPARE_PHASE,
-                                              cfg_dict, timeout=300.0,
+                                              cfg_dict,
+                                              params=trace_params,
+                                              timeout=300.0,
                                               idempotent=False)
+        self._record_rpc_span(proto.PATH_PREPARE_PHASE, flow_id, t0_ns)
         self._replay_error_history(reply)
         if status != 200:
             raise WorkerRemoteException(
@@ -554,9 +713,16 @@ class RemoteWorker(Worker):
 
     def _start_remote_phase(self, phase: BenchPhase, bench_id: str) -> None:
         self._expected_bench_id = bench_id
-        status, reply = self.client.get_json(proto.PATH_START_PHASE, {
-            proto.KEY_PHASE_CODE: int(phase),
-            proto.KEY_BENCH_ID: bench_id}, idempotent=False)
+        params = {proto.KEY_PHASE_CODE: int(phase),
+                  proto.KEY_BENCH_ID: bench_id}
+        trace_params, flow_id = self._trace_params()
+        if trace_params:
+            params.update(trace_params)
+        tracer = self.shared.tracer
+        t0_ns = tracer.now_ns() if tracer is not None else 0
+        status, reply = self.client.get_json(proto.PATH_START_PHASE,
+                                             params, idempotent=False)
+        self._record_rpc_span(proto.PATH_START_PHASE, flow_id, t0_ns)
         if status != 200:
             raise WorkerRemoteException(
                 f"phase start on {self.host} failed: "
@@ -640,14 +806,25 @@ class RemoteWorker(Worker):
         stalled_secs = max(self.cfg.svc_stalled_secs, 0)
 
         def reopen(resync: bool):
+            trace_params, flow_id = self._trace_params()
+            tracer = self.shared.tracer
+            t0_ns = tracer.now_ns() if tracer is not None else 0
             try:
-                return self.client.open_stream(
+                handle = self.client.open_stream(
                     self._expected_bench_id, interval_ms,
                     fanout=sc.fanout, subtree=subtree,
-                    read_timeout=read_timeout, resync=resync)
+                    read_timeout=read_timeout, resync=resync,
+                    trace_params=trace_params)
             except (WorkerRemoteException, *TRANSIENT_EXCEPTIONS) as err:
                 raise StreamDetachedError(
                     f"cannot open live stream: {err}") from err
+            self._record_rpc_span(proto.PATH_LIVE_STREAM, flow_id, t0_ns)
+            if handle.svc_clock_usec:
+                # the stream-open ping doubles as a clock-offset sample
+                self.clock_sync.add_sample(handle.clock_t0_usec,
+                                           handle.clock_t1_usec,
+                                           handle.svc_clock_usec)
+            return handle
 
         handle = None
         state: dict = {}
@@ -743,6 +920,8 @@ class RemoteWorker(Worker):
                     self._raise_host_failure("stalled", stalled_secs)
                 if sc.subtree_satisfied(self.host,
                                         self.num_remote_threads):
+                    self.done_obs_quantum_usec = \
+                        STREAM_DONE_OBS_QUANTUM_USEC
                     normal_exit = True
                     return
         finally:
@@ -769,6 +948,8 @@ class RemoteWorker(Worker):
                 elif st.err:
                     action = "err"
                 elif st.done >= self.num_remote_threads:
+                    self.done_obs_quantum_usec = \
+                        STREAM_DONE_OBS_QUANTUM_USEC
                     return
                 elif st.unreachable or not st.attached \
                         or sc.root_worker_lost(self.host):
@@ -814,6 +995,7 @@ class RemoteWorker(Worker):
             deadline = (last_success + stalled_secs) if stalled_secs \
                 else None
             t0 = time.monotonic()
+            t0_wall = time.time_ns() // 1000
             try:
                 # the bench UUID marks this poll as the owning master's
                 # heartbeat: the service's --svcleasesecs lease renews on
@@ -837,6 +1019,9 @@ class RemoteWorker(Worker):
             # --svcping: the /status round-trip IS the service ping
             # (reference fullscreen shows per-service latency, --svcping)
             self.last_ping_usec = int((now - t0) * 1e6)
+            # fleet tracing: the same round trip is a clock-offset
+            # sample (lease-renewal piggyback — zero extra requests)
+            self._feed_clock_sample(t0_wall, stats)
             self.svc_conn_hwm = max(self.svc_conn_hwm,
                                     ServiceClient.open_connections)
             # heartbeat age: gap between successive successful polls
@@ -862,6 +1047,10 @@ class RemoteWorker(Worker):
                 self._raise_host_failure("err")
             done = stats.get(proto.KEY_NUM_WORKERS_DONE, 0)
             if done >= self.num_remote_threads:
+                # the done observation is quantized by the CURRENT poll
+                # interval (the host may have finished any time since
+                # the previous poll)
+                self.done_obs_quantum_usec = int(interval * 1e6)
                 return
             counters = (self.live_ops.num_entries_done,
                         self.live_ops.num_bytes_done,
@@ -979,9 +1168,19 @@ class RemoteWorker(Worker):
 
     def _finish_phase_remote(self) -> None:
         """GET /benchresult and ingest per-thread elapsed + histograms
-        (reference: finishPhase :172-280)."""
+        (reference: finishPhase :172-280). With fleet tracing armed the
+        same request also asks the service to ship its span ring
+        (ShipTrace) — collection piggybacks, zero extra requests."""
+        params, flow_id = self._trace_params()
+        if params is not None:
+            params[proto.KEY_SHIP_TRACE] = 1
+        tracer = self.shared.tracer
+        t0_ns = tracer.now_ns() if tracer is not None else 0
+        t0_wall = time.time_ns() // 1000
         status, result = self.client.get_json(proto.PATH_BENCH_RESULT,
-                                              timeout=60.0)
+                                              params=params, timeout=60.0)
+        self._record_rpc_span(proto.PATH_BENCH_RESULT, flow_id, t0_ns)
+        self._feed_clock_sample(t0_wall, result)
         if status != 200:
             raise WorkerRemoteException(
                 f"result fetch from {self.host} failed ({status})")
@@ -1035,6 +1234,8 @@ class RemoteWorker(Worker):
             int(chip): (v.get("Bytes", 0), v.get("USec", 0))
             for chip, v in result.get("TpuPerChip", {}).items()}
         self.got_phase_work = bool(self.elapsed_usec_vec)
+        if self._fleet_trace:
+            self._collect_trace_ring(result)
         if getattr(self.shared, "stream_control", None) is not None:
             self.client.drop_connection()  # back to the parked steady state
 

@@ -99,6 +99,14 @@ HOST_IOPS = "I"          # live iops done
 HOST_CPU = "C"           # CPU util percent
 HOST_RTT = "Rtt"         # stream-open round trip usec (measured upstream)
 HOST_HIJACKED = "Hij"    # bench UUID mismatch AFTER a first match
+# fleet tracing: per-host clock offset/uncertainty (usec) relative to
+# THIS frame's sender, estimated from the parent->child stream-open
+# ping and CHAINED down the aggregation tree (each node adds its own
+# measured child offset to the entries it forwards) — the master adds
+# its root measurement on top, giving master-relative offsets for every
+# host without one extra request (telemetry/tracefleet.py)
+HOST_CLOCK_OFF = "Co"
+HOST_CLOCK_UNC = "Cu"
 
 #: top-level keys excluded from the numeric subtree merge: identity and
 #: frame plumbing stay the aggregating node's own
@@ -273,7 +281,8 @@ class StreamHandle:
     the streaming replacement for the --svcping /status RTT."""
 
     def __init__(self, conn, resp, rtt_usec: int, label: str,
-                 on_close=None):
+                 on_close=None, clock_t0_usec: int = 0,
+                 clock_t1_usec: int = 0, svc_clock_usec: int = 0):
         self._conn = conn
         self._resp = resp
         self._on_close = on_close
@@ -281,6 +290,12 @@ class StreamHandle:
         self.label = label
         self.last_frame_bytes = 0
         self._closed = False
+        # fleet tracing: the open round trip bracketed in LOCAL wall
+        # clock + the peer's X-Svc-Clock-Usec stamp — one ready-made
+        # clock-offset sample (0s when the peer predates the header)
+        self.clock_t0_usec = clock_t0_usec
+        self.clock_t1_usec = clock_t1_usec
+        self.svc_clock_usec = svc_clock_usec
 
     def read_frame(self) -> dict:
         """Next frame dict. Raises OSError on EOF/timeout (the socket
@@ -339,6 +354,10 @@ class ChildAggregator:
         self.default_port = default_port
         self.rtt_usec = 0
         self.hijacked = False
+        # child clock offset relative to THIS node (fleet tracing),
+        # min-RTT filtered over the reconnect history
+        from ..telemetry.tracefleet import ClockSyncEstimator
+        self.clock = ClockSyncEstimator()
         self._matched = False
         self._state: "dict | None" = None
         self._lock = threading.Lock()
@@ -412,6 +431,12 @@ class ChildAggregator:
                     resync=True)
                 self._handle = handle
                 self.rtt_usec = handle.rtt_usec
+                if handle.svc_clock_usec:
+                    # the stream-open ping doubles as a clock-offset
+                    # sample (X-Svc-Clock-Usec response header)
+                    self.clock.add_sample(handle.clock_t0_usec,
+                                          handle.clock_t1_usec,
+                                          handle.svc_clock_usec)
                 backoff = self.RECONNECT_MIN_SECS
                 last_seq = 0
                 state: dict = {}
@@ -502,10 +527,20 @@ class StreamSession:
         subtree = [h for h in
                    (params.get(proto.KEY_STREAM_SUBTREE, "") or "")
                    .split(",") if h]
+        self.default_port = default_port
+        self.params = params
         self.aggs = [
             ChildAggregator(child, chunk, self.bench_id, self.interval_ms,
                             self.fanout, state.pw_hash, default_port)
             for child, chunk in plan_subtree(subtree, self.fanout)]
+
+    def _record_open_span(self) -> None:
+        """Fleet tracing: a /livestream open stamped with a ParentSpan
+        flow id gets its handling span + flow-finish like any request
+        route (the open is the stream plane's one RPC)."""
+        from ..telemetry.tracefleet import record_handle_span
+        record_handle_span(self.state.manager, proto.PATH_LIVE_STREAM,
+                           self.params, time.perf_counter_ns())
 
     def build_frame(self) -> dict:
         """Current merged state: own live stats + every reachable child's
@@ -528,14 +563,28 @@ class StreamSession:
                 continue
             depth = max(depth, 1 + snap.get(KEY_AGG_DEPTH, 1))
             merge_subtree_frame(merged, snap)
+            # fleet tracing: chain clock offsets down the tree — every
+            # entry below this child is (child-relative offset) + (our
+            # measured offset TO the child); uncertainty bounds add
+            child_off = agg.clock.offset_usec
+            child_unc = agg.clock.uncertainty_usec
+            has_clock = agg.clock.has_estimate
             for hlabel, entry in snap.get(KEY_HOSTS, {}).items():
                 if hlabel == SELF_LABEL:
                     entry = dict(entry)
                     entry[HOST_RTT] = agg.rtt_usec
                     if agg.hijacked:
                         entry[HOST_HIJACKED] = 1
+                    if has_clock:
+                        entry[HOST_CLOCK_OFF] = child_off
+                        entry[HOST_CLOCK_UNC] = child_unc
                     hosts[agg.label] = entry
                 else:
+                    if has_clock and HOST_CLOCK_OFF in entry:
+                        entry = dict(entry)
+                        entry[HOST_CLOCK_OFF] += child_off
+                        entry[HOST_CLOCK_UNC] = \
+                            entry.get(HOST_CLOCK_UNC, 0) + child_unc
                     hosts[hlabel] = entry
             unreach.extend(snap.get(KEY_UNREACH, []))
         merged[KEY_HOSTS] = hosts
@@ -560,12 +609,20 @@ class StreamSession:
         )
 
     def serve(self) -> None:
+        from ..telemetry.tracefleet import svc_wall_clock_usec
         h = self.handler
         h.send_response(200)
         h.send_header("Content-Type", NDJSON_CONTENT_TYPE)
         h.send_header("Transfer-Encoding", "chunked")
+        # clock stamp for the consumer's skew estimator: the stream-open
+        # round trip is a ready-made NTP-style sample (fleet tracing) —
+        # a header, not a frame key, so frames never carry (or subtree-
+        # sum) a per-tick clock value
+        h.send_header(proto.HDR_SVC_CLOCK,
+                      str(svc_wall_clock_usec(self.default_port)))
         h.end_headers()
         h.close_connection = True
+        self._record_open_span()
         try:
             h.connection.settimeout(SEND_TIMEOUT_SECS)
         except OSError:
@@ -714,7 +771,8 @@ class HostStreamState:
     host's RemoteWorker under StreamControl.cond."""
 
     __slots__ = ("done", "err", "entries", "bytes", "iops", "cpu", "rtt",
-                 "hijacked", "unreachable", "attached", "last_change")
+                 "hijacked", "unreachable", "attached", "last_change",
+                 "clock_off", "clock_unc", "has_clock")
 
     def __init__(self):
         self.reset(time.monotonic())
@@ -731,6 +789,12 @@ class HostStreamState:
         self.unreachable = False
         self.attached = True
         self.last_change = now
+        # fleet tracing: tree-chained clock offset of this host relative
+        # to its ROOT (the master adds its own root measurement on top);
+        # reset with the phase and repopulated by the next frame
+        self.clock_off = 0
+        self.clock_unc = 0
+        self.has_clock = False
 
 
 class StreamControl:
@@ -841,6 +905,14 @@ class StreamControl:
                 st.err = entry.get(HOST_ERR, 0)
                 st.cpu = entry.get(HOST_CPU, 0.0)
                 st.rtt = entry.get(HOST_RTT, st.rtt)
+                if HOST_CLOCK_OFF in entry:
+                    # tree-chained clock offset relative to the ROOT;
+                    # a root's own entry carries none (offset 0 to
+                    # itself) — has_clock then stays False and the
+                    # master's direct root estimate stands alone
+                    st.clock_off = entry[HOST_CLOCK_OFF]
+                    st.clock_unc = entry.get(HOST_CLOCK_UNC, 0)
+                    st.has_clock = True
                 if entry.get(HOST_HIJACKED):
                     st.hijacked = True
                 worker = self.workers_by_host.get(label)

@@ -452,6 +452,14 @@ class Statistics:
                     b, u = res.tpu_per_chip.get(chip, (0, 0))
                     res.tpu_per_chip[chip] = (b + b2, u + u2)
         res.tpu_path_counters = sum_path_audit_counters(workers)
+        # fleet straggler attribution (fleet tracing / run doctor): the
+        # per-host finish spread behind the phase barrier, computed here
+        # — after the barrier, before the control-counter merge — so
+        # StragglerSkewUsec (MAX merge = the straggler's lag behind the
+        # FIRST finisher) and BarrierWaitUSec (sum = worker-time the
+        # fleet idled waiting for the LAST finisher) ride the existing
+        # CONTROL_AUDIT_COUNTERS plumbing into JSON//metrics/flightrec
+        self._compute_barrier_skew()
         # per-host CPU util (last /status ingest of each RemoteWorker)
         res.host_cpu_util = {
             w.host: round(getattr(w, "cpu_util_pct", 0.0), 1)
@@ -472,6 +480,51 @@ class Statistics:
         res.stonewall_rwmix = stonewall_rwmix
         res.final_rwmix = final_rwmix
         return res
+
+    def _compute_barrier_skew(self) -> None:
+        """Per-host barrier decomposition from the finish stamps each
+        RemoteWorker takes when its host's /benchresult lands: skew =
+        lag behind the first host to finish, barrier wait = idle wait
+        for the last. Meaningful only with >= 2 finishing hosts; local
+        runs and single-host fleets keep both counters at zero."""
+        finishes = [(w, w.phase_done_monotonic)
+                    for w in self.manager.workers
+                    if getattr(w, "host", None) is not None
+                    and getattr(w, "phase_done_monotonic", 0.0)]
+        if len(finishes) < 2:
+            return
+        first = min(t for _w, t in finishes)
+        last = max(t for _w, t in finishes)
+        for w, t in finishes:
+            w.straggler_skew_usec = int((t - first) * 1e6)
+            w.barrier_wait_usec = int((last - t) * 1e6)
+
+    def per_host_barrier_stats(self) -> "dict[str, dict]":
+        """{host: {...}} snapshot of the barrier decomposition plus each
+        host's clock-offset estimate — the flight recorder stores it in
+        phase_end rows and the doctor names the straggler from it."""
+        out: "dict[str, dict]" = {}
+        for w in self.manager.workers:
+            host = getattr(w, "host", None)
+            if host is None:
+                continue
+            entry = {
+                "StragglerSkewUsec": getattr(w, "straggler_skew_usec", 0),
+                "BarrierWaitUSec": getattr(w, "barrier_wait_usec", 0),
+                # how coarse the master's done observation was for this
+                # host (poll-interval / stream-tick quantization) — the
+                # doctor's straggler floor scales with it so sampling
+                # noise can't fabricate a verdict
+                "ObsQuantumUsec": getattr(w, "done_obs_quantum_usec", 0),
+            }
+            estimate = getattr(w, "_host_clock_estimate", None)
+            if estimate is not None:
+                off, unc, known = estimate()
+                if known:
+                    entry["ClockOffsetUsec"] = off
+                    entry["ClockUncUsec"] = unc
+            out[host] = entry
+        return out
 
     # -- rendering ----------------------------------------------------------
 

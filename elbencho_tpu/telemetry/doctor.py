@@ -64,6 +64,24 @@ STALL_RATIO_BOUND = 0.5
 #: minimum worker-time share for a stage to be named the bottleneck
 DOMINANT_SHARE_PCT = 15.0
 
+#: barrier-wait share of fleet worker-time at/above which a multi-host
+#: phase is declared straggler-bound: the fleet spent this fraction of
+#: its worker-seconds idle at the phase barrier waiting for the slowest
+#: host — no per-stage tuning helps until the straggler is fixed
+BARRIER_SHARE_PCT = 15.0
+
+#: absolute straggler-skew floor for the straggler-bound verdict: on a
+#: degenerate sub-second phase, scheduler jitter alone gives one host a
+#: large RELATIVE share of a tiny wall — a real straggler lags by real
+#: time, not only by percentage (the Straggler evidence block is
+#: attached either way). The EFFECTIVE floor additionally scales with
+#: the master's done-observation quantum (poll mode detects completion
+#: only on a poll tick, up to --svcupint late, independently per host —
+#: two hosts finishing together can look ~a poll interval apart), so a
+#: verdict is never built on sampling noise.
+STRAGGLER_MIN_SKEW_USEC = 50_000
+OBS_QUANTUM_FLOOR_FACTOR = 2
+
 
 def _overlap_eff(a_usec: float, b_usec: float, wall_usec: float
                  ) -> "float | None":
@@ -102,14 +120,53 @@ def rising_after(series, key: str) -> "float | None":
     return None
 
 
+def _straggler_block(host_info: "dict | None", totals: dict,
+                     wall: int, worker_usec: int) -> "dict | None":
+    """Per-host straggler attribution (fleet tracing / barrier skew):
+    names the host that lagged the fleet, its finish skew, the fleet's
+    barrier-wait share, and — when the flight recorder counted them —
+    the fraction of ticks it trailed in. None for local runs and
+    single-host fleets (no barrier to decompose)."""
+    if not host_info or len(host_info) < 2:
+        return None
+    skews = {h: int(e.get("StragglerSkewUsec", 0))
+             for h, e in host_info.items()}
+    if not any(skews.values()):
+        return None
+    straggler = max(skews, key=lambda h: (skews[h], h))
+    barrier_usec = int(totals.get(
+        "BarrierWaitUSec",
+        sum(int(e.get("BarrierWaitUSec", 0))
+            for e in host_info.values())))
+    obs_quantum = max((int(e.get("ObsQuantumUsec", 0))
+                       for e in host_info.values()), default=0)
+    return {
+        "Host": straggler,
+        "SkewUSec": skews[straggler],
+        "SkewFloorUsec": max(STRAGGLER_MIN_SKEW_USEC,
+                             OBS_QUANTUM_FLOOR_FACTOR * obs_quantum),
+        "SkewPctOfWall": round(100.0 * skews[straggler] / wall, 1)
+        if wall else 0.0,
+        "LastTickPct": host_info[straggler].get("LastTickPct", 0.0),
+        "BarrierWaitUSec": barrier_usec,
+        "BarrierWaitPct": round(100.0 * barrier_usec / worker_usec, 1)
+        if worker_usec else 0.0,
+        "PerHost": host_info,
+    }
+
+
 def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
-                  num_workers: int, series=None) -> dict:
+                  num_workers: int, series=None,
+                  host_info: "dict | None" = None) -> dict:
     """One phase's stage decomposition + bottleneck verdict.
 
     ``totals`` is the fleet-merged cumulative counter state at phase end
     (flightrec wire keys: IoBusyUSec/TpuHbmDispatchUSec/TpuHbmUSec/...);
     ``series`` is the phase's fleet delta series [(t_rel, deltas)] for
-    trend evidence, optional."""
+    trend evidence, optional; ``host_info`` is the per-host barrier
+    decomposition ({host: {StragglerSkewUsec, BarrierWaitUSec,
+    LastTickPct, ClockOffsetUsec, ...}}) for straggler attribution,
+    optional."""
     workers = max(num_workers, 1)
     wall = max(int(elapsed_usec), 0)
     worker_usec = wall * workers
@@ -138,10 +195,33 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
                                     wall),
     }
 
+    straggler = _straggler_block(host_info, totals, wall, worker_usec)
+
     # -- verdict -------------------------------------------------------------
     verdict = "inconclusive"
     bottleneck = ""
-    if stalls and stall_ratio >= STALL_RATIO_BOUND:
+    if straggler is not None \
+            and straggler["BarrierWaitPct"] >= BARRIER_SHARE_PCT \
+            and straggler["SkewUSec"] >= straggler["SkewFloorUsec"]:
+        # the fleet idled at the phase barrier for a dominant share of
+        # its worker-time: the slowest HOST bounds the phase, and no
+        # per-stage knob helps until that host is fixed/replaced —
+        # checked before the stage decomposition because the stage sums
+        # describe the busy hosts, not the wait they caused
+        verdict = "straggler-bound"
+        bottleneck = "barrier"
+        ev = (f"host {straggler['Host']} finished "
+              f"{straggler['SkewUSec'] / 1e6:.2f}s after the first host "
+              f"({straggler['SkewPctOfWall']:g}% of the phase wall)")
+        if straggler["LastTickPct"]:
+            ev += (f"; last in {straggler['LastTickPct']:g}% of "
+                   f"recorded ticks")
+        evidence.append(ev)
+        evidence.append(f"barrier wait = "
+                        f"{straggler['BarrierWaitPct']:g}% of fleet "
+                        f"worker time ({straggler['BarrierWaitUSec']} "
+                        f"us summed over hosts)")
+    elif stalls and stall_ratio >= STALL_RATIO_BOUND:
         # the producer kept hitting a full transfer ring: the in-flight
         # window bounds the phase, not any single stage's raw speed
         verdict = "stall-bound"
@@ -191,6 +271,15 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
         evidence.append(f"pipe_full_stalls {stalls} "
                         f"(~{stall_ratio:.2f}/op, below the "
                         f"{STALL_RATIO_BOUND:g} stall-bound threshold)")
+    if verdict != "straggler-bound" and straggler is not None:
+        evidence.append(
+            f"straggler: host {straggler['Host']} last by "
+            f"{straggler['SkewUSec'] / 1e6:.2f}s; barrier wait "
+            f"{straggler['BarrierWaitPct']:g}% of worker time (below "
+            f"the straggler-bound gate: >= {BARRIER_SHARE_PCT:g}% "
+            f"barrier share AND >= "
+            f"{straggler['SkewFloorUsec'] / 1e6:g}s skew — floor "
+            f"covers the done-observation quantum)")
     if int(totals.get("IoRetries", 0)):
         evidence.append(f"storage retries: {totals.get('IoRetries', 0)} "
                         f"({stage_usec['io_retry']} us backoff)")
@@ -220,6 +309,9 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
             "SvcCtlBytes": int(totals.get("SvcCtlBytes", 0)),
             "SvcStreamFrames": int(totals.get("SvcStreamFrames", 0)),
         },
+        # fleet straggler attribution (null for local / single-host
+        # phases): appended key, never reordered
+        "Straggler": straggler,
     }
 
 
@@ -237,7 +329,8 @@ def analyze_recording(rec: dict) -> "list[dict]":
         series = [(round(t - t0, 3), d) for t, d in series]
         out.append(analyze_phase(phase["name"], end.get("Totals", {}),
                                  end.get("ElapsedUSec", 0),
-                                 end.get("Workers", 0), series=series))
+                                 end.get("Workers", 0), series=series,
+                                 host_info=end.get("Hosts")))
     return out
 
 
@@ -297,6 +390,16 @@ def diff_recordings(rec_a: dict, rec_b: dict) -> "list[dict]":
                     causes.append(f"{name} share grew "
                                   f"{ana_a['StagePct'][name]:g}% -> "
                                   f"{ana_b['StagePct'][name]:g}%")
+            straggler_a = ana_a.get("Straggler") or {}
+            straggler_b = ana_b.get("Straggler") or {}
+            barrier_grew = (straggler_b.get("BarrierWaitPct", 0.0)
+                            - straggler_a.get("BarrierWaitPct", 0.0))
+            if straggler_b and barrier_grew >= REGRESSION_SHARE_PTS:
+                causes.append(
+                    f"barrier wait grew "
+                    f"{straggler_a.get('BarrierWaitPct', 0.0):g}% -> "
+                    f"{straggler_b.get('BarrierWaitPct', 0.0):g}% of "
+                    f"worker time (straggler: {straggler_b['Host']})")
             if ana_b["Verdict"] != ana_a["Verdict"]:
                 causes.append(f"verdict changed {ana_a['Verdict']} -> "
                               f"{ana_b['Verdict']}")

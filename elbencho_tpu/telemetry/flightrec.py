@@ -210,6 +210,10 @@ class FlightRecorder:
         self._phase: "str | None" = None
         self._phase_t0 = self._t0
         self._series: "list[tuple[float, dict]]" = []
+        # straggler attribution: per tick, which host trails the fleet
+        # (lowest cumulative bytes) — "host X last in N% of ticks"
+        self._host_last_ticks: "dict[str, int]" = {}
+        self._progress_ticks = 0
         schema = counter_schema()
         self._append({
             "Type": "header", "Schema": SCHEMA_VERSION,
@@ -274,6 +278,8 @@ class FlightRecorder:
         self._phase_t0 = time.monotonic()
         self._prev = {}
         self._series = []
+        self._host_last_ticks = {}
+        self._progress_ticks = 0
         self._append({"Type": "phase_start", "Phase": phase_label,
                       "T": self._now()})
         self.flush()
@@ -284,10 +290,20 @@ class FlightRecorder:
         t = self._now()
         fleet = snapshot_fleet(statistics)
         self._record_entity(FLEET, fleet, t)
+        host_bytes: "dict[str, int]" = {}
         for w in statistics.manager.workers:
             host = getattr(w, "host", None)
             if host is not None:
-                self._record_entity(host, snapshot_host(w), t)
+                snap = snapshot_host(w)
+                host_bytes[host] = snap.get("Bytes", 0)
+                self._record_entity(host, snap, t)
+        if len(host_bytes) > 1 and any(host_bytes.values()):
+            # straggler evidence: the host trailing the fleet this tick
+            # (ties break deterministically by label)
+            laggard = min(host_bytes, key=lambda h: (host_bytes[h], h))
+            self._host_last_ticks[laggard] = \
+                self._host_last_ticks.get(laggard, 0) + 1
+            self._progress_ticks += 1
         self.flush()
 
     def _record_entity(self, entity: str, snap: dict, t: float) -> None:
@@ -330,21 +346,41 @@ class FlightRecorder:
             return None
         self.sample(statistics)
         totals = dict(self._prev.get(FLEET, {}))
+        host_info = self._host_info(statistics)
         from .doctor import analyze_phase
         analysis = analyze_phase(res.phase_name, totals,
                                  res.last_done_usec, res.num_workers,
-                                 series=self._series)
-        self._append({
+                                 series=self._series, host_info=host_info)
+        rec = {
             "Type": "phase_end", "Phase": self._phase, "T": self._now(),
             "ElapsedUSec": res.last_done_usec,
             "Workers": res.num_workers,
             "Totals": totals,
             "Analysis": analysis,
             "RowsDropped": self.rows_dropped,
-        })
+        }
+        if host_info:
+            # per-host barrier decomposition + clock estimates, so the
+            # doctor CLI can recompute straggler verdicts (and the skew
+            # report survives) from the recording alone
+            rec["Hosts"] = host_info
+        self._append(rec)
         self._phase = None
         self.flush(force=True)
         return analysis
+
+    def _host_info(self, statistics) -> "dict[str, dict]":
+        """Per-host straggler/clock view for the doctor: the barrier
+        decomposition Statistics computed after the phase barrier plus
+        this recording's last-in-tick counts."""
+        stats_fn = getattr(statistics, "per_host_barrier_stats", None)
+        host_info = dict(stats_fn()) if stats_fn is not None else {}
+        if self._progress_ticks:
+            for host, count in self._host_last_ticks.items():
+                entry = host_info.setdefault(host, {})
+                entry["LastTickPct"] = round(
+                    100.0 * count / self._progress_ticks, 1)
+        return host_info
 
     def close(self) -> None:
         if self._fh is None:

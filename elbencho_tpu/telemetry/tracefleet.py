@@ -1,0 +1,408 @@
+"""Fleet-wide distributed tracing: clock alignment, collection, merge.
+
+Per-host tracing (tracer.py) leaves one span ring per process: the master
+writes ``--tracefile PATH``, every service writes ``PATH.r<rankoffset>``
+on ITS host, each with its own clock — islands. This module turns a
+master-mode run into ONE clock-aligned, causally-linked Chrome/Perfetto
+trace (docs/telemetry.md "Fleet tracing"):
+
+- **Span-context propagation.** The master mints a run ``trace_id`` and a
+  per-request flow id, stamps them onto ``/preparephase``/``/startphase``/
+  ``/benchresult`` (query params) and the ``/livestream`` open; the master
+  records an ``rpc:<path>`` span with a Chrome flow-start event, the
+  service a ``handle:<path>`` span with the matching flow-finish — so the
+  merged trace renders master->service request edges as arrows.
+
+- **Clock-skew estimation** (``ClockSyncEstimator``). NTP-style
+  RTT-midpoint sampling piggybacked on exchanges the master performs
+  ANYWAY (/status lease-renewal polls, the stream-open ping, the
+  /benchresult fetch): the service stamps its wall clock onto the reply,
+  the master brackets the exchange with its own wall clock, and
+  ``offset = peer_clock - (t0+t1)/2`` with uncertainty ``rtt/2``. Samples
+  are min-RTT filtered — congested exchanges only widen the bound, they
+  never displace a tighter sample. Interior aggregation-tree nodes
+  estimate their children the same way and the offsets CHAIN down the
+  tree (stream frame ``Co``/``Cu`` host-entry keys).
+
+- **Collection + merge.** At ``/benchresult`` the master asks each
+  service to ship its bounded span ring (size-capped by
+  ``--traceshipcap``; a refusal is LOUD, never fatal) and writes it next
+  to its own trace as ``PATH.fleet.r<rankoffset>`` — distinct from the
+  service-local ``PATH.r<rankoffset>`` name, so a shared-filesystem
+  service rewrite can't clobber it — with the estimated clock offset
+  recorded in ``otherData``. ``merge_fleet_trace`` folds the
+  per-host files into one trace: per-host process lanes, per-host
+  offsets applied to every timestamp, flows stitched, and a skew report
+  in ``otherData`` (also via ``tools/elbencho-tpu-trace`` and
+  ``elbencho-tpu-chart --fleet-trace``).
+
+Invariants: everything is off unless the master armed ``--tracefile`` in
+master mode (``--tracefleet auto``); no extra per-tick service requests
+— sampling and collection ride existing exchanges only.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+#: bound on retained min-RTT samples per peer (plenty for a verdict; the
+#: estimator is fed once per poll tick / stream open / benchresult)
+SAMPLE_CAP = 16
+
+#: clock uncertainty can never honestly be below 1us (timestamp quantum)
+MIN_UNCERTAINTY_USEC = 1
+
+#: test-only per-port clock skew injected into svc_wall_clock_usec —
+#: in-process fleets share one physical clock, so skew-path tests seed
+#: this (gated on ELBENCHO_TPU_TESTING) to make offsets observable
+TEST_SKEW_BY_PORT: "dict[int, int]" = {}
+
+
+def svc_wall_clock_usec(port: int = 0) -> int:
+    """The service-side clock stamp shipped on /status, /benchresult and
+    the /livestream open (wire key ``SvcClockUsec`` / header
+    ``X-Svc-Clock-Usec``). Plain epoch microseconds; the test-only skew
+    injection needs the explicit ELBENCHO_TPU_TESTING opt-in."""
+    usec = time.time_ns() // 1000
+    if TEST_SKEW_BY_PORT \
+            and os.environ.get("ELBENCHO_TPU_TESTING") == "1":
+        usec += TEST_SKEW_BY_PORT.get(port, 0)
+    return usec
+
+
+def fleet_trace_enabled(cfg) -> bool:
+    """--tracefleet auto|on|off: is fleet trace collection armed for this
+    (master) process? ``auto`` = on exactly when a master-mode run is
+    tracing at all; services never collect (they ship)."""
+    mode = getattr(cfg, "trace_fleet", "auto")
+    if mode == "off" or getattr(cfg, "run_as_service", False):
+        return False
+    if not getattr(cfg, "trace_file_path", ""):
+        return False
+    if mode == "on":
+        return True
+    return bool(getattr(cfg, "hosts", None))
+
+
+class ClockSyncEstimator:
+    """Per-peer NTP-style clock-offset estimator over piggybacked
+    round trips.
+
+    Each sample is one request/reply exchange: ``t0``/``t1`` bracket it
+    on the LOCAL wall clock, ``peer_clock`` is the peer's wall-clock
+    stamp taken while building the reply. The classic midpoint estimate
+    assumes the reply stamp sits halfway through the RTT; asymmetric
+    path delay can push the true offset anywhere inside ``±rtt/2``,
+    which is exactly the uncertainty reported. Min-RTT filtering keeps
+    the tightest exchanges: a congested poll (retry, loaded host) has a
+    huge RTT and therefore never displaces a tight sample."""
+
+    def __init__(self, cap: int = SAMPLE_CAP):
+        self._cap = max(cap, 1)
+        self._best: "list[tuple[int, int]]" = []  # (rtt_usec, offset_usec)
+        self.num_samples = 0
+
+    def add_sample(self, t0_usec: int, t1_usec: int,
+                   peer_clock_usec: int) -> None:
+        if t1_usec < t0_usec:  # local clock stepped backwards mid-exchange
+            return
+        rtt = t1_usec - t0_usec
+        offset = peer_clock_usec - (t0_usec + t1_usec) // 2
+        self.num_samples += 1
+        self._best.append((rtt, offset))
+        self._best.sort(key=lambda s: s[0])
+        del self._best[self._cap:]
+
+    @property
+    def has_estimate(self) -> bool:
+        return bool(self._best)
+
+    @property
+    def offset_usec(self) -> int:
+        """Estimated peer_clock - local_clock, from the min-RTT sample."""
+        return self._best[0][1] if self._best else 0
+
+    @property
+    def uncertainty_usec(self) -> int:
+        """Half the best RTT: the true offset provably lies within
+        offset ± uncertainty (up to clock drift between samples)."""
+        if not self._best:
+            return 0
+        return max(self._best[0][0] // 2, MIN_UNCERTAINTY_USEC)
+
+    def as_dict(self) -> dict:
+        return {"OffsetUsec": self.offset_usec,
+                "UncUsec": self.uncertainty_usec,
+                "Samples": self.num_samples}
+
+
+def record_handle_span(manager, route: str, params: dict,
+                       t0_ns: int) -> None:
+    """Service half of an RPC edge, shared by the HTTP route handlers
+    and the /livestream open: a request stamped with a ParentSpan flow
+    id gets a ``handle:<route>`` span plus the Chrome flow-finish event
+    that stitches the master's ``rpc:<route>`` arrow to it (and the
+    run's trace id lands in the tracer's otherData). Best effort —
+    tracing must never break a route."""
+    from ..service import protocol as proto
+    try:
+        flow_id = int(params.get(proto.KEY_PARENT_SPAN, ""))
+    except (ValueError, TypeError):
+        return
+    try:
+        tracer = manager.shared.tracer if manager is not None else None
+        if tracer is None:
+            return
+        trace_id = params.get(proto.KEY_TRACE_ID, "")
+        if trace_id:
+            tracer.extra_other_data["traceId"] = trace_id
+        dur = max((tracer.now_ns() - t0_ns) // 1000, 1)
+        tracer.record_rpc(f"handle:{route}", t0_ns, dur, rank=0,
+                          flow_id=flow_id, side="in")
+    except Exception:  # noqa: BLE001 - never fail the route over a span
+        pass
+
+
+def chain_offsets(parent_off: int, parent_unc: int,
+                  child_off: int, child_unc: int) -> "tuple[int, int]":
+    """Compose offsets down the aggregation tree: master measured the
+    root at ``parent``, the root measured its child at ``child`` (both
+    ``peer - self``), so master->child is the sum — and so are the
+    uncertainty bounds (intervals add under composition)."""
+    return parent_off + child_off, parent_unc + child_unc
+
+
+# ---------------------------------------------------------------------------
+# collection: the master writes shipped per-host rings next to its trace
+# ---------------------------------------------------------------------------
+
+def host_trace_path(master_path: str, rank_offset: int) -> str:
+    """Collected per-host file name: ``<base>.fleet.r<rankoffset><ext>``
+    — deliberately DISTINCT from the ``.r<rankoffset>`` name a service
+    writes locally. On a shared filesystem both exist and the service's
+    teardown rewrite (its own ring, no clock stamps) must never clobber
+    the master's collected copy, which carries the estimated offsets
+    the merge depends on."""
+    base, ext = os.path.splitext(master_path)
+    return f"{base}.fleet.r{rank_offset}{ext}"
+
+
+def write_collected_ring(master_path: str, rank_offset: int, ring: dict,
+                         host: str, offset_usec: int, unc_usec: int,
+                         trace_id: str) -> str:
+    """Persist a service's shipped span ring as a loadable per-host
+    Chrome trace file, stamping the master's clock estimate + host label
+    into otherData for the merge. Atomic temp-then-rename like
+    Tracer.write. Returns the path written."""
+    from .tracer import atomic_write_json
+    path = host_trace_path(master_path, rank_offset)
+    doc = {
+        "traceEvents": ring.get("traceEvents", []),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "elbencho-tpu",
+            **ring.get("otherData", {}),
+            "host": host,
+            "traceId": trace_id,
+            "clockOffsetUsec": offset_usec,
+            "clockUncertaintyUsec": unc_usec,
+        },
+    }
+    atomic_write_json(path, doc)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# merge: per-host files -> one clock-aligned fleet trace
+# ---------------------------------------------------------------------------
+
+class FleetTraceError(ValueError):
+    """Unreadable/mismatched input to the fleet trace merge."""
+
+
+def _load_trace(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as err:
+        raise FleetTraceError(f"{path}: not a loadable Chrome trace "
+                              f"({err})") from err
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise FleetTraceError(f"{path}: no traceEvents array")
+    return doc
+
+
+def discover_host_traces(master_path: str) -> "list[str]":
+    """Per-host sibling files of a master trace, sorted by rank offset.
+    Master-collected ``<base>.fleet.r*<ext>`` files (clock offsets
+    stamped) win; service-local ``<base>.r*<ext>`` files — present on a
+    shared filesystem, or left by a run whose collection was refused —
+    fill in ranks with no collected copy (their lanes merge with offset
+    0, honestly reported in the skew report)."""
+    base, ext = os.path.splitext(master_path)
+
+    def scan(pattern: str, prefix_len: int) -> "dict[int, str]":
+        out: "dict[int, str]" = {}
+        for path in glob.glob(pattern):
+            suffix = path[prefix_len:len(path) - len(ext)] if ext \
+                else path[prefix_len:]
+            try:
+                out[int(suffix)] = path
+            except ValueError:
+                continue  # not a rank-offset sibling (e.g. .rXtmp123)
+        return out
+
+    ebase = glob.escape(base)
+    eext = glob.escape(ext)
+    collected = scan(f"{ebase}.fleet.r*{eext}", len(base) + 8)
+    local = scan(f"{ebase}.r*{eext}", len(base) + 2)
+    merged = {**local, **collected}
+    return [p for _off, p in sorted(merged.items())]
+
+
+def merge_fleet_trace(master_path: str,
+                      host_paths: "list[str] | None" = None,
+                      out_path: "str | None" = None) -> dict:
+    """Merge the master trace + per-host collected traces into ONE
+    clock-aligned Chrome trace.
+
+    - every input becomes its own process lane (``pid``: master = 0,
+      hosts = 1.. in rank-offset order) with ``process_name`` metadata;
+    - per-host timestamps are rebased onto the master timeline through
+      each file's wall anchor minus its estimated clock offset
+      (``otherData.wallAnchorUsec`` / ``clockOffsetUsec``);
+    - flow events (the RPC arrows) pass through untouched — their ids
+      were minted fleet-unique by the master;
+    - host-file phase-marker spans duplicated by the master lane are
+      dedup'd (counted in the skew report);
+    - ``otherData`` carries the skew report: per-host offset ±
+      uncertainty, the max absolute offset, and loss counters.
+
+    Returns the merged document; writes it to ``out_path`` when given
+    (default: ``<base>.fleet<ext>`` next to the master file).
+    """
+    master = _load_trace(master_path)
+    explicit_inputs = host_paths is not None
+    if host_paths is None:
+        host_paths = discover_host_traces(master_path)
+    m_other = master.get("otherData", {})
+    m_anchor = int(m_other.get("wallAnchorUsec", 0))
+    trace_id = m_other.get("traceId", "")
+
+    events: "list[dict]" = []
+    master_phase_names = set()
+    for ev in master.get("traceEvents", []):
+        ev = dict(ev)
+        ev["pid"] = 0
+        if ev.get("cat") == "phase" and ev.get("ph") == "X":
+            master_phase_names.add(ev.get("name"))
+        events.append(ev)
+    lanes = [{"pid": 0, "name": "master", "path": master_path,
+              "offsetUsec": 0, "uncUsec": 0,
+              "rankOffset": int(m_other.get("rankOffset", 0))}]
+
+    deduped_phase_markers = 0
+    dropped_events = int(m_other.get("numDropped", 0))
+    skipped: "list[str]" = []
+    pid = 0
+    for path in host_paths:
+        doc = _load_trace(path)
+        other = doc.get("otherData", {})
+        if trace_id and other.get("traceId") \
+                and other.get("traceId") != trace_id:
+            if explicit_inputs:
+                # the user NAMED this file: mixing runs is an error
+                raise FleetTraceError(
+                    f"{path}: trace id {other.get('traceId')!r} does "
+                    f"not match the master's {trace_id!r} — files from "
+                    f"different runs cannot merge into one timeline")
+            # auto-discovered: a stale lane from a PREVIOUS run reusing
+            # the same --tracefile path (retention keeps collected
+            # files around) must not abort the whole merge — skip it
+            # loudly in the skew report instead
+            skipped.append(path)
+            continue
+        pid += 1
+        offset = int(other.get("clockOffsetUsec", 0))
+        unc = int(other.get("clockUncertaintyUsec", 0))
+        anchor = int(other.get("wallAnchorUsec", 0))
+        # an event at host trace-ts T happened at host wall time
+        # anchor+T = master wall time anchor+T-offset, i.e. master
+        # trace-ts T + (anchor - offset - m_anchor)
+        delta = (anchor - offset - m_anchor) if anchor and m_anchor else 0
+        host = other.get("host", f"r{other.get('rankOffset', pid)}")
+        lanes.append({"pid": pid, "name": str(host), "path": path,
+                      "offsetUsec": offset, "uncUsec": unc,
+                      "rankOffset": int(other.get("rankOffset", 0))})
+        dropped_events += int(other.get("numDropped", 0))
+        for ev in doc.get("traceEvents", []):
+            if ev.get("cat") == "phase" and ev.get("ph") == "X" \
+                    and ev.get("name") in master_phase_names:
+                # the master lane already carries this phase marker for
+                # the whole fleet; a copy per host is noise
+                deduped_phase_markers += 1
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            ev["ts"] = max(int(ev.get("ts", 0)) + delta, 0)
+            events.append(ev)
+
+    events.sort(key=lambda e: e.get("ts", 0))
+    meta = []
+    for lane in lanes:
+        meta.append({"name": "process_name", "ph": "M", "pid": lane["pid"],
+                     "tid": 0, "args": {"name": lane["name"]}})
+        meta.append({"name": "process_sort_index", "ph": "M",
+                     "pid": lane["pid"], "tid": 0,
+                     "args": {"sort_index": lane["pid"]}})
+    max_abs = max((abs(lane["offsetUsec"]) for lane in lanes), default=0)
+    doc = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "elbencho-tpu",
+            "fleetMerge": True,
+            "traceId": trace_id,
+            "numInputs": len(lanes),
+            "maxAbsClockOffsetUsec": max_abs,
+            "dedupedPhaseMarkers": deduped_phase_markers,
+            "numDropped": dropped_events,
+            "skippedInputs": skipped,
+            "skewReport": {
+                lane["name"]: {"OffsetUsec": lane["offsetUsec"],
+                               "UncUsec": lane["uncUsec"],
+                               "RankOffset": lane["rankOffset"]}
+                for lane in lanes},
+        },
+    }
+    if out_path is None:
+        base, ext = os.path.splitext(master_path)
+        out_path = f"{base}.fleet{ext or '.json'}"
+    from .tracer import atomic_write_json
+    atomic_write_json(out_path, doc)
+    doc["outPath"] = out_path
+    return doc
+
+
+def skew_report_text(doc: dict) -> "list[str]":
+    """Human-readable skew-report lines for a merged fleet trace (the
+    CLI/report header of elbencho-tpu-trace and --fleet-trace)."""
+    other = doc.get("otherData", {})
+    report = other.get("skewReport", {})
+    lines = [f"fleet trace: {other.get('numInputs', 0)} lane(s), "
+             f"max |clock offset| "
+             f"{other.get('maxAbsClockOffsetUsec', 0)}us, "
+             f"{other.get('dedupedPhaseMarkers', 0)} phase marker(s) "
+             f"dedup'd, {other.get('numDropped', 0)} event(s) lost to "
+             f"ring/sampling bounds"]
+    for path in other.get("skippedInputs", []):
+        lines.append(f"  SKIPPED {path}: trace id from a different run "
+                     f"(stale leftover? delete it or merge explicitly)")
+    for name, entry in report.items():
+        lines.append(f"  {name or 'master'}: offset "
+                     f"{entry.get('OffsetUsec', 0)}us "
+                     f"± {entry.get('UncUsec', 0)}us")
+    return lines

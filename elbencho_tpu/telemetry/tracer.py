@@ -18,6 +18,7 @@ offset (host slot in a distributed run), ``tid`` the worker rank.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import random
@@ -27,6 +28,36 @@ import time
 #: default ring capacity (events); old spans are overwritten when a phase
 #: outgrows it — num_overwritten says how many were lost
 DEFAULT_RING_EVENTS = 1 << 18
+
+#: fleet-wide flow ids (master-generated, echoed by services): one shared
+#: counter per process so concurrent RemoteWorkers can never mint the
+#: same id — uniqueness across hosts holds because ONLY the master mints
+_flow_ids = itertools.count(1)
+
+
+def next_flow_id() -> int:
+    """A process-unique Chrome flow-event id (master side of an RPC edge
+    mints one; the service side echoes it back — docs/telemetry.md
+    "Fleet tracing")."""
+    return next(_flow_ids)
+
+
+def atomic_write_json(path: str, doc) -> None:
+    """Write a JSON document via temp-then-rename so a concurrent
+    reader (Perfetto, a scraper, the merge) never sees a torn file —
+    the one crash-safe write path shared by the trace ring, the
+    collected per-host rings, and the merged fleet trace."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class Tracer:
@@ -53,6 +84,17 @@ class Tracer:
         self._lock = threading.Lock()
         self._rng = random.Random(0xe1be0 + rank_offset)
         self._t0_ns = time.perf_counter_ns()
+        # wall-clock anchor captured at the SAME instant as the
+        # perf-counter epoch: an event at trace-ts T usec happened at
+        # wall time wall_anchor_usec + T on THIS host's clock — the
+        # hook the fleet merge (telemetry/tracefleet.py) aligns
+        # per-host files through after subtracting the estimated
+        # per-host clock offset
+        self.wall_anchor_usec = time.time_ns() // 1000
+        # fleet-tracing metadata merged into write()'s otherData: the
+        # run trace id (master-minted, echoed by services) and — on
+        # collected per-host files — the master-estimated clock offset
+        self.extra_other_data: "dict" = {}
 
     # -- recording -----------------------------------------------------------
 
@@ -79,6 +121,9 @@ class Tracer:
             "tid": rank,
             "args": args,
         }
+        self._push(event)
+
+    def _push(self, event: dict) -> None:
         with self._lock:
             slot = self._idx % self._cap
             if self._ring[slot] is not None:
@@ -86,6 +131,31 @@ class Tracer:
             self._ring[slot] = event
             self._idx += 1
             self.num_recorded += 1
+
+    def to_trace_ts(self, start_ns: int) -> int:
+        return max(start_ns - self._t0_ns, 0) // 1000
+
+    def record_rpc(self, name: str, start_ns: int, dur_usec: int,
+                   rank: int, flow_id: int, side: str) -> None:
+        """One control-plane RPC edge end: a complete span PLUS the bound
+        Chrome flow event that lets Perfetto draw the master->service
+        arrow. ``side`` is "out" (master sent the request; flow start
+        "s") or "in" (service handled it; flow finish "f"/bp=e). The
+        flow event's ts sits at the span start so it binds to the span
+        it is emitted with. Never sampled: RPC volume is per-phase, not
+        per-op."""
+        ts = self.to_trace_ts(start_ns)
+        self.record(name, "rpc", start_ns, dur_usec, rank=rank,
+                    flow=flow_id)
+        flow = {
+            "name": "rpc", "cat": "rpc",
+            "ph": "s" if side == "out" else "f",
+            "id": flow_id, "ts": ts,
+            "pid": self.rank_offset, "tid": rank,
+        }
+        if side != "out":
+            flow["bp"] = "e"  # bind to the enclosing slice
+        self._push(flow)
 
     def record_op(self, op: str, phase: str, start_ns: int, dur_usec: int,
                   rank: int, offset: int, size: int,
@@ -133,19 +203,11 @@ class Tracer:
                 "numOverwritten": self.num_overwritten,
                 "numSampledOut": self.num_sampled_out,
                 "numDropped": self.num_dropped,
+                "wallAnchorUsec": self.wall_anchor_usec,
+                **self.extra_other_data,
             },
         }
-        tmp = f"{self.path}.tmp{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(doc, f)
-            os.replace(tmp, self.path)
-        except OSError:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write_json(self.path, doc)
 
 
 def make_tracer(cfg) -> "Tracer | None":

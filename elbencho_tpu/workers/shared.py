@@ -76,6 +76,12 @@ class WorkersSharedData:
         # (None when tracing is off — instrumentation stays no-op)
         from ..telemetry.tracer import make_tracer
         self.tracer = make_tracer(config)
+        if self.tracer is not None \
+                and not getattr(config, "run_as_service", False):
+            # fleet tracing: the master/local coordinator mints the run
+            # trace id; services only ever echo the one stamped onto
+            # their requests (span-context propagation)
+            self.tracer.extra_other_data["traceId"] = uuid_mod.uuid4().hex
         # --svcstream: master-side streaming control plane bookkeeping
         # (tree plan + per-host live states fed by root stream readers);
         # None = per-request polling, byte-for-byte parity

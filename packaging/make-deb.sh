@@ -42,7 +42,7 @@ LAUNCHER
 chmod 755 "$PKGROOT/usr/bin/elbencho-tpu"
 
 for tool in elbencho-tpu-chart elbencho-tpu-summarize-json \
-        elbencho-tpu-doctor \
+        elbencho-tpu-doctor elbencho-tpu-trace \
         elbencho-tpu-scan-path elbencho-tpu-sweep elbencho-tpu-dgen \
         elbencho-tpu-blockdev-rand elbencho-tpu-cleanup-mpu; do
     # the tools' repo-relative sys.path bootstrap resolves to /usr when

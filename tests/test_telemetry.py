@@ -36,21 +36,31 @@ def _metric(body: str, name: str) -> "float | None":
 
 
 def _validate_chrome_trace(path: str) -> "list[dict]":
-    """Chrome trace-event schema check: every span is a complete-event
-    with the fields Perfetto needs, args is a JSON object."""
+    """Chrome trace-event schema check: complete-event spans ("X") with
+    the fields Perfetto needs (args a JSON object), plus the fleet-
+    tracing flow events ("s"/"f" RPC arrows, id-bound) and "M" process
+    metadata a merged trace carries."""
     with open(path) as f:
         doc = json.load(f)
     assert isinstance(doc["traceEvents"], list)
     assert doc["otherData"]["tool"] == "elbencho-tpu"
     for e in doc["traceEvents"]:
-        assert e["ph"] == "X"
+        assert e["ph"] in ("X", "s", "f", "M"), e
         assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["pid"], int)
+        if e["ph"] == "M":
+            assert isinstance(e.get("args", {}), dict)
+            continue
         assert isinstance(e["cat"], str) and e["cat"]
         assert isinstance(e["ts"], int) and e["ts"] >= 0
-        assert isinstance(e["dur"], int) and e["dur"] >= 0
-        assert isinstance(e["pid"], int)
         assert isinstance(e["tid"], int)
-        assert isinstance(e.get("args", {}), dict)
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], int) and e["dur"] >= 0
+            assert isinstance(e.get("args", {}), dict)
+        else:  # flow event: bound by id, finish side carries bp=e
+            assert isinstance(e["id"], int)
+            if e["ph"] == "f":
+                assert e.get("bp") == "e"
     return doc["traceEvents"]
 
 

@@ -1,0 +1,555 @@
+"""Fleet-wide distributed tracing suite (docs/telemetry.md "Fleet
+tracing"): clock-skew estimator units, trace merge properties, the
+append-only schema lint, and the 8-host in-process fleet e2e proving
+span context crosses the wire and offsets are applied.
+
+Marker `obs` — rides `make test-obs` with the telemetry/flightrec
+suites.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from elbencho_tpu.telemetry import tracefleet as tf
+from elbencho_tpu.telemetry.tracer import Tracer
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# clock-skew estimator units
+# ---------------------------------------------------------------------------
+
+def test_estimator_symmetric_exchange_recovers_offset():
+    est = tf.ClockSyncEstimator()
+    # local brackets [1000, 3000]; peer stamped its clock exactly at the
+    # midpoint (2000) + 12345 offset -> perfect recovery, unc = rtt/2
+    est.add_sample(1000, 3000, 2000 + 12345)
+    assert est.has_estimate
+    assert est.offset_usec == 12345
+    assert est.uncertainty_usec == 1000
+
+
+def test_estimator_asymmetric_rtt_error_within_uncertainty():
+    """With asymmetric path delays the midpoint estimate is wrong by
+    |d1-d2|/2 — provably within the reported rtt/2 uncertainty."""
+    true_off = 50_000
+    t0 = 1_000_000
+    d1, d2 = 1800, 200  # request slow, reply fast
+    peer_stamp = (t0 + d1) + true_off
+    t1 = t0 + d1 + d2
+    est = tf.ClockSyncEstimator()
+    est.add_sample(t0, t1, peer_stamp)
+    err = abs(est.offset_usec - true_off)
+    assert err == (d1 - d2) // 2
+    assert err <= est.uncertainty_usec
+    assert est.uncertainty_usec == (d1 + d2) // 2
+
+
+def test_estimator_min_rtt_filter_keeps_tight_sample():
+    est = tf.ClockSyncEstimator()
+    est.add_sample(0, 200, 100 + 7)          # tight: rtt 200, off 7
+    est.add_sample(0, 100_000, 50_000 + 999)  # congested: huge rtt
+    assert est.offset_usec == 7
+    assert est.uncertainty_usec == 100
+    est.add_sample(0, 50, 25 + 3)             # tighter still: wins
+    assert est.offset_usec == 3
+    assert est.uncertainty_usec >= tf.MIN_UNCERTAINTY_USEC
+
+
+def test_estimator_bounds_and_bad_samples():
+    est = tf.ClockSyncEstimator()
+    est.add_sample(100, 50, 0)  # clock stepped backwards: dropped
+    assert not est.has_estimate and est.offset_usec == 0 \
+        and est.uncertainty_usec == 0
+    for i in range(100):
+        est.add_sample(0, 1000 + i, 500)
+    assert est.num_samples == 100
+    assert len(est._best) <= tf.SAMPLE_CAP
+
+
+def test_chain_offsets_adds_offsets_and_uncertainty():
+    assert tf.chain_offsets(100, 10, -40, 5) == (60, 15)
+
+
+def test_svc_wall_clock_test_skew_needs_opt_in(monkeypatch):
+    import time
+    monkeypatch.setitem(tf.TEST_SKEW_BY_PORT, 1234, 1_000_000_000)
+    monkeypatch.delenv("ELBENCHO_TPU_TESTING", raising=False)
+    base = tf.svc_wall_clock_usec(1234)
+    assert abs(base - time.time_ns() // 1000) < 10_000_000  # no skew
+    monkeypatch.setenv("ELBENCHO_TPU_TESTING", "1")
+    skewed = tf.svc_wall_clock_usec(1234)
+    assert skewed - base > 900_000_000  # skew applied only under opt-in
+
+
+# ---------------------------------------------------------------------------
+# merge properties
+# ---------------------------------------------------------------------------
+
+def _make_trace(path, rank_offset, wall_anchor, events):
+    t = Tracer(str(path), rank_offset=rank_offset)
+    t.wall_anchor_usec = wall_anchor
+    for ev in events:
+        t.record(**ev)
+    return t
+
+
+def test_merge_applies_offsets_counts_and_monotone_lanes(tmp_path):
+    """Merge property: event count == sum of inputs minus dedup'd phase
+    markers; per-host timestamps are rebased through wall anchor minus
+    clock offset; the merged stream is sorted (monotone per lane)."""
+    master_path = tmp_path / "t.json"
+    m = _make_trace(master_path, 0, 1_000_000, [])
+    m.extra_other_data["traceId"] = "run1"
+    base = m._t0_ns
+    m.record("op_a", "io", base, 10, rank=0)
+    m.record("WRITE", "phase", base, 500, rank=0)  # fleet phase marker
+    m.write()
+
+    host_path = tf.host_trace_path(str(master_path), 8)
+    h = Tracer(host_path, rank_offset=8)
+    hbase = h._t0_ns
+    h.record("op_b", "io", hbase + 7_000_000, 20, rank=1)  # ts = 7000us
+    h.record("WRITE", "phase", hbase, 400, rank=0)  # duplicate marker
+    ring = {"traceEvents": h.snapshot_events(),
+            "otherData": {"rankOffset": 8,
+                          "wallAnchorUsec": 1_050_000}}
+    # host clock runs 30000us AHEAD of the master's
+    tf.write_collected_ring(str(master_path), 8, ring, "hostA",
+                            30_000, 250, "run1")
+
+    doc = tf.merge_fleet_trace(str(master_path))
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    # 2 master events + 2 host events - 1 dedup'd phase marker
+    assert len(events) == 2 + 2 - 1
+    assert doc["otherData"]["dedupedPhaseMarkers"] == 1
+    assert doc["otherData"]["maxAbsClockOffsetUsec"] == 30_000
+    op_b = next(e for e in events if e["name"] == "op_b")
+    # host wall anchor 1_050_000 + ts 7000 - offset 30_000 rebased onto
+    # master anchor 1_000_000 -> 1_057_000 - 30_000 - 1_000_000
+    assert op_b["ts"] == 27_000
+    assert op_b["pid"] == 1  # own process lane
+    ts_list = [e.get("ts", 0) for e in events]
+    assert ts_list == sorted(ts_list)
+    # lanes named via process_name metadata
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"master", "hostA"}
+    assert os.path.exists(doc["outPath"])
+    # skew report carries offset ± uncertainty per lane
+    assert doc["otherData"]["skewReport"]["hostA"]["OffsetUsec"] == 30_000
+    assert doc["otherData"]["skewReport"]["hostA"]["UncUsec"] == 250
+
+
+def test_merge_mismatched_trace_ids_skip_or_refuse(tmp_path):
+    """A stale lane from a previous run (same --tracefile path reused)
+    must not abort an auto-discovered merge — it is skipped and named
+    in the skew report. An EXPLICITLY listed mismatched file is a user
+    error and still refuses."""
+    master_path = tmp_path / "t.json"
+    m = _make_trace(master_path, 0, 1_000, [])
+    m.extra_other_data["traceId"] = "run1"
+    m.write()
+    stale = tf.write_collected_ring(
+        str(master_path), 8,
+        {"traceEvents": [], "otherData": {"wallAnchorUsec": 1_000}},
+        "hostA", 0, 0, "DIFFERENT-RUN")
+    doc = tf.merge_fleet_trace(str(master_path))  # discovery: skips
+    assert doc["otherData"]["numInputs"] == 1
+    assert doc["otherData"]["skippedInputs"] == [stale]
+    assert any("SKIPPED" in line for line in tf.skew_report_text(doc))
+    with pytest.raises(tf.FleetTraceError, match="trace id"):
+        tf.merge_fleet_trace(str(master_path), host_paths=[stale])
+
+
+def test_discover_host_traces_sorts_and_prefers_collected(tmp_path):
+    master = tmp_path / "t.json"
+    master.write_text("{}")
+    for off in (16, 0, 8):
+        (tmp_path / f"t.r{off}.json").write_text("{}")
+    (tmp_path / "t.rX.json").write_text("{}")   # not a rank sibling
+    (tmp_path / "t.fleet.json").write_text("{}")  # the merged OUTPUT
+    found = tf.discover_host_traces(str(master))
+    assert [os.path.basename(p) for p in found] == \
+        ["t.r0.json", "t.r8.json", "t.r16.json"]
+    # a master-collected copy (clock offsets stamped) outranks the
+    # service-local file of the same rank
+    (tmp_path / "t.fleet.r8.json").write_text("{}")
+    found = tf.discover_host_traces(str(master))
+    assert [os.path.basename(p) for p in found] == \
+        ["t.r0.json", "t.fleet.r8.json", "t.r16.json"]
+
+
+def test_flow_events_survive_merge_and_bind_by_id(tmp_path):
+    master_path = tmp_path / "t.json"
+    m = _make_trace(master_path, 0, 0, [])
+    m.record_rpc("rpc:/startphase", m._t0_ns, 50, rank=2, flow_id=77,
+                 side="out")
+    m.write()
+    h = Tracer(str(tmp_path / "h.json"), rank_offset=8)
+    h.record_rpc("handle:/startphase", h._t0_ns, 10, rank=0, flow_id=77,
+                 side="in")
+    tf.write_collected_ring(
+        str(master_path), 8,
+        {"traceEvents": h.snapshot_events(),
+         "otherData": {"rankOffset": 8, "wallAnchorUsec": 0}},
+        "hostA", 0, 0, "")
+    doc = tf.merge_fleet_trace(str(master_path))
+    flows = [e for e in doc["traceEvents"] if e.get("ph") in ("s", "f")]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert all(e["id"] == 77 for e in flows)
+    start = next(e for e in flows if e["ph"] == "s")
+    finish = next(e for e in flows if e["ph"] == "f")
+    assert start["pid"] != finish["pid"]  # the arrow crosses lanes
+    assert finish["bp"] == "e"
+
+
+# ---------------------------------------------------------------------------
+# config + schema lint satellites
+# ---------------------------------------------------------------------------
+
+def test_tracefleet_config_validation(tmp_path):
+    from elbencho_tpu.config.args import ConfigError, parse_cli
+    target = str(tmp_path / "f")
+    cfg, _ = parse_cli(["-w", "-s", "4K", "--tracefile",
+                        str(tmp_path / "t.json"), target])
+    cfg.derive(probe_paths=False)
+    cfg.check()  # default auto is fine
+    for bad in (["--tracefleet", "sometimes"],
+                ["--tracefleet", "on"],           # without --tracefile
+                ["--traceshipcap", "0"]):
+        cfg, _ = parse_cli(["-w", "-s", "4K", *bad, target])
+        cfg.derive(probe_paths=False)
+        with pytest.raises(ConfigError):
+            cfg.check()
+
+
+def test_fleet_trace_enabled_predicate(tmp_path):
+    from elbencho_tpu.config.args import parse_cli
+    target = str(tmp_path / "f")
+
+    def cfg_for(argv):
+        cfg, _ = parse_cli(argv + [target])
+        cfg.derive(probe_paths=False)
+        return cfg
+
+    trace = ["--tracefile", str(tmp_path / "t.json")]
+    assert not tf.fleet_trace_enabled(cfg_for(["-w"]))
+    assert not tf.fleet_trace_enabled(cfg_for(["-w", *trace]))  # local auto
+    assert tf.fleet_trace_enabled(
+        cfg_for(["-w", *trace, "--hosts", "h1,h2"]))
+    assert tf.fleet_trace_enabled(cfg_for(["-w", *trace,
+                                           "--tracefleet", "on"]))
+    assert not tf.fleet_trace_enabled(
+        cfg_for(["-w", *trace, "--hosts", "h1", "--tracefleet", "off"]))
+    svc = cfg_for(["-w", *trace, "--tracefleet", "on"])
+    svc.run_as_service = True
+    assert not tf.fleet_trace_enabled(svc)  # services ship, never collect
+
+
+def _load_check_schema_module():
+    import importlib.util
+    from importlib.machinery import SourceFileLoader
+    path = os.path.join(REPO, "tools", "check-schema")
+    loader = SourceFileLoader("check_schema", path)
+    spec = importlib.util.spec_from_loader("check_schema", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def test_check_schema_extractors_catch_reorder():
+    mod = _load_check_schema_module()
+    old = mod.extract_counter_keys(
+        'X = (("a", "KeyA", "x"), ("b", "KeyB", "x"))', "X")
+    new_ok = mod.extract_counter_keys(
+        'X = (("a", "KeyA", "x"), ("b", "KeyB", "x"), ("c", "KeyC", "x"))',
+        "X")
+    new_bad = mod.extract_counter_keys(
+        'X = (("b", "KeyB", "x"), ("a", "KeyA", "x"))', "X")
+    assert old == ["KeyA", "KeyB"]
+    assert new_ok[:len(old)] == old          # append-only: passes
+    assert new_bad[:len(old)] != old         # reorder: caught
+    cols = mod.extract_header_columns(
+        'header = ["A"]\nif x:\n    header.append("Cond")\n'
+        'header += ["B", "C"]\n')
+    assert cols == ["A", "B", "C"]  # conditional .append not in the tail
+
+
+def test_check_schema_tool_passes_against_head():
+    """The real lint over the real tree: every schema list must be
+    append-only vs HEAD (this IS the `make check-schema` gate)."""
+    probe = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                           capture_output=True)
+    if probe.returncode != 0:
+        pytest.skip("not a git checkout — nothing to diff against")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check-schema")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    for label in ("PATH_AUDIT_COUNTERS", "CONTROL_AUDIT_COUNTERS",
+                  "CSV_RESULT_COLUMNS", "summarize-json"):
+        assert label in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# service-side trace-file scrub (quit/orphan satellite)
+# ---------------------------------------------------------------------------
+
+def test_service_quit_scrubs_only_shipped_trace_files(tmp_path):
+    """Quit/orphan scrub retention rule: a ring the master COLLECTED is
+    a duplicate and is removed; a never-shipped ring (refused over
+    --traceshipcap, master crashed before collection) is the only copy
+    of the host's spans and must survive."""
+    from elbencho_tpu.config.args import parse_cli
+    from elbencho_tpu.phases import BenchPhase
+    from elbencho_tpu.service import protocol as proto
+    from elbencho_tpu.service.http_service import ServiceState
+    svc_cfg, _ = parse_cli(["--service", "--foreground", "--port",
+                            "18998"])
+    svc_cfg.derive(probe_paths=False)
+    svc_cfg.check()
+    state = ServiceState(svc_cfg)
+    cfg, _ = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                        "--tracefile", str(tmp_path / "t.json"),
+                        str(tmp_path / "data")])
+    cfg.derive(probe_paths=False)
+    cfg.check()
+    trace_path = tmp_path / "t.r0.json"
+    try:
+        # run 1: tracing armed but the ring never shipped (no ShipTrace
+        # — e.g. the master died first): the local file must survive
+        state.prepare_phase(cfg.to_service_dict())
+        trace_path.write_text("{}")  # stands in for the written ring
+        state.teardown_workers()
+        state._cleanup_run_temp_files()
+        assert trace_path.exists(), \
+            "an unshipped ring is the only copy — scrub must spare it"
+        # run 2: the ring ships at /benchresult — PENDING only; without
+        # a later master contact (master died mid-response?) the local
+        # file still survives
+        state.prepare_phase(cfg.to_service_dict())
+        trace_path.write_text("{}")
+        result = state.bench_result({proto.KEY_SHIP_TRACE: "1"})
+        assert ServiceState.TRACE_RING_JSON_KEY in result
+        assert state._trace_ship_pending
+        state._cleanup_run_temp_files()
+        assert trace_path.exists(), \
+            "a ship not yet acked by a later contact must survive"
+        state._trace_files.add(str(trace_path))  # scrub cleared the set
+        # the master's next contact (here: the deliberate /interrupt-
+        # phase release at run end) proves the reply landed — NOW the
+        # local ring is a duplicate and quit scrubs it
+        state.note_master_contact()
+        state.teardown_workers()
+        state._cleanup_run_temp_files()
+        assert not trace_path.exists(), \
+            "an acked shipped ring is a duplicate — quit must scrub it"
+        # a new phase would record spans no master collected: the marks
+        # reset (sticky-shipped must not delete phase-N spans)
+        state.prepare_phase(cfg.to_service_dict())
+        trace_path.write_text("{}")
+        state.bench_result({proto.KEY_SHIP_TRACE: "1"})
+        state.note_master_contact()
+        state.start_phase(int(BenchPhase.CREATEFILES), "uuid-2")
+        state.teardown_workers()
+        state._cleanup_run_temp_files()
+        assert trace_path.exists(), \
+            "a phase after the last collection un-ships the local ring"
+    finally:
+        state.close()
+
+
+def test_trace_ship_cap_refusal_is_loud_not_fatal(tmp_path):
+    """A ring over --traceshipcap is refused with a marker (and a LOUD
+    log) but the /benchresult exchange still succeeds — the run's
+    numbers outrank its telemetry."""
+    from elbencho_tpu.config.args import parse_cli
+    from elbencho_tpu.service import protocol as proto
+    from elbencho_tpu.service.http_service import ServiceState
+    svc_cfg, _ = parse_cli(["--service", "--foreground", "--port",
+                            "18999"])
+    svc_cfg.derive(probe_paths=False)
+    svc_cfg.check()
+    state = ServiceState(svc_cfg)
+    cfg, _ = parse_cli(["-w", "-t", "1", "-s", "4K", "-b", "4K",
+                        "--tracefile", str(tmp_path / "t.json"),
+                        "--traceshipcap", "1", str(tmp_path / "data")])
+    cfg.derive(probe_paths=False)
+    cfg.check()
+    try:
+        state.prepare_phase(cfg.to_service_dict())
+        tracer = state.manager.shared.tracer
+        assert tracer is not None
+        for i in range(16000):  # ~>1 MiB serialized
+            tracer.record(f"op{i}", "io", tracer.now_ns(), 5, rank=0,
+                          offset=i * 4096, size=4096)
+        result = state.bench_result({proto.KEY_SHIP_TRACE: "1"})
+        refused = result[proto.KEY_TRACE_RING_REFUSED]
+        assert refused["Bytes"] > 1 << 20 and refused["CapMiB"] == 1
+        assert proto.KEY_TRACE_RING not in result
+        # the exchange itself stayed healthy
+        assert proto.KEY_SVC_CLOCK in result
+        # under a bigger cap the same ring ships — pre-serialized, so
+        # the handler can splice it into the reply without a second
+        # json.dumps of megabytes under route_lock
+        state.cfg.trace_ship_cap_mib = 64
+        result = state.bench_result({proto.KEY_SHIP_TRACE: "1"})
+        ring = json.loads(result[type(state).TRACE_RING_JSON_KEY])
+        assert len(ring["traceEvents"]) >= 16000
+    finally:
+        state.close()
+
+
+# ---------------------------------------------------------------------------
+# 8-host in-process fleet e2e (acceptance)
+# ---------------------------------------------------------------------------
+
+NUM_HOSTS = 8
+
+
+def _master_run(hosts, bench_dir, jsonfile, extra):
+    from elbencho_tpu.cli import main
+    return main(["-w", "-d", "-t", "1", "-n", "1", "-N", "8", "-s", "256K",
+                 "-b", "64K", "--svcupint", "25",
+                 "--hosts", hosts, "--jsonfile", str(jsonfile),
+                 "--nolive", str(bench_dir)] + extra)
+
+
+def _recs_of(jsonfile):
+    return [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+
+
+def test_fleet_e2e_merged_trace_flows_offsets_straggler(tmp_path,
+                                                        monkeypatch):
+    """Acceptance: a master-mode run over an 8-host in-process fleet
+    emits ONE merged Chrome trace with >= 1 cross-host flow (master
+    request -> service handling), applies non-zero per-host clock
+    offsets (injected per port — the in-process fleet shares a physical
+    clock), and the run JSON Analysis block names a straggler host with
+    its barrier-wait share."""
+    monkeypatch.setenv("ELBENCHO_TPU_TESTING", "1")
+    from elbencho_tpu.testing.service_harness import in_process_services
+    trace = tmp_path / "trace.json"
+    rec_path = tmp_path / "run.rec"
+    jsonfile = tmp_path / "out.json"
+    with in_process_services(NUM_HOSTS) as ports:
+        for p in ports:
+            # ±(100..800)ms injected skew, sign alternating by port
+            monkeypatch.setitem(
+                tf.TEST_SKEW_BY_PORT, p,
+                (1 if p % 2 else -1) * (100_000 + (p % 8) * 100_000))
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        host_names = [f"127.0.0.1:{p}" for p in ports]
+        bench = tmp_path / "bench"
+        bench.mkdir()
+        assert _master_run(hosts, bench, jsonfile,
+                           ["--tracefile", str(trace),
+                            "--flightrec", str(rec_path)]) == 0
+
+    # ONE merged, loadable Chrome trace with a lane per host + master
+    fleet_path = tmp_path / "trace.fleet.json"
+    assert fleet_path.exists()
+    doc = json.load(open(fleet_path))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    assert doc["otherData"]["numInputs"] == NUM_HOSTS + 1
+
+    # >= 1 cross-host flow: a flow-start on the master lane whose
+    # matching flow-finish sits on a DIFFERENT (service) lane
+    flows = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") in ("s", "f"):
+            flows.setdefault(e["id"], {})[e["ph"]] = e["pid"]
+        if e.get("ph") == "X":
+            assert isinstance(e["ts"], int) and e["ts"] >= 0
+    crossing = [fid for fid, sides in flows.items()
+                if "s" in sides and "f" in sides
+                and sides["s"] != sides["f"]]
+    assert crossing, "no master->service flow crossed the wire"
+    # the /benchresult edge must be stitched too: its handling span is
+    # recorded BEFORE the ring snapshot ships, so the shipped lane
+    # carries it (a dangling rpc:/benchresult arrow would mean not)
+    assert any(e.get("name") == "handle:/benchresult" and e["pid"] != 0
+               for e in doc["traceEvents"])
+
+    # non-zero per-host clock offsets applied (the injected skew must
+    # show up in the skew report, min-RTT bounded near the truth)
+    report = doc["otherData"]["skewReport"]
+    host_offsets = {name: entry["OffsetUsec"]
+                    for name, entry in report.items() if name != "master"}
+    assert len(host_offsets) == NUM_HOSTS
+    assert all(off != 0 for off in host_offsets.values()), host_offsets
+    assert doc["otherData"]["maxAbsClockOffsetUsec"] >= 100_000
+
+    # the run JSON Analysis block names a straggler host + barrier share
+    recs = _recs_of(jsonfile)
+    ana = next(r["Analysis"] for r in recs if r.get("Analysis"))
+    straggler = ana["Straggler"]
+    assert straggler is not None
+    assert straggler["Host"] in host_names
+    assert "BarrierWaitPct" in straggler
+    assert straggler["BarrierWaitUSec"] >= 0
+    # the straggler counters rode the normal JSON plumbing too
+    assert any(r.get("BarrierWaitUSec", 0) > 0
+               or r.get("StragglerSkewUsec", 0) > 0 for r in recs)
+
+    # the flight recording carries the per-host clock estimates
+    from elbencho_tpu.telemetry.flightrec import read_recording
+    rec = read_recording(str(rec_path))
+    ends = [p["end"] for p in rec["phases"] if p["end"] is not None]
+    host_blocks = [e.get("Hosts", {}) for e in ends if e.get("Hosts")]
+    assert host_blocks, "phase_end rows carry no Hosts block"
+    assert any(entry.get("ClockOffsetUsec")
+               for blocks in host_blocks for entry in blocks.values())
+
+
+def test_fleet_tracing_adds_no_per_tick_requests(tmp_path, monkeypatch):
+    """Acceptance: per-tick service request/byte counts are unchanged
+    vs --flightrec alone — SvcRequests identical (collection piggybacks
+    on /benchresult; zero extra requests), and the per-tick stream
+    traffic (frames) stays put; only the phase-end /benchresult payload
+    grows by the shipped ring."""
+    monkeypatch.setenv("ELBENCHO_TPU_TESTING", "1")
+    from elbencho_tpu.testing.service_harness import in_process_services
+    results = {}
+    with in_process_services(NUM_HOSTS) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        for label, extra in (
+                ("flightrec", []),
+                ("fleettrace", ["--tracefile",
+                                str(tmp_path / "trace.json")])):
+            bench = tmp_path / f"bench-{label}"
+            bench.mkdir()
+            jsonfile = tmp_path / f"{label}.json"
+            assert _master_run(
+                hosts, bench, jsonfile,
+                ["--svcstream", "--flightrec",
+                 str(tmp_path / f"{label}.rec")] + extra) == 0
+            rec = next(r for r in _recs_of(jsonfile)
+                       if r["Phase"] == "WRITE")
+            results[label] = rec
+    a, b = results["flightrec"], results["fleettrace"]
+    # request counts: IDENTICAL — tracing adds no request, per-tick or
+    # otherwise (ShipTrace rides the existing /benchresult)
+    assert b["SvcRequests"] == a["SvcRequests"], (a, b)
+    # byte counts: the only growth is the phase-end /benchresult ring
+    # payload. Per-tick stream bytes are excluded on BOTH sides (frame
+    # COUNT legitimately differs — a traced phase runs longer, so more
+    # heartbeats fire); what remains is request-reply payload, and its
+    # delta must be bounded by the collected rings (plus JSON slack).
+    import glob as glob_mod
+    ring_bytes = sum(os.path.getsize(p) for p in glob_mod.glob(
+        str(tmp_path / "trace.fleet.r*.json")))
+    assert ring_bytes > 0, "no collected per-host rings found"
+    nonstream_a = a["SvcCtlBytes"] - a["SvcStreamBytes"]
+    nonstream_b = b["SvcCtlBytes"] - b["SvcStreamBytes"]
+    delta = nonstream_b - nonstream_a
+    assert 0 <= delta <= ring_bytes * 1.5 + 8192, \
+        (delta, ring_bytes, a, b)
