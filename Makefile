@@ -4,7 +4,7 @@
 
 .PHONY: all native native-tsan native-asan tsan asan check check-schema \
 	test test-fast test-chaos test-scale test-mesh test-obs \
-	test-examples fuzz bench docs clean deb rpm docker
+	test-scenario test-examples fuzz bench docs clean deb rpm docker
 
 all: native
 
@@ -118,6 +118,16 @@ test-scale:
 test-obs: check-schema
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
 		tests/test_flightrec.py tests/test_tracefleet.py -q -m obs
+
+# training-ingest scenario gate: the --scenario suite (plan expansion
+# units, shuffle-window generator properties, dataloader pacing, e2e
+# local runs of all five scenarios with scenario-level doctor verdicts,
+# the in-process master-mode fleet run, summarize/chart column checks;
+# pytest marker `scenario`; docs/scenarios.md). Also part of the default
+# `make test` pytest sweep.
+test-scenario: native check-schema
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios.py \
+		-q -m scenario
 
 # end-to-end example suite against real resources (loopdevs, services)
 test-examples: native

@@ -42,6 +42,10 @@ def _print_help(category: "str | None") -> None:
               "# read into TPU HBM")
         print("  elbencho-tpu --service --foreground --port 1611")
         print("  elbencho-tpu --hosts h1,h2 -w -t 16 -s 1g /mnt/shared")
+        print("  elbencho-tpu --scenario epochs --scenario-opt "
+              "epochs=4,window=64M \\")
+        print("      -t 8 -n 1 -N 64 -s 16M /mnt/dataset  "
+              "# training-ingest scenario")
 
 
 def _print_dry_run(cfg) -> None:
@@ -57,9 +61,21 @@ def _print_dry_run(cfg) -> None:
     print(f"  dataset threads: {cfg.num_dataset_threads}")
     if cfg.tpu_ids:
         print(f"  tpu chips      : {cfg.tpu_ids}")
+    from .phases import phase_name
+    if cfg.scenario:
+        # --scenario --dryrun: show the expanded step plan (the exact
+        # list the journal fingerprints) without running anything
+        from .scenarios import expand_scenario
+        plan = expand_scenario(cfg)
+        print(f"  scenario       : {plan.name} ({len(plan.steps)} steps)")
+        for step in plan.steps:
+            overlay = " ".join(f"{k}={v}"
+                               for k, v in sorted(step.overlay.items()))
+            print(f"    {step.label:<18} {phase_name(step.phase):<10}"
+                  f" {overlay}")
+        return
     for phase in cfg.enabled_phases():
         entries, num_bytes = manager.get_phase_num_entries_and_bytes(phase)
-        from .phases import phase_name
         print(f"  {phase_name(phase):<10}: {entries} entries, "
               f"{format_bytes(num_bytes)}B")
 

@@ -637,6 +637,54 @@ FLAG_DEFS = [
      "phase re-runs from scratch, and a config-fingerprint mismatch "
      "against the journal is a hard error"),
 
+    # training-ingest scenario layer (docs/scenarios.md)
+    ("scenario", None, "scenario", "str", "", "essential",
+     "Run a named training-ingest scenario that composes multiple "
+     "phases with per-step config overlays: epochs (multi-epoch "
+     "shuffled shard reads) | ckpt-burst (all-hosts checkpoint "
+     "save/restore bursts) | contend (train-read vs checkpoint-write "
+     "contention) | coldwarm (cold-vs-warm cache epochs) | dataloader "
+     "(prefetch/decode/consume-cadence emulation). The scenario "
+     "defines the phase plan, so explicit phase flags (-w/-r/...) are "
+     "rejected; every record is tagged with scenario + step identity "
+     "and the run ends with a scenario-level verdict "
+     "(docs/scenarios.md)"),
+    ("scenario-opt", None, "scenario_opts_str", "str", "", "essential",
+     "Comma-separated key=val knobs for --scenario, e.g. "
+     "'epochs=4,window=16M' or 'prefetch=4,stepusec=2000' (each "
+     "scenario's knob table: docs/scenarios.md)"),
+    ("shufflewindow", None, "shuffle_window", "size", 0, "large",
+     "Read phases: emit block offsets as a seeded permutation within "
+     "consecutive windows of this many bytes — every block exactly "
+     "once, locality bounded by the window (the shuffle-buffer access "
+     "shape of training input pipelines; the epochs scenario sets it "
+     "per epoch with a per-epoch seed). 0 = off; incompatible with "
+     "--rand/--backward/--strided/--mmap"),
+    # internal (master -> service): per-step scenario identity + the
+    # dataloader pacing knobs, set by the scenario engine's overlays so
+    # remote workers shape their loops like local ones
+    ("scenstep", None, "scenario_step_label", "str", "", "misc",
+     "[internal] scenario step label, set by the scenario engine"),
+    ("scenepoch", None, "scenario_epoch", "int", 0, "misc",
+     "[internal] scenario epoch number (seeds --shufflewindow "
+     "permutations; tags EpochRateMiBs records)"),
+    ("loaderprefetch", None, "scenario_prefetch", "int", 0, "misc",
+     "[internal] dataloader emulation: max batches the reader may run "
+     "ahead of the consume clock"),
+    ("loaderdecodeusec", None, "scenario_decode_usec", "int", 0, "misc",
+     "[internal] dataloader emulation: CPU decode burn per batch "
+     "(busy-spin microseconds)"),
+    ("loaderstepusec", None, "scenario_step_usec", "int", 0, "misc",
+     "[internal] dataloader emulation: consume cadence — one batch "
+     "per this many microseconds (0 = unpaced)"),
+    ("loaderbatchblocks", None, "scenario_batch_blocks", "int", 0, "misc",
+     "[internal] dataloader emulation: blocks per batch/step"),
+    ("scencreates", None, "scenario_creates_files", "bool", False, "misc",
+     "[internal] the expanded scenario plan contains a write leg — "
+     "file-mode fd opens need O_CREAT even though the explicit phase "
+     "flags stay off under --scenario (set by validate_scenario on the "
+     "master, shipped to services on the wire)"),
+
     # misc
     ("configfile", "c", "config_file_path", "str", "", "misc",
      "Read benchmark settings from this file (ini-style: flag = value)"),
@@ -954,7 +1002,11 @@ class BenchConfig(BenchConfigBase):
                 # defaults is recomputed, never validated against
                 detected = True
                 if not cur_size and (self.run_read_files
-                                     or self.run_create_files):
+                                     or self.run_create_files
+                                     or self.scenario):
+                    # a scenario always reads and/or writes the dataset,
+                    # so a missing file without -s must refuse exactly
+                    # like -w/-r would, not run a silent 0-byte plan
                     raise ConfigError(
                         "file size must not be 0 when benchmark path is "
                         f"a file (give -s): {p}")
@@ -963,15 +1015,42 @@ class BenchConfig(BenchConfigBase):
                     f"NOTE: Auto-setting file size. Size: {cur_size}; "
                     f"Path: {p}")
                 self.file_size = cur_size
-            elif not self.run_create_files and st is not None \
+            elif not self.run_create_files \
+                    and not self._scenario_writes_dataset() \
+                    and st is not None \
                     and cur_size < self.file_size \
                     and stat_mod.S_ISREG(st.st_mode):
+                # a scenario's write legs (setup, ckpt saves) grow the
+                # file to -s themselves, so the read-only size refusal
+                # does not apply to a plan that writes — but a write-less
+                # plan (e.g. --scenario-opt setup=0) must refuse an
+                # undersized file here, exactly like plain -r would
                 # ignore character devices like /dev/zero, as the
                 # reference does
                 raise ConfigError(
                     f"given size to use is larger than detected size. "
                     f"File: {p}; Detected size: {cur_size}; "
                     f"Given size: {self.file_size}")
+
+    def _scenario_writes_dataset(self) -> bool:
+        """Whether the effective run's scenario plan contains a write
+        leg. Master side this expands the plan (the probe runs before
+        check()/validate_scenario set scenario_creates_files); a service
+        sees the wire-shipped scenario_creates_files instead — the
+        scenario name itself is stripped from its config."""
+        if self.scenario_creates_files:
+            return True
+        if not self.scenario:
+            return False
+        try:
+            from ..phases import BenchPhase
+            from ..scenarios import expand_scenario
+            plan = expand_scenario(self)
+        except ConfigError:
+            # a bad scenario/knob gets its own config-time error from
+            # validate_scenario; don't mask it with a size refusal here
+            return True
+        return any(s.phase == BenchPhase.CREATEFILES for s in plan.steps)
 
     def _calc_dataset_threads(self) -> None:
         """numDataSetThreads = threads * hosts if paths shared between
@@ -1035,8 +1114,19 @@ class BenchConfig(BenchConfigBase):
                 self.tpu_ids = [0]  # default to the first chip
             if not self.file_size:
                 self.file_size = 256 << 20  # sensible default amount
-        if self.num_rwmix_read_threads and not self.run_create_files:
-            raise ConfigError("--rwmixthr requires the write phase (-w)")
+        if self.num_rwmix_read_threads and not self.run_create_files \
+                and not self.scenario_step_label:
+            # the step-label exemption covers the service side only: a
+            # contend step ships its overlay with the phase flags
+            # stripped but the label set. A USER-given --rwmixthr next
+            # to --scenario still lands here (label empty at parse
+            # time) — the scenario engine owns the thread split, and a
+            # stray rwmixthr would convert setup-write threads into
+            # readers of files not yet written
+            raise ConfigError(
+                "--rwmixthr requires the write phase (-w)"
+                + ("; with --scenario use the contend scenario's "
+                   "readthreads knob instead" if self.scenario else ""))
 
     @staticmethod
     def _default_results_base() -> str:
@@ -1453,6 +1543,21 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--resume replays a run journal — give --journal FILE "
                 "(the same path the interrupted run journaled to)")
+        if self.scenario_opts_str and not self.scenario:
+            raise ConfigError(
+                "--scenario-opt tunes a --scenario; give --scenario NAME")
+        if self.shuffle_window:
+            if self.use_random_offsets or self.do_reverse_seq_offsets \
+                    or self.do_strided_access or self.use_mmap:
+                raise ConfigError(
+                    "--shufflewindow drives its own offset permutation — "
+                    "incompatible with --rand/--backward/--strided/--mmap")
+            if self.block_size and self.shuffle_window < self.block_size:
+                raise ConfigError(
+                    "--shufflewindow must be at least one --block")
+        if self.scenario:
+            from ..scenarios import validate_scenario
+            validate_scenario(self)
         if self.run_netbench:
             if not self.hosts and not self.netbench_total_hosts:
                 raise ConfigError(
@@ -1575,6 +1680,13 @@ class BenchConfig(BenchConfigBase):
         # the lease advertisement the service watchdog arms on)
         d["journal_file_path"] = ""
         d["resume_run"] = False
+        # scenario composition is master-side: services receive each
+        # step's EFFECTIVE config (the overlay knobs below stay on the
+        # wire: shuffle_window, scenario_epoch, the loader pacing set,
+        # scenario_step_label), never the plan itself — a service must
+        # not re-expand and re-run the whole scenario per step
+        d["scenario"] = ""
+        d["scenario_opts_str"] = ""
         d["num_dataset_threads_override"] = self.num_dataset_threads
         if self.assign_tpu_per_service and self.tpu_ids:
             # --tpuperservice: round-robin chips across service instances —

@@ -35,6 +35,8 @@ class Coordinator:
         self._flightrec = None   # FlightRecorder when --flightrec
         self._journal = None     # RunJournal when --journal
         self._resume_plan = None  # ResumePlan when --resume
+        self._scenario_plan = None  # ScenarioPlan when --scenario
+        self._last_phase_results = None  # PhaseResults of the last phase
 
     # ------------------------------------------------------------------
 
@@ -61,6 +63,13 @@ class Coordinator:
         self._install_signal_handler()
         try:
             try:
+                if cfg.scenario:
+                    # expand ONCE; the same plan object drives the
+                    # journal's run_start, the resume filter and the
+                    # step loop (journal.config_fingerprint re-expands
+                    # deterministically for the hash)
+                    from .scenarios import expand_scenario
+                    self._scenario_plan = expand_scenario(cfg)
                 if self._setup_journal():
                     return 0  # --resume against a complete journal
             except (ConfigError, OSError) as err:
@@ -152,7 +161,13 @@ class Coordinator:
             # (that restart point is someone's resume) and truncates a
             # complete one — mixing runs in one file would poison every
             # later --resume replay
-            self._journal.start_fresh(cfg.enabled_phases(), cfg.iterations)
+            if self._scenario_plan is not None:
+                self._journal.start_fresh(
+                    self._scenario_plan.phases(), cfg.iterations,
+                    scenario=self._scenario_plan.describe())
+            else:
+                self._journal.start_fresh(cfg.enabled_phases(),
+                                          cfg.iterations)
         return False
 
     def _merge_fleet_trace(self) -> None:
@@ -261,6 +276,9 @@ class Coordinator:
         host rotation still applies to skipped slots so the re-run phases
         see the same rank assignments the original run would have."""
         cfg = self.cfg
+        if self._scenario_plan is not None:
+            self._run_scenario()
+            return
         phases = cfg.enabled_phases()
         from .phases import phase_name
         for iteration in range(cfg.iterations):
@@ -284,18 +302,263 @@ class Coordinator:
                         time.sleep(cfg.next_phase_delay_secs)
                     self._rotate_hosts()
 
+    # ------------------------------------------------------------------
+    # training-ingest scenarios (--scenario; docs/scenarios.md)
+    # ------------------------------------------------------------------
+
+    def _run_scenario(self) -> None:
+        """Drive the expanded scenario plan through the unchanged phase
+        machinery: per step, apply the config overlay (re-shipping it to
+        the services when the wire-relevant effective config changed),
+        run the phase journaled under the step's plan index, and collect
+        a per-step summary for the scenario-level verdict."""
+        cfg = self.cfg
+        plan = self._scenario_plan
+        from .phases import phase_name
+        from .scenarios.verdict import analyze_scenario
+        logger.log(0, f"Scenario {plan.name}: {len(plan.steps)} step(s) — "
+                      + ", ".join(s.label for s in plan.steps))
+        self.statistics.print_phase_results_table_header()
+        finished = self._resume_plan.finished \
+            if self._resume_plan is not None else set()
+        runs = plan.resume_runs(finished)
+        # every attribute any step overlays, snapshotted once so each
+        # step starts from the BASE config, not the previous overlay
+        base = {}
+        for step in plan.steps:
+            for key in step.overlay:
+                base.setdefault(key, getattr(cfg, key))
+        base.setdefault("scenario_step_label", cfg.scenario_step_label)
+        base.setdefault("scenario_epoch", cfg.scenario_epoch)
+        # what the initial prepare_threads shipped to the services; the
+        # step label is log-only and never worth a fleet re-prepare
+        wire_keys = sorted(set(base) - {"scenario_step_label"})
+
+        def wire_relevant(overlay: dict) -> dict:
+            """The overlay keys a service actually consumes. The only
+            service-side reader of scenario_epoch is the shuffle seed,
+            so without a shuffle window in effect an epoch-only change
+            (coldwarm's measured legs) must not bounce the fleet — the
+            epoch tag on the records is stamped master-side."""
+            eff = {k: overlay[k] for k in wire_keys}
+            if not eff.get("shuffle_window", cfg.shuffle_window):
+                eff.pop("scenario_epoch", None)
+            return eff
+
+        shipped = wire_relevant(base)
+        summaries: "list[dict]" = []
+        ran_any = False
+        try:
+            for idx, step in enumerate(plan.steps):
+                if not runs[idx]:
+                    logger.log(0, f"RESUME: skipping finished scenario "
+                                  f"step {step.label} "
+                                  f"({phase_name(step.phase)})")
+                    continue
+                if self._skip_mkdirs_leg(step):
+                    continue
+                if step.delay_secs:
+                    time.sleep(step.delay_secs)
+                elif ran_any and cfg.next_phase_delay_secs:
+                    # --phasedelay idles between scenario steps exactly
+                    # like between plain phases; a step's own interval
+                    # knob (ckpt-burst) wins over it
+                    time.sleep(cfg.next_phase_delay_secs)
+                overlay = {**base, **step.overlay,
+                           "scenario_step_label": step.label,
+                           "scenario_epoch": step.epoch}
+                for key, val in overlay.items():
+                    setattr(cfg, key, val)
+                from .phases import UNJOURNALED_PHASES
+                if step.phase not in UNJOURNALED_PHASES:
+                    # master mode ships the full config once per prepare
+                    # (/preparephase): an overlay that changes the wire
+                    # config needs a fleet re-prepare — the rotate-hosts
+                    # rebuild, reused (identical-overlay steps share
+                    # one). Sync/dropcaches legs never read the overlay,
+                    # so they must not bounce the fleet just because the
+                    # epoch tag reverted between two measured steps.
+                    effective = wire_relevant(overlay)
+                    if cfg.hosts and effective != shipped:
+                        self._rebuild_manager()
+                    shipped = effective
+                self._last_phase_results = None
+                ran_any = True
+                try:
+                    self._run_journaled_phase(0, idx, step.phase,
+                                              step_label=step.label)
+                except WorkerException as err:
+                    if not step.best_effort:
+                        raise
+                    # sync/dropcaches legs degrade LOUDLY, never fatally:
+                    # an unprivileged run still measures, but its "cold"
+                    # epochs are flagged in the verdict evidence
+                    logger.log_error(
+                        f"scenario step {step.label} failed ({err}); "
+                        f"continuing — best-effort leg, later cold "
+                        f"epochs may not be cold")
+                    summaries.append({"Label": step.label,
+                                      "Role": step.role,
+                                      "Phase": phase_name(step.phase),
+                                      "Failed": True})
+                    self._mark_cold_degraded(plan, idx, summaries)
+                    if cfg.hosts:
+                        # a failed phase leaves the RemoteWorkers in
+                        # their terminal error state (unlike local
+                        # workers, which respawn per phase) — the next
+                        # measured leg needs a fresh fleet prepare
+                        self._rebuild_manager()
+                        shipped = wire_relevant(overlay)
+                    continue
+                summaries.append(self._scenario_step_summary(step))
+        finally:
+            for key, val in base.items():  # never leak the last overlay
+                setattr(cfg, key, val)
+        self._finish_scenario(plan, summaries, analyze_scenario)
+
+    def _skip_mkdirs_leg(self, step) -> bool:
+        """The expansion emits the setup.mkdirs leg whenever the bench
+        path type is DIR **or unknown** (master mode cannot probe the
+        remote path at expansion time) — but by the time the step loop
+        runs, prepare_threads has exchanged the services' probed path
+        type into cfg.bench_path_type. A file/blockdev fleet must skip
+        the leg instead of hammering CREATEDIRS against a file."""
+        from .phases import BenchPathType, BenchPhase
+        if step.phase != BenchPhase.CREATEDIRS or step.role != "setup":
+            return False
+        if self.cfg.bench_path_type == BenchPathType.DIR:
+            return False
+        logger.log(0, f"Skipping scenario step {step.label}: bench path "
+                      f"is not a directory")
+        return True
+
+    @staticmethod
+    def _mark_cold_degraded(plan, failed_idx: int,
+                            summaries: "list[dict]") -> None:
+        """A failed cache-drop leg taints the cold labels that depend on
+        it — record the degradation on the summary side so the verdict
+        can say so instead of publishing a fake cold/warm ratio."""
+        if plan.steps[failed_idx].role != "cachedrop":
+            return
+        for step in plan.steps[failed_idx + 1:]:
+            if step.cold:
+                summaries.append({"__cold_degraded__": step.label})
+                return
+
+    def _scenario_step_summary(self, step) -> dict:
+        """Per-step result summary feeding scenarios/verdict.py — the
+        cross-leg numbers only (full records live in the JSON file)."""
+        res = self._last_phase_results
+        cfg = self.cfg
+        if res is None:  # phase ran without a result (should not happen)
+            return {"Label": step.label, "Role": step.role,
+                    "Epoch": step.epoch, "Failed": True}
+        last_s = res.last_done_usec / 1e6 or 1e-9
+        mibs = round(res.final["bytes"] / last_s / (1 << 20), 2)
+        read_mibs = round(res.final_rwmix["bytes"] / last_s / (1 << 20), 2)
+        out = {
+            "Label": step.label,
+            "Role": step.role,
+            "Epoch": step.epoch,
+            "Cold": step.cold,
+            "Phase": res.phase_name,
+            "ElapsedUSec": res.last_done_usec,
+            "Bytes": res.final["bytes"],
+            "Entries": res.final["entries"],
+            "MiBPerSec": mibs,
+            "ReadMiBPerSec": read_mibs,
+            "EpochRate": mibs if step.epoch else 0,
+            "NumWorkers": res.num_workers,
+            # fleet-wide thread counts: NumWorkers counts RemoteWorkers
+            # (= hosts) in master mode, so per-thread normalization in
+            # the verdicts needs the real totals
+            "TotalThreads": cfg.num_threads * max(1, len(cfg.hosts) or 1),
+            "ReadThreads": step.overlay.get("num_rwmix_read_threads", 0)
+            * max(1, len(cfg.hosts) or 1),
+            "BlockSize": cfg.block_size,
+        }
+        for knob, key in (("scenario_step_usec", "LoaderStepUSec"),
+                          ("scenario_batch_blocks", "LoaderBatchBlocks"),
+                          ("scenario_prefetch", "LoaderPrefetch"),
+                          ("scenario_decode_usec", "LoaderDecodeUSec")):
+            if step.overlay.get(knob):
+                out[key] = step.overlay[knob]
+        if res.analysis is not None:
+            # the per-phase doctor's stage decomposition (--flightrec):
+            # what the scenario verdict compares ACROSS legs
+            out["Analysis"] = {k: res.analysis[k] for k in
+                               ("Verdict", "BottleneckStage", "StagePct")}
+        return out
+
+    def _finish_scenario(self, plan, summaries: "list[dict]",
+                         analyze_scenario) -> None:
+        """Compute + print the scenario-level verdicts and append the
+        terminal SCENARIO record to the JSON results, so summarize/chart
+        and the artifact pipeline see the analysis without new files."""
+        degraded = {s["__cold_degraded__"] for s in summaries
+                    if "__cold_degraded__" in s}
+        steps = [s for s in summaries if "__cold_degraded__" not in s]
+        for s in steps:
+            if s.get("Label") in degraded:
+                s["ColdDegraded"] = True
+        analysis = analyze_scenario(plan.name, steps)
+        for v in analysis["Verdicts"]:
+            logger.log(0, f"Scenario verdict [{v['Kind']}]: "
+                          f"{v['Verdict']}")
+            for ev in v["Evidence"]:
+                logger.log(1, f"  - {ev}")
+        if not analysis["Verdicts"]:
+            logger.log(0, "Scenario verdict: inconclusive (not enough "
+                          "finished legs to compare)")
+        cfg = self.cfg
+        if cfg.json_file_path:
+            import json as json_mod
+            rec = {"ISODate": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                   "Label": cfg.bench_label,
+                   "Phase": "SCENARIO",
+                   "Scenario": plan.name,
+                   "ScenarioStep": "summary",
+                   "ScenarioAnalysis": analysis}
+            with open(cfg.json_file_path, "a") as f:
+                f.write(json_mod.dumps(rec) + "\n")
+
+    def _rebuild_manager(self) -> None:
+        """Tear down the worker fleet and re-prepare it against the
+        CURRENT cfg — the mechanism behind --rotatehosts re-ranking and
+        scenario overlay re-shipping (master mode posts the full config
+        at /preparephase, so a changed step config needs a fresh
+        prepare). Keeps tracer/telemetry/flightrec across the rebuild."""
+        old_tracer = self.manager.shared.tracer
+        self.manager.join_all_threads()
+        from .workers.manager import WorkerManager
+        self.manager = WorkerManager(self.cfg)
+        if old_tracer is not None:
+            # keep the run's span ring across the rebuild: a fresh tracer
+            # at the same path would overwrite the file and silently drop
+            # every earlier span at the next phase-end write()
+            self.manager.shared.tracer = old_tracer
+        self.statistics = Statistics(self.cfg, self.manager)
+        self.statistics.telemetry = self._telemetry  # follow the rebuild
+        self.statistics.flightrec = self._flightrec  # keep recording
+        self.manager.prepare_threads()
+
     def _run_journaled_phase(self, iteration: int, idx: int,
-                             phase: BenchPhase) -> None:
+                             phase: BenchPhase,
+                             step_label: str = "") -> None:
         """One table phase, bracketed by journal records: the fsync'd
         phase_start makes a later crash provable (no finish record = the
         phase did not complete), phase_interrupted marks signal/error
-        aborts, phase_finish carries per-host result summaries."""
+        aborts, phase_finish carries per-host result summaries. Scenario
+        steps pass their label so the records stay human-readable;
+        sync/dropcaches legs stay out of the journal here exactly like
+        the interleave (UNJOURNALED_PHASES) — a resume must never treat
+        a cache drop as finished work."""
         from .phases import UNJOURNALED_PHASES
         if self._journal is None or phase in UNJOURNALED_PHASES:
             self.run_benchmark_phase(phase)
             return
         self._journal_write(self._journal.phase_start, iteration, idx,
-                            phase)
+                            phase, step_label)
         try:
             self.run_benchmark_phase(phase)
         except BaseException as err:
@@ -303,12 +566,13 @@ class Coordinator:
                 else type(err).__name__
             try:  # best effort: never mask the original abort cause
                 self._journal.phase_interrupted(iteration, idx, phase,
-                                                reason)
+                                                reason, step_label)
             except OSError:
                 pass
             raise
         self._journal_write(self._journal.phase_finish, iteration, idx,
-                            phase, self._phase_host_summaries())
+                            phase, self._phase_host_summaries(),
+                            step_label)
 
     def _journal_write(self, method, *args) -> None:
         """A mid-run journal append failure (disk full, lost mount) must
@@ -386,7 +650,7 @@ class Coordinator:
                     tracer.write()
                 except OSError as err:
                     logger.log_error(f"--tracefile write failed: {err}")
-        self.statistics.print_phase_results(phase)
+        self._last_phase_results = self.statistics.print_phase_results(phase)
         if self._interrupted:
             # user Ctrl-C: print what we have for this phase, then abort the
             # remaining phases (reference: handleInterruptSignal semantics)
@@ -456,18 +720,7 @@ class Coordinator:
         if not k:
             return
         cfg.hosts = cfg.hosts[k:] + cfg.hosts[:k]
-        old_tracer = self.manager.shared.tracer
-        self.manager.join_all_threads()
-        self.manager = WorkerManager(cfg)
-        if old_tracer is not None:
-            # keep the run's span ring across the rebuild: a fresh tracer
-            # at the same path would overwrite the file and silently drop
-            # every pre-rotation span at the next phase-end write()
-            self.manager.shared.tracer = old_tracer
-        self.statistics = Statistics(cfg, self.manager)
-        self.statistics.telemetry = self._telemetry  # follow the rebuild
-        self.statistics.flightrec = self._flightrec  # keep recording
-        self.manager.prepare_threads()
+        self._rebuild_manager()
 
     # ------------------------------------------------------------------
 

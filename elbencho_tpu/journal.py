@@ -104,6 +104,14 @@ def config_fingerprint(cfg) -> str:
         paths = [os.path.abspath(p) for p in paths]
     vals["paths"] = paths
     vals["hosts"] = list(getattr(cfg, "hosts", []))
+    if getattr(cfg, "scenario", ""):
+        # fingerprint the EXPANDED plan, not just the scenario name +
+        # knob string: a changed built-in expansion (new default epoch
+        # count, reordered steps in a newer version) must mismatch —
+        # the journal's (iteration, index) records are only meaningful
+        # against the exact step list they were written for
+        from .scenarios import expand_scenario
+        vals["scenario_plan"] = expand_scenario(cfg).describe()
     blob = json.dumps(vals, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode()).hexdigest()
 
@@ -139,7 +147,8 @@ class RunJournal:
 
     # -- lifecycle records --------------------------------------------------
 
-    def start_fresh(self, phases, iterations: int) -> None:
+    def start_fresh(self, phases, iterations: int,
+                    scenario: "dict | None" = None) -> None:
         """Begin a NEW journaled run at this path. An existing journal
         holding an INCOMPLETE run is refused (it is a restart point —
         resume it with --resume or remove the file); a completed one is
@@ -161,41 +170,60 @@ class RunJournal:
                     f"resume it with --resume, or remove the file to "
                     f"start over")
             os.truncate(self.path, 0)
-        self.run_start(phases, iterations)
+        self.run_start(phases, iterations, scenario)
 
-    def run_start(self, phases, iterations: int) -> None:
+    def run_start(self, phases, iterations: int,
+                  scenario: "dict | None" = None) -> None:
         from . import __version__
         from .phases import phase_name
+        fields = {}
+        if scenario is not None:
+            # the expanded scenario plan (scenarios.ScenarioPlan
+            # .describe()): human-readable restart context — the binding
+            # contract is the fingerprint, which hashes the same plan
+            fields["scenario"] = scenario
         self._append(REC_RUN_START,
                      fingerprint=self.fingerprint,
                      version=__version__,
                      label=self.cfg.bench_label,
                      iterations=iterations,
                      phases=[{"code": int(p), "name": phase_name(p)}
-                             for p in phases])
+                             for p in phases],
+                     **fields)
 
     def resume(self, num_skipped: int) -> None:
         self._append(REC_RESUME, fingerprint=self.fingerprint,
                      skipped_phases=num_skipped)
 
-    def phase_start(self, iteration: int, idx: int,
-                    phase: BenchPhase) -> None:
+    @staticmethod
+    def _step_fields(step_label: str) -> dict:
+        # scenario runs label their phase records with the step identity
+        # ("epoch2", "ckpt1.save"); resume matching stays on
+        # (iteration, index) so the label is context, not contract
+        return {"step": step_label} if step_label else {}
+
+    def phase_start(self, iteration: int, idx: int, phase: BenchPhase,
+                    step_label: str = "") -> None:
         from .phases import phase_name
         self._append(REC_PHASE_START, iteration=iteration, index=idx,
-                     code=int(phase), name=phase_name(phase))
+                     code=int(phase), name=phase_name(phase),
+                     **self._step_fields(step_label))
 
     def phase_finish(self, iteration: int, idx: int, phase: BenchPhase,
-                     host_summaries: "dict[str, dict]") -> None:
+                     host_summaries: "dict[str, dict]",
+                     step_label: str = "") -> None:
         from .phases import phase_name
         self._append(REC_PHASE_FINISH, iteration=iteration, index=idx,
                      code=int(phase), name=phase_name(phase),
-                     hosts=host_summaries)
+                     hosts=host_summaries, **self._step_fields(step_label))
 
     def phase_interrupted(self, iteration: int, idx: int,
-                          phase: BenchPhase, reason: str) -> None:
+                          phase: BenchPhase, reason: str,
+                          step_label: str = "") -> None:
         from .phases import phase_name
         self._append(REC_PHASE_INTERRUPTED, iteration=iteration, index=idx,
-                     code=int(phase), name=phase_name(phase), reason=reason)
+                     code=int(phase), name=phase_name(phase), reason=reason,
+                     **self._step_fields(step_label))
 
     def run_complete(self) -> None:
         self._append(REC_RUN_COMPLETE, fingerprint=self.fingerprint)

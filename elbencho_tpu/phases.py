@@ -77,7 +77,12 @@ PHASE_NAMES = {
 #: phases the run journal (--journal) does NOT record: the sync/dropcaches
 #: interleave is cheap, idempotent, and its effect (kernel cache state)
 #: does not survive a crash anyway — a --resume re-runs it around the
-#: first re-run phase instead of trusting stale records
+#: first re-run phase instead of trusting stale records. Scenario plans
+#: (--scenario) route their explicit sync/dropcaches legs through the
+#: same set: a coldwarm resume must never replay a cache drop as
+#: "finished work" (scenarios/plan.py ScenarioPlan.resume_runs decides
+#: when such a leg re-executes: exactly when its following journaled
+#: step does).
 UNJOURNALED_PHASES = frozenset({
     BenchPhase.IDLE, BenchPhase.TERMINATE,
     BenchPhase.SYNC, BenchPhase.DROPCACHES,

@@ -1,0 +1,20 @@
+"""Training-ingest scenario suite: compose phases into the workloads
+people actually size TPU storage for (docs/scenarios.md).
+
+The phase engine speaks elbencho's primitives (write/read/stat/delete
+plus TPUSLICE); the questions users ask are workload-shaped: "do
+checkpoint bursts starve my train reads?", "what does epoch 2 look like
+warm?". ``--scenario NAME`` (with ``--scenario-opt key=val`` knobs)
+expands a named scenario into a plan of existing phases with per-step
+config overlays, runs it through the unchanged coordinator/worker/
+service machinery, and tags every emitted record with scenario + step
+identity so the whole JSON/telemetry/doctor toolchain works without
+modification (arXiv 2604.21275: shuffle windows, prefetch depth and
+consume cadence — not raw sequential bandwidth — determine real
+input-pipeline throughput).
+"""
+
+from .plan import (SCENARIOS, ScenarioPlan, ScenarioStep,  # noqa: F401
+                   expand_scenario, parse_scenario_opts,
+                   validate_scenario)
+from .verdict import analyze_scenario  # noqa: F401

@@ -1,0 +1,386 @@
+"""Scenario expansion: named scenario + knobs -> an ordered step plan.
+
+A step is ONE existing benchmark phase plus a config overlay the
+coordinator applies (and, in master mode, re-ships to the services) for
+that step only — the scenario layer composes, the phase machinery runs.
+Expansion is deterministic for a given effective config, which is what
+lets the run journal fingerprint the EXPANDED plan: a ``--resume``
+against a journal written by a different expansion (changed knobs, or a
+changed built-in default) is a hard mismatch, not a silent re-plan.
+
+Sync/dropcaches legs ride along as explicit steps marked
+``best_effort`` — they stay out of the journal (``UNJOURNALED_PHASES``)
+and a resume must never replay a cache drop as "finished work"; see
+``ScenarioPlan.resume_runs``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..config.args import ConfigError
+from ..phases import UNJOURNALED_PHASES, BenchPhase
+from ..toolkits.units import parse_size
+
+
+@dataclasses.dataclass
+class ScenarioStep:
+    """One phase of a scenario plan with its per-step config overlay."""
+
+    phase: BenchPhase
+    label: str                 # "epoch2", "ckpt1.save", ... (record tag)
+    overlay: dict = dataclasses.field(default_factory=dict)
+    epoch: int = 0             # > 0 tags an epoch-rate leg (EpochRateMiBs)
+    role: str = ""             # setup|epoch|save|restore|baseline|contend|
+                               # loader|cachedrop|sync
+    delay_secs: int = 0        # sleep before the step (--scenario-opt interval)
+    cold: bool = False         # coldwarm: leg measured behind a cache drop
+    best_effort: bool = False  # failure logs LOUDLY but does not abort
+
+    def describe(self) -> dict:
+        """JSON-able identity of this step (journal + fingerprint)."""
+        return {"phase": int(self.phase), "label": self.label,
+                "overlay": {k: self.overlay[k]
+                            for k in sorted(self.overlay)},
+                "epoch": self.epoch, "role": self.role,
+                "delay_secs": self.delay_secs, "cold": self.cold}
+
+
+@dataclasses.dataclass
+class ScenarioPlan:
+    name: str
+    opts: dict
+    steps: "list[ScenarioStep]"
+
+    def describe(self) -> dict:
+        """JSON-able plan identity for the journal's run_start record and
+        the config fingerprint."""
+        return {"name": self.name,
+                "opts": {k: str(self.opts[k]) for k in sorted(self.opts)},
+                "steps": [s.describe() for s in self.steps]}
+
+    def phases(self) -> "list[BenchPhase]":
+        return [s.phase for s in self.steps]
+
+    def resume_runs(self, finished: "set[tuple[int, int]]",
+                    iteration: int = 0) -> "list[bool]":
+        """Which steps a --resume run executes. Journaled steps follow
+        the normal rule (skip when a phase_finish record exists).
+        Unjournaled legs (sync/dropcaches) never have records — they run
+        exactly when the NEXT journaled step runs, so a coldwarm resume
+        re-drops caches for the epoch it re-runs but never replays a
+        drop in front of a skipped (finished) epoch."""
+        runs: "list[bool]" = []
+        for idx, step in enumerate(self.steps):
+            if step.phase not in UNJOURNALED_PHASES:
+                runs.append((iteration, idx) not in finished)
+                continue
+            nxt = next((j for j in range(idx + 1, len(self.steps))
+                        if self.steps[j].phase not in UNJOURNALED_PHASES),
+                       None)
+            runs.append(nxt is None or (iteration, nxt) not in finished)
+        return runs
+
+
+# ---------------------------------------------------------------------------
+# knob parsing
+# ---------------------------------------------------------------------------
+
+def parse_scenario_opts(opts_str: str) -> "dict[str, str]":
+    """``--scenario-opt epochs=4,window=16M`` -> {"epochs": "4", ...}.
+    Malformed pairs fail at config time, not mid-run."""
+    out: "dict[str, str]" = {}
+    for part in (opts_str or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, val = part.partition("=")
+        if not eq or not key.strip() or not val.strip():
+            raise ConfigError(
+                f"--scenario-opt entries must be key=val pairs, got "
+                f"{part!r}")
+        out[key.strip()] = val.strip()
+    return out
+
+
+def _opt_int(opts: dict, key: str, default: int, lo: int = 0) -> int:
+    raw = opts.get(key)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ConfigError(
+            f"--scenario-opt {key}={raw!r} is not an integer") from None
+    if val < lo:
+        raise ConfigError(f"--scenario-opt {key} must be >= {lo}")
+    return val
+
+
+def _opt_size(opts: dict, key: str, default: int) -> int:
+    raw = opts.get(key)
+    if raw is None:
+        return default
+    try:
+        val = parse_size(raw)
+    except ValueError as err:
+        raise ConfigError(f"--scenario-opt {key}={raw!r}: {err}") from None
+    if val < 0:
+        raise ConfigError(f"--scenario-opt {key} must be >= 0")
+    return val
+
+
+def _check_known(name: str, opts: dict, known: "tuple[str, ...]") -> None:
+    unknown = sorted(set(opts) - set(known))
+    if unknown:
+        raise ConfigError(
+            f"--scenario {name} does not know --scenario-opt "
+            f"{', '.join(unknown)} (knobs: {', '.join(known)}; "
+            f"docs/scenarios.md)")
+
+
+def _block_multiple(size: int, block: int) -> int:
+    """Overlay sizes follow the same trim the base config gets
+    (_reduce_file_size_to_block_multiple): a trailing partial block
+    would short-read in striped/direct modes."""
+    if block and size and size % block:
+        size -= size % block
+    return max(size, block)
+
+
+def _mkdirs_leg(cfg, steps: "list[ScenarioStep]") -> None:
+    """Dir-mode datasets need their rank/dir namespace created before
+    the first write leg — master mode cannot probe the remote path type
+    at expansion time, so the mkdirs leg is emitted (best-effort there)
+    whenever the type is DIR or unknown."""
+    from ..phases import BenchPathType
+    if cfg.bench_path_type == BenchPathType.DIR or cfg.hosts:
+        steps.append(ScenarioStep(BenchPhase.CREATEDIRS, "setup.mkdirs",
+                                  role="setup",
+                                  best_effort=bool(cfg.hosts)))
+
+
+def _maybe_setup(cfg, opts: dict, steps: "list[ScenarioStep]") -> None:
+    """All read-centric scenarios lay their dataset down first; the
+    ``setup=0`` knob reuses an existing dataset instead."""
+    if not _opt_int(opts, "setup", 1):
+        return
+    _mkdirs_leg(cfg, steps)
+    steps.append(ScenarioStep(BenchPhase.CREATEFILES, "setup",
+                              role="setup"))
+
+
+# ---------------------------------------------------------------------------
+# built-in scenarios
+# ---------------------------------------------------------------------------
+
+def _expand_epochs(cfg, opts: dict) -> "list[ScenarioStep]":
+    """Multi-epoch shuffled shard reads: every epoch reads the whole
+    dataset with block order permuted inside consecutive shuffle windows
+    (the tf.data/PyTorch shuffle-buffer access shape), each epoch under
+    a different permutation seed. Epoch boundaries are phase boundaries,
+    so the flight recorder / tracer mark them for free."""
+    _check_known("epochs", opts, ("epochs", "window", "setup"))
+    epochs = _opt_int(opts, "epochs", 3, lo=1)
+    window = _opt_size(opts, "window", 0)
+    if window and window < cfg.block_size:
+        # same rule as standalone --shufflewindow: a sub-block window
+        # means one block per window, i.e. no shuffling at all — refuse
+        # rather than silently measure an unshuffled "epoch"
+        raise ConfigError(
+            "--scenario-opt window must be at least one --block")
+    if not window:
+        window = 16 * max(cfg.block_size, 1)
+    steps: "list[ScenarioStep]" = []
+    _maybe_setup(cfg, opts, steps)
+    for e in range(1, epochs + 1):
+        steps.append(ScenarioStep(
+            BenchPhase.READFILES, f"epoch{e}", epoch=e, role="epoch",
+            overlay={"shuffle_window": window, "scenario_epoch": e}))
+    return steps
+
+
+def _expand_ckpt_burst(cfg, opts: dict) -> "list[ScenarioStep]":
+    """All-hosts-at-once checkpoint save/restore bursts: every burst
+    writes the checkpoint (CREATEFILES) and reads it back (READFILES),
+    with an optional idle interval between bursts — the burst cadence
+    of a real training job's checkpoint schedule."""
+    _check_known("ckpt-burst", opts, ("bursts", "interval", "size"))
+    bursts = _opt_int(opts, "bursts", 2, lo=1)
+    interval = _opt_int(opts, "interval", 0)
+    size = _opt_size(opts, "size", 0)
+    overlay = {}
+    if size:
+        overlay["file_size"] = _block_multiple(size, cfg.block_size)
+    steps: "list[ScenarioStep]" = []
+    _mkdirs_leg(cfg, steps)  # the save burst IS the dataset write
+    for b in range(1, bursts + 1):
+        steps.append(ScenarioStep(
+            BenchPhase.CREATEFILES, f"ckpt{b}.save", role="save",
+            overlay=dict(overlay),
+            delay_secs=interval if b > 1 else 0))
+        steps.append(ScenarioStep(
+            BenchPhase.READFILES, f"ckpt{b}.restore", role="restore",
+            overlay=dict(overlay)))
+    return steps
+
+
+def _expand_contend(cfg, opts: dict) -> "list[ScenarioStep]":
+    """Mixed train-read + checkpoint-write contention, reusing the
+    --rwmixthr thread-split machinery: after a pure-read baseline leg,
+    the contended leg runs the write phase with ``readthreads`` of its
+    workers converted to train readers — read and write legs share the
+    fleet, and the verdict compares per-thread read rates across legs
+    ("checkpoint writes starve train reads by N%")."""
+    _check_known("contend", opts, ("readthreads", "setup"))
+    default_readers = max(cfg.num_threads // 2, 1)
+    readers = _opt_int(opts, "readthreads", default_readers, lo=1)
+    if readers >= max(cfg.num_threads, 1):
+        raise ConfigError(
+            f"--scenario contend: readthreads={readers} must leave at "
+            f"least one writer of the {cfg.num_threads} --threads")
+    steps: "list[ScenarioStep]" = []
+    _maybe_setup(cfg, opts, steps)
+    steps.append(ScenarioStep(BenchPhase.READFILES, "train.baseline",
+                              role="baseline"))
+    steps.append(ScenarioStep(
+        BenchPhase.CREATEFILES, "contend", role="contend",
+        overlay={"num_rwmix_read_threads": readers}))
+    return steps
+
+
+def _expand_coldwarm(cfg, opts: dict) -> "list[ScenarioStep]":
+    """Cold-vs-warm cache epochs: the first ``cold`` epochs run behind a
+    sync + kernel cache drop, later epochs run warm — the per-epoch rate
+    comparison is what "epoch 2" really looks like. The cache legs are
+    best-effort (an unprivileged run logs LOUDLY and its epochs are
+    labeled not-cold in the verdict) and stay out of the journal."""
+    _check_known("coldwarm", opts, ("epochs", "cold", "setup"))
+    epochs = _opt_int(opts, "epochs", 2, lo=1)
+    cold = _opt_int(opts, "cold", 1)
+    cold = min(cold, epochs)
+    steps: "list[ScenarioStep]" = []
+    _maybe_setup(cfg, opts, steps)
+    if cold:
+        steps.append(ScenarioStep(BenchPhase.SYNC, "sync", role="sync",
+                                  best_effort=True))
+    for e in range(1, epochs + 1):
+        is_cold = e <= cold
+        if is_cold:
+            steps.append(ScenarioStep(
+                BenchPhase.DROPCACHES, f"epoch{e}.dropcaches",
+                role="cachedrop", best_effort=True))
+        steps.append(ScenarioStep(
+            BenchPhase.READFILES,
+            f"epoch{e}.{'cold' if is_cold else 'warm'}",
+            epoch=e, role="epoch", cold=is_cold,
+            overlay={"scenario_epoch": e}))
+    return steps
+
+
+def _expand_dataloader(cfg, opts: dict) -> "list[ScenarioStep]":
+    """Data-loader emulation: the read leg is paced like a training
+    input pipeline — ``batchblocks`` blocks per batch, a CPU decode burn
+    per batch, one batch consumed per ``stepusec``, and the reader
+    allowed at most ``prefetch`` batches ahead of the consume clock — so
+    the result predicts whether storage keeps a real loader fed instead
+    of its burst bandwidth (arXiv 2604.21275)."""
+    _check_known("dataloader", opts, ("prefetch", "decodeusec", "stepusec",
+                                     "batchblocks", "setup"))
+    prefetch = _opt_int(opts, "prefetch", 2, lo=1)
+    decode_usec = _opt_int(opts, "decodeusec", 200)
+    step_usec = _opt_int(opts, "stepusec", 1000)
+    batch_blocks = _opt_int(opts, "batchblocks", 8, lo=1)
+    steps: "list[ScenarioStep]" = []
+    _maybe_setup(cfg, opts, steps)
+    steps.append(ScenarioStep(
+        BenchPhase.READFILES, "loader", epoch=1, role="loader",
+        overlay={"scenario_prefetch": prefetch,
+                 "scenario_decode_usec": decode_usec,
+                 "scenario_step_usec": step_usec,
+                 "scenario_batch_blocks": batch_blocks,
+                 "scenario_epoch": 1}))
+    return steps
+
+
+#: name -> (builder, one-line summary); the summary feeds --help,
+#: docs/scenarios.md and error messages
+SCENARIOS = {
+    "epochs": (_expand_epochs,
+               "multi-epoch shuffled shard reads (windowed permutation)"),
+    "ckpt-burst": (_expand_ckpt_burst,
+                   "all-hosts checkpoint save/restore bursts"),
+    "contend": (_expand_contend,
+                "train-read vs checkpoint-write contention (rwmixthr)"),
+    "coldwarm": (_expand_coldwarm,
+                 "cold-vs-warm cache epochs (dropcaches between cold ones)"),
+    "dataloader": (_expand_dataloader,
+                   "data-loader emulation (prefetch/decode/consume cadence)"),
+}
+
+
+# phase-selection flags a scenario plan replaces; any of them set
+# alongside --scenario is a config error, not a silent merge
+_PHASE_FLAG_ATTRS = (
+    "run_create_files", "run_read_files", "run_create_dirs",
+    "run_delete_dirs", "run_delete_files", "run_stat_files",
+    "run_stat_dirs", "run_sync_phase", "run_drop_caches_phase",
+    "run_netbench", "run_tpu_bench", "run_tpu_slice",
+)
+
+
+def validate_scenario(cfg) -> None:
+    """Config-time validation (called from BenchConfig.check); expansion
+    itself is the validator, so a bad knob fails before any phase
+    runs."""
+    if cfg.scenario not in SCENARIOS:
+        raise ConfigError(
+            f"unknown --scenario {cfg.scenario!r} (have: "
+            f"{', '.join(sorted(SCENARIOS))}; docs/scenarios.md)")
+    conflicting = [a for a in _PHASE_FLAG_ATTRS if getattr(cfg, a)]
+    if conflicting:
+        raise ConfigError(
+            f"--scenario defines the phase plan itself; drop the "
+            f"explicit phase flags ({', '.join(conflicting)})")
+    if cfg.iterations != 1:
+        raise ConfigError(
+            "--scenario plans carry their own epoch/burst structure; "
+            "--iterations must stay 1")
+    if cfg.do_infinite_io_loop:
+        raise ConfigError("--scenario is incompatible with --infloop")
+    if cfg.rotate_hosts_num:
+        raise ConfigError(
+            "--rotatehosts re-ranks the fleet between phases, which "
+            "would reshuffle a scenario's epoch seeds and contention "
+            "legs mid-plan; drop it under --scenario")
+    plan = expand_scenario(cfg)  # knob + geometry validation
+    if any("shuffle_window" in s.overlay for s in plan.steps) \
+            and (cfg.use_random_offsets or cfg.do_reverse_seq_offsets
+                 or cfg.do_strided_access or cfg.use_mmap):
+        # same rule as standalone --shufflewindow (args.check): the
+        # per-step overlay sets shuffle_window at run time, after the
+        # flag-level incompatibility check already passed on 0
+        raise ConfigError(
+            f"--scenario {cfg.scenario} drives its own shuffle-window "
+            f"offset permutation — incompatible with "
+            f"--rand/--backward/--strided/--mmap")
+    # file-mode fd opens gate O_CREAT on run_create_files, which stays
+    # off under --scenario — derive the "this plan writes files" fact
+    # here so the manager/worker opens (and, on the wire, the services'
+    # opens) can create a not-yet-existing file for the write legs
+    cfg.scenario_creates_files = any(
+        s.phase == BenchPhase.CREATEFILES for s in plan.steps)
+
+
+def expand_scenario(cfg) -> ScenarioPlan:
+    """Expand cfg.scenario/--scenario-opt into the step plan. Pure and
+    deterministic over the effective config — the journal fingerprints
+    its output (journal.config_fingerprint)."""
+    if cfg.scenario not in SCENARIOS:
+        raise ConfigError(
+            f"unknown --scenario {cfg.scenario!r} (have: "
+            f"{', '.join(sorted(SCENARIOS))})")
+    opts = parse_scenario_opts(cfg.scenario_opts_str)
+    builder, _summary = SCENARIOS[cfg.scenario]
+    steps = builder(cfg, opts)
+    return ScenarioPlan(name=cfg.scenario, opts=opts, steps=steps)

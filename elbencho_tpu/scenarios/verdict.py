@@ -1,0 +1,217 @@
+"""Scenario-level doctor verdicts: compare the per-leg results (and,
+when the flight recorder armed, the per-leg stage decompositions) of a
+finished scenario and name what they mean for a training workload —
+"checkpoint writes starve train reads by N%", "epoch 2 is M.Mx
+warm-cache", "storage-limited input pipeline".
+
+The per-phase doctor (telemetry/doctor.py) answers WHERE one phase's
+wall time went; this layer answers the cross-leg questions a scenario
+exists to pose. Its output is the ``ScenarioAnalysis`` block of the run
+JSON's terminal SCENARIO record (and the text summary's "Scenario
+verdicts" lines), schema-versioned and append-only like the per-phase
+``Analysis`` block.
+"""
+
+from __future__ import annotations
+
+#: ScenarioAnalysis schema version (run JSON SCENARIO record)
+SCENARIO_ANALYSIS_SCHEMA = 1
+
+#: contention slowdown (per-thread read rate drop, %) at/above which the
+#: contend scenario declares the train reads starved
+CONTENTION_MIN_PCT = 10.0
+
+#: warm/cold epoch rate ratio at/above which coldwarm/epochs declare a
+#: warm-cache effect (below it the dataset simply doesn't fit the cache,
+#: or the storage path already runs at device speed)
+WARM_MIN_RATIO = 1.2
+
+#: achieved/target step-rate ratio at/above which the dataloader
+#: scenario declares the pipeline fed (storage keeps up with the
+#: consume cadence)
+CADENCE_KEEPUP_RATIO = 0.9
+
+#: stage-share growth (percentage points, per-phase doctor StagePct)
+#: worth naming as cross-leg evidence
+STAGE_GROWTH_PTS = 10.0
+
+
+def _rate(step: "dict | None", key: str = "MiBPerSec") -> float:
+    return float((step or {}).get(key) or 0.0)
+
+
+def _stage_growth_evidence(a: "dict | None", b: "dict | None",
+                           label_a: str, label_b: str) -> "list[str]":
+    """Per-leg stage-decomposition comparison (flight-recorder runs
+    only): which doctor stage share grew between leg A and leg B."""
+    out: "list[str]" = []
+    ana_a = (a or {}).get("Analysis") or {}
+    ana_b = (b or {}).get("Analysis") or {}
+    pct_a, pct_b = ana_a.get("StagePct") or {}, ana_b.get("StagePct") or {}
+    for stage in pct_b:
+        grew = float(pct_b.get(stage, 0.0)) - float(pct_a.get(stage, 0.0))
+        if grew >= STAGE_GROWTH_PTS:
+            out.append(f"{stage} share grew {pct_a.get(stage, 0.0):g}% "
+                       f"({label_a}) -> {pct_b.get(stage, 0.0):g}% "
+                       f"({label_b})")
+    if ana_a.get("Verdict") and ana_b.get("Verdict") \
+            and ana_a["Verdict"] != ana_b["Verdict"]:
+        out.append(f"doctor verdict changed {ana_a['Verdict']} "
+                   f"({label_a}) -> {ana_b['Verdict']} ({label_b})")
+    return out
+
+
+def _verdict(kind: str, verdict: str, metric: "float | None",
+             evidence: "list[str]") -> dict:
+    return {"Kind": kind, "Verdict": verdict,
+            "Metric": round(metric, 3) if metric is not None else None,
+            "Evidence": evidence}
+
+
+def _contention_verdict(steps: "list[dict]") -> "dict | None":
+    base = next((s for s in steps if s.get("Role") == "baseline"), None)
+    cont = next((s for s in steps if s.get("Role") == "contend"), None)
+    if base is None or cont is None:
+        return None
+    base_threads = max(int(base.get("TotalThreads")
+                           or base.get("NumWorkers") or 1), 1)
+    cont_readers = max(int(cont.get("ReadThreads") or 1), 1)
+    per_thr_base = _rate(base) / base_threads
+    per_thr_cont = _rate(cont, "ReadMiBPerSec") / cont_readers
+    if per_thr_base <= 0:
+        return None
+    slowdown = 100.0 * (1.0 - per_thr_cont / per_thr_base)
+    evidence = [
+        f"baseline train read {per_thr_base:.1f} MiB/s per thread "
+        f"({base_threads} threads)",
+        f"contended train read {per_thr_cont:.1f} MiB/s per thread "
+        f"({cont_readers} reader threads beside "
+        f"{_rate(cont):.1f} MiB/s of checkpoint writes)",
+    ]
+    evidence += _stage_growth_evidence(base, cont, "baseline", "contended")
+    if slowdown >= CONTENTION_MIN_PCT:
+        text = (f"checkpoint writes starve train reads by "
+                f"{slowdown:.0f}% (per-thread read rate vs the "
+                f"uncontended baseline)")
+    else:
+        text = (f"train reads essentially unaffected by concurrent "
+                f"checkpoint writes ({slowdown:.0f}% per-thread drop)")
+    return _verdict("contention", text, slowdown, evidence)
+
+
+def _warmup_verdict(steps: "list[dict]") -> "dict | None":
+    epochs = [s for s in steps if s.get("Epoch")]
+    if len(epochs) < 2:
+        return None
+    cold = [s for s in epochs if s.get("Cold")]
+    effective_cold = [s for s in cold if not s.get("ColdDegraded")]
+    reference = (effective_cold or cold or epochs[:1])[0]
+    # compare against genuinely warm epochs; only when every other
+    # epoch is also cold (e.g. cold == epochs) fall back to them —
+    # a cold epoch must never masquerade as the warm-cache evidence
+    warm = [s for s in epochs if s is not reference
+            and not s.get("Cold")] \
+        or [s for s in epochs if s is not reference]
+    cold_rate = _rate(reference, "EpochRate") or _rate(reference)
+    best = max(warm, key=lambda s: _rate(s, "EpochRate") or _rate(s))
+    best_rate = _rate(best, "EpochRate") or _rate(best)
+    if cold_rate <= 0:
+        return None
+    ratio = best_rate / cold_rate
+    evidence = [f"{s['Label']}: "
+                f"{_rate(s, 'EpochRate') or _rate(s):.1f} MiB/s"
+                for s in epochs]
+    if cold and any(s.get("ColdDegraded") for s in cold):
+        evidence.append(
+            "WARNING: a cache-drop leg failed (unprivileged run?) — "
+            "the 'cold' epochs may have run warm")
+    evidence += _stage_growth_evidence(best, reference,
+                                       best["Label"], reference["Label"])
+    if ratio >= WARM_MIN_RATIO:
+        text = (f"{best['Label']} is {ratio:.1f}x warm-cache vs "
+                f"{reference['Label']}")
+    else:
+        text = (f"no significant warm-cache effect: {best['Label']} runs "
+                f"{ratio:.2f}x {reference['Label']} (dataset exceeds the "
+                f"cache, or storage already at device speed)")
+    return _verdict("cache-warmup", text, ratio, evidence)
+
+
+def _burst_verdict(steps: "list[dict]") -> "dict | None":
+    saves = [s for s in steps if s.get("Role") == "save"]
+    restores = [s for s in steps if s.get("Role") == "restore"]
+    if not saves or not restores:
+        return None
+    save_rate = sum(_rate(s) for s in saves) / len(saves)
+    restore_rate = sum(_rate(s) for s in restores) / len(restores)
+    if save_rate <= 0 or restore_rate <= 0:
+        return None  # a zero side has no meaningful asymmetry ratio
+    ratio = restore_rate / save_rate
+    evidence = [f"save {save_rate:.1f} MiB/s over {len(saves)} burst(s)",
+                f"restore {restore_rate:.1f} MiB/s over "
+                f"{len(restores)} burst(s)"]
+    evidence += _stage_growth_evidence(restores[0], saves[0],
+                                       restores[0]["Label"],
+                                       saves[0]["Label"])
+    direction = "faster" if ratio >= 1 else "slower"
+    text = (f"checkpoint restore runs {max(ratio, 1 / ratio):.1f}x "
+            f"{direction} than save "
+            f"({restore_rate:.0f} vs {save_rate:.0f} MiB/s)")
+    return _verdict("burst-asymmetry", text, ratio, evidence)
+
+
+def _cadence_verdict(steps: "list[dict]") -> "dict | None":
+    loader = next((s for s in steps if s.get("Role") == "loader"), None)
+    if loader is None:
+        return None
+    step_usec = int(loader.get("LoaderStepUSec") or 0)
+    batch_blocks = max(int(loader.get("LoaderBatchBlocks") or 1), 1)
+    block = max(int(loader.get("BlockSize") or 1), 1)
+    elapsed_s = max(int(loader.get("ElapsedUSec") or 0), 1) / 1e6
+    total_bytes = float(loader.get("Bytes") or 0)
+    workers = max(int(loader.get("TotalThreads")
+                      or loader.get("NumWorkers") or 1), 1)
+    batches = total_bytes / block / batch_blocks
+    achieved = batches / elapsed_s / workers  # steps/s per loader
+    evidence = [f"{batches:.0f} batches of {batch_blocks} x {block} B "
+                f"over {elapsed_s:.1f}s ({workers} loader worker(s))"]
+    if not step_usec:
+        return _verdict(
+            "cadence",
+            f"unpaced loader run: {achieved:.1f} steps/s per loader "
+            f"(decode burn only, no consume cadence configured)",
+            achieved, evidence)
+    target = 1e6 / step_usec
+    ratio = achieved / target
+    evidence.append(f"consume cadence target {target:.1f} steps/s "
+                    f"(stepusec={step_usec}, prefetch="
+                    f"{loader.get('LoaderPrefetch')})")
+    if ratio >= CADENCE_KEEPUP_RATIO:
+        text = (f"input pipeline keeps up with the consume cadence: "
+                f"{achieved:.1f} of {target:.1f} steps/s per loader")
+    else:
+        text = (f"storage-limited input pipeline: achieves "
+                f"{achieved:.1f} of {target:.1f} steps/s per loader "
+                f"({100 * ratio:.0f}% of the consume cadence)")
+    return _verdict("cadence", text, ratio, evidence)
+
+
+def analyze_scenario(name: str, steps: "list[dict]") -> dict:
+    """Cross-leg analysis of a finished scenario. ``steps`` are the
+    coordinator's per-step summaries (scenarios/plan.py order; skipped
+    resume steps absent). Every applicable verdict is emitted — a
+    coldwarm run gets both the warm-cache ratio and, with a flight
+    recording, the stage-growth evidence inside it."""
+    verdicts = [v for v in (
+        _contention_verdict(steps),
+        _warmup_verdict(steps),
+        _burst_verdict(steps),
+        _cadence_verdict(steps),
+    ) if v is not None]
+    return {
+        "Schema": SCENARIO_ANALYSIS_SCHEMA,
+        "Scenario": name,
+        "NumSteps": len(steps),
+        "Steps": steps,
+        "Verdicts": verdicts,
+    }

@@ -763,6 +763,18 @@ class Statistics:
         rec["RWMixReadIOPSLast"] = round(res.final_rwmix["iops"] / last_s, 2)
         rec["RWMixReadMiBPerSecLast"] = round(
             res.final_rwmix["bytes"] / last_s / (1 << 20), 2)
+        # scenario identity (--scenario; docs/scenarios.md): every record
+        # of a scenario run carries the scenario + step tag so the whole
+        # JSON/CSV/summarize/chart pipeline works unchanged; EpochRateMiBs
+        # is the per-epoch data rate on epoch-type legs (0 elsewhere) —
+        # the coldwarm/epochs comparison column. Appended, never
+        # reordered (make check-schema).
+        rec["Scenario"] = getattr(self.cfg, "scenario", "")
+        rec["ScenarioStep"] = getattr(self.cfg, "scenario_step_label", "")
+        rec["EpochRateMiBs"] = rec["MiBPerSecLast"] \
+            if getattr(self.cfg, "scenario_epoch", 0) else 0
+        # the epoch number itself is JSON-only (popped for CSV)
+        rec["ScenarioEpoch"] = getattr(self.cfg, "scenario_epoch", 0)
         return rec
 
     #: fixed result columns of the CSV schema (docs/result-columns.md);
@@ -776,7 +788,8 @@ class Statistics:
         "IOLatUSecMax", "IOLatUSecP99", "EntLatUSecMin", "EntLatUSecAvg",
         "EntLatUSecMax", "TpuHbmBytes", "TpuHbmMiBPerSec",
         "TpuDispatchUSec", "TpuTransferUSec", "NumHostsDegraded",
-        "RWMixReadIOPSLast", "RWMixReadMiBPerSecLast")
+        "RWMixReadIOPSLast", "RWMixReadMiBPerSecLast",
+        "Scenario", "ScenarioStep", "EpochRateMiBs")
 
     @classmethod
     def check_csv_file_compatibility(cls, cfg) -> None:
@@ -833,7 +846,7 @@ class Statistics:
         for _attr, key, _mode in CONTROL_AUDIT_COUNTERS:  # JSON-only keys
             rec.pop(key)
         for key in ("HostCPUUtil", "TelemetryScrapes", "TraceEvents",
-                    "TraceDropped", "Resumed"):
+                    "TraceDropped", "Resumed", "ScenarioEpoch"):
             rec.pop(key)  # telemetry + lifecycle keys are JSON-only
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()

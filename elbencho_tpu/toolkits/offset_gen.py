@@ -338,6 +338,83 @@ class OffsetGenRandomAlignedFullCoverage(OffsetGenerator):
         return offs, lens
 
 
+class OffsetGenShuffleWindow(OffsetGenerator):
+    """Windowed permutation over block indices (``--shufflewindow``).
+
+    Blocks are read exactly once, grouped into consecutive windows of
+    ``window_bytes``; within each window the block order is a seeded
+    permutation — the access shape of a training input pipeline's
+    shuffle buffer (tf.data ``shuffle(window)``, the PyTorch sampler's
+    chunked shuffle): global locality stays window-bounded while the
+    in-window order is random. Different seeds (epoch, rank) give
+    different permutations over the same coverage, which is what the
+    ``epochs`` scenario varies per epoch (docs/scenarios.md; arXiv
+    2604.21275 on shuffle windows bounding pipeline throughput).
+    """
+
+    def __init__(self, num_bytes: int, block_size: int, window_bytes: int,
+                 seed: int = 0, start: int = 0):
+        if block_size <= 0:
+            raise ValueError("block_size must be > 0")
+        if window_bytes < block_size:
+            raise ValueError("shuffle window smaller than block size")
+        self.num_bytes = num_bytes
+        self.block_size = block_size
+        self.num_blocks = num_blocks_for(num_bytes, block_size)
+        self.win_blocks = max(1, window_bytes // block_size)
+        self.seed = seed
+        self.start = start
+        self.reset()
+
+    def reset(self) -> None:
+        self._window = 0
+        self._i = 0
+        self._perm = None
+
+    def _window_perm(self, w: int) -> "np.ndarray":
+        lo = w * self.win_blocks
+        hi = min(lo + self.win_blocks, self.num_blocks)
+        # Knuth-multiplicative mix so (seed, window) pairs never collide
+        # into the same RandomState stream for nearby values
+        rng = np.random.RandomState(
+            (self.seed * 2654435761 + w * 40503) & 0x7FFFFFFF)
+        return lo + rng.permutation(hi - lo)
+
+    def next_block(self):
+        if self._perm is None or self._i >= len(self._perm):
+            if self._window * self.win_blocks >= self.num_blocks:
+                return None
+            self._perm = self._window_perm(self._window)
+            self._window += 1
+            self._i = 0
+        blk = int(self._perm[self._i])
+        self._i += 1
+        off = blk * self.block_size
+        return (self.start + off,
+                min(self.block_size, self.num_bytes - off))
+
+    def next_batch(self, max_n: int):
+        """Closed-form batch over the current window's permutation slice
+        (same sequence as the scalar path; a batch never spans windows —
+        callers tolerate short batches and call again)."""
+        if self._perm is None or self._i >= len(self._perm):
+            if self._window * self.win_blocks >= self.num_blocks:
+                return None
+            self._perm = self._window_perm(self._window)
+            self._window += 1
+            self._i = 0
+        take = self._perm[self._i:self._i + max_n]
+        self._i += len(take)
+        offs = take.astype(np.uint64) * np.uint64(self.block_size)
+        lens = np.full(len(take), self.block_size, dtype=np.uint64)
+        last_off = (self.num_blocks - 1) * self.block_size
+        if self.num_bytes - last_off < self.block_size:  # short final block
+            lens[offs == last_off] = self.num_bytes - last_off
+        if self.start:
+            offs += np.uint64(self.start)
+        return offs, lens
+
+
 class OffsetGenStrided(OffsetGenerator):
     """Strided access for shared files (``--strided``): worker ``rank`` starts
     at rank*block_size and advances by block_size*num_dataset_threads

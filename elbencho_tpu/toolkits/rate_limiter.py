@@ -39,6 +39,64 @@ class RateLimiter:
         self._bytes_in_window += num_bytes
 
 
+class DataLoaderPacer:
+    """Training input-pipeline consumer emulation (``--scenario
+    dataloader``; arXiv 2604.21275).
+
+    The worker's read loop calls :meth:`on_block` per completed block;
+    every ``batch_blocks`` blocks close a batch. A closed batch pays a
+    CPU decode burn (busy-spin for ``decode_usec`` — a sleep would
+    release the core a real decoder occupies), then waits for the
+    consume clock: one batch is consumed every ``step_usec`` from the
+    first block, and the reader may run at most ``prefetch`` batches
+    ahead of it. Storage faster than the cadence fills the prefetch
+    queue and idles (the healthy-pipeline shape); storage slower than
+    the cadence never waits here — its rate IS the (degraded) pipeline
+    rate the cadence verdict names.
+    """
+
+    def __init__(self, batch_blocks: int, step_usec: int,
+                 decode_usec: int, prefetch: int,
+                 interrupt_check=None):
+        self.batch_blocks = max(batch_blocks, 1)
+        self.step_secs = max(step_usec, 0) / 1e6
+        self.decode_secs = max(decode_usec, 0) / 1e6
+        self.prefetch = max(prefetch, 1)
+        self._interrupt_check = interrupt_check
+        self._blocks = 0
+        self.batches = 0
+        self._t0 = 0.0
+        self.wait_secs = 0.0   # consume-clock idle (prefetch full)
+        self.decode_secs_total = 0.0
+
+    def on_block(self) -> None:
+        if not self._t0:
+            self._t0 = time.monotonic()
+        self._blocks += 1
+        if self._blocks % self.batch_blocks:
+            return
+        self.batches += 1
+        if self.decode_secs:
+            end = time.perf_counter() + self.decode_secs
+            while time.perf_counter() < end:
+                pass
+            self.decode_secs_total += self.decode_secs
+        if not self.step_secs:
+            return
+        # batch b may complete no earlier than (b - prefetch) steps
+        # after the first block: that is when the consumer frees the
+        # prefetch slot this batch lands in
+        target = self._t0 + (self.batches - self.prefetch) * self.step_secs
+        while True:
+            now = time.monotonic()
+            if now >= target:
+                return
+            if self._interrupt_check is not None:
+                self._interrupt_check()
+            self.wait_secs += min(target - now, 0.05)
+            time.sleep(min(target - now, 0.05))
+
+
 class RateLimiterRWMixThreads:
     """Keeps the read:write *byte ratio* of a mixed-threads phase near the
     requested percentage (``--rwmixthrpct``).

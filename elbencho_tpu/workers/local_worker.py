@@ -298,7 +298,7 @@ class LocalWorker(Worker):
             self._path_fds = cfg.bench_path_fds
             return
         flags = os.O_RDWR
-        if cfg.run_create_files:
+        if cfg.run_create_files or cfg.scenario_creates_files:
             flags |= os.O_CREAT
         if cfg.use_direct_io:
             flags |= os.O_DIRECT
@@ -347,6 +347,8 @@ class LocalWorker(Worker):
     def _dispatch_phase(self, phase: BenchPhase) -> None:
         cfg = self.cfg
         self._num_iops_submitted = 0
+        self._loader_pacer = self._make_loader_pacer(
+            is_write=(phase != BenchPhase.READFILES))
         # --rwmixthr: the first N local ranks of a WRITE phase run the READ
         # workload instead, accounted as rwmix-read (reference: rwmix-threads
         # reader conversion, LocalWorker.cpp:1054-1062)
@@ -498,7 +500,11 @@ class LocalWorker(Worker):
                 and not cfg.fadvise_flags
                 and not cfg.use_mmap
                 and not cfg.use_random_offsets
-                and not cfg.do_reverse_seq_offsets)
+                and not cfg.do_reverse_seq_offsets
+                # the native per-file loop generates its own sequential
+                # offsets; the shuffle-window permutation feeds the
+                # gen-driven loops instead
+                and not cfg.shuffle_window)
 
     def _run_native_file_loop(self, native, phase: BenchPhase) -> None:
         """Chunked delegation of the per-file loop to the C++ engine."""
@@ -705,9 +711,25 @@ class LocalWorker(Worker):
     # offset generator wiring (reference: initPhaseRWOffsetGen :1141-1186)
     # ------------------------------------------------------------------
 
+    def _make_shuffle_gen(self, num_bytes: int, start: int = 0):
+        """--shufflewindow: seeded windowed permutation (every block
+        exactly once, locality bounded by the window) — the
+        training-pipeline shuffle-buffer shape. ONE seed mix for both
+        the dir-mode and shared-file constructions: the scenario epoch
+        (so the epochs scenario re-shuffles per epoch) times a prime,
+        plus the worker rank (so workers don't read in lockstep)."""
+        cfg = self.cfg
+        bs = cfg.block_size
+        from ..toolkits.offset_gen import OffsetGenShuffleWindow
+        return OffsetGenShuffleWindow(
+            num_bytes, bs, max(cfg.shuffle_window, bs),
+            seed=cfg.scenario_epoch * 1_000_003 + self.rank, start=start)
+
     def _make_offset_gen_for_file(self, is_write: bool):
         cfg = self.cfg
         size, bs = cfg.file_size, cfg.block_size
+        if not is_write and cfg.shuffle_window:
+            return self._make_shuffle_gen(size)
         if cfg.use_random_offsets:
             amount = max(cfg.random_amount // max(1, cfg.num_dataset_threads),
                          bs) if cfg.random_amount else size
@@ -796,6 +818,10 @@ class LocalWorker(Worker):
                 f"--verifydirect/--readinline/--flock need the sync "
                 f"engine)")
         num_bufs = len(self._io_bufs)
+        # the pacer is PER PHASE (created in _dispatch_phase): dir-mode
+        # read phases enter here once per file, and the consume clock /
+        # batch count must span the whole epoch, not restart per shard
+        pacer = None if is_write else getattr(self, "_loader_pacer", None)
         is_rwmix_reader = getattr(self, "_rwmix_thread_reader", False)
         # the byte-ratio balancer only applies to the mixed WRITE phase
         # (writers + converted readers); a later pure READ phase must not
@@ -886,6 +912,10 @@ class LocalWorker(Worker):
             self._num_iops_submitted += 1
             if self._staging_pool is not None:
                 self._staging_pool.account_ops(1)
+            if pacer is not None:
+                # dataloader emulation: decode burn + consume-cadence
+                # wait per closed batch (--scenario dataloader)
+                pacer.on_block()
         if self._tpu is not None:
             # drain pipelined transfers before phase end (guarded: an
             # in-flight transfer of a dying chip surfaces here)
@@ -989,6 +1019,25 @@ class LocalWorker(Worker):
                 "degrading to host-memory staging")
         ctx.failover_to_host()
 
+    def _loader_pacing_active(self, is_write: bool) -> bool:
+        """Dataloader-emulation pacing (--scenario dataloader) shapes the
+        READ loop with per-batch decode burns and consume-cadence waits —
+        per-op Python behavior no native loop expresses."""
+        cfg = self.cfg
+        return (not is_write
+                and bool(cfg.scenario_step_usec or cfg.scenario_decode_usec))
+
+    def _make_loader_pacer(self, is_write: bool):
+        if not self._loader_pacing_active(is_write):
+            return None
+        from ..toolkits.rate_limiter import DataLoaderPacer
+        cfg = self.cfg
+        return DataLoaderPacer(
+            cfg.scenario_batch_blocks or 1, cfg.scenario_step_usec,
+            cfg.scenario_decode_usec, cfg.scenario_prefetch or 1,
+            interrupt_check=lambda:
+                self.check_interruption_request(force=True))
+
     def _native_loop_eligible(self, native) -> bool:
         """Conditions every native delegation shares: no per-op Python
         feature may be active. Verify/rwmix-pct/block-variance run INSIDE
@@ -1006,6 +1055,10 @@ class LocalWorker(Worker):
                 # fused TPU stream loop records its own and stays native)
                 and self._tracer is None
                 and self.shared.rwmix_balancer is None
+                # dataloader-emulation pacing is per-op Python behavior
+                # (the knobs are only set on the loader read leg, so a
+                # scenario's setup write still runs native)
+                and not (cfg.scenario_step_usec or cfg.scenario_decode_usec)
                 and (not cfg.block_variance_pct
                      or cfg.block_variance_algo == "fast"))
 
@@ -1073,6 +1126,8 @@ class LocalWorker(Worker):
             return "--readinline/--verifydirect inline read-back"
         if self._rate_limiter_read or self._rate_limiter_write:
             return "per-op rate limits"
+        if cfg.scenario_step_usec or cfg.scenario_decode_usec:
+            return "dataloader-emulation pacing (--scenario dataloader)"
         if cfg.io_engine != "auto" and \
                 ENGINE_CODES.get(cfg.io_engine) != native.stream_backend():
             return (f"--ioengine {cfg.io_engine} pinned but the stream "
@@ -1759,6 +1814,10 @@ class LocalWorker(Worker):
             slice_len = total_range - slice_start  # last takes remainder
         if not slice_len:
             return None
+        if not is_write and cfg.shuffle_window:
+            # shared-file shape: each worker permutes its own
+            # contiguous slice with the common epoch+rank seed
+            return self._make_shuffle_gen(slice_len, start=slice_start)
         if cfg.do_reverse_seq_offsets:
             return OffsetGenReverseSeq(slice_len, bs, start=slice_start)
         return OffsetGenSequential(slice_len, bs, start=slice_start)

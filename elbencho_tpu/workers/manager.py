@@ -97,7 +97,7 @@ class WorkerManager:
                 or cfg.no_fd_sharing or not cfg.paths or cfg.hosts:
             return
         flags = os.O_RDWR
-        if cfg.run_create_files:
+        if cfg.run_create_files or cfg.scenario_creates_files:
             flags |= os.O_CREAT
         if cfg.use_direct_io:
             flags |= os.O_DIRECT

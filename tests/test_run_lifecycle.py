@@ -602,6 +602,92 @@ def test_first_signal_writes_interrupted_journal_record(tmp_path):
             master.wait()
 
 
+def _scenario_args(tmp_path, journal, jsonfile, extra=()):
+    bench = tmp_path / "bench"
+    bench.mkdir(exist_ok=True)
+    return ["--scenario", "epochs", "--scenario-opt", "epochs=2,window=64K",
+            "-t", "1", "-n", "1", "-N", "2", "-s", "64K", "-b", "16K",
+            "--journal", str(journal), "--jsonfile", str(jsonfile),
+            *extra, str(bench)]
+
+
+def test_scenario_resume_runs_first_unfinished_epoch(tmp_path):
+    """A SIGKILL'd --scenario epochs run resumes at the first unfinished
+    epoch: the journal records every step under its plan index (with the
+    step label attached), the fingerprint covers the EXPANDED plan, and
+    a resume under changed knobs is a hard mismatch."""
+    journal = tmp_path / "j.jsonl"
+    res1 = tmp_path / "res1.json"
+    assert _master(_scenario_args(tmp_path, journal, res1)) == 0
+    recs = _journal_recs(journal)
+    assert recs[0]["rec"] == "run_start"
+    assert recs[0]["scenario"]["name"] == "epochs"
+    assert [s["label"] for s in recs[0]["scenario"]["steps"]] == \
+        ["setup.mkdirs", "setup", "epoch1", "epoch2"]
+    steps = [(r["rec"], r.get("step")) for r in recs[1:-1]]
+    assert steps == [
+        ("phase_start", "setup.mkdirs"), ("phase_finish", "setup.mkdirs"),
+        ("phase_start", "setup"), ("phase_finish", "setup"),
+        ("phase_start", "epoch1"), ("phase_finish", "epoch1"),
+        ("phase_start", "epoch2"), ("phase_finish", "epoch2")]
+    assert recs[-1]["rec"] == "run_complete"
+    # simulate a crash between epoch1 finish and epoch2 finish: drop the
+    # epoch2 finish + run_complete, keep its phase_start
+    lines = journal.read_text().splitlines()
+    journal.write_text("\n".join(lines[:-2]) + "\n")
+    res2 = tmp_path / "res2.json"
+    rc = _master(_scenario_args(tmp_path, journal, res2,
+                                extra=["--resume"]))
+    assert rc == 0
+    recs2 = _json_recs(res2)
+    steps2 = [r["ScenarioStep"] for r in recs2
+              if not r.get("ScenarioAnalysis")]
+    assert steps2 == ["epoch2"], \
+        "only the unfinished epoch may re-run on resume"
+    assert all(r["Resumed"] == 3 for r in recs2
+               if not r.get("ScenarioAnalysis"))
+    # the scenario-level verdict still lands on the resumed tail
+    assert any(r.get("ScenarioAnalysis") for r in recs2)
+    tail = _journal_recs(journal)
+    assert tail[-1]["rec"] == "run_complete"
+    assert tail[-2]["rec"] == "phase_finish" and tail[-2]["step"] == "epoch2"
+    # changed scenario knobs => expanded-plan fingerprint mismatch
+    lines = journal.read_text().splitlines()
+    journal.write_text("\n".join(lines[:-2]) + "\n")  # incomplete again
+    res3 = tmp_path / "res3.json"
+    args = _scenario_args(tmp_path, journal, res3, extra=["--resume"])
+    args[args.index("--scenario-opt") + 1] = "epochs=3,window=64K"
+    assert _master(args) != 0, \
+        "changed scenario knobs must hard-fail the resume"
+    assert not res3.exists()
+
+
+def test_scenario_cache_legs_stay_out_of_the_journal(tmp_path):
+    """Coldwarm's sync/dropcaches legs ride the plan but never the
+    journal (UNJOURNALED_PHASES): a resume must not replay a cache drop
+    as finished work — and the dropcaches leg is best-effort, so the
+    run completes even unprivileged."""
+    journal = tmp_path / "j.jsonl"
+    res = tmp_path / "res.json"
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    rc = _master(["--scenario", "coldwarm", "--scenario-opt",
+                  "epochs=2,cold=1", "-t", "1", "-n", "1", "-N", "2",
+                  "-s", "64K", "-b", "16K", "--journal", str(journal),
+                  "--jsonfile", str(res), str(bench)])
+    assert rc == 0
+    recs = _journal_recs(journal)
+    names = {r.get("name") for r in recs if "name" in r}
+    assert "DROPCACHE" not in names and "SYNC" not in names, \
+        "cache legs must stay out of the journal"
+    # but the PLAN in run_start still lists them (restart context)
+    plan_labels = [s["label"] for s in recs[0]["scenario"]["steps"]]
+    assert "epoch1.dropcaches" in plan_labels and "sync" in plan_labels
+    # journaled indices are PLAN indices: epoch1.cold is step 4
+    cold_start = next(r for r in recs if r.get("step") == "epoch1.cold")
+    assert cold_start["index"] == plan_labels.index("epoch1.cold")
+
+
 def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     """LeaseExp/Resumed append AFTER every pre-existing column (never
     reordered) and a resumed record triggers the RESUMED banner."""
@@ -618,8 +704,8 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     header = res.stdout.splitlines()[0].split(",")
     # the streaming-control-plane trio + pod-slice trio append after the
     # lifecycle pair (never reordered)
-    assert header[-11:-9] == ["LeaseExp", "Resumed"]
+    assert header[-14:-12] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-11:-9] == ["2", "3"]
+    assert row[-14:-12] == ["2", "3"]
     assert "RESUMED" in res.stderr

@@ -1,0 +1,742 @@
+"""Training-ingest scenario suite (--scenario; docs/scenarios.md).
+
+Covers the subsystem at every layer:
+- unit: plan expansion (steps/labels/overlays), knob validation,
+  resume-filter semantics for unjournaled legs, verdict math;
+- generator: shuffle-window permutation properties (exact coverage,
+  window locality, per-seed variation, batch==scalar sequence);
+- pacing: the dataloader consumer emulation enforces the consume
+  cadence;
+- e2e: all five scenarios run end-to-end locally AND against an
+  in-process service fleet, tag every record with scenario + step
+  identity through the unchanged JSON pipeline, and end with a
+  scenario-level verdict (the acceptance criterion);
+- tools: summarize-json column tail + verdict banner, chart timeline.
+
+Run via `make test-scenario` (marker `scenario`); also part of the
+default tier-1 pytest sweep.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from elbencho_tpu.config.args import ConfigError, parse_cli
+from elbencho_tpu.phases import BenchPhase
+from elbencho_tpu.scenarios import (SCENARIOS, analyze_scenario,
+                                    expand_scenario, parse_scenario_opts)
+from elbencho_tpu.toolkits.offset_gen import OffsetGenShuffleWindow
+from elbencho_tpu.toolkits.rate_limiter import DataLoaderPacer
+
+pytestmark = pytest.mark.scenario
+
+REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL_SCENARIOS = sorted(SCENARIOS)
+
+
+def _cfg(extra=(), paths=("/tmp/_scn_cfg",)):
+    cfg, _ = parse_cli([*extra, *paths])
+    cfg.derive(probe_paths=False)
+    return cfg
+
+
+def _run_main(args):
+    from elbencho_tpu.cli import main
+    return main(args + ["--nolive"])
+
+
+def _recs(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# unit: expansion + validation
+# ---------------------------------------------------------------------------
+
+def test_epochs_expansion_steps_and_overlays():
+    cfg = _cfg(["--scenario", "epochs",
+                "--scenario-opt", "epochs=2,window=64K",
+                "-t", "1", "-s", "128K", "-b", "16K"])
+    plan = expand_scenario(cfg)
+    assert [s.label for s in plan.steps] == \
+        ["setup.mkdirs", "setup", "epoch1", "epoch2"]
+    assert plan.steps[2].phase == BenchPhase.READFILES
+    assert plan.steps[2].overlay == {"shuffle_window": 64 * 1024,
+                                     "scenario_epoch": 1}
+    assert plan.steps[3].overlay["scenario_epoch"] == 2
+    assert plan.steps[2].epoch == 1 and plan.steps[3].epoch == 2
+    # default window derives from the block size
+    cfg2 = _cfg(["--scenario", "epochs", "-t", "1", "-s", "128K",
+                 "-b", "16K"])
+    plan2 = expand_scenario(cfg2)
+    epoch_steps = [s for s in plan2.steps if s.role == "epoch"]
+    assert len(epoch_steps) == 3  # default epochs=3
+    assert epoch_steps[0].overlay["shuffle_window"] == 16 * 16 * 1024
+
+
+def test_ckpt_burst_expansion_interval_and_size():
+    cfg = _cfg(["--scenario", "ckpt-burst",
+                "--scenario-opt", "bursts=3,interval=7,size=96K",
+                "-t", "1", "-s", "128K", "-b", "64K"])
+    plan = expand_scenario(cfg)
+    labels = [s.label for s in plan.steps]
+    assert labels == ["setup.mkdirs", "ckpt1.save", "ckpt1.restore",
+                      "ckpt2.save", "ckpt2.restore",
+                      "ckpt3.save", "ckpt3.restore"]
+    saves = [s for s in plan.steps if s.role == "save"]
+    # size trims to a block multiple (96K -> 64K with 64K blocks)
+    assert all(s.overlay["file_size"] == 64 * 1024 for s in saves)
+    assert [s.delay_secs for s in saves] == [0, 7, 7]
+
+
+def test_contend_and_coldwarm_and_dataloader_expansion():
+    cfg = _cfg(["--scenario", "contend", "--scenario-opt",
+                "readthreads=3", "-t", "4", "-s", "64K", "-b", "16K"])
+    plan = expand_scenario(cfg)
+    assert [s.role for s in plan.steps] == \
+        ["setup", "setup", "baseline", "contend"]
+    assert plan.steps[-1].overlay == {"num_rwmix_read_threads": 3}
+
+    cfg = _cfg(["--scenario", "coldwarm", "--scenario-opt",
+                "epochs=3,cold=2", "-t", "1", "-s", "64K", "-b", "16K"])
+    plan = expand_scenario(cfg)
+    assert [s.label for s in plan.steps] == [
+        "setup.mkdirs", "setup", "sync",
+        "epoch1.dropcaches", "epoch1.cold",
+        "epoch2.dropcaches", "epoch2.cold", "epoch3.warm"]
+    drops = [s for s in plan.steps if s.role == "cachedrop"]
+    assert all(s.best_effort for s in drops)
+
+    cfg = _cfg(["--scenario", "dataloader", "--scenario-opt",
+                "prefetch=4,stepusec=500,batchblocks=2,decodeusec=50",
+                "-t", "1", "-s", "64K", "-b", "16K"])
+    plan = expand_scenario(cfg)
+    loader = plan.steps[-1]
+    assert loader.overlay == {"scenario_prefetch": 4,
+                              "scenario_decode_usec": 50,
+                              "scenario_step_usec": 500,
+                              "scenario_batch_blocks": 2,
+                              "scenario_epoch": 1}
+
+
+def test_scenario_validation_errors():
+    with pytest.raises(ConfigError, match="unknown --scenario"):
+        _cfg(["--scenario", "nope", "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="phase plan itself"):
+        _cfg(["--scenario", "epochs", "-w", "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="iterations"):
+        _cfg(["--scenario", "epochs", "-i", "2", "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="does not know"):
+        _cfg(["--scenario", "epochs", "--scenario-opt", "bogus=1",
+              "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="key=val"):
+        parse_scenario_opts("epochs")
+    with pytest.raises(ConfigError, match="not an integer"):
+        _cfg(["--scenario", "epochs", "--scenario-opt", "epochs=x",
+              "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="give --scenario"):
+        _cfg(["--scenario-opt", "epochs=2", "-r", "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="at least one writer"):
+        _cfg(["--scenario", "contend", "--scenario-opt",
+              "readthreads=2", "-t", "2", "-s", "4K"]).check()
+    with pytest.raises(ConfigError, match="shufflewindow"):
+        _cfg(["-r", "--shufflewindow", "1M", "--rand",
+              "-s", "4M"]).check()
+    # sub-block window = no shuffling at all: refuse like the
+    # standalone flag, never silently clamp
+    with pytest.raises(ConfigError, match="at least one --block"):
+        _cfg(["--scenario", "epochs", "--scenario-opt", "window=8K",
+              "-b", "16K", "-s", "64K"]).check()
+    # rank rotation would reshuffle epoch seeds / contention legs
+    with pytest.raises(ConfigError, match="rotatehosts"):
+        _cfg(["--scenario", "epochs", "--rotatehosts", "1",
+              "-s", "4K"]).check()
+
+
+def test_resume_runs_skips_unjournaled_legs_of_finished_steps():
+    cfg = _cfg(["--scenario", "coldwarm", "--scenario-opt",
+                "epochs=2,cold=1", "-t", "1", "-s", "64K", "-b", "16K"])
+    plan = expand_scenario(cfg)
+    labels = [s.label for s in plan.steps]
+    # crash after epoch1.cold finished: everything journaled up to and
+    # including its index is finished
+    finished = {(0, i) for i, s in enumerate(plan.steps)
+                if s.label in ("setup.mkdirs", "setup", "epoch1.cold")}
+    runs = dict(zip(labels, plan.resume_runs(finished)))
+    assert runs["setup"] is False
+    # the sync + dropcaches legs precede a FINISHED epoch: never
+    # replayed as finished work, never needlessly executed
+    assert runs["sync"] is False
+    assert runs["epoch1.dropcaches"] is False
+    assert runs["epoch1.cold"] is False
+    assert runs["epoch2.warm"] is True
+    # crash DURING epoch1.cold instead: its dropcaches leg re-runs
+    finished2 = {(0, i) for i, s in enumerate(plan.steps)
+                 if s.label in ("setup.mkdirs", "setup")}
+    runs2 = dict(zip(labels, plan.resume_runs(finished2)))
+    assert runs2["epoch1.dropcaches"] is True
+    assert runs2["epoch1.cold"] is True
+
+
+def test_scenario_creates_files_flag_derived_and_shipped():
+    """File-mode fd opens gate O_CREAT on run_create_files, which stays
+    off under --scenario: validation must derive 'this plan writes'
+    from the expanded steps, and to_service_dict must SHIP it (the
+    scenario name itself is stripped from the service config)."""
+    cfg = _cfg(["--scenario", "ckpt-burst", "-t", "1", "-s", "64K",
+                "-b", "16K"])
+    cfg.check()
+    assert cfg.scenario_creates_files is True
+    wire = cfg.to_service_dict(0)
+    assert wire["scenario"] == ""  # plan stays master-side
+    assert wire["scenario_creates_files"] is True
+    # a read-only plan (existing dataset, no write legs) must NOT claim
+    # creation — the read-only size guards stay armed for it
+    ro = _cfg(["--scenario", "epochs", "--scenario-opt", "setup=0",
+               "-t", "1", "-s", "64K", "-b", "16K"])
+    ro.check()
+    assert ro.scenario_creates_files is False
+
+
+def test_writeless_scenario_refuses_undersized_file(tmp_path):
+    """--scenario-opt setup=0 yields a plan with no write leg: an
+    existing file smaller than -s must refuse at config time exactly
+    like plain -r would — only a plan that WRITES the dataset may rely
+    on its own legs to grow the file to -s."""
+    small = tmp_path / "data.bin"
+    small.write_bytes(b"\0" * 64 * 1024)
+    cfg, _ = parse_cli(["--scenario", "epochs", "--scenario-opt",
+                        "setup=0", "-s", "128K", "-b", "16K", str(small)])
+    with pytest.raises(ConfigError, match="larger than detected size"):
+        cfg.derive()
+    # the same plan WITH its setup write leg grows the file itself
+    cfg2, _ = parse_cli(["--scenario", "epochs", "-s", "128K",
+                         "-b", "16K", str(small)])
+    cfg2.derive()
+    cfg2.check()
+
+
+def test_scenario_on_missing_file_requires_size(tmp_path):
+    """A scenario always reads and/or writes the dataset: a FILE bench
+    path that does not exist yet must demand -s exactly like -w/-r
+    would, never auto-size a silent 0-byte plan."""
+    cfg, _ = parse_cli(["--scenario", "ckpt-burst",
+                        str(tmp_path / "nonexistent")])
+    with pytest.raises(ConfigError, match="file size must not be 0"):
+        cfg.derive()
+
+
+def test_fingerprint_covers_expanded_plan():
+    from elbencho_tpu.journal import config_fingerprint
+    base = ["--scenario", "epochs", "-t", "1", "-s", "64K", "-b", "16K"]
+    fp1 = config_fingerprint(_cfg(base))
+    fp2 = config_fingerprint(_cfg(base))
+    assert fp1 == fp2, "expansion must be deterministic"
+    fp3 = config_fingerprint(
+        _cfg(["--scenario", "epochs", "--scenario-opt", "epochs=4",
+              "-t", "1", "-s", "64K", "-b", "16K"]))
+    assert fp3 != fp1, "changed knobs must change the fingerprint"
+
+
+# ---------------------------------------------------------------------------
+# unit: shuffle-window generator + dataloader pacer
+# ---------------------------------------------------------------------------
+
+def test_shuffle_window_covers_every_block_exactly_once():
+    bs, win = 16 * 1024, 64 * 1024
+    size = 130 * 1024  # 9 blocks, short tail
+    gen = OffsetGenShuffleWindow(size, bs, win, seed=7)
+    blocks = list(gen)
+    offs = [o for o, _l in blocks]
+    assert sorted(offs) == [i * bs for i in range(9)]
+    assert len(set(offs)) == 9
+    # the short final block keeps its true length
+    assert dict(blocks)[8 * bs] == size - 8 * bs
+    # window locality: every offset stays inside its window
+    for pos, (off, _l) in enumerate(blocks):
+        assert off // win == pos * bs // win
+
+
+def test_shuffle_window_seed_and_batch_semantics():
+    bs, win, size = 4096, 16 * 4096, 64 * 4096
+    a = [o for o, _ in OffsetGenShuffleWindow(size, bs, win, seed=1)]
+    b = [o for o, _ in OffsetGenShuffleWindow(size, bs, win, seed=2)]
+    a2 = [o for o, _ in OffsetGenShuffleWindow(size, bs, win, seed=1)]
+    assert a != b, "different seeds must permute differently"
+    assert a == a2, "same seed must reproduce the sequence"
+    assert a != sorted(a), "a 16-block window must actually shuffle"
+    # next_batch (the native block loop's feed) == scalar sequence
+    gen = OffsetGenShuffleWindow(size, bs, win, seed=1)
+    got = []
+    while True:
+        batch = gen.next_batch(5)
+        if batch is None:
+            break
+        offs, lens = batch
+        got.extend(int(o) for o in offs)
+        assert all(int(x) == bs for x in lens)
+    assert got == a
+
+
+def test_shuffle_window_batch_short_tail_and_start():
+    """next_batch must reproduce the scalar sequence exactly — including
+    a non-block-divisible tail (short final length) and a non-zero slice
+    start, the shared-file worker-slice shape."""
+    bs, win = 4096, 4 * 4096
+    size = 9 * 4096 + 100  # short final block
+    scalar = list(OffsetGenShuffleWindow(size, bs, win, seed=3,
+                                         start=1 << 20))
+    gen = OffsetGenShuffleWindow(size, bs, win, seed=3, start=1 << 20)
+    got = []
+    while True:
+        batch = gen.next_batch(7)
+        if batch is None:
+            break
+        got.extend((int(o), int(ln)) for o, ln in zip(*batch))
+    assert got == scalar
+
+
+def test_scenario_shuffle_rejects_conflicting_access_flags():
+    """--rand/--mmap are rejected next to standalone --shufflewindow; a
+    scenario that overlays shuffle_window per step (epochs) must reject
+    them too, at config time — not silently override --rand at dispatch
+    (the overlay sets shuffle_window only at run time, after the
+    flag-level incompatibility check already passed on 0)."""
+    for flag in ("--rand", "--mmap"):
+        cfg = _cfg(["--scenario", "epochs", flag, "-t", "1",
+                    "-s", "64K", "-b", "16K"])
+        with pytest.raises(ConfigError, match="shuffle-window"):
+            cfg.check()
+
+
+def test_dataloader_pacer_enforces_consume_cadence():
+    # 8 batches, 20ms step, prefetch 2 => completion no earlier than
+    # (8 - 2) * 20ms = 120ms even though the "storage" is instant
+    pacer = DataLoaderPacer(batch_blocks=2, step_usec=20_000,
+                            decode_usec=0, prefetch=2)
+    t0 = time.monotonic()
+    for _ in range(16):
+        pacer.on_block()
+    elapsed = time.monotonic() - t0
+    assert pacer.batches == 8
+    assert elapsed >= 0.115, f"pacer let the reader run free ({elapsed})"
+    assert pacer.wait_secs > 0
+
+
+def test_dataloader_pacer_decode_burn_counts():
+    pacer = DataLoaderPacer(batch_blocks=4, step_usec=0,
+                            decode_usec=2000, prefetch=1)
+    for _ in range(8):
+        pacer.on_block()
+    assert pacer.batches == 2
+    assert pacer.decode_secs_total == pytest.approx(0.004)
+
+
+# ---------------------------------------------------------------------------
+# unit: verdict math
+# ---------------------------------------------------------------------------
+
+def test_contention_verdict_slowdown_pct():
+    steps = [
+        {"Label": "train.baseline", "Role": "baseline", "MiBPerSec": 400.0,
+         "NumWorkers": 4},
+        {"Label": "contend", "Role": "contend", "MiBPerSec": 120.0,
+         "ReadMiBPerSec": 120.0, "ReadThreads": 2, "NumWorkers": 4},
+    ]
+    ana = analyze_scenario("contend", steps)
+    v = next(v for v in ana["Verdicts"] if v["Kind"] == "contention")
+    # 100 * (1 - (120/2) / (400/4)) = 40%
+    assert v["Metric"] == pytest.approx(40.0)
+    assert "starve train reads by 40%" in v["Verdict"]
+
+
+def test_warmup_verdict_and_cold_degraded_flag():
+    steps = [
+        {"Label": "epoch1.cold", "Role": "epoch", "Epoch": 1, "Cold": True,
+         "MiBPerSec": 100.0, "EpochRate": 100.0, "ColdDegraded": True},
+        {"Label": "epoch2.warm", "Role": "epoch", "Epoch": 2, "Cold": False,
+         "MiBPerSec": 300.0, "EpochRate": 300.0},
+    ]
+    ana = analyze_scenario("coldwarm", steps)
+    v = next(v for v in ana["Verdicts"] if v["Kind"] == "cache-warmup")
+    assert v["Metric"] == pytest.approx(3.0)
+    assert any("cache-drop leg failed" in e for e in v["Evidence"])
+
+
+def test_cadence_verdict_names_storage_limited_pipeline():
+    steps = [{
+        "Label": "loader", "Role": "loader", "ElapsedUSec": 2_000_000,
+        "Bytes": 100 * 65536, "BlockSize": 65536, "NumWorkers": 1,
+        "LoaderStepUSec": 10_000, "LoaderBatchBlocks": 1,
+        "LoaderPrefetch": 2,
+    }]
+    ana = analyze_scenario("dataloader", steps)
+    v = next(v for v in ana["Verdicts"] if v["Kind"] == "cadence")
+    # 50 achieved vs 100 target steps/s
+    assert v["Metric"] == pytest.approx(0.5)
+    assert "storage-limited" in v["Verdict"]
+
+
+def test_user_given_rwmixthr_rejected_next_to_scenario():
+    """A stray --rwmixthr beside --scenario would convert setup-write
+    threads into readers of files not yet written — rejected at config
+    time (the contend scenario owns the thread split)."""
+    with pytest.raises(ConfigError, match="readthreads knob"):
+        _cfg(["--scenario", "epochs", "--rwmixthr", "1", "-t", "4",
+              "-s", "64K"])
+
+
+def test_burst_verdict_skips_zero_sided_ratio():
+    steps = [
+        {"Label": "ckpt1.save", "Role": "save", "MiBPerSec": 200.0},
+        {"Label": "ckpt1.restore", "Role": "restore", "MiBPerSec": 0.0},
+    ]
+    ana = analyze_scenario("ckpt-burst", steps)  # must not divide by 0
+    assert not any(v["Kind"] == "burst-asymmetry" for v in ana["Verdicts"])
+
+
+def test_warmup_verdict_never_uses_a_cold_epoch_as_warm_evidence():
+    cold = [{"Label": f"epoch{e}.cold", "Role": "epoch", "Epoch": e,
+             "Cold": True, "MiBPerSec": 100.0 * e, "EpochRate": 100.0 * e}
+            for e in (1, 2)]
+    # all-cold run: the fallback may compare cold epochs, but a mixed
+    # run must pick a genuinely warm epoch as the evidence
+    mixed = cold + [{"Label": "epoch3.warm", "Role": "epoch", "Epoch": 3,
+                     "Cold": False, "MiBPerSec": 150.0, "EpochRate": 150.0}]
+    ana = analyze_scenario("coldwarm", mixed)
+    v = next(v for v in ana["Verdicts"] if v["Kind"] == "cache-warmup")
+    assert "epoch3.warm" in v["Verdict"]
+    assert v["Metric"] == pytest.approx(1.5)  # NOT epoch2.cold's 2.0
+
+
+def test_burst_verdict_restore_vs_save():
+    steps = [
+        {"Label": "ckpt1.save", "Role": "save", "MiBPerSec": 200.0},
+        {"Label": "ckpt1.restore", "Role": "restore", "MiBPerSec": 500.0},
+    ]
+    ana = analyze_scenario("ckpt-burst", steps)
+    v = next(v for v in ana["Verdicts"] if v["Kind"] == "burst-asymmetry")
+    assert v["Metric"] == pytest.approx(2.5)
+    assert "2.5x faster" in v["Verdict"]
+
+
+# ---------------------------------------------------------------------------
+# e2e: every scenario locally + against an in-process service fleet
+# (the acceptance criterion: per-step records through the unchanged
+# JSON pipeline + at least one scenario-level verdict)
+# ---------------------------------------------------------------------------
+
+_E2E_ARGS = {
+    "epochs": ["--scenario-opt", "epochs=2,window=64K"],
+    "ckpt-burst": ["--scenario-opt", "bursts=2,size=64K"],
+    "contend": ["--scenario-opt", "readthreads=1"],
+    "coldwarm": ["--scenario-opt", "epochs=2,cold=1"],
+    "dataloader": ["--scenario-opt",
+                   "prefetch=2,stepusec=2000,batchblocks=2,decodeusec=50"],
+}
+
+_EXPECTED_VERDICT_KIND = {
+    "epochs": "cache-warmup",
+    "ckpt-burst": "burst-asymmetry",
+    "contend": "contention",
+    "coldwarm": "cache-warmup",
+    "dataloader": "cadence",
+}
+
+
+def _assert_scenario_records(recs, scenario):
+    steps = [r for r in recs if not r.get("ScenarioAnalysis")]
+    assert steps, "no per-step records emitted"
+    # every record rides the normal pipeline WITH scenario identity
+    for r in steps:
+        assert r["Scenario"] == scenario
+        assert r["ScenarioStep"]
+        assert "MiBPerSecLast" in r and "IOLatHisto" in r
+    # epoch-type legs carry the EpochRateMiBs comparison column
+    if scenario in ("epochs", "coldwarm", "dataloader"):
+        assert any(r.get("EpochRateMiBs", 0) > 0 for r in steps)
+    summary = [r for r in recs if r.get("ScenarioAnalysis")]
+    assert len(summary) == 1, "exactly one terminal SCENARIO record"
+    ana = summary[0]["ScenarioAnalysis"]
+    assert summary[0]["Phase"] == "SCENARIO"
+    assert ana["Scenario"] == scenario
+    kinds = [v["Kind"] for v in ana["Verdicts"]]
+    assert _EXPECTED_VERDICT_KIND[scenario] in kinds, \
+        f"missing scenario-level verdict (got {kinds})"
+    v = next(v for v in ana["Verdicts"]
+             if v["Kind"] == _EXPECTED_VERDICT_KIND[scenario])
+    assert v["Verdict"] and v["Metric"] is not None and v["Evidence"]
+
+
+@pytest.mark.parametrize("scenario", ALL_SCENARIOS)
+def test_scenario_e2e_local(scenario, tmp_path):
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "r.json"
+    rc = _run_main(["--scenario", scenario, *_E2E_ARGS[scenario],
+                    "-t", "2", "-n", "1", "-N", "2", "-s", "128K",
+                    "-b", "16K", "--jsonfile", str(jf), str(bench)])
+    assert rc == 0
+    _assert_scenario_records(_recs(jf), scenario)
+
+
+@pytest.mark.parametrize("scenario", ALL_SCENARIOS)
+def test_scenario_e2e_service_fleet(scenario, tmp_path):
+    """The same five scenarios against a REAL in-process 2-host fleet:
+    per-step overlays re-ship over /preparephase (the fleet re-prepare
+    path), per-step records merge from the services' /benchresult
+    payloads, and the scenario verdict still lands."""
+    from elbencho_tpu.testing.service_harness import in_process_services
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "r.json"
+    with in_process_services(2) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        rc = _run_main(["--scenario", scenario, *_E2E_ARGS[scenario],
+                        "--hosts", hosts, "-t", "2", "-n", "1", "-N", "2",
+                        "-s", "128K", "-b", "16K",
+                        "--jsonfile", str(jf), str(bench)])
+    assert rc == 0
+    recs = _recs(jf)
+    _assert_scenario_records(recs, scenario)
+    # both hosts really worked every measured step
+    for r in recs:
+        if r.get("ScenarioAnalysis") or r["Phase"] == "MKDIRS":
+            continue
+        assert r["NumWorkers"] == 2, r["ScenarioStep"]
+
+
+def test_phasedelay_idles_between_scenario_steps(tmp_path, monkeypatch):
+    """--phasedelay applies between scenario steps exactly like between
+    plain phases (never before the first step; a step's own interval
+    knob would win over it)."""
+    from elbencho_tpu import coordinator as coord_mod
+    sleeps = []
+    monkeypatch.setattr(coord_mod.time, "sleep",
+                        lambda secs: sleeps.append(secs))
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    rc = _run_main(["--scenario", "epochs", "--scenario-opt", "epochs=2",
+                    "--phasedelay", "7", "-t", "1", "-n", "1", "-N", "1",
+                    "-s", "32K", "-b", "16K",
+                    "--jsonfile", str(tmp_path / "r.json"), str(bench)])
+    assert rc == 0
+    # 4 steps ran (setup.mkdirs, setup, epoch1, epoch2) -> 3 inter-step
+    # delays, none before the first step
+    assert sleeps.count(7) == 3
+
+
+def test_shuffle_window_file_mode_really_shuffles(tmp_path):
+    """FILE bench path: the shared-file offset generator must honor the
+    shuffle window too — a per-worker sequential slice labeled as a
+    shuffled epoch would publish epoch-rate verdicts from a workload
+    that never shuffled. The opslog proves the read order is permuted
+    with exact coverage."""
+    target = tmp_path / "data.bin"
+    ops = tmp_path / "ops.jsonl"
+    rc = _run_main(["--scenario", "epochs", "--scenario-opt",
+                    "epochs=1,window=64K", "-t", "1", "-s", "256K",
+                    "-b", "4K", "--opslog", str(ops),
+                    "--jsonfile", str(tmp_path / "r.json"), str(target)])
+    assert rc == 0
+    recs = [json.loads(ln) for ln in ops.read_text().strip().splitlines()]
+    offsets = [r["offset"] for r in recs if r["op_name"] == "read"]
+    assert sorted(offsets) == list(range(0, 256 * 1024, 4096)), \
+        "every block exactly once"
+    assert offsets != sorted(offsets), "file-mode epochs must shuffle"
+
+
+def test_epoch_tag_alone_does_not_bounce_the_fleet(tmp_path, monkeypatch):
+    """Services consume scenario_epoch solely as the shuffle seed, so
+    coldwarm's measured legs (epoch-only overlay, no shuffle window)
+    must NOT trigger the fleet re-prepare rebuild — it would re-open
+    dataset fds and re-warm metadata right behind the cache drop. The
+    epochs scenario (per-epoch shuffle_window + seed) still must."""
+    from elbencho_tpu import coordinator as coord_mod
+    from elbencho_tpu.testing.service_harness import in_process_services
+    calls = []
+    real = coord_mod.Coordinator._rebuild_manager
+    monkeypatch.setattr(
+        coord_mod.Coordinator, "_rebuild_manager",
+        lambda self: (calls.append(1), real(self))[1])
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    with in_process_services(2) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        rc = _run_main(["--scenario", "coldwarm", "--scenario-opt",
+                        "epochs=2,cold=0", "--hosts", hosts, "-t", "1",
+                        "-n", "1", "-N", "2", "-s", "64K", "-b", "16K",
+                        "--jsonfile", str(tmp_path / "cw.json"),
+                        str(bench)])
+        assert rc == 0
+        assert not calls, "epoch-only overlay re-prepared the fleet"
+        rc = _run_main(["--scenario", "epochs", "--scenario-opt",
+                        "epochs=2", "--hosts", hosts, "-t", "1",
+                        "-n", "1", "-N", "2", "-s", "64K", "-b", "16K",
+                        "--jsonfile", str(tmp_path / "ep.json"),
+                        str(bench)])
+    assert rc == 0
+    assert calls, "per-epoch shuffle seed must re-ship the config"
+
+
+def test_scenario_e2e_file_mode_creates_missing_file(tmp_path):
+    """FILE bench path that does not exist yet: the plan's write legs
+    must create it (O_CREAT via scenario_creates_files) even though the
+    explicit phase flags stay off under --scenario."""
+    target = tmp_path / "ckpt.bin"
+    jf = tmp_path / "r.json"
+    rc = _run_main(["--scenario", "ckpt-burst", "--scenario-opt",
+                    "bursts=2", "-t", "2", "-s", "128K", "-b", "16K",
+                    "--jsonfile", str(jf), str(target)])
+    assert rc == 0
+    assert target.stat().st_size == 128 * 1024
+    _assert_scenario_records(_recs(jf), "ckpt-burst")
+
+
+def test_scenario_e2e_file_mode_service_fleet(tmp_path):
+    """The file-mode scenario against a real in-process fleet: services
+    see scenario_creates_files on the wire (their O_CREAT + size-guard
+    relaxation; the scenario name itself is stripped), and the
+    expansion-time setup.mkdirs leg — emitted because master mode cannot
+    probe the remote path type — is skipped at run time once the
+    services' probe reports a non-DIR path, instead of hammering
+    CREATEDIRS against a file."""
+    from elbencho_tpu.testing.service_harness import in_process_services
+    target = tmp_path / "ckpt.bin"
+    jf = tmp_path / "r.json"
+    with in_process_services(2) as ports:
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        rc = _run_main(["--scenario", "ckpt-burst", "--scenario-opt",
+                        "bursts=1", "--hosts", hosts, "-t", "1",
+                        "-s", "128K", "-b", "16K",
+                        "--jsonfile", str(jf), str(target)])
+    assert rc == 0
+    assert target.exists()
+    recs = _recs(jf)
+    _assert_scenario_records(recs, "ckpt-burst")
+    # no MKDIRS record: the setup.mkdirs leg was skipped, not failed
+    assert all(r["Phase"] != "MKDIRS" for r in recs)
+    for r in recs:
+        if not r.get("ScenarioAnalysis"):
+            assert r["NumWorkers"] == 2, r["ScenarioStep"]
+
+
+def test_contend_doctor_verdict_with_flightrec(tmp_path):
+    """--flightrec + --scenario: each leg's per-phase doctor analysis
+    rides its step summary, so the scenario verdict can compare stage
+    decompositions across legs (the 'doctor learns scenario-level
+    verdicts' acceptance line)."""
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "r.json"
+    rec_path = tmp_path / "run.rec"
+    rc = _run_main(["--scenario", "contend", "--scenario-opt",
+                    "readthreads=1", "-t", "2", "-n", "1", "-N", "2",
+                    "-s", "256K", "-b", "16K", "--flightrec",
+                    str(rec_path), "--jsonfile", str(jf), str(bench)])
+    assert rc == 0
+    summary = next(r for r in _recs(jf) if r.get("ScenarioAnalysis"))
+    ana = summary["ScenarioAnalysis"]
+    contend = next(s for s in ana["Steps"] if s.get("Role") == "contend")
+    assert "Analysis" in contend and "StagePct" in contend["Analysis"]
+    assert any(v["Kind"] == "contention" for v in ana["Verdicts"])
+
+
+def test_shuffle_window_standalone_flag(tmp_path):
+    """--shufflewindow works outside scenarios: a plain read phase reads
+    the full file (byte parity with sequential) in permuted order."""
+    data = tmp_path / "data.bin"
+    payload = np.arange(64 * 1024, dtype=np.uint8).tobytes()
+    data.write_bytes(payload)
+    jf = tmp_path / "r.json"
+    rc = _run_main(["-r", "-t", "1", "-b", "4K", "--shufflewindow", "16K",
+                    "--jsonfile", str(jf), str(data)])
+    assert rc == 0
+    rec = next(r for r in _recs(jf) if r["Phase"] == "READ")
+    assert rec["BytesLast"] == len(payload)
+    assert rec["IOPSLast"] > 0
+
+
+def test_dataloader_pacing_shapes_the_phase(tmp_path):
+    """The paced loader leg must take at least the consume-clock floor:
+    (batches - prefetch) * stepusec, proving the pacer really shaped
+    the phase instead of letting storage burst."""
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "r.json"
+    # 1 thread x 1 dir x 2 files x 128K / 16K blocks = 16 blocks
+    # = 8 batches of 2; prefetch 2, step 30ms => floor ~180ms
+    rc = _run_main(["--scenario", "dataloader", "--scenario-opt",
+                    "prefetch=2,stepusec=30000,batchblocks=2,decodeusec=0",
+                    "-t", "1", "-n", "1", "-N", "2", "-s", "128K",
+                    "-b", "16K", "--jsonfile", str(jf), str(bench)])
+    assert rc == 0
+    loader = next(r for r in _recs(jf)
+                  if r.get("ScenarioStep") == "loader")
+    assert loader["ElapsedUSecLast"] >= 170_000, \
+        "loader leg finished faster than the consume clock allows"
+
+
+# ---------------------------------------------------------------------------
+# tools: summarize columns + banner, chart timeline, CSV schema
+# ---------------------------------------------------------------------------
+
+def _scenario_jsonfile(tmp_path):
+    bench = tmp_path / "bench"
+    bench.mkdir(exist_ok=True)
+    jf = tmp_path / "r.json"
+    csvf = tmp_path / "r.csv"
+    rc = _run_main(["--scenario", "epochs", "--scenario-opt",
+                    "epochs=2,window=64K", "-t", "1", "-n", "1", "-N", "2",
+                    "-s", "128K", "-b", "16K", "--jsonfile", str(jf),
+                    "--csvfile", str(csvf), str(bench)])
+    assert rc == 0
+    return jf, csvf
+
+
+def test_summarize_appends_scenario_columns_and_banners(tmp_path):
+    jf, csvf = _scenario_jsonfile(tmp_path)
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_DIR, "tools", "elbencho-tpu-summarize-json"),
+         str(jf), "--csv"], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    header = res.stdout.splitlines()[0].split(",")
+    # the scenario trio appends AFTER every pre-existing column
+    assert header[-3:] == ["Scenario", "Step", "EpochRate"]
+    assert header.index("LatP99.9") < header.index("Scenario")
+    rows = [ln.split(",") for ln in res.stdout.splitlines()[1:]]
+    # the terminal SCENARIO record is bannered, not tabulated
+    assert all(row[0] != "SCENARIO" for row in rows)
+    epoch_rows = [r for r in rows if r[-2].startswith("epoch")]
+    assert len(epoch_rows) == 2
+    assert all(r[-3] == "epochs" for r in epoch_rows)
+    assert float(epoch_rows[0][-1]) > 0
+    assert "SCENARIO epochs [cache-warmup]" in res.stderr
+    # CSV result columns carry the appended trio too (schema check)
+    csv_header = csvf.read_text().splitlines()[0].split(",")
+    trio_at = csv_header.index("Scenario")
+    assert csv_header[trio_at:trio_at + 3] == \
+        ["Scenario", "ScenarioStep", "EpochRateMiBs"]
+
+
+def test_chart_renders_scenario_timeline(tmp_path):
+    jf, _csvf = _scenario_jsonfile(tmp_path)
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_DIR, "tools", "elbencho-tpu-chart"),
+         "--scenario", str(jf)], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    out = res.stdout
+    assert "scenario epochs:" in out
+    # labeled timeline segments, one per step, plus the verdict line
+    for label in ("setup [WRITE]", "epoch1 [READ]", "epoch2 [READ]"):
+        assert label in out
+    assert out.count("|") >= 8  # bar rails
+    assert "verdict [cache-warmup]:" in out

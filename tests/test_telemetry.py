@@ -339,10 +339,11 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     # with the (later) data-plane fault-tolerance, staging-pool,
     # run-lifecycle, streaming-control-plane, pod-slice, and
     # latency-percentile columns after them
-    assert cols[-22:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    assert cols[-25:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                           "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                           "PoolReuse", "RegOps", "SqpollOps",
                           "LeaseExp", "Resumed", "StreamB", "DeltaSave",
                           "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
-                          "LatP50", "LatP99", "LatP99.9"]
-    assert row.split(",")[-22:-17] == ["3", "7", "2", "5", "11"]
+                          "LatP50", "LatP99", "LatP99.9",
+                          "Scenario", "Step", "EpochRate"]
+    assert row.split(",")[-25:-20] == ["3", "7", "2", "5", "11"]
