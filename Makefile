@@ -110,14 +110,17 @@ test-scale:
 		python -m pytest tests/test_stream_scale.py -q -m scale
 
 # observability gate: the telemetry + flight-recorder + run-doctor +
-# fleet-tracing suites (/metrics scrape-under-load, trace schema,
-# flightrec codec round-trip/torn-tail/merge properties, doctor
-# verdicts incl. straggler attribution, clock-skew estimator units,
-# fleet trace merge properties, the 8-host cross-host-flow e2e, the
-# no-op overhead guards; pytest marker `obs`; docs/telemetry.md)
+# fleet-tracing + slow-op-forensics suites (/metrics scrape-under-load,
+# trace schema, flightrec codec round-trip/torn-tail/merge properties,
+# doctor verdicts incl. straggler + tail attribution, clock-skew
+# estimator units, fleet trace merge properties, the 8-host
+# cross-host-flow e2e, the --slowops chaos e2e naming an injected slow
+# host/file/offset, the no-op overhead guards; pytest marker `obs`;
+# docs/telemetry.md)
 test-obs: check-schema
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
-		tests/test_flightrec.py tests/test_tracefleet.py -q -m obs
+		tests/test_flightrec.py tests/test_tracefleet.py \
+		tests/test_slowops.py -q -m obs
 
 # training-ingest scenario gate: the --scenario suite (plan expansion
 # units, shuffle-window generator properties, dataloader pacing, e2e

@@ -618,6 +618,49 @@ def _fleet_trace_attach(tmpdir, target, tier, extra_args=None,
         return {"tier": tier, "error": str(err)[-300:]}
 
 
+def _tail_attach(med_rec, tmpdir, target, tier, extra_args=None,
+                 extra_env=None):
+    """Tail dict for the artifact (slow-op forensics satellite): the
+    p50/p99/p99.9 percentiles come from the MEASURED median pass's
+    histogram — the headline pass never runs --slowops, which (like
+    tracing) swaps the plain native block loop for the instrumented
+    Python loop — and the top-slow-op context comes from one SHORT
+    --slowops rider pass. Tier-labeled like the doctor dict, so a
+    host-path tail can never masquerade as TPU evidence; failures are
+    context, never fatal."""
+    out = {"tier": tier}
+    try:
+        from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+        histo = LatencyHistogram.from_dict(med_rec.get("IOLatHisto", {}))
+        p50 = histo.percentile(50)
+        tail_usec = max(histo.percentile(99.9), float(histo.max_micro))
+        out.update({
+            "p50_usec": round(p50, 1),
+            "p99_usec": round(histo.percentile(99), 1),
+            "p999_usec": round(histo.percentile(99.9), 1),
+            "max_usec": histo.max_micro,
+            "tail_vs_median": round(tail_usec / p50, 1) if p50 else 0,
+        })
+    except Exception as err:  # noqa: BLE001 - rider must never kill a record
+        out["error"] = str(err)[-300:]
+        return out
+    jf = os.path.join(tmpdir, "tailrider.json")
+    try:
+        recs = _run_cli(["-r", "-t", THREADS, "-s", BLOCK_SIZE,
+                         "-b", BLOCK_SIZE, "--slowops", "8",
+                         *(extra_args or []), target], jf,
+                        extra_env=extra_env, timeout=300)
+        tail = next((r["TailAnalysis"] for r in recs
+                     if r.get("TailAnalysis")), None)
+        if tail:
+            out["rider_tail_ratio"] = tail.get("TailRatio", 0)
+            out["top_slow_op"] = (tail.get("SlowOps") or [{}])[0]
+            out["owners"] = tail.get("Owners", {})
+    except Exception as err:  # noqa: BLE001 - rider must never kill a record
+        out["rider_error"] = str(err)[-300:]
+    return out
+
+
 def _fixedbuf_ab(target, jsonfile, extra_env=None):
     """Fixed-buffers-vs-malloc A/B rider: one read pass on the unified
     staging pool's registered ring (--ioengine uring where the kernel
@@ -818,6 +861,13 @@ def _run_fallback_ladder(probe_err) -> int:
             # like the doctor dict (single lane on a local fallback run)
             "fleet_trace": _fleet_trace_attach(
                 tmpdir, target, tier,
+                extra_args=["--tpuids", "0"] if tier == "host_staging"
+                else [],
+                extra_env=_FALLBACK_ENV),
+            # tail signal (slow-op forensics): measured-pass percentiles
+            # + a short --slowops rider's top-op context, tier-labeled
+            "tail": _tail_attach(
+                med_rec, tmpdir, target, tier,
                 extra_args=["--tpuids", "0"] if tier == "host_staging"
                 else [],
                 extra_env=_FALLBACK_ENV),
@@ -1121,6 +1171,13 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             # skew evidence riding next to the verdict; tier-labeled)
             "fleet_trace": _fleet_trace_attach(
                 tmpdir, target,
+                "tpu" if platform in TPU_PLATFORMS
+                else f"selftest_{platform}",
+                extra_args=["--tpuids", "0", "--tpudirect"]),
+            # tail signal (slow-op forensics): measured-pass percentiles
+            # + a short --slowops rider's top-op context, tier-labeled
+            "tail": _tail_attach(
+                med_rec, tmpdir, target,
                 "tpu" if platform in TPU_PLATFORMS
                 else f"selftest_{platform}",
                 extra_args=["--tpuids", "0", "--tpudirect"]),

@@ -288,6 +288,22 @@ FLAG_DEFS = [
      "/benchresult for the fleet trace merge; an over-cap ring is "
      "refused LOUDLY on both ends (never fatal) and the host's lane "
      "stays local-only"),
+    ("slowops", None, "slow_ops_k", "int", 0, "misc",
+     "Slow-op forensics: each worker captures its K slowest storage ops "
+     "(op, phase, rank, file/offset/size, latency, retry/timeout chain, "
+     "storage-vs-dispatch-vs-DMA split under TPU staging, trace span "
+     "link) plus a deterministic latency sample; services ship the "
+     "capture with the /benchresult reply (zero extra requests, "
+     "--traceshipcap bounds it) and the master merges everything into "
+     "the run JSON's TailAnalysis block for the doctor's tail-bound "
+     "verdict and elbencho-tpu-chart --tail heatmaps (0 = off, the "
+     "default; docs/telemetry.md \"Tail forensics\")"),
+    ("opsample", None, "op_sample_rate", "float", 1.0, "misc",
+     "Fraction of ops the --slowops density sample keeps (0..1, "
+     "deterministic systematic sampling by op index; the bounded "
+     "per-worker reservoir halves its resolution instead of growing — "
+     "drops are counted in OpSamplesDropped). Default 1.0 = every op "
+     "feeds the sample until the reservoir bound bites"),
 
     # distribution
     ("hosts", None, "hosts_str", "str", "", "dist",
@@ -1465,6 +1481,14 @@ class BenchConfig(BenchConfigBase):
                 "--tracefile PATH")
         if self.trace_ship_cap_mib < 1:
             raise ConfigError("--traceshipcap must be >= 1 (MiB)")
+        if self.slow_ops_k < 0:
+            raise ConfigError("--slowops must be >= 0 (0 = off)")
+        if not (0.0 <= self.op_sample_rate <= 1.0):
+            raise ConfigError("--opsample must be in 0..1")
+        if self.op_sample_rate != 1.0 and not self.slow_ops_k:
+            raise ConfigError(
+                "--opsample tunes the --slowops density sample — give "
+                "--slowops K")
         if self.io_num_retries < 0:
             raise ConfigError("--ioretries must be >= 0")
         if self.io_retry_budget_secs < 0:

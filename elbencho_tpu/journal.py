@@ -67,6 +67,7 @@ FINGERPRINT_EXCLUDE = frozenset({
     "ops_log_path", "ops_log_lock", "telemetry", "telemetry_port",
     "trace_file_path", "trace_sample", "trace_fleet",
     "trace_ship_cap_mib", "flightrec_file_path",
+    "slow_ops_k", "op_sample_rate",
     "tpu_profile_dir",
     # control-plane resilience knobs (retry shape, not data shape)
     "svc_num_retries", "svc_retry_budget_secs", "svc_stalled_secs",

@@ -403,6 +403,8 @@ class ServiceState:
                 logger.log_error(f"--tracefile write failed: {err}")
         if tracer is not None and params.get(proto.KEY_SHIP_TRACE):
             self._attach_trace_ring(result, tracer)
+        if params.get(proto.KEY_SHIP_SLOWOPS) and manager is not None:
+            self._attach_slowops(result, manager)
         return result
 
     #: reply key carrying the PRE-SERIALIZED span ring from bench_result
@@ -449,6 +451,43 @@ class ServiceState:
         # must not cost the only copy of these spans
         self._trace_ship_pending = getattr(self.cfg,
                                            "trace_file_path", "")
+
+    #: reply key carrying the PRE-SERIALIZED slow-op capture, spliced
+    #: into the reply body like the span ring (serialized exactly once)
+    SLOWOPS_JSON_KEY = "_SlowOpsJson"
+
+    def _attach_slowops(self, result: dict, manager) -> None:
+        """Slow-op forensics: merge this host's per-worker captures and
+        attach them to the /benchresult reply. The density sample is
+        thinned to the merged-lane cap BEFORE shipping (the master
+        decimates each host's lane to MERGED_LANE_CAP anyway, so extra
+        points would only be serialized to be discarded on arrival) and
+        still enforced against --traceshipcap like the span ring — an
+        over-cap capture is refused LOUDLY on both ends, never fatally
+        (the run's numbers outrank its telemetry)."""
+        import json as json_mod
+        from ..telemetry.slowops import merge_snapshots, thin_points
+        parts = [w._slowops.snapshot() for w in manager.workers
+                 if getattr(w, "_slowops", None) is not None]
+        if not parts:
+            return
+        merged = merge_snapshots(parts,
+                                 getattr(self.cfg, "slow_ops_k", 0))
+        merged["Sample"] = thin_points(merged["Sample"])
+        cap_mib = getattr(self.cfg, "trace_ship_cap_mib", 16)
+        merged_json = json_mod.dumps(merged, separators=(",", ":"))
+        if len(merged_json) > cap_mib << 20:
+            logger.log_error(
+                f"slow-op forensics: NOT shipping this host's capture — "
+                f"{len(merged_json) >> 20} MiB serialized exceeds "
+                f"--traceshipcap {cap_mib} MiB; lower "
+                f"--slowops/--opsample or raise the cap (the merged "
+                f"TailAnalysis will miss this host)")
+            result[proto.KEY_SLOWOPS_REFUSED] = {
+                "Records": len(merged.get("Records", [])),
+                "Bytes": len(merged_json), "CapMiB": cap_mib}
+            return
+        result[self.SLOWOPS_JSON_KEY] = merged_json
 
     def metrics(self) -> str:
         """Prometheus text rendering of this service's live state."""
@@ -599,18 +638,26 @@ def _make_handler(state: ServiceState, server_holder: dict):
                                 content_type=PROMETHEUS_CONTENT_TYPE)
                 elif route == proto.PATH_BENCH_RESULT:
                     result = state.bench_result(params)
+                    # splice the pre-serialized payloads in, so the
+                    # multi-MiB span ring / slow-op capture are never
+                    # dumps'd a second time under route_lock
+                    splices = []
                     ring_json = result.pop(
                         ServiceState.TRACE_RING_JSON_KEY, None)
-                    if ring_json is None:
+                    if ring_json is not None:
+                        splices.append(
+                            f'"{proto.KEY_TRACE_RING}":' + ring_json)
+                    slowops_json = result.pop(
+                        ServiceState.SLOWOPS_JSON_KEY, None)
+                    if slowops_json is not None:
+                        splices.append(
+                            f'"{proto.KEY_SLOWOPS}":' + slowops_json)
+                    if not splices:
                         self._reply(200, result)
                     else:
-                        # splice the pre-serialized ring in, so the
-                        # multi-MiB span payload is never dumps'd twice
                         body = json.dumps(result)
                         body = (body[:-1] + "," if body != "{}"
-                                else "{") \
-                            + f'"{proto.KEY_TRACE_RING}":' \
-                            + ring_json + "}"
+                                else "{") + ",".join(splices) + "}"
                         self._reply(200, body)
                 elif route == proto.PATH_START_PHASE:
                     code, msg = state.start_phase(

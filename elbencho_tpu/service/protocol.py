@@ -77,6 +77,14 @@ KEY_SVC_CLOCK = "SvcClockUsec"
 KEY_TRACE_RING = "TraceRing"
 KEY_TRACE_RING_REFUSED = "TraceRingRefused"
 HDR_SVC_CLOCK = "X-Svc-Clock-Usec"
+# slow-op forensics (--slowops; docs/telemetry.md "Tail forensics"):
+# ShipSlowOps on /benchresult asks the service to attach its merged
+# worker slow-op capture (K-slowest heaps + density samples) to the
+# reply — same piggyback discipline as ShipTrace: size-capped by
+# --traceshipcap, refusal LOUD never fatal, zero extra requests
+KEY_SHIP_SLOWOPS = "ShipSlowOps"
+KEY_SLOWOPS = "SlowOps"
+KEY_SLOWOPS_REFUSED = "SlowOpsRefused"
 
 
 def make_pw_hash(secret: str) -> str:

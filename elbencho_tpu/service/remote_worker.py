@@ -438,6 +438,11 @@ class RemoteWorker(Worker):
                                             fleet_trace_enabled)
         self.clock_sync = ClockSyncEstimator()
         self._fleet_trace = fleet_trace_enabled(self.cfg)
+        # slow-op forensics (--slowops): this proxy never records ops
+        # itself — it ingests the snapshot its service ships at
+        # /benchresult (the counters arrive via the PATH_AUDIT ingest)
+        self._slowops = None
+        self.slowops_shipped: "dict | None" = None
         pw_hash = ""
         if self.cfg.svc_password_file:
             pw_hash = proto.read_pw_file(self.cfg.svc_password_file)
@@ -488,6 +493,7 @@ class RemoteWorker(Worker):
         self.barrier_wait_usec = 0
         self.phase_done_monotonic = 0.0
         self.done_obs_quantum_usec = 0
+        self.slowops_shipped = None
         if self.degraded:
             # a lost host stays excluded from all later phase results
             self.got_phase_work = False
@@ -630,6 +636,25 @@ class RemoteWorker(Worker):
         if best is None:
             return 0, 0, False
         return best[0], best[1], True
+
+    def _ingest_slowops(self, result: dict) -> None:
+        """Slow-op forensics: keep the snapshot this host's /benchresult
+        shipped for the master's TailAnalysis merge. A refusal (capture
+        over --traceshipcap) is LOUD, never fatal — the merged block
+        then names the missing host in its Refusals list."""
+        refused = result.get(proto.KEY_SLOWOPS_REFUSED)
+        if refused:
+            logger.log_error(
+                f"slow-op forensics: {self.host} refused to ship its "
+                f"capture ({refused.get('Records', 0)} records, "
+                f"{refused.get('Bytes', 0)} bytes > --traceshipcap "
+                f"{refused.get('CapMiB', 0)} MiB) — TailAnalysis will "
+                f"miss this host")
+            self.slowops_shipped = None
+            return
+        shipped = result.get(proto.KEY_SLOWOPS)
+        self.slowops_shipped = shipped if isinstance(shipped, dict) \
+            else None
 
     def _collect_trace_ring(self, result: dict) -> None:
         """Fleet tracing: persist the span ring a /benchresult reply
@@ -1174,6 +1199,11 @@ class RemoteWorker(Worker):
         params, flow_id = self._trace_params()
         if params is not None:
             params[proto.KEY_SHIP_TRACE] = 1
+        if getattr(self.cfg, "slow_ops_k", 0):
+            # slow-op forensics rides the SAME request (zero extra
+            # requests; SvcRequests stays byte-identical)
+            params = params or {}
+            params[proto.KEY_SHIP_SLOWOPS] = 1
         tracer = self.shared.tracer
         t0_ns = tracer.now_ns() if tracer is not None else 0
         t0_wall = time.time_ns() // 1000
@@ -1234,6 +1264,8 @@ class RemoteWorker(Worker):
             int(chip): (v.get("Bytes", 0), v.get("USec", 0))
             for chip, v in result.get("TpuPerChip", {}).items()}
         self.got_phase_work = bool(self.elapsed_usec_vec)
+        if getattr(self.cfg, "slow_ops_k", 0):
+            self._ingest_slowops(result)
         if self._fleet_trace:
             self._collect_trace_ring(result)
         if getattr(self.shared, "stream_control", None) is not None:

@@ -96,6 +96,9 @@ class PhaseResults:
         # --flightrec: the run doctor's verdict for this phase
         # (telemetry/doctor.py; JSON-only "Analysis" block)
         self.analysis: "dict | None" = None
+        # --slowops: the fleet-merged tail forensics block
+        # (telemetry/slowops.py; JSON-only "TailAnalysis" block)
+        self.tail_analysis: "dict | None" = None
 
 
 class Statistics:
@@ -258,7 +261,26 @@ class Statistics:
             lines.append(f"... showing {scroll}..{scroll + len(window) - 1} "
                          f"of {len(workers)} workers (arrow keys / PgUp / "
                          f"PgDn scroll)")
-        # footer: per-service-host CPU util sampled from the /status polls
+        # footer: running tail percentiles (bucket-walk over the live
+        # histograms the wire already carries — tails are visible
+        # MID-RUN, not only post-mortem; slow-op forensics satellite).
+        # Entry-granular phases (mkdirs/stat/delete) move no blocks, so
+        # their entry latencies ARE the per-op distribution shown.
+        # gate on BUCKET content, not num_values: master-mode sum-only
+        # mirrors (no --telemetry bucket view on the wire) carry counts
+        # and sums with empty buckets — percentile() would answer 0
+        io_histo, ent_histo = merge_live_latency_histos(workers)
+        tail_histo, tail_label = ((io_histo, "IO")
+                                  if any(io_histo.buckets)
+                                  else (ent_histo, "Entry"))
+        if any(tail_histo.buckets):
+            lines.append(
+                f"{tail_label} lat us: "
+                f"p50={tail_histo.percentile(50):,.0f}  "
+                f"p99={tail_histo.percentile(99):,.0f}  "
+                f"p99.9={tail_histo.percentile(99.9):,.0f}  "
+                f"max={tail_histo.max_micro:,}")
+        # per-service-host CPU util sampled from the /status polls
         # (telemetry satellite; RemoteWorker.cpu_util_pct live ingest)
         host_cpus = [(w.host, w.cpu_util_pct) for w in workers
                      if getattr(w, "host", None) is not None
@@ -479,7 +501,40 @@ class Statistics:
         res.final = final_totals
         res.stonewall_rwmix = stonewall_rwmix
         res.final_rwmix = final_rwmix
+        if getattr(cfg, "slow_ops_k", 0):
+            res.tail_analysis = self._build_tail_analysis(res)
         return res
+
+    def _build_tail_analysis(self, res: PhaseResults) -> "dict | None":
+        """Fleet-merge every worker's slow-op capture (local recorders
+        directly, RemoteWorkers' shipped snapshots) into the phase's
+        TailAnalysis block. The exact percentiles come from the merged
+        io histogram (rwmix reads folded in, like the live view); the
+        captures add the WHO/WHERE attribution."""
+        from ..telemetry.slowops import build_tail_analysis
+        parts: "list[tuple[str, dict | None]]" = []
+        for w in self.manager.workers:
+            if getattr(w, "_slowops", None) is not None:
+                parts.append(("", w._slowops.snapshot()))
+            elif getattr(w, "host", None) is not None:
+                parts.append((w.host, getattr(w, "slowops_shipped",
+                                              None)))
+        if not any(((snap or {}).get("OpsSeen", 0)
+                    or (snap or {}).get("Records"))
+                   for _host, snap in parts):
+            return None  # nothing captured (e.g. a pure mkdir phase)
+        io_histo = LatencyHistogram()
+        io_histo.merge(res.iops_histo)
+        io_histo.merge(res.iops_histo_rwmix)
+        if not io_histo.num_values:
+            # entry-granular phase (stat/delete): the entry latencies
+            # ARE the per-op distribution the captures attribute
+            io_histo.merge(res.entries_histo)
+        if not io_histo.num_values:
+            return None  # no latencies recorded this phase (e.g. mkdir)
+        return build_tail_analysis(
+            parts, io_histo, getattr(self.cfg, "slow_ops_k", 0),
+            getattr(self.cfg, "op_sample_rate", 1.0))
 
     def _compute_barrier_skew(self) -> None:
         """Per-host barrier decomposition from the finish stamps each
@@ -651,6 +706,19 @@ class Statistics:
                                  f"{_fmt_elapsed_usec(max(w.elapsed_usec_vec))}")
             if parts:
                 rows.append(f"{'':12}Service elapsed  : {', '.join(parts)}")
+        if res.tail_analysis is not None:
+            # --slowops tail forensics: how heavy the tail is and who
+            # owns it (full detail in the JSON TailAnalysis block)
+            tail = res.tail_analysis
+            hosts = tail["Owners"]["ByHost"]
+            owner = max(hosts, key=hosts.get) if hosts else ""
+            line = (f"p50={tail['P50Usec']} p99={tail['P99Usec']} "
+                    f"p99.9={tail['P999Usec']} max={tail['MaxUsec']} "
+                    f"({tail['TailRatio']:g}x p50")
+            if owner:
+                line += (f"; {hosts[owner]:.0%} of captured tail time "
+                         f"on {owner}")
+            rows.append(f"{'':12}{'Tail lat us :':<20}{line})")
         if res.analysis is not None:
             # --flightrec run doctor: where the wall time went + the
             # named bottleneck, right under the numbers it explains
@@ -874,6 +942,11 @@ class Statistics:
             # verdict (docs/result-columns.md Analysis block); absent
             # without --flightrec so the off path stays byte-identical
             rec["Analysis"] = res.analysis
+        if res.tail_analysis is not None:
+            # --slowops tail forensics (docs/result-columns.md
+            # TailAnalysis block); absent without --slowops so the off
+            # path stays byte-identical
+            rec["TailAnalysis"] = res.tail_analysis
         with open(self.cfg.json_file_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
 

@@ -82,6 +82,18 @@ BARRIER_SHARE_PCT = 15.0
 STRAGGLER_MIN_SKEW_USEC = 50_000
 OBS_QUANTUM_FLOOR_FACTOR = 2
 
+#: tail-bound gates (--slowops TailAnalysis input): the tail (p99.9, or
+#: the observed max where p99.9 is unresolved at low op counts) must be
+#: this many times p50, ...
+TAIL_RATIO_BOUND = 10.0
+#: ... at least this slow in absolute terms (a 300us tail over a 30us
+#: p50 is a curiosity, not a bottleneck), ...
+TAIL_MIN_USEC = 50_000
+#: ... and the captured tail ops must own a real share of the fleet's
+#: storage busy time — otherwise the tail is measurable but not what
+#: bounds the phase
+TAIL_MIN_SHARE_PCT = 5.0
+
 
 def _overlap_eff(a_usec: float, b_usec: float, wall_usec: float
                  ) -> "float | None":
@@ -157,7 +169,8 @@ def _straggler_block(host_info: "dict | None", totals: dict,
 
 def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
                   num_workers: int, series=None,
-                  host_info: "dict | None" = None) -> dict:
+                  host_info: "dict | None" = None,
+                  tail: "dict | None" = None) -> dict:
     """One phase's stage decomposition + bottleneck verdict.
 
     ``totals`` is the fleet-merged cumulative counter state at phase end
@@ -166,7 +179,8 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
     trend evidence, optional; ``host_info`` is the per-host barrier
     decomposition ({host: {StragglerSkewUsec, BarrierWaitUSec,
     LastTickPct, ClockOffsetUsec, ...}}) for straggler attribution,
-    optional."""
+    optional; ``tail`` is the --slowops TailAnalysis block for
+    tail-attribution verdicts, optional."""
     workers = max(num_workers, 1)
     wall = max(int(elapsed_usec), 0)
     worker_usec = wall * workers
@@ -196,11 +210,43 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
     }
 
     straggler = _straggler_block(host_info, totals, wall, worker_usec)
+    from .slowops import describe_slowest, tail_doctor_summary
+    tail_summary = tail_doctor_summary(tail)
+    tail_hot = (
+        tail is not None
+        and max(tail.get("P999Usec", 0),
+                tail.get("MaxUsec", 0)) >= TAIL_MIN_USEC
+        and tail.get("TailRatio", 0.0) >= TAIL_RATIO_BOUND
+        and tail.get("TailSharePct", 0.0) >= TAIL_MIN_SHARE_PCT)
 
     # -- verdict -------------------------------------------------------------
     verdict = "inconclusive"
     bottleneck = ""
-    if straggler is not None \
+    if tail_hot:
+        # a handful of ops own the phase: tail attribution outranks the
+        # coarser verdicts below (a straggler host whose lag IS a few
+        # slow ops is better explained by naming those ops, and stage
+        # shares describe the mean, not the ops that bound the phase)
+        verdict = "tail-bound"
+        bottleneck = "tail"
+        evidence.append(
+            f"p99.9 is {tail['TailRatio']:g}x p50 "
+            f"({max(tail['P999Usec'], tail['MaxUsec'])}us vs "
+            f"{tail['P50Usec']}us); captured tail ops own "
+            f"{tail['TailSharePct']:g}% of storage busy time")
+        if tail_summary and tail_summary["TopHost"]:
+            evidence.append(
+                f"{tail_summary['TopHostPct']:g}% of captured tail "
+                f"time on host {tail_summary['TopHost']}")
+        if tail_summary and tail_summary["TopDir"] \
+                and tail_summary["TopDir"] != tail_summary["TopHost"]:
+            evidence.append(
+                f"{tail_summary['TopDirPct']:g}% of tail ops hit "
+                f"files under {tail_summary['TopDir']}")
+        slowest = describe_slowest(tail)
+        if slowest:
+            evidence.append(slowest)
+    elif straggler is not None \
             and straggler["BarrierWaitPct"] >= BARRIER_SHARE_PCT \
             and straggler["SkewUSec"] >= straggler["SkewFloorUsec"]:
         # the fleet idled at the phase barrier for a dominant share of
@@ -280,6 +326,15 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
             f"barrier share AND >= "
             f"{straggler['SkewFloorUsec'] / 1e6:g}s skew — floor "
             f"covers the done-observation quantum)")
+    if verdict != "tail-bound" and tail_summary is not None \
+            and tail_summary["TailRatio"]:
+        evidence.append(
+            f"tail: p99.9/p50 = {tail_summary['TailRatio']:g}x, "
+            f"captured tail share "
+            f"{tail_summary['TailSharePct']:g}% (below the tail-bound "
+            f"gate: >= {TAIL_RATIO_BOUND:g}x AND >= "
+            f"{TAIL_MIN_USEC / 1000:g}ms AND >= "
+            f"{TAIL_MIN_SHARE_PCT:g}% of storage busy time)")
     if int(totals.get("IoRetries", 0)):
         evidence.append(f"storage retries: {totals.get('IoRetries', 0)} "
                         f"({stage_usec['io_retry']} us backoff)")
@@ -312,6 +367,11 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
         # fleet straggler attribution (null for local / single-host
         # phases): appended key, never reordered
         "Straggler": straggler,
+        # tail forensics summary (null without --slowops): the compact
+        # view verdicts and diffs consume — the full TailAnalysis block
+        # lives beside this Analysis in the run JSON / phase_end row.
+        # Appended key, never reordered.
+        "Tail": tail_summary,
     }
 
 
@@ -330,7 +390,8 @@ def analyze_recording(rec: dict) -> "list[dict]":
         out.append(analyze_phase(phase["name"], end.get("Totals", {}),
                                  end.get("ElapsedUSec", 0),
                                  end.get("Workers", 0), series=series,
-                                 host_info=end.get("Hosts")))
+                                 host_info=end.get("Hosts"),
+                                 tail=end.get("Tail")))
     return out
 
 
@@ -343,6 +404,10 @@ REGRESSION_RATE_DROP = 0.10
 #: stage-share growth (percentage points) at/above which a stage is
 #: flagged as the likely culprit
 REGRESSION_SHARE_PTS = 10.0
+#: tail-ratio growth factor at/above which "tail grew" is flagged as a
+#: regression cause (p99.9/p50 doubling is a tail problem even when the
+#: mean throughput barely moved)
+REGRESSION_TAIL_GROWTH_X = 2.0
 
 
 def _phase_rate_mibs(end: dict) -> float:
@@ -400,6 +465,18 @@ def diff_recordings(rec_a: dict, rec_b: dict) -> "list[dict]":
                     f"{straggler_a.get('BarrierWaitPct', 0.0):g}% -> "
                     f"{straggler_b.get('BarrierWaitPct', 0.0):g}% of "
                     f"worker time (straggler: {straggler_b['Host']})")
+            tail_a = ana_a.get("Tail") or {}
+            tail_b = ana_b.get("Tail") or {}
+            ratio_a = tail_a.get("TailRatio", 0.0)
+            ratio_b = tail_b.get("TailRatio", 0.0)
+            if ratio_b and ratio_b >= TAIL_RATIO_BOUND \
+                    and ratio_b >= max(ratio_a, 1.0) \
+                    * REGRESSION_TAIL_GROWTH_X:
+                cause = (f"tail grew (p99.9/p50 {ratio_a:g}x -> "
+                         f"{ratio_b:g}x")
+                if tail_b.get("TopHost"):
+                    cause += f"; owner: {tail_b['TopHost']}"
+                causes.append(cause + ")")
             if ana_b["Verdict"] != ana_a["Verdict"]:
                 causes.append(f"verdict changed {ana_a['Verdict']} -> "
                               f"{ana_b['Verdict']}")

@@ -347,10 +347,12 @@ class FlightRecorder:
         self.sample(statistics)
         totals = dict(self._prev.get(FLEET, {}))
         host_info = self._host_info(statistics)
+        tail = getattr(res, "tail_analysis", None)
         from .doctor import analyze_phase
         analysis = analyze_phase(res.phase_name, totals,
                                  res.last_done_usec, res.num_workers,
-                                 series=self._series, host_info=host_info)
+                                 series=self._series, host_info=host_info,
+                                 tail=tail)
         rec = {
             "Type": "phase_end", "Phase": self._phase, "T": self._now(),
             "ElapsedUSec": res.last_done_usec,
@@ -359,6 +361,11 @@ class FlightRecorder:
             "Analysis": analysis,
             "RowsDropped": self.rows_dropped,
         }
+        if tail is not None:
+            # full --slowops TailAnalysis (bounded by construction), so
+            # the doctor CLI can recompute tail verdicts and the diff's
+            # "tail grew" cause from the recording alone
+            rec["Tail"] = tail
         if host_info:
             # per-host barrier decomposition + clock estimates, so the
             # doctor CLI can recompute straggler verdicts (and the skew

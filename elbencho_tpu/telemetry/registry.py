@@ -226,6 +226,15 @@ class BenchTelemetry:
         reg.histogram("io_latency_usec",
                       "Per-op I/O latency in microseconds "
                       "(log2 buckets at quarter-log2 resolution)")
+        # running tail gauges (slow-op forensics satellite): bucket-walk
+        # percentiles over the same live histogram, so dashboards see
+        # the tail mid-run without histogram_quantile() support
+        reg.gauge("io_latency_p99_usec",
+                  "Running p99 of per-op I/O latency this phase "
+                  "(bucket-walk over the live latency histogram)")
+        reg.gauge("io_latency_p999_usec",
+                  "Running p99.9 of per-op I/O latency this phase "
+                  "(bucket-walk over the live latency histogram)")
         reg.histogram("entry_latency_usec",
                       "Per-entry latency in microseconds")
         reg.counter("scrapes_total", "Served /metrics replies")
@@ -323,6 +332,13 @@ class BenchTelemetry:
         io_histo, ent_histo = merge_live_latency_histos(workers)
         put("io_latency_usec", io_histo)
         put("entry_latency_usec", ent_histo)
+        if any(io_histo.buckets):
+            # bucket gate, not num_values: sum-only mirrors (master-mode
+            # live ingest without the bucket view) would publish 0s as
+            # if the tail were measured
+            put("io_latency_p99_usec", round(io_histo.percentile(99), 1))
+            put("io_latency_p999_usec",
+                round(io_histo.percentile(99.9), 1))
         reg.commit(up)
 
     def render(self) -> str:

@@ -1,0 +1,402 @@
+"""Slow-op forensics: fleet-wide tail-latency capture (--slowops/--opsample).
+
+The aggregate histograms answer "HOW slow is the tail" (LatP50/P99/P99.9);
+nothing in the system could answer "WHICH ops, files, offsets, or hosts own
+it" — the question every storage sizing exercise ultimately reduces to
+(PAPERS.md arXiv 2604.21275: input-pipeline stalls at scale are driven by
+tail ops, not means). This module closes that gap:
+
+- **Per-worker capture.** Each worker holds a ``SlowOpRecorder``: a bounded
+  min-heap of its K slowest op records (op, phase, rank, file path or
+  blockdev, offset, size, latency, retry/timeout chain, storage-vs-
+  dispatch-vs-DMA stage split where a TPU context is attached, and the
+  op's trace span timestamp when ``--tracefile`` is armed) plus a
+  deterministic systematic sample of op latencies over time for density
+  estimation (the heatmap lanes). Off by default: workers hold
+  ``self._slowops is None`` and every instrumentation point is a single
+  attribute test — the same zero-overhead contract as the tracer.
+
+- **Fleet collection.** Services attach their merged worker snapshots to
+  the ``/benchresult`` reply when the master asks (``ShipSlowOps`` —
+  size-capped by ``--traceshipcap``, refusal LOUD never fatal, zero extra
+  per-tick service requests, the ``--tracefleet`` discipline). The master
+  merges everything into the run JSON's ``TailAnalysis`` block.
+
+- **Three consumers.** The run doctor learns tail-attribution verdicts
+  with evidence (``tail-bound``, diff cause "tail grew");
+  ``elbencho-tpu-chart --tail`` renders time x host and offset x latency
+  heatmaps; new audit counters (``SlowOpsRecorded``/``OpSamplesDropped``
+  sum, ``TailP999UsecHwm`` MAX) auto-plumb through PATH_AUDIT_COUNTERS
+  into wire/JSON//metrics/flightrec.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import time
+
+#: TailAnalysis block schema version (run JSON + flightrec phase_end rows)
+TAIL_ANALYSIS_SCHEMA = 1
+
+#: ordered key list of the TailAnalysis block — appended, never reordered
+#: (tools/check-schema lints this tuple against the previous commit, the
+#: same mechanical append-only gate the counter schemas ride)
+TAIL_ANALYSIS_KEYS = (
+    "Schema", "K", "SampleRate", "OpsSeen", "SlowOpsRecorded",
+    "OpSamplesDropped", "P50Usec", "P99Usec", "P999Usec", "MaxUsec",
+    "TailRatio", "TailSharePct", "SlowOps", "Owners", "Lanes", "Refusals")
+
+#: per-worker bound on retained (t, latency) sample points; overflow
+#: halves the kept set and doubles the effective stride (counted in
+#: OpSamplesDropped, so a sampled density is honest about what it lost)
+RESERVOIR_CAP = 4096
+
+#: per-host bound on merged heatmap lane points (the run-JSON block must
+#: stay a report, not a second trace file)
+MERGED_LANE_CAP = 2048
+
+#: recompute the TailP999UsecHwm mirror every this many recorded ops
+#: (bucket-walk over the recorder's own histogram; cheap but not free)
+P999_REFRESH_OPS = 512
+
+#: test-only per-port op-delay injection: in-process fleets share one
+#: process, so the chaos suite seeds this (gated on ELBENCHO_TPU_TESTING,
+#: the same opt-in as the stream ring's fault injection and the clock-skew
+#: seam tracefleet.TEST_SKEW_BY_PORT) to make exactly ONE op on ONE host
+#: provably slow: {service_port: (op_index, delay_usec)}
+TEST_OP_DELAY_BY_PORT: "dict[int, tuple[int, int]]" = {}
+
+
+def test_op_delay(cfg) -> "tuple[int, int] | None":
+    """(op_index, delay_usec) this worker's loop must inject, or None.
+    Resolved once per phase by the storage loops; needs the explicit
+    ELBENCHO_TPU_TESTING=1 opt-in, so production hot paths never even
+    consult the dict."""
+    if not TEST_OP_DELAY_BY_PORT \
+            or os.environ.get("ELBENCHO_TPU_TESTING") != "1":
+        return None
+    return TEST_OP_DELAY_BY_PORT.get(getattr(cfg, "service_port", 0))
+
+
+class SlowOpRecorder:
+    """Per-worker slow-op capture. Owned and written by the worker thread
+    (no locks — like every live counter, snapshot readers ride the GIL);
+    the heap keeps the K slowest ops, the reservoir keeps a deterministic
+    systematic sample of (t, latency) points for density estimation."""
+
+    def __init__(self, worker, k: int, sample_rate: float):
+        self.worker = worker
+        self.k = max(int(k), 1)
+        self.sample_rate = min(max(sample_rate, 0.0), 1.0)
+        # (lat_usec, seq, record) entries — seq breaks latency ties so
+        # heapq never falls through to comparing dicts
+        self._heap: "list[tuple[int, int, dict]]" = []
+        self._heap_min = -1  # lat of the heap root once K records exist
+        self._seq = 0
+        self.ops_seen = 0
+        # deterministic systematic sample: keep every _stride'th op
+        self._stride = max(round(1.0 / self.sample_rate), 1) \
+            if self.sample_rate else 0
+        self._sample: "list[tuple[int, int]]" = []  # (t_ms, lat_usec)
+        # own histogram for the running p99.9 high-water mark (the
+        # worker's phase histograms reset per phase underneath us)
+        from ..stats.latency_histogram import LatencyHistogram
+        self._histo = LatencyHistogram()
+        self._p999_refresh = 0
+
+    # -- hot path ------------------------------------------------------------
+
+    def record(self, op: str, phase: str, lat_usec: int, offset: int,
+               size: int, path: str = "", retries: int = 0,
+               timed_out: bool = False, dispatch_usec: int = 0,
+               dma_usec: int = 0, slot: "int | None" = None,
+               start_ns: "int | None" = None) -> None:
+        """One completed storage op. The common case (op faster than the
+        current K'th slowest, not sampled this stride) is two integer
+        comparisons past the caller's ``is None`` test."""
+        worker = self.worker
+        self.ops_seen += 1
+        lat_usec = int(lat_usec)
+        self._histo.add_latency(lat_usec)
+        self._p999_refresh += 1
+        if self._p999_refresh >= P999_REFRESH_OPS:
+            self._p999_refresh = 0
+            self.refresh_hwm()
+        if self._stride:
+            if self.ops_seen % self._stride == 0:
+                t_ms = int((time.monotonic()
+                            - worker.shared.phase_start_monotonic) * 1000)
+                self._sample.append((t_ms, lat_usec))
+                if len(self._sample) >= RESERVOIR_CAP:
+                    # halve resolution, keep whole-phase coverage; the
+                    # dropped half is counted honestly
+                    worker.op_samples_dropped += len(self._sample) // 2
+                    self._sample = self._sample[::2]
+                    self._stride *= 2
+        if lat_usec <= self._heap_min:
+            return
+        rec = {"Op": op, "Phase": phase, "Rank": worker.rank,
+               "LatUsec": lat_usec, "Offset": int(offset),
+               "Size": int(size),
+               "TMs": int((time.monotonic()
+                           - worker.shared.phase_start_monotonic) * 1000)}
+        if path:
+            rec["File"] = path
+        if retries:
+            rec["Retries"] = int(retries)
+        if timed_out:
+            rec["TimedOut"] = True
+        if dispatch_usec or dma_usec:
+            # stage split: storage latency is LatUsec itself; the TPU
+            # legs are the context's dispatch/DMA accounting deltas
+            # around this op's transfer hand-off
+            rec["DispatchUsec"] = int(dispatch_usec)
+            rec["DmaUsec"] = int(dma_usec)
+        if slot is not None:
+            rec["Slot"] = slot
+        tracer = getattr(worker, "_tracer", None)
+        if tracer is not None and start_ns is not None:
+            # Perfetto linkage: the instant event marks the captured op
+            # at its span's trace timestamp, so a heatmap cell can be
+            # found on the (fleet) trace timeline
+            rec["SpanTs"] = tracer.to_trace_ts(start_ns)
+            tracer.record("slow_op", "tail", start_ns, 0,
+                          rank=worker.rank, lat_usec=lat_usec,
+                          offset=int(offset), size=int(size), op=op)
+        self._seq += 1
+        entry = (lat_usec, self._seq, rec)
+        if len(self._heap) < self.k:
+            heapq.heappush(self._heap, entry)
+        else:
+            heapq.heappushpop(self._heap, entry)
+        if len(self._heap) >= self.k:
+            self._heap_min = self._heap[0][0]
+        worker.slow_ops_recorded += 1
+
+    def refresh_hwm(self) -> None:
+        """Fold the current p99.9 into the worker's TailP999UsecHwm
+        mirror. Also called from the worker's phase-finish hook so the
+        counter is final BEFORE the wire/result reads sum it."""
+        self.worker.tail_p999_usec_hwm = max(
+            self.worker.tail_p999_usec_hwm,
+            int(self._histo.percentile(99.9)))
+
+    # -- snapshot / reset ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Shippable per-worker state (plain JSON types only). Called by
+        the coordinator/service thread at phase end; list copies keep it
+        safe against a still-running worker appending."""
+        self.refresh_hwm()
+        return {
+            "K": self.k,
+            "Rank": self.worker.rank,
+            "OpsSeen": self.ops_seen,
+            "Records": [e[2] for e in sorted(self._heap, reverse=True)],
+            "Recorded": self.worker.slow_ops_recorded,
+            "Sample": [list(p) for p in self._sample],
+            "SamplesDropped": self.worker.op_samples_dropped,
+            "P999Usec": self.worker.tail_p999_usec_hwm,
+        }
+
+    def reset_phase(self) -> None:
+        """Per-phase reset, called from the worker's reset_stats next to
+        every other per-phase counter (the worker attrs are zeroed
+        there)."""
+        self._heap = []
+        self._heap_min = -1
+        self._seq = 0
+        self.ops_seen = 0
+        self._sample = []
+        self._stride = max(round(1.0 / self.sample_rate), 1) \
+            if self.sample_rate else 0
+        self._histo.reset()
+        self._p999_refresh = 0
+
+
+def make_recorder(worker) -> "SlowOpRecorder | None":
+    """The single arming point: a recorder exists iff --slowops K > 0
+    (instrumentation stays a no-op ``is None`` test otherwise)."""
+    cfg = worker.shared.config
+    k = getattr(cfg, "slow_ops_k", 0)
+    if not k:
+        return None
+    return SlowOpRecorder(worker, k, getattr(cfg, "op_sample_rate", 1.0))
+
+
+# ---------------------------------------------------------------------------
+# merge: per-worker / per-host snapshots -> one TailAnalysis block
+# ---------------------------------------------------------------------------
+
+def merge_snapshots(parts: "list[dict]", k: int) -> dict:
+    """Merge per-worker snapshot dicts into one (service-side, before the
+    ship; also the master's first fold). Top-K of the union, samples
+    concatenated (the per-host lane split happens master-side where the
+    host labels live), counters summed, P999 MAX-merged."""
+    records: "list[dict]" = []
+    sample: "list[list[int]]" = []
+    ops_seen = recorded = dropped = p999 = 0
+    for part in parts:
+        records.extend(part.get("Records", []))
+        sample.extend(part.get("Sample", []))
+        ops_seen += part.get("OpsSeen", 0)
+        recorded += part.get("Recorded", len(part.get("Records", [])))
+        dropped += part.get("SamplesDropped", 0)
+        p999 = max(p999, part.get("P999Usec", 0))
+    records.sort(key=lambda r: (-r.get("LatUsec", 0), r.get("TMs", 0)))
+    return {"K": k, "OpsSeen": ops_seen, "Records": records[:k],
+            "Recorded": recorded, "Sample": sorted(sample),
+            "SamplesDropped": dropped, "P999Usec": p999}
+
+
+def thin_points(points: "list", cap: int = MERGED_LANE_CAP) -> "list":
+    """Decimate a time-sorted (t, lat) point list to at most ``cap``
+    points by stride, keeping whole-phase coverage (used on the ship
+    path so a host never serializes more sample bytes than the merged
+    lane keeps, and on the master's per-host lane fold)."""
+    if len(points) <= cap:
+        return points
+    return points[::(len(points) + cap - 1) // cap]
+
+
+def _owner_shares(records: "list[dict]", key_fn, top: int
+                  ) -> "dict[str, float]":
+    """{owner: fraction of captured tail-op TIME} for the heaviest
+    owners — time-weighted, so one 250ms op outranks ten 1ms ones."""
+    total = sum(r.get("LatUsec", 0) for r in records)
+    if not total:
+        return {}
+    shares: "dict[str, float]" = {}
+    for rec in records:
+        owner = key_fn(rec)
+        if owner:
+            shares[owner] = shares.get(owner, 0) + rec.get("LatUsec", 0)
+    ranked = sorted(shares.items(), key=lambda kv: -kv[1])[:top]
+    return {owner: round(usec / total, 3) for owner, usec in ranked}
+
+
+def _file_dir(rec: dict) -> str:
+    path = rec.get("File", "")
+    if not path:
+        return ""
+    head = os.path.dirname(path)
+    return (head + "/") if head else path
+
+
+def build_tail_analysis(parts: "list[tuple[str, dict]]", io_histo,
+                        k: int, sample_rate: float) -> dict:
+    """The run JSON's ``TailAnalysis`` block for one phase.
+
+    ``parts`` is [(host_label, snapshot)] — "" labels the local worker
+    pool; ``io_histo`` is the fleet-merged per-op latency histogram
+    (rwmix reads folded in, like the live view), which carries the EXACT
+    percentiles — the captured records and samples add the attribution
+    and density the histogram cannot."""
+    labeled_records: "list[dict]" = []
+    lanes: "dict[str, list]" = {}
+    refusals: "list[str]" = []
+    merged_parts = []
+    for host, snap in parts:
+        if snap is None:
+            refusals.append(host or "local")
+            continue
+        label = host or "local"
+        for rec in snap.get("Records", []):
+            rec = dict(rec)
+            if host:
+                rec["Host"] = host
+            labeled_records.append(rec)
+        lane = [list(p) for p in snap.get("Sample", [])]
+        if lane:
+            # EXTEND, never assign: a local run contributes one part per
+            # worker and they all share the "local" label
+            lanes.setdefault(label, []).extend(lane)
+        merged_parts.append(snap)
+    for label in lanes:
+        lanes[label] = thin_points(sorted(lanes[label]))
+    merged = merge_snapshots(merged_parts, k)
+    labeled_records.sort(key=lambda r: (-r.get("LatUsec", 0),
+                                        r.get("TMs", 0)))
+    top = labeled_records[:k]
+    p50 = int(io_histo.percentile(50))
+    p99 = int(io_histo.percentile(99))
+    p999 = int(io_histo.percentile(99.9))
+    max_usec = int(io_histo.max_micro)
+    tail_usec = max(p999, max_usec)
+    ratio = round(tail_usec / p50, 1) if p50 else 0.0
+    captured_usec = sum(r.get("LatUsec", 0) for r in top)
+    share = round(100.0 * captured_usec / io_histo.sum_micro, 1) \
+        if io_histo.sum_micro else 0.0
+    owners = {
+        "ByHost": _owner_shares(top, lambda r: r.get("Host", "local"), 8),
+        "ByFile": _owner_shares(top, lambda r: r.get("File", ""), 5),
+        "ByDir": _owner_shares(top, _file_dir, 3),
+        "ByOp": _owner_shares(top, lambda r: r.get("Op", ""), 5),
+    }
+    out = {
+        "Schema": TAIL_ANALYSIS_SCHEMA,
+        "K": k,
+        "SampleRate": sample_rate,
+        "OpsSeen": merged["OpsSeen"],
+        "SlowOpsRecorded": merged["Recorded"],
+        "OpSamplesDropped": merged["SamplesDropped"],
+        "P50Usec": p50,
+        "P99Usec": p99,
+        "P999Usec": p999,
+        "MaxUsec": max_usec,
+        "TailRatio": ratio,
+        "TailSharePct": share,
+        "SlowOps": top,
+        "Owners": owners,
+        "Lanes": lanes,
+        "Refusals": refusals,
+    }
+    assert tuple(out) == TAIL_ANALYSIS_KEYS, "TailAnalysis schema drift"
+    return out
+
+
+def tail_doctor_summary(tail: "dict | None") -> "dict | None":
+    """The compact Tail block the doctor attaches to its Analysis dict
+    (the full TailAnalysis lives in the run JSON / flightrec phase_end;
+    the Analysis copy carries only what verdicts and diffs consume)."""
+    if not tail:
+        return None
+    by_host = tail.get("Owners", {}).get("ByHost", {})
+    by_dir = tail.get("Owners", {}).get("ByDir", {})
+    top_host = max(by_host, key=by_host.get) if by_host else ""
+    top_dir = max(by_dir, key=by_dir.get) if by_dir else ""
+    return {
+        "TailRatio": tail.get("TailRatio", 0.0),
+        "P50Usec": tail.get("P50Usec", 0),
+        "P999Usec": tail.get("P999Usec", 0),
+        "MaxUsec": tail.get("MaxUsec", 0),
+        "TailSharePct": tail.get("TailSharePct", 0.0),
+        "TopHost": top_host,
+        "TopHostPct": round(100.0 * by_host.get(top_host, 0.0), 1),
+        "TopDir": top_dir,
+        "TopDirPct": round(100.0 * by_dir.get(top_dir, 0.0), 1),
+    }
+
+
+def describe_slowest(tail: dict) -> str:
+    """One evidence line naming the slowest captured op (host, file,
+    offset, size, latency, retry chain) — the doctor's "WHICH op" line."""
+    ops = tail.get("SlowOps", [])
+    if not ops:
+        return ""
+    rec = ops[0]
+    where = rec.get("File", "")
+    host = rec.get("Host", "")
+    parts = [f"slowest op: {rec.get('Op', '?')} "
+             f"{rec.get('Size', 0)}B at offset {rec.get('Offset', 0)}"]
+    if where:
+        parts.append(f"of {where}")
+    if host:
+        parts.append(f"on {host}")
+    parts.append(f"— {rec.get('LatUsec', 0) / 1000:.1f}ms")
+    if rec.get("Retries"):
+        parts.append(f"after {rec['Retries']} retry(s)")
+    if rec.get("TimedOut"):
+        parts.append("(timed out)")
+    return " ".join(parts)

@@ -84,6 +84,15 @@ PATH_AUDIT_COUNTERS = (
     ("ici_redist_mib", "IciRedistMiB", "ici_redist_mib"),
     ("ici_redist_usec", "IciRedistUSec", "ici_redist_usec"),
     ("ici_gbps_hwm", "IciGbpsHwm", "ici_gbps_hwm"),
+    # slow-op forensics (--slowops/--opsample; telemetry/slowops.py):
+    # records captured into the per-worker K-slowest heaps, sample
+    # points the density reservoirs dropped on compaction, and the
+    # running p99.9 high-water mark of per-op latency (MAX merge — a
+    # sum of percentiles means nothing). All worker-owned: the capture
+    # happens in storage loops that exist with or without a TPU context.
+    ("slow_ops_recorded", "SlowOpsRecorded", "slow_ops_recorded"),
+    ("op_samples_dropped", "OpSamplesDropped", "op_samples_dropped"),
+    ("tail_p999_usec_hwm", "TailP999UsecHwm", "tail_p999_usec_hwm"),
 )
 
 #: counters owned by the Worker object itself rather than the
@@ -94,7 +103,8 @@ PATH_AUDIT_WORKER_ATTRS = frozenset({
     "io_retries", "io_retry_usec", "io_timeouts",
     "pool_buf_reuses", "pool_occupancy_hwm", "pool_registered_ops",
     "pool_sqpoll_ops", "shard_ingest_mib", "ici_redist_mib",
-    "ici_redist_usec", "ici_gbps_hwm"})
+    "ici_redist_usec", "ici_gbps_hwm", "slow_ops_recorded",
+    "op_samples_dropped", "tail_p999_usec_hwm"})
 
 #: counters owned by the worker's StagingPool: the merge reads them
 #: from worker._staging_pool when one is attached (local workers), and
@@ -110,7 +120,8 @@ PATH_AUDIT_POOL_ATTRS = frozenset({
 #: loss by the worker count — MAX reports the deepest failover chain any
 #: single worker ran (~ chips lost along the worst path).
 PATH_AUDIT_MAX_KEYS = frozenset({"TpuPipeInflightHwm", "TpuChipFailovers",
-                                 "PoolOccupancyHwm", "IciGbpsHwm"})
+                                 "PoolOccupancyHwm", "IciGbpsHwm",
+                                 "TailP999UsecHwm"})
 
 
 def sum_path_audit_counters(workers) -> dict:

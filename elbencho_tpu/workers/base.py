@@ -108,6 +108,16 @@ class Worker:
         self.ici_gbps_hwm = 0
         self._shard_ingest_bytes = 0
         self._ici_redist_bytes = 0
+        # slow-op forensics audit (--slowops/--opsample;
+        # PATH_AUDIT_WORKER_ATTRS): plain ints so RemoteWorker ingest
+        # and recorder-less workers read as zero
+        self.slow_ops_recorded = 0
+        self.op_samples_dropped = 0
+        self.tail_p999_usec_hwm = 0
+        # --slowops per-worker recorder; None keeps every instrumentation
+        # point a single attribute test (telemetry/slowops.py contract)
+        from ..telemetry.slowops import make_recorder
+        self._slowops = make_recorder(self)
 
     def oplog(self, op_name: str, entry_name: str = "", offset: int = 0,
               length: int = 0):
@@ -155,6 +165,11 @@ class Worker:
         self.ici_gbps_hwm = 0
         self._shard_ingest_bytes = 0
         self._ici_redist_bytes = 0
+        self.slow_ops_recorded = 0
+        self.op_samples_dropped = 0
+        self.tail_p999_usec_hwm = 0
+        if self._slowops is not None:
+            self._slowops.reset_phase()
 
     def create_stonewall_stats_if_triggered(self) -> None:
         """Snapshot current counters when the first worker finished
@@ -168,6 +183,10 @@ class Worker:
 
     def finish_phase_stats(self) -> None:
         """Called by the worker when its phase work is complete."""
+        if self._slowops is not None:
+            # final TailP999UsecHwm BEFORE anything sums the counters
+            # (the service's /benchresult, the master's phase results)
+            self._slowops.refresh_hwm()
         if not self.stonewall_taken:
             # first finisher: stonewall stats == final stats
             self.stonewall_ops = self.live_ops.snapshot()

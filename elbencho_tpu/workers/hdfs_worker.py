@@ -23,7 +23,7 @@ from __future__ import annotations
 import posixpath
 import time
 
-from ..phases import BenchPhase
+from ..phases import BenchPhase, phase_name
 from .shared import WorkerException
 
 _fs_factory = None   # test hook: replaces _make_fs entirely
@@ -162,8 +162,12 @@ def _write_file(worker, fs, path: str) -> None:
             # surfaced — re-writing the block would duplicate bytes, not
             # replay them. Only the positional read path retries.
             out.write(bytes(buf[:length]))
-            worker.iops_latency_histo.add_latency(
-                (time.perf_counter_ns() - t0) // 1000)
+            lat = (time.perf_counter_ns() - t0) // 1000
+            worker.iops_latency_histo.add_latency(lat)
+            if worker._slowops is not None:  # --slowops tail capture
+                worker._slowops.record(
+                    "hdfs_write", phase_name(worker.shared.current_phase),
+                    lat, offset, length, path=path, start_ns=t0)
             worker.live_ops.num_bytes_done += length
             worker.live_ops.num_iops_done += 1
             worker._num_iops_submitted += 1
@@ -189,6 +193,7 @@ def _read_file(worker, fs, path: str) -> None:
                 return data
 
             t0 = time.perf_counter_ns()
+            r0 = worker.io_retries
             try:
                 data = _retrying_op(worker, read_op)
             except OSError as err:
@@ -198,6 +203,11 @@ def _read_file(worker, fs, path: str) -> None:
                         f"short HDFS read at {offset} of {path}") from None
                 raise
             lat = (time.perf_counter_ns() - t0) // 1000
+            if worker._slowops is not None:  # --slowops tail capture
+                worker._slowops.record(
+                    "hdfs_read", phase_name(worker.shared.current_phase),
+                    lat, offset, length, path=path,
+                    retries=worker.io_retries - r0, start_ns=t0)
             buf = worker.rotated_staging_buf()
             buf[:length] = data
             worker._post_read_actions(buf, offset, length)

@@ -72,6 +72,10 @@ class LocalWorker(Worker):
         self._stream_drain_failed = False  # aborted ring drain: leak bufs
         self._io_retrier = None        # --ioretries (workers/io_errors.py)
         self._tolerate_note_logged = False  # partial-dataset delete note
+        # --slowops: the entry path the CURRENT block loop works on, so a
+        # captured tail op can name its file (dir mode sets it per file;
+        # file/bdev mode falls back to the first bench path)
+        self._slowop_path = ""
         import ctypes
         self._native_interrupt = ctypes.c_int(0)  # seen by the C++ engine
 
@@ -85,6 +89,7 @@ class LocalWorker(Worker):
         self.tpu_per_chip = {}
         self._stream_mode_logged = False  # log the mode once per phase
         self._tolerate_note_logged = False
+        self._slowop_path = ""  # re-resolved by the phase's entry loop
         if self._io_retrier is not None:
             self._io_retrier.reset()  # per-phase backoff budget
         if self._tpu is not None:
@@ -626,6 +631,14 @@ class LocalWorker(Worker):
                     self._tracer.record_op(
                         phase.name.lower(), phase_name(phase), t0,
                         lat_usec, self.rank, 0, cfg.file_size)
+                if self._slowops is not None and phase in (
+                        BenchPhase.STATFILES, BenchPhase.DELETEFILES):
+                    # entry-granular phases: the whole entry IS the op
+                    # (create/read capture per-block records inside
+                    # _rw_block_sized instead)
+                    self._slowops.record(
+                        phase.name.lower(), phase_name(phase), lat_usec,
+                        0, cfg.file_size, path=path, start_ns=t0)
 
     def _open_flags_write(self) -> int:
         cfg = self.cfg
@@ -640,6 +653,7 @@ class LocalWorker(Worker):
 
     def _write_one_file(self, path: str) -> None:
         cfg = self.cfg
+        self._slowop_path = path  # --slowops: name the file in captures
         try:
             flags = self._open_flags_write()
             if cfg.use_mmap:
@@ -673,6 +687,7 @@ class LocalWorker(Worker):
 
     def _read_one_file(self, path: str) -> None:
         cfg = self.cfg
+        self._slowop_path = path  # --slowops: name the file in captures
         flags = os.O_RDONLY
         if cfg.use_direct_io:
             flags |= os.O_DIRECT
@@ -828,6 +843,10 @@ class LocalWorker(Worker):
         # be throttled against zero writer bytes
         balancer = (self.shared.rwmix_balancer
                     if (is_write or is_rwmix_reader) else None)
+        # chaos-test seam: a deterministic per-op delay for exactly one
+        # (port, op_index) — None outside ELBENCHO_TPU_TESTING fleets
+        from ..telemetry.slowops import test_op_delay
+        fault_delay = test_op_delay(cfg)
         for off, length in gen:
             # rotate buffers so pipelined TPU transfers never race a reuse
             buf = self._io_bufs[self._num_iops_submitted % num_bufs]
@@ -850,14 +869,28 @@ class LocalWorker(Worker):
                 fd, real_off = multi_file(off, length)
             else:
                 real_off = file_offset_base + off
+            # --slowops stage split: bracket this op's TPU hand-offs
+            # (D2H pre-write fill here, H2D post-read below) with the
+            # context's dispatch/DMA accounting so a captured tail op
+            # says WHERE its time went
+            tpu_snap = ((self._tpu.dispatch_usec, self._tpu.transfer_usec)
+                        if self._slowops is not None
+                        and self._tpu is not None else None)
+            slow_r0 = self.io_retries if self._slowops is not None else 0
             if not do_read_this_op:
                 self._pre_write_fill(buf, real_off, length)
 
             def one_op(fd=fd, real_off=real_off, length=length,
-                       do_read=do_read_this_op, buf=buf):
+                       do_read=do_read_this_op, buf=buf,
+                       delay=(fault_delay[1]
+                              if fault_delay is not None
+                              and self._num_iops_submitted
+                              == fault_delay[0] else 0)):
                 """One positional I/O attempt; a short transfer raises
                 the (transient) ShortIOError so --ioretries covers it."""
                 t0 = time.perf_counter_ns()
+                if delay:  # chaos-test seam: provably slow op
+                    time.sleep(delay / 1e6)
                 if cfg.use_file_locks:
                     with FileRangeLock(fd, cfg.use_file_locks, real_off,
                                        length, is_write=not do_read):
@@ -907,6 +940,20 @@ class LocalWorker(Worker):
                     phase_name(self.shared.current_phase), t0, lat_usec,
                     self.rank, real_off, length,
                     slot=self._num_iops_submitted % num_bufs)
+            if self._slowops is not None:  # no-op path: one attribute test
+                self._slowops.record(
+                    "read" if do_read_this_op else "write",
+                    phase_name(self.shared.current_phase), lat_usec,
+                    real_off, length,
+                    path=self._slowop_path
+                    or (cfg.paths[0] if cfg.paths else ""),
+                    retries=self.io_retries - slow_r0,
+                    dispatch_usec=(self._tpu.dispatch_usec - tpu_snap[0]
+                                   if tpu_snap is not None else 0),
+                    dma_usec=(self._tpu.transfer_usec - tpu_snap[1]
+                              if tpu_snap is not None else 0),
+                    slot=self._num_iops_submitted % num_bufs,
+                    start_ns=t0)
             ops.num_bytes_done += n
             ops.num_iops_done += 1
             self._num_iops_submitted += 1
@@ -1054,6 +1101,11 @@ class LocalWorker(Worker):
                 # --tracefile spans are recorded by the Python loops (the
                 # fused TPU stream loop records its own and stays native)
                 and self._tracer is None
+                # --slowops captures per-op context (path/offset/retry
+                # chain) the block-loop arrays don't carry — same
+                # fallback rule as tracing; the fused stream ring stays
+                # engaged and records from its reap events
+                and self._slowops is None
                 and self.shared.rwmix_balancer is None
                 # dataloader-emulation pacing is per-op Python behavior
                 # (the knobs are only set on the loader read leg, so a
@@ -1328,6 +1380,20 @@ class LocalWorker(Worker):
                         phase_name(self.shared.current_phase),
                         self._tracer.now_ns() - int(lat) * 1000, lat,
                         self.rank, r_off, length, slot=slot)
+                if self._slowops is not None:
+                    # per-op latency straight from the engine's reap
+                    # event; file attribution via the stripe fd index
+                    self._slowops.record(
+                        "read" if rd else "write",
+                        phase_name(self.shared.current_phase), int(lat),
+                        r_off, length,
+                        path=(self._slowop_path
+                              or (cfg.paths[fdi]
+                                  if fdi < len(cfg.paths) else "")),
+                        retries=attempts,
+                        slot=slot,
+                        start_ns=(time.perf_counter_ns()
+                                  - int(lat) * 1000))
                 if rd:
                     # host->HBM DMA + verify (host memcmp or on-device),
                     # identical to the Python loop's post-read hook

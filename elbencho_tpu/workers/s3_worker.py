@@ -189,6 +189,12 @@ class _S3Pipeline:
                     "s3_req", phase_name(worker.shared.current_phase),
                     tracer.now_ns() - lat_usec * 1000, lat_usec,
                     worker.rank, 0, nbytes)
+            slowops_rec = getattr(worker, "_slowops", None)
+            if slowops_rec is not None:  # --slowops tail capture
+                slowops_rec.record(
+                    "s3_req", phase_name(worker.shared.current_phase),
+                    lat_usec, 0, nbytes,
+                    start_ns=time.perf_counter_ns() - lat_usec * 1000)
 
     def drain(self) -> None:
         while self._inflight:
@@ -447,6 +453,10 @@ def _upload_object(worker, bucket: str, key: str) -> None:
             worker._tracer.record_op(
                 "s3_put", phase_name(worker.shared.current_phase), t0,
                 lat_usec, worker.rank, 0, size)
+        if worker._slowops is not None:  # --slowops tail capture
+            worker._slowops.record(
+                "s3_put", phase_name(worker.shared.current_phase),
+                lat_usec, 0, size, path=f"{bucket}/{key}", start_ns=t0)
         return
     upload_id = client.create_multipart_upload(
         bucket, key, extra_headers=_mpu_init_headers(cfg))
@@ -666,6 +676,11 @@ def _download_object(worker, bucket: str, key: str) -> None:
             worker._tracer.record_op(
                 "s3_get", phase_name(worker.shared.current_phase), t0,
                 lat_usec, worker.rank, offset, length)
+        if worker._slowops is not None:  # --slowops tail capture
+            worker._slowops.record(
+                "s3_get", phase_name(worker.shared.current_phase),
+                lat_usec, offset, length, path=f"{bucket}/{key}",
+                start_ns=t0)
         if not cfg.s3_fast_get:
             buf = worker.rotated_staging_buf()
             buf[:length] = data

@@ -333,6 +333,17 @@ def test_fallback_ladder_lands_tier_labeled_number_fast():
         assert curve["scenario"] == "coldwarm"
         assert any(s["mibs"] > 0 for s in curve["steps"])
         assert curve["verdicts"], "scenario verdict missing from rider"
+    # the tail rider (slow-op forensics): every measured tier carries a
+    # tier-labeled tail dict — percentiles from the MEASURED median
+    # pass, top-op context from the short --slowops rider pass
+    tail = rec.get("tail")
+    assert isinstance(tail, dict)
+    assert tail["tier"] == rec["fallback_tier"]
+    if "error" not in tail:
+        assert tail["p999_usec"] >= tail["p50_usec"] > 0
+        assert tail["tail_vs_median"] >= 1
+    if "rider_error" not in tail and "error" not in tail:
+        assert tail["top_slow_op"].get("LatUsec", 0) > 0
 
 
 @pytest.mark.slow

@@ -76,6 +76,10 @@ def test_fullscreen_per_worker_rows_and_scroll(tmp_path):
     assert "of 16 workers" in text    # scroll footer (12-row pty, 16 ranks)
     # worker rows actually rendered (rank column + running state)
     assert "run" in text
+    # running tail percentiles footer (slow-op forensics satellite):
+    # mid-run p99/p99.9 from the live histograms the frame already
+    # holds (the looping phase here is MKDIRS, an entry-granular phase)
+    assert "lat us: p50=" in text and "p99.9=" in text
     # keyboard nav: the visible window moved off position 0
     assert "showing 0.." in text
     moved = any(f"showing {n}.." in text for n in range(1, 11))

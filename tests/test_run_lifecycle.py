@@ -704,8 +704,8 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     header = res.stdout.splitlines()[0].split(",")
     # the streaming-control-plane trio + pod-slice trio append after the
     # lifecycle pair (never reordered)
-    assert header[-14:-12] == ["LeaseExp", "Resumed"]
+    assert header[-16:-14] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-14:-12] == ["2", "3"]
+    assert row[-16:-14] == ["2", "3"]
     assert "RESUMED" in res.stderr

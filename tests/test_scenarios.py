@@ -709,15 +709,17 @@ def test_summarize_appends_scenario_columns_and_banners(tmp_path):
     assert res.returncode == 0, res.stderr
     header = res.stdout.splitlines()[0].split(",")
     # the scenario trio appends AFTER every pre-existing column
-    assert header[-3:] == ["Scenario", "Step", "EpochRate"]
+    # (the --slowops TailX/TailOwner pair appends after it)
+    assert header[-5:] == ["Scenario", "Step", "EpochRate",
+                           "TailX", "TailOwner"]
     assert header.index("LatP99.9") < header.index("Scenario")
     rows = [ln.split(",") for ln in res.stdout.splitlines()[1:]]
     # the terminal SCENARIO record is bannered, not tabulated
     assert all(row[0] != "SCENARIO" for row in rows)
-    epoch_rows = [r for r in rows if r[-2].startswith("epoch")]
+    epoch_rows = [r for r in rows if r[-4].startswith("epoch")]
     assert len(epoch_rows) == 2
-    assert all(r[-3] == "epochs" for r in epoch_rows)
-    assert float(epoch_rows[0][-1]) > 0
+    assert all(r[-5] == "epochs" for r in epoch_rows)
+    assert float(epoch_rows[0][-3]) > 0
     assert "SCENARIO epochs [cache-warmup]" in res.stderr
     # CSV result columns carry the appended trio too (schema check)
     csv_header = csvf.read_text().splitlines()[0].split(",")

@@ -1,0 +1,749 @@
+"""Slow-op forensics suite (--slowops/--opsample; docs/telemetry.md
+"Tail forensics"): recorder units (K-slowest heap, bounded systematic
+sample), merge properties (tree == flat for the new counters,
+snapshot-union top-K), TailAnalysis construction, the doctor's
+tail-bound verdict + "tail grew" diff cause, the off-path no-op guard,
+and the chaos acceptance e2e — a 250ms delay injected into ONE op on
+ONE host of an in-process fleet must be named (host + file + offset) by
+the merged TailAnalysis and the doctor, at ZERO extra service requests.
+
+Marker `obs` — rides `make test-obs` with the telemetry/flightrec/
+tracefleet suites.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from elbencho_tpu.telemetry import slowops
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_native(monkeypatch):
+    # the Python loops carry the --slowops instrumentation; the fused
+    # stream ring records from its reap events (not exercised here)
+    monkeypatch.setenv("ELBENCHO_TPU_NO_NATIVE", "1")
+    from elbencho_tpu.utils.native import reset_native_engine_cache
+    reset_native_engine_cache()
+
+
+class _FakeShared:
+    def __init__(self, cfg):
+        self.config = cfg
+        self.phase_start_monotonic = 0.0
+        self.tracer = None
+
+
+class _FakeCfg:
+    def __init__(self, k=0, rate=1.0):
+        self.slow_ops_k = k
+        self.op_sample_rate = rate
+
+
+class _FakeWorker:
+    """Bare attribute carrier satisfying the SlowOpRecorder contract."""
+
+    def __init__(self, k=4, rate=1.0, rank=0):
+        self.shared = _FakeShared(_FakeCfg(k, rate))
+        self.rank = rank
+        self.slow_ops_recorded = 0
+        self.op_samples_dropped = 0
+        self.tail_p999_usec_hwm = 0
+        self._tracer = None
+        self._slowops = slowops.make_recorder(self)
+
+
+# ---------------------------------------------------------------------------
+# recorder units
+# ---------------------------------------------------------------------------
+
+def test_recorder_keeps_k_slowest_sorted():
+    w = _FakeWorker(k=3)
+    rec = w._slowops
+    for i, lat in enumerate([10, 500, 20, 900, 30, 700, 40]):
+        rec.record("read", "READ", lat, offset=i * 4096, size=4096,
+                   path=f"/d/f{i}")
+    snap = rec.snapshot()
+    assert snap["OpsSeen"] == 7
+    lats = [r["LatUsec"] for r in snap["Records"]]
+    assert lats == [900, 700, 500]  # K slowest, slowest first
+    assert snap["Records"][0]["File"] == "/d/f3"
+    assert snap["Records"][0]["Offset"] == 3 * 4096
+    # the audit counter saw every heap insertion attempt that landed
+    assert w.slow_ops_recorded >= 3
+
+
+def test_recorder_latency_ties_never_compare_dicts():
+    """heapq must never fall through to comparing the record dicts —
+    the seq tiebreaker guarantees it (a TypeError here would kill the
+    worker thread mid-phase)."""
+    w = _FakeWorker(k=2)
+    for i in range(6):
+        w._slowops.record("read", "READ", 777, offset=i, size=1)
+    assert [r["LatUsec"] for r in w._slowops.snapshot()["Records"]] \
+        == [777, 777]
+
+
+def test_recorder_retry_and_timeout_chain_recorded():
+    w = _FakeWorker(k=1)
+    w._slowops.record("read", "READ", 5000, offset=0, size=4096,
+                      path="/d/f", retries=3, timed_out=True)
+    r = w._slowops.snapshot()["Records"][0]
+    assert r["Retries"] == 3 and r["TimedOut"] is True
+
+
+def test_recorder_stage_split_recorded_only_when_nonzero():
+    w = _FakeWorker(k=2)
+    w._slowops.record("write", "WRITE", 100, 0, 4096,
+                      dispatch_usec=7, dma_usec=11)
+    w._slowops.record("write", "WRITE", 90, 0, 4096)
+    recs = w._slowops.snapshot()["Records"]
+    assert recs[0]["DispatchUsec"] == 7 and recs[0]["DmaUsec"] == 11
+    assert "DispatchUsec" not in recs[1]  # plain storage op stays lean
+
+
+def test_reservoir_bounded_halves_resolution_and_counts_drops():
+    w = _FakeWorker(k=1, rate=1.0)
+    rec = w._slowops
+    for i in range(slowops.RESERVOIR_CAP + 100):
+        rec.record("read", "READ", 10, offset=0, size=1)
+    snap = rec.snapshot()
+    assert len(snap["Sample"]) < slowops.RESERVOIR_CAP
+    assert w.op_samples_dropped >= slowops.RESERVOIR_CAP // 2
+    assert snap["SamplesDropped"] == w.op_samples_dropped
+    assert rec._stride == 2  # resolution halved, coverage kept
+
+
+def test_opsample_rate_sets_deterministic_stride():
+    w = _FakeWorker(k=1, rate=0.25)
+    rec = w._slowops
+    for _ in range(40):
+        rec.record("read", "READ", 10, offset=0, size=1)
+    assert len(rec._sample) == 10  # every 4th op, by op index
+
+
+def test_p999_hwm_tracks_monotonically_across_resets():
+    w = _FakeWorker(k=1)
+    for _ in range(20):
+        w._slowops.record("read", "READ", 100, 0, 1)
+    w._slowops.record("read", "READ", 90_000, 0, 1)
+    w._slowops.refresh_hwm()
+    first = w.tail_p999_usec_hwm
+    assert first >= 90_000 * 0.8  # quarter-log2 bucket lower bound
+    # a quieter next phase must not lower the high-water mark
+    w._slowops.reset_phase()
+    for _ in range(10):
+        w._slowops.record("read", "READ", 50, 0, 1)
+    w._slowops.refresh_hwm()
+    assert w.tail_p999_usec_hwm >= first
+
+
+def test_make_recorder_off_by_default():
+    assert _FakeWorker(k=0)._slowops is None
+
+
+def test_config_validation():
+    from elbencho_tpu.config.args import ConfigError, parse_cli
+    cfg, _ = parse_cli(["-w", "-d", "-t", "1", "-s", "4K",
+                        "--slowops", "-1", "/tmp"])
+    with pytest.raises(ConfigError, match="slowops"):
+        cfg.check()
+    cfg, _ = parse_cli(["-w", "-d", "-t", "1", "-s", "4K",
+                        "--slowops", "4", "--opsample", "1.5", "/tmp"])
+    with pytest.raises(ConfigError, match="opsample"):
+        cfg.check()
+    # --opsample without --slowops is a no-op the user must not assume
+    cfg, _ = parse_cli(["-w", "-d", "-t", "1", "-s", "4K",
+                        "--opsample", "0.5", "/tmp"])
+    with pytest.raises(ConfigError, match="slowops"):
+        cfg.check()
+
+
+def test_test_op_delay_needs_testing_opt_in(monkeypatch):
+    monkeypatch.setitem(slowops.TEST_OP_DELAY_BY_PORT, 1611, (3, 1000))
+    cfg = _FakeCfg()
+    cfg.service_port = 1611
+    monkeypatch.delenv("ELBENCHO_TPU_TESTING", raising=False)
+    assert slowops.test_op_delay(cfg) is None
+    monkeypatch.setenv("ELBENCHO_TPU_TESTING", "1")
+    assert slowops.test_op_delay(cfg) == (3, 1000)
+
+
+# ---------------------------------------------------------------------------
+# merge properties
+# ---------------------------------------------------------------------------
+
+def _snap(records, sample=(), ops_seen=None, dropped=0, p999=0):
+    return {"K": 8, "Rank": 0, "OpsSeen": ops_seen or len(records),
+            "Records": [{"Op": "read", "LatUsec": lat, "TMs": i}
+                        for i, lat in enumerate(records)],
+            "Sample": [list(p) for p in sample],
+            "SamplesDropped": dropped, "P999Usec": p999}
+
+
+def test_merge_snapshots_topk_union_counters_summed_p999_maxed():
+    a = _snap([900, 100], dropped=3, p999=900)
+    b = _snap([500, 400, 50], dropped=4, p999=500)
+    merged = slowops.merge_snapshots([a, b], k=3)
+    assert [r["LatUsec"] for r in merged["Records"]] == [900, 500, 400]
+    assert merged["OpsSeen"] == 5
+    assert merged["SamplesDropped"] == 7
+    assert merged["P999Usec"] == 900  # MAX, never summed
+
+
+def test_new_counters_tree_merge_equals_flat_merge():
+    """SlowOpsRecorded/OpSamplesDropped sum; TailP999UsecHwm MAX-merges
+    (a sum of percentiles means nothing) — and the property must hold
+    for any aggregation-tree shape, like every wire counter."""
+    from elbencho_tpu.service.stream import merge_subtree_frame
+    from elbencho_tpu.tpu.device import PATH_AUDIT_MAX_KEYS
+    assert "TailP999UsecHwm" in PATH_AUDIT_MAX_KEYS
+    hosts = [
+        {"SlowOpsRecorded": 8, "OpSamplesDropped": 0,
+         "TailP999UsecHwm": 2500},
+        {"SlowOpsRecorded": 3, "OpSamplesDropped": 4096,
+         "TailP999UsecHwm": 250_000},
+        {"SlowOpsRecorded": 5, "OpSamplesDropped": 7,
+         "TailP999UsecHwm": 9000},
+    ]
+    flat: dict = {}
+    for h in hosts:
+        merge_subtree_frame(flat, h)
+    left: dict = {}
+    merge_subtree_frame(left, hosts[0])
+    merge_subtree_frame(left, hosts[1])
+    merge_subtree_frame(left, hosts[2])
+    inner: dict = {}
+    merge_subtree_frame(inner, hosts[1])
+    merge_subtree_frame(inner, hosts[2])
+    right: dict = {}
+    merge_subtree_frame(right, hosts[0])
+    merge_subtree_frame(right, inner)
+    assert flat == left == right
+    assert flat["SlowOpsRecorded"] == 16        # sum
+    assert flat["OpSamplesDropped"] == 4103     # sum
+    assert flat["TailP999UsecHwm"] == 250_000   # MAX
+
+
+def _histo_of(lats):
+    from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+    h = LatencyHistogram()
+    for lat in lats:
+        h.add_latency(lat)
+    return h
+
+
+def test_build_tail_analysis_owners_lanes_refusals_schema():
+    host_a = _snap([250_000, 240_000], sample=[(5, 250_000)])
+    host_a["Records"][0]["File"] = "/data/ckpt/s0"
+    host_a["Records"][1]["File"] = "/data/ckpt/s1"
+    host_b = _snap([1000], sample=[(9, 1000)])
+    host_b["Records"][0]["File"] = "/data/train/t0"
+    lats = [100] * 997 + [1000, 240_000, 250_000]
+    tail = slowops.build_tail_analysis(
+        [("h-a", host_a), ("h-b", host_b), ("h-c", None)],
+        _histo_of(lats), k=8, sample_rate=1.0)
+    assert tuple(tail) == slowops.TAIL_ANALYSIS_KEYS
+    assert tail["Refusals"] == ["h-c"]
+    assert set(tail["Lanes"]) == {"h-a", "h-b"}
+    # owner shares are TIME-weighted: h-a owns ~490ms of ~491ms
+    by_host = tail["Owners"]["ByHost"]
+    assert max(by_host, key=by_host.get) == "h-a"
+    assert by_host["h-a"] > 0.99
+    by_dir = tail["Owners"]["ByDir"]
+    assert max(by_dir, key=by_dir.get) == "/data/ckpt/"
+    # every captured record is host-labeled in the merged top list
+    assert tail["SlowOps"][0]["Host"] == "h-a"
+    assert tail["TailRatio"] > 100
+    assert 0 < tail["TailSharePct"] <= 100
+
+
+def test_build_tail_analysis_lane_points_capped():
+    big = _snap([100], sample=[(t, 10) for t in range(10_000)])
+    tail = slowops.build_tail_analysis(
+        [("h", big)], _histo_of([100] * 50), k=4, sample_rate=1.0)
+    assert len(tail["Lanes"]["h"]) <= slowops.MERGED_LANE_CAP
+
+
+def test_local_multiworker_lanes_merge_never_overwrite():
+    """A local run contributes one part per WORKER and they all share
+    the "local" lane — every worker's density samples must survive the
+    merge (assignment instead of extend would keep only the last
+    worker's)."""
+    a = _snap([100], sample=[(1, 100)])
+    b = _snap([200], sample=[(2, 200)])
+    tail = slowops.build_tail_analysis(
+        [("", a), ("", b)], _histo_of([100, 200]), k=4, sample_rate=1.0)
+    assert tail["Lanes"]["local"] == [[1, 100], [2, 200]]
+
+
+def test_slow_ops_recorded_is_heap_insertions_not_retained():
+    """TailAnalysis.SlowOpsRecorded must agree with the PATH_AUDIT
+    SlowOpsRecorded counter (heap insertions), not the retained top-K —
+    docs call them the same merged audit number."""
+    w = _FakeWorker(k=2)
+    for lat in [100, 200, 300, 400, 500]:  # 3 displace the heap root
+        w._slowops.record("read", "READ", lat, 0, 1)
+    tail = slowops.build_tail_analysis(
+        [("", w._slowops.snapshot())], _histo_of([100] * 10), k=2,
+        sample_rate=1.0)
+    assert tail["SlowOpsRecorded"] == w.slow_ops_recorded == 5
+    assert len(tail["SlowOps"]) == 2
+
+
+def test_thin_points_caps_with_whole_range_coverage():
+    pts = [[t, 1] for t in range(10_000)]
+    thinned = slowops.thin_points(pts, 2048)
+    assert len(thinned) <= 2048
+    assert thinned[0] == [0, 1] and thinned[-1][0] >= 9000
+    assert slowops.thin_points(pts[:10], 2048) == pts[:10]  # no-op under cap
+
+
+def test_describe_slowest_names_op_host_file_offset():
+    tail = {"SlowOps": [{"Op": "read", "Host": "h3", "File": "/d/ckpt/s1",
+                         "Offset": 49152, "Size": 16384,
+                         "LatUsec": 250_000, "Retries": 2}]}
+    line = slowops.describe_slowest(tail)
+    for needle in ("read", "h3", "/d/ckpt/s1", "49152", "250.0ms",
+                   "2 retry"):
+        assert needle in line, (needle, line)
+
+
+# ---------------------------------------------------------------------------
+# doctor: tail-bound verdict + diff cause
+# ---------------------------------------------------------------------------
+
+def _tail_block(ratio=20.0, p999=200_000, share=50.0, host="h3",
+                directory="/d/ckpt/"):
+    return {
+        "Schema": slowops.TAIL_ANALYSIS_SCHEMA, "K": 8, "SampleRate": 1.0,
+        "OpsSeen": 1000, "SlowOpsRecorded": 8, "OpSamplesDropped": 0,
+        "P50Usec": int(p999 / ratio), "P99Usec": p999 // 2,
+        "P999Usec": p999, "MaxUsec": p999, "TailRatio": ratio,
+        "TailSharePct": share,
+        "SlowOps": [{"Op": "read", "Host": host, "File": directory + "s0",
+                     "Offset": 49152, "Size": 16384, "LatUsec": p999,
+                     "TMs": 5}],
+        "Owners": {"ByHost": {host: 0.9, "h1": 0.1},
+                   "ByFile": {directory + "s0": 0.9},
+                   "ByDir": {directory: 0.9},
+                   "ByOp": {"read": 1.0}},
+        "Lanes": {}, "Refusals": [],
+    }
+
+
+def _busy_totals():
+    return {"IoBusyUSec": 800_000, "TpuDispatchUSec": 0,
+            "TpuTransferUSec": 0}
+
+
+def test_doctor_tail_bound_verdict_names_owner_and_op():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    ana = analyze_phase("READ", _busy_totals(), elapsed_usec=1_000_000,
+                        num_workers=1, tail=_tail_block())
+    assert ana["Verdict"] == "tail-bound"
+    assert ana["Tail"]["TopHost"] == "h3"
+    joined = " ".join(ana["Evidence"])
+    for needle in ("h3", "/d/ckpt/", "49152"):
+        assert needle in joined, (needle, joined)
+
+
+def test_doctor_tail_gates_all_three_must_hold():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    for tail in (_tail_block(ratio=5.0),          # ratio below 10x
+                 _tail_block(p999=20_000),        # tail under 50ms abs
+                 _tail_block(share=1.0)):         # share under 5%
+        ana = analyze_phase("READ", _busy_totals(), 1_000_000, 1,
+                            tail=tail)
+        assert ana["Verdict"] != "tail-bound", tail
+        # the compact Tail summary still rides the Analysis block
+        assert ana["Tail"]["TailRatio"] == tail["TailRatio"]
+
+
+def test_doctor_without_slowops_has_null_tail():
+    from elbencho_tpu.telemetry.doctor import analyze_phase
+    ana = analyze_phase("READ", _busy_totals(), 1_000_000, 1)
+    assert ana["Tail"] is None
+
+
+def _phase_end(name, tail=None, rate_mib=100):
+    end = {"Totals": dict(_busy_totals(), Bytes=rate_mib << 20),
+           "ElapsedUSec": 1_000_000, "Workers": 1}
+    if tail is not None:
+        end["Tail"] = tail
+    return {"name": name, "end": end, "sample_ts": [], "samples": [],
+            "start_t": 0.0}
+
+
+def test_doctor_diff_flags_tail_grew():
+    from elbencho_tpu.telemetry.doctor import diff_recordings
+    rec_a = {"phases": [_phase_end("READ", _tail_block(ratio=2.0,
+                                                       share=1.0))]}
+    rec_b = {"phases": [_phase_end("READ", _tail_block(ratio=40.0),
+                                   rate_mib=80)]}
+    diffs = diff_recordings(rec_a, rec_b)
+    causes = " ".join(c for d in diffs for c in d["Causes"])
+    assert "tail grew" in causes
+    assert "h3" in causes  # the new owner is named
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: --slowops off == no recorder, no per-op work
+# ---------------------------------------------------------------------------
+
+def test_slowops_off_path_is_noop(tmp_path, monkeypatch):
+    """Without --slowops no SlowOpRecorder may even be CONSTRUCTED and
+    no record() may fire — the off path must resolve to a single
+    ``is None`` test per op, exactly like the tracer — and the run JSON
+    must carry no TailAnalysis key."""
+
+    def boom(*_a, **_k):
+        raise AssertionError("slow-op recorder touched while off")
+
+    for name in ("__init__", "record", "snapshot"):
+        monkeypatch.setattr(slowops.SlowOpRecorder, name, boom)
+    from elbencho_tpu.cli import main
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "out.json"
+    assert main(["-w", "-d", "-t", "1", "-n", "1", "-N", "2", "-s", "8K",
+                 "-b", "4K", "--jsonfile", str(jf), "--nolive",
+                 str(bench)]) == 0
+    recs = [json.loads(ln) for ln in jf.read_text().splitlines()]
+    assert all("TailAnalysis" not in r for r in recs)
+    # the appended audit counters exist (zero) — append-only schema
+    assert all(r["SlowOpsRecorded"] == 0 and r["TailP999UsecHwm"] == 0
+               for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# local e2e: TailAnalysis lands in the run JSON + text summary
+# ---------------------------------------------------------------------------
+
+def test_local_e2e_tail_analysis_in_json_and_text(tmp_path, capsys):
+    from elbencho_tpu.cli import main
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "out.json"
+    assert main(["-w", "-r", "-d", "-t", "2", "-n", "1", "-N", "4",
+                 "-s", "64K", "-b", "16K", "--slowops", "8",
+                 "--jsonfile", str(jf), "--nolive", str(bench)]) == 0
+    assert "Tail lat us" in capsys.readouterr().out
+    recs = [json.loads(ln) for ln in jf.read_text().splitlines()]
+    write = next(r for r in recs if r["Phase"] == "WRITE")
+    tail = write["TailAnalysis"]
+    assert tuple(tail) == slowops.TAIL_ANALYSIS_KEYS
+    assert 0 < len(tail["SlowOps"]) <= 8
+    top = tail["SlowOps"][0]
+    assert top["File"].startswith(str(bench))  # names the file
+    assert top["Size"] == 16384
+    assert tail["Lanes"]["local"]  # density lane for the heatmap
+    # the audit counters rode the normal JSON plumbing
+    assert write["SlowOpsRecorded"] > 0
+    assert write["TailP999UsecHwm"] > 0
+    # pure-metadata phases carry no block (nothing captured)
+    mkdirs = next(r for r in recs if r["Phase"] == "MKDIRS")
+    assert "TailAnalysis" not in mkdirs
+
+
+def test_local_e2e_slow_op_instant_events_link_into_trace(tmp_path):
+    """With --tracefile armed, each captured slow op records a
+    ``slow_op`` span in the ring, so heatmap cells can be found on the
+    (fleet) trace timeline and the records carry SpanTs."""
+    from elbencho_tpu.cli import main
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf, trace = tmp_path / "out.json", tmp_path / "trace.json"
+    assert main(["-w", "-d", "-t", "1", "-n", "1", "-N", "2", "-s",
+                 "32K", "-b", "16K", "--slowops", "4",
+                 "--tracefile", str(trace),
+                 "--jsonfile", str(jf), "--nolive", str(bench)]) == 0
+    doc = json.load(open(trace))
+    slow_spans = [e for e in doc["traceEvents"]
+                  if e.get("name") == "slow_op"]
+    assert slow_spans
+    assert all(e["cat"] == "tail" and "lat_usec" in e["args"]
+               for e in slow_spans)
+    recs = [json.loads(ln) for ln in jf.read_text().splitlines()]
+    tail = next(r["TailAnalysis"] for r in recs if r.get("TailAnalysis"))
+    assert all("SpanTs" in r for r in tail["SlowOps"])
+
+
+# ---------------------------------------------------------------------------
+# ship/refusal semantics (service side + master ingest)
+# ---------------------------------------------------------------------------
+
+def test_refused_capture_is_loud_never_fatal_and_named(monkeypatch):
+    """A service whose serialized capture exceeds --traceshipcap must
+    refuse LOUDLY (reply carries SlowOpsRefused, not SlowOps) and the
+    master-side merge must name the host under Refusals — without
+    failing either side."""
+    from elbencho_tpu.service import protocol as proto
+    from elbencho_tpu.service.http_service import ServiceState
+
+    class _Mgr:
+        workers = [_FakeWorker(k=4)]
+
+    _Mgr.workers[0]._slowops.record("read", "READ", 9000, 0, 4096,
+                                    path="/d/f0")
+    state = ServiceState.__new__(ServiceState)  # attach only what's read
+    state.cfg = _FakeCfg(k=4)
+    state.cfg.trace_ship_cap_mib = 0  # everything is over-cap
+    result: dict = {}
+    state._attach_slowops(result, _Mgr)
+    assert proto.KEY_SLOWOPS not in result
+    refused = result[proto.KEY_SLOWOPS_REFUSED]
+    assert refused["Records"] == 1 and refused["Bytes"] > 0
+
+    # master ingest: a refusal clears the shipped snapshot...
+    class _RW:
+        host = "h-over"
+        cfg = state.cfg
+        slowops_shipped = {"stale": True}
+    rw = _RW()
+    from elbencho_tpu.service.remote_worker import RemoteWorker
+    RemoteWorker._ingest_slowops(rw, result)
+    assert rw.slowops_shipped is None
+    # ...and the merged block lists the host instead of dropping it
+    tail = slowops.build_tail_analysis(
+        [("h-ok", _snap([1000])), ("h-over", None)],
+        _histo_of([100] * 99 + [1000]), k=4, sample_rate=1.0)
+    assert tail["Refusals"] == ["h-over"]
+
+    # under a real cap the same capture ships — PRE-SERIALIZED (the
+    # handler splices it into the reply body so the capture is dumps'd
+    # exactly once; the wire still carries it under KEY_SLOWOPS)
+    state.cfg.trace_ship_cap_mib = 16
+    result2: dict = {}
+    state._attach_slowops(result2, _Mgr)
+    shipped = json.loads(result2[ServiceState.SLOWOPS_JSON_KEY])
+    assert shipped["Records"]
+    RemoteWorker._ingest_slowops(rw, {proto.KEY_SLOWOPS: shipped})
+    assert rw.slowops_shipped == shipped
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance e2e: one slow op on one host, named fleet-wide
+# ---------------------------------------------------------------------------
+
+NUM_HOSTS = 2
+DELAY_OP_IDX = 3
+DELAY_USEC = 250_000
+BLOCK = 16384
+
+
+def _master_run(hosts, bench_dir, jsonfile, extra):
+    from elbencho_tpu.cli import main
+    return main(["-w", "-r", "-d", "-t", "2", "-n", "1", "-N", "4",
+                 "-s", "64K", "-b", str(BLOCK), "--hosts", hosts,
+                 "--jsonfile", str(jsonfile), "--nolive",
+                 str(bench_dir)] + extra)
+
+
+def _recs_of(jsonfile):
+    return [json.loads(ln) for ln in jsonfile.read_text().splitlines()]
+
+
+def test_fleet_chaos_delay_named_by_tail_analysis_and_doctor(
+        tmp_path, monkeypatch):
+    """Acceptance: a deterministic 250ms delay injected into ONE op on
+    ONE host of an in-process fleet — the merged TailAnalysis must name
+    that host, the file, and the exact offset; the doctor must emit
+    tail-bound with the host in evidence; and the flightrec phase_end
+    rows must carry the block for post-mortem re-analysis."""
+    monkeypatch.setenv("ELBENCHO_TPU_TESTING", "1")
+    from elbencho_tpu.testing.service_harness import in_process_services
+    jf = tmp_path / "out.json"
+    rec_path = tmp_path / "run.rec"
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    with in_process_services(NUM_HOSTS) as ports:
+        slow_port = ports[1]
+        monkeypatch.setitem(slowops.TEST_OP_DELAY_BY_PORT, slow_port,
+                            (DELAY_OP_IDX, DELAY_USEC))
+        hosts = ",".join(f"localhost:{p}" for p in ports)
+        assert _master_run(hosts, bench, jf,
+                           ["--slowops", "8", "--flightrec",
+                            str(rec_path)]) == 0
+    slow_host = f"localhost:{slow_port}"
+
+    recs = _recs_of(jf)
+    write = next(r for r in recs if r["Phase"] == "WRITE")
+    tail = write["TailAnalysis"]
+    # WHO: the injected host owns the captured tail time
+    by_host = tail["Owners"]["ByHost"]
+    assert max(by_host, key=by_host.get) == slow_host
+    assert by_host[slow_host] > 0.5
+    # WHICH: the top record names host + file + the EXACT offset
+    top = tail["SlowOps"][0]
+    assert top["Host"] == slow_host
+    assert top["Offset"] == DELAY_OP_IDX * BLOCK
+    assert str(bench) in top["File"]
+    assert top["LatUsec"] >= DELAY_USEC
+    # the counters merged across the wire
+    assert write["SlowOpsRecorded"] > 0
+    assert write["TailP999UsecHwm"] >= DELAY_USEC * 0.8
+
+    # the doctor: tail-bound, host named in the Tail summary + evidence
+    ana = write["Analysis"]
+    assert ana["Verdict"] == "tail-bound"
+    assert ana["Tail"]["TopHost"] == slow_host
+    assert any(slow_host in ev for ev in ana["Evidence"])
+
+    # the recording carries the full block per phase_end (doctor CLI
+    # re-derives the same verdict from the recording alone)
+    from elbencho_tpu.telemetry.flightrec import read_recording
+    rec = read_recording(str(rec_path))
+    ends = [p["end"] for p in rec["phases"] if p["end"]]
+    assert any(e.get("Tail", {}).get("SlowOps") for e in ends)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/elbencho-tpu-doctor"),
+         str(rec_path)], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    assert "tail-bound" in out.stdout
+
+
+def test_slowops_adds_no_service_requests(tmp_path, monkeypatch):
+    """Acceptance: collection rides the existing /benchresult only —
+    SvcRequests is byte-identical with --slowops on vs off. Stream mode
+    pins the per-phase request count to the setup handful (in polling
+    mode the count is O(poll ticks), which varies with run duration, so
+    a parity claim there would be noise)."""
+    monkeypatch.setenv("ELBENCHO_TPU_TESTING", "1")
+    from elbencho_tpu.testing.service_harness import in_process_services
+    results = {}
+    with in_process_services(NUM_HOSTS) as ports:
+        hosts = ",".join(f"localhost:{p}" for p in ports)
+        for label, extra in (("off", []), ("on", ["--slowops", "8"])):
+            bench = tmp_path / f"bench-{label}"
+            bench.mkdir()
+            jf = tmp_path / f"{label}.json"
+            assert _master_run(hosts, bench, jf,
+                               ["--svcstream"] + extra) == 0
+            results[label] = next(r for r in _recs_of(jf)
+                                  if r["Phase"] == "WRITE")
+    on, off = results["on"], results["off"]
+    assert on["SvcRequests"] == off["SvcRequests"], (on, off)
+    assert on["SvcStreamFrames"] > 0  # the streaming rung actually ran
+    assert "TailAnalysis" in on and "TailAnalysis" not in off
+
+
+# ---------------------------------------------------------------------------
+# live view: running tail percentiles on /metrics (satellite)
+# ---------------------------------------------------------------------------
+
+def test_metrics_running_tail_gauges_and_audit_counters(tmp_path):
+    """/metrics surfaces the running p99/p99.9 (bucket-walk over the
+    live histograms the wire already carries) plus the new audit
+    counters — tails visible MID-RUN, not only post-mortem."""
+    from elbencho_tpu.config.args import parse_cli
+    from elbencho_tpu.telemetry.registry import BenchTelemetry
+    from elbencho_tpu.workers.base import Worker
+    from elbencho_tpu.workers.shared import WorkersSharedData
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    cfg, _ = parse_cli(["-w", "-d", "-t", "1", "-n", "1", "-N", "2",
+                        "-s", "8K", "-b", "4K", "--slowops", "4",
+                        str(bench)])
+    cfg.derive()
+    cfg.check()
+    shared = WorkersSharedData(cfg)
+    shared.tracer = None
+    worker = Worker(shared, 0)
+    for lat in [100] * 98 + [5000, 9000]:
+        worker.iops_latency_histo.add_latency(lat)
+        worker._slowops.record("read", "READ", lat, 0, 4096)
+    worker._slowops.refresh_hwm()
+
+    class _Mgr:
+        pass
+
+    mgr = _Mgr()
+    mgr.shared, mgr.workers = shared, [worker]
+    text = BenchTelemetry(cfg, lambda: (None, mgr)).render()
+    p99 = next(ln for ln in text.splitlines()
+               if ln.startswith("elbencho_tpu_io_latency_p99_usec "))
+    assert float(p99.split()[-1]) >= 1000  # the tail, not the median
+    assert "elbencho_tpu_io_latency_p999_usec " in text
+    # the new PATH_AUDIT counters auto-plumbed (hwm is a gauge, no _total)
+    assert "elbencho_tpu_slow_ops_recorded_total " in text
+    assert "elbencho_tpu_op_samples_dropped_total " in text
+    hwm = next(ln for ln in text.splitlines()
+               if ln.startswith("elbencho_tpu_tail_p999_usec_hwm "))
+    assert float(hwm.split()[-1]) > 0
+
+    # sum-only mirror (master-mode live ingest without the bucket view):
+    # counts and sums but EMPTY buckets — the gauges must stay absent
+    # rather than publish p99=0 as if the tail were measured
+    from elbencho_tpu.stats.latency_histogram import LatencyHistogram
+    sum_only = LatencyHistogram()
+    sum_only.num_values, sum_only.sum_micro = 100, 10_000
+    worker.iops_latency_histo = sum_only
+    worker.iops_latency_histo_rwmix = LatencyHistogram()
+    text2 = BenchTelemetry(cfg, lambda: (None, mgr)).render()
+    assert "elbencho_tpu_io_latency_p99_usec " not in text2
+    assert "elbencho_tpu_io_latency_p999_usec " not in text2
+
+
+# ---------------------------------------------------------------------------
+# tools: chart --tail heatmaps, summarize-json tail columns
+# ---------------------------------------------------------------------------
+
+def _run_slowops_json(tmp_path):
+    from elbencho_tpu.cli import main
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "out.json"
+    assert main(["-w", "-d", "-t", "2", "-n", "1", "-N", "4", "-s",
+                 "64K", "-b", "16K", "--slowops", "8",
+                 "--jsonfile", str(jf), "--nolive", str(bench)]) == 0
+    return jf
+
+
+def test_chart_tail_renders_heatmap_lanes(tmp_path):
+    jf = _run_slowops_json(tmp_path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/elbencho-tpu-chart"),
+         "--tail", str(jf)], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "time x host" in out.stdout
+    assert "offset-range x latency" in out.stdout
+    assert "p99.9=" in out.stdout
+
+
+def test_chart_tail_refuses_run_without_slowops(tmp_path):
+    from elbencho_tpu.cli import main
+    bench = tmp_path / "bench"
+    bench.mkdir()
+    jf = tmp_path / "plain.json"
+    assert main(["-w", "-d", "-t", "1", "-n", "1", "-N", "2", "-s", "8K",
+                 "-b", "4K", "--jsonfile", str(jf), "--nolive",
+                 str(bench)]) == 0
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/elbencho-tpu-chart"),
+         "--tail", str(jf)], capture_output=True, text=True, timeout=60)
+    assert out.returncode != 0
+    assert "--slowops" in out.stderr
+
+
+def test_summarize_json_tail_columns(tmp_path):
+    jf = _run_slowops_json(tmp_path)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools/elbencho-tpu-summarize-json"),
+         str(jf)], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    header = out.stdout.splitlines()[0]
+    assert header.rstrip().endswith("TailOwner")
+    assert "TailX" in header
+    write_row = next(ln for ln in out.stdout.splitlines()
+                     if " WRITE " in f" {ln} ")
+    # TailX populated (tail-vs-median ratio lands in the table)
+    assert any(ch.isdigit() for ch in write_row.split()[-2])
