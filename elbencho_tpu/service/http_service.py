@@ -365,7 +365,7 @@ class ServiceState:
         self.phase_start_monotonic = time.monotonic()
         self.manager.start_next_phase(phase)
         if bench_id:
-            shared.bench_uuid = bench_id  # master's UUID wins (hijack check)
+            shared.adopt_bench_uuid(bench_id)  # master's UUID wins
         return (200, "phase started")
 
     def status(self) -> dict:
@@ -591,6 +591,17 @@ def _make_handler(state: ServiceState, server_holder: dict):
                 except Exception as err:  # noqa: BLE001 - log, drop conn
                     logger.log_error(f"live stream session failed: {err}")
                 return
+            if route == proto.PATH_INTERRUPT_PHASE:
+                # O(fanout) teardown: forward to this node's subtree
+                # children FIRST (bounded, best-effort, read-only on
+                # state) so a --quit that shuts us down cannot strand
+                # the tree below us — and BEFORE taking route_lock:
+                # holding the route lock across outbound child requests
+                # would stall every control route for up to the forward
+                # join deadline (the lock-order detector's
+                # route_lock-across-RPC rule, testing/lockgraph.py)
+                from .stream import forward_interrupt
+                forward_interrupt(state, params)
             with state.route_lock:
                 self._do_get_locked(route, params)
 
@@ -665,11 +676,8 @@ def _make_handler(state: ServiceState, server_holder: dict):
                         params.get(proto.KEY_BENCH_ID, ""))
                     self._reply(code, {"Message": msg})
                 elif route == proto.PATH_INTERRUPT_PHASE:
-                    # O(fanout) teardown: forward to this node's subtree
-                    # children FIRST (bounded, best-effort) so a --quit
-                    # that shuts us down cannot strand the tree below us
-                    from .stream import forward_interrupt
-                    forward_interrupt(state, params)
+                    # (subtree forwarding already happened in do_GET,
+                    # outside route_lock)
                     # a deliberate interrupt is the master LETTING GO —
                     # never an expiry, so disarm before the workers stop
                     # (and it proves the master processed the last

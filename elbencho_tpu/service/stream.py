@@ -754,9 +754,11 @@ def forward_interrupt(state, params: dict) -> None:
                for child, chunk in plan_subtree(subtree, fanout)]
     for t in threads:
         t.start()
-    # one shared deadline for ALL forwards: this runs under the route
-    # lock, and a row of dead children must not stall the control plane
-    # for fanout x timeout
+    # one shared deadline for ALL forwards: do_GET calls this BEFORE
+    # taking route_lock (holding the route lock across child RPCs is
+    # the stall testing/lockgraph.py bans), but the handler thread is
+    # still pinned here — a row of dead children must not hold it for
+    # fanout x timeout
     deadline = time.monotonic() + FORWARD_JOIN_SECS
     for t in threads:
         t.join(timeout=max(deadline - time.monotonic(), 0))

@@ -151,7 +151,7 @@ class WorkerManager:
         if not limit or self.shared.phase_time_expired:
             return
         if (time.monotonic() - phase_start) > limit:
-            self.shared.phase_time_expired = True
+            self.shared.mark_phase_time_expired()
             self.interrupt_and_notify_workers()
 
     def wait_for_workers_done(self, phase_start: float) -> None:

@@ -127,6 +127,24 @@ class WorkersSharedData:
             self.cond.notify_all()
             return self.bench_uuid
 
+    def adopt_bench_uuid(self, bench_id: str) -> None:
+        """Replace the locally-minted phase UUID with the master's
+        (service-side /startphase: the master's UUID wins so the hijack
+        check compares against what the master believes). Under the
+        condition lock like every bench_uuid transition — workers block
+        in wait_for_phase_change comparing this field."""
+        with self.cond:
+            self.bench_uuid = bench_id
+            self.cond.notify_all()
+
+    def mark_phase_time_expired(self) -> None:
+        """Latch --timelimit expiry. Reentrant-safe under the condition
+        lock (threading.Condition wraps an RLock), so callers already
+        holding self.cond — the done-wait loop — can use it too."""
+        with self.cond:
+            self.phase_time_expired = True
+            self.cond.notify_all()
+
     def clear_bench_uuid(self) -> None:
         """Forget the current master's run id. Used by the service-side
         lease watchdog after orphan recovery (--svcleasesecs): the next
