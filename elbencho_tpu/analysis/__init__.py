@@ -1,0 +1,29 @@
+"""Project-invariant static analysis (``tools/elbencho-tpu-lint``).
+
+The reference elbencho is one C++17 binary whose compiler and linker
+enforce its ABI; this Python rebuild keeps its load-bearing invariants —
+append-only counter/column schemas, sum-vs-MAX wire merge rules,
+``route_lock`` serialization, ``is None`` off-path telemetry guards,
+``to_service_dict`` stripping, ``FINGERPRINT_EXCLUDE`` coverage — purely
+by convention. This package makes the machine enforce them, the same way
+``make tsan``/``make asan`` already gate the native engine.
+
+Layout:
+  core.py          Finding/Project/Allowlist + the rule registry
+  schema_rules.py  append-only schema lint (absorbed tools/check-schema)
+                   + the summarize-json column-tail manifest (fixable)
+  merge_rules.py   merge-rule completeness: every wire counter has
+                   exactly one sum/MAX/histogram merge rule, everywhere
+  lock_rules.py    route_lock discipline + WorkersSharedData writes
+  offpath_rules.py off-path telemetry guards on worker hot paths
+  wire_rules.py    wire-dict hygiene vs config/wire_policy.py
+  flags_rules.py   FLAGS-PARITY + generated usage-docs drift (fixable)
+  cli.py           the elbencho-tpu-lint entry point
+
+The runtime half of the subsystem — the testing-gated lock-order
+detector — lives in ``elbencho_tpu/testing/lockgraph.py``.
+
+Rule catalog with before/after examples: docs/static-analysis.md.
+"""
+
+from .core import Finding, LintError, Project, run_rules  # noqa: F401

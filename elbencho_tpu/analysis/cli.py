@@ -1,0 +1,122 @@
+"""elbencho-tpu-lint command line (tools/elbencho-tpu-lint).
+
+Exit codes mirror tools/check-schema: 0 clean (allowlisted findings
+only), 1 violations, 2 the engine itself could not run (schema moved,
+unknown rule) — update the engine, that is part of the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import RULES, LintError, Project, load_all_rules, run_rules
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="elbencho-tpu-lint",
+        description="project-invariant static analysis "
+                    "(docs/static-analysis.md)")
+    ap.add_argument("--schema", action="store_true",
+                    help="run only the append-only schema rules (the "
+                         "old tools/check-schema surface)")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="NAME", help="run only the named rule "
+                    "(repeatable; see --list)")
+    ap.add_argument("--list", action="store_true", dest="list_rules",
+                    help="list the rule catalog and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--fix", action="store_true",
+                    help="rewrite the generated files the two "
+                         "mechanical rules check (flags-parity usage "
+                         "docs + parity stubs, summarize-columns "
+                         "manifest), then re-lint")
+    ap.add_argument("--root", default=_repo_root(),
+                    help=argparse.SUPPRESS)  # fixture trees in tests
+    args = ap.parse_args(argv)
+
+    try:
+        if args.root == _repo_root() and not os.path.isfile(
+                os.path.join(args.root, "pyproject.toml")):
+            # running from an installed package (deb/rpm ship the tool
+            # beside the other elbencho-tpu-* binaries): the analyzer
+            # lints the project's own SOURCE — without the checkout
+            # every rule input (FLAGS-PARITY.md, docs/usage, the
+            # allowlist, the column manifest) is missing and the
+            # findings would be meaningless noise
+            raise LintError(
+                f"{args.root} is not an elbencho-tpu source checkout "
+                f"(no pyproject.toml) — elbencho-tpu-lint analyzes the "
+                f"project's own source tree; run it from a git "
+                f"checkout or pass --root <checkout>")
+        load_all_rules()
+        if args.list_rules:
+            for name in sorted(RULES):
+                rd = RULES[name]
+                tags = "".join(
+                    [" [schema]" if rd.schema_tier else "",
+                     " [fixable]" if rd.fix else ""])
+                print(f"{name}{tags}\n    {rd.doc}")
+            return 0
+        project = Project(args.root)
+        if args.fix:
+            for name in sorted(RULES):
+                if RULES[name].fix is None:
+                    continue
+                if args.rule and name not in args.rule:
+                    continue
+                for msg in RULES[name].fix(project):
+                    print(f"fix: {msg}")
+            project = Project(args.root)  # re-read what --fix rewrote
+        findings = run_rules(project, names=args.rule or None,
+                             schema_only=args.schema)
+    except LintError as err:
+        print(f"ERROR: {err}", file=sys.stderr)
+        return 2
+
+    live = [f for f in findings if not f.allowed]
+    allowed = [f for f in findings if f.allowed]
+    if args.as_json:
+        print(json.dumps({
+            "clean": not live,
+            "findings": [f.as_dict() for f in findings],
+        }, indent=1))
+        return 1 if live else 0
+
+    for f in findings:
+        stream = sys.stderr if not f.allowed else sys.stdout
+        print(f.render(), file=stream)
+    if args.schema and not live:
+        # the old check-schema progress report — its callers (make
+        # check-schema, tests) assert on these lines
+        from .schema_rules import run_schema_report
+        _violations, report = run_schema_report(project)
+        for line in report:
+            print(line)
+        print("check-schema: all counter lists / column tails "
+              "append-only")
+    if live:
+        print(f"elbencho-tpu-lint: {len(live)} violation(s)"
+              + (f" (+{len(allowed)} allowlisted)" if allowed else ""),
+              file=sys.stderr)
+        return 1
+    if not args.schema:
+        ran = (", ".join(args.rule) if args.rule
+               else f"{len(RULES)} rules")
+        print(f"elbencho-tpu-lint: clean ({ran}"
+              + (f"; {len(allowed)} allowlisted exception(s)"
+                 if allowed else "") + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

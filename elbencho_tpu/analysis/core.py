@@ -1,0 +1,282 @@
+"""Rule engine core: findings, the project view, the allowlist.
+
+A rule is a function ``check(project) -> list[Finding]`` registered via
+``@rule(...)``. Findings carry a repo-relative ``file:line`` anchor for
+humans and a *stable key* for the allowlist: keys name the violating
+construct (``manager.check_phase_time_limit:phase_time_expired``), never
+a line number, so an audited exception survives unrelated edits above it.
+
+The allowlist (``tools/lint-allowlist``) records audited exceptions, one
+per line: ``rule-name | key | justification``. An entry with an empty
+justification is itself a violation, and so is an entry that no longer
+matches any finding (stale entries hide future regressions under an old
+excuse).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+ALLOWLIST_PATH = os.path.join("tools", "lint-allowlist")
+
+
+class LintError(Exception):
+    """The engine itself cannot run (schema moved, file unparsable) —
+    distinct from a rule violation: exit code 2, never 1."""
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str            # repo-relative
+    line: int
+    key: str             # stable allowlist key (no line numbers)
+    message: str
+    allowed: bool = False
+    allow_reason: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        tail = f"  [allowlisted: {self.allow_reason}]" if self.allowed \
+            else ""
+        return f"{loc}: {self.rule}: {self.message}{tail}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "key": self.key, "message": self.message,
+                "allowed": self.allowed,
+                **({"allowReason": self.allow_reason} if self.allowed
+                   else {})}
+
+
+class Project:
+    """Read-only view of one source tree (normally the repo; tests point
+    it at fixture trees). Parses lazily, caches ASTs, annotates every
+    node with a ``_lint_parent`` link so rules can walk upward."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._src: "dict[str, str | None]" = {}
+        self._ast: "dict[str, ast.Module]" = {}
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self.abspath(rel))
+
+    def source(self, rel: str) -> "str | None":
+        if rel not in self._src:
+            try:
+                with open(self.abspath(rel)) as f:
+                    self._src[rel] = f.read()
+            except OSError:
+                self._src[rel] = None
+        return self._src[rel]
+
+    def tree(self, rel: str) -> "ast.Module | None":
+        """Parsed AST with parent links, or None when the file does not
+        exist. A file that exists but does not parse is a LintError —
+        the tier-1 suite would already be red, but the engine must say
+        why IT stopped."""
+        if rel in self._ast:
+            return self._ast[rel]
+        src = self.source(rel)
+        if src is None:
+            return None
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as err:
+            raise LintError(f"{rel} does not parse: {err}") from err
+        link_parents(tree)
+        self._ast[rel] = tree
+        return tree
+
+    def py_files(self, subdir: str = "elbencho_tpu") -> "list[str]":
+        out = []
+        base = self.abspath(subdir)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, name), self.root))
+        return out
+
+
+# -- AST helpers shared by the rules ----------------------------------------
+
+def link_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._lint_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> "ast.AST | None":
+    return getattr(node, "_lint_parent", None)
+
+
+def dotted_name(node: ast.AST) -> "str | None":
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def ordered_walk(node: ast.AST):
+    """ast.walk without its breadth-first order scrambling: depth-first
+    in source order, so extracted lists keep file order."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from ordered_walk(child)
+
+
+def enclosing_function(node: ast.AST) -> "ast.AST | None":
+    n = parent(node)
+    while n is not None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return n
+        n = parent(n)
+    return None
+
+
+def enclosing_class(node: ast.AST) -> "ast.ClassDef | None":
+    n = parent(node)
+    while n is not None:
+        if isinstance(n, ast.ClassDef):
+            return n
+        n = parent(n)
+    return None
+
+
+# -- allowlist ---------------------------------------------------------------
+
+@dataclass
+class AllowEntry:
+    rule: str
+    key: str
+    reason: str
+    line: int
+    used: bool = False
+
+
+class Allowlist:
+    """``tools/lint-allowlist`` — audited exceptions, justification
+    mandatory, staleness checked."""
+
+    def __init__(self, entries: "list[AllowEntry]", path: str):
+        self.entries = entries
+        self.path = path
+
+    @classmethod
+    def load(cls, project: Project) -> "Allowlist":
+        entries: "list[AllowEntry]" = []
+        src = project.source(ALLOWLIST_PATH)
+        if src is not None:
+            for lineno, raw in enumerate(src.splitlines(), 1):
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split("|", 2)]
+                while len(parts) < 3:
+                    parts.append("")
+                entries.append(AllowEntry(parts[0], parts[1], parts[2],
+                                          lineno))
+        return cls(entries, ALLOWLIST_PATH)
+
+    def apply(self, findings: "list[Finding]") -> None:
+        by_key = {}
+        for e in self.entries:
+            by_key[(e.rule, e.key)] = e
+        for f in findings:
+            e = by_key.get((f.rule, f.key))
+            if e is not None and e.reason:
+                f.allowed = True
+                f.allow_reason = e.reason
+                e.used = True
+
+    def hygiene_findings(self) -> "list[Finding]":
+        """Empty justifications and stale entries are violations of the
+        allowlist contract itself."""
+        out = []
+        for e in self.entries:
+            if not e.reason:
+                out.append(Finding(
+                    "allowlist", self.path, e.line,
+                    f"no-reason:{e.rule}:{e.key}",
+                    f"allowlist entry '{e.rule} | {e.key}' has no "
+                    f"justification — every audited exception must say "
+                    f"why it is safe"))
+            elif not e.used:
+                out.append(Finding(
+                    "allowlist", self.path, e.line,
+                    f"stale:{e.rule}:{e.key}",
+                    f"stale allowlist entry '{e.rule} | {e.key}' matches "
+                    f"no finding — the violation was fixed (or the key "
+                    f"changed); remove the entry so it cannot excuse a "
+                    f"future regression"))
+        return out
+
+
+# -- rule registry -----------------------------------------------------------
+
+@dataclass
+class RuleDef:
+    name: str
+    doc: str
+    check: "object"                  # check(project) -> list[Finding]
+    schema_tier: bool = False        # runs under --schema
+    fix: "object | None" = None      # fix(project) -> list[str] (messages)
+
+
+RULES: "dict[str, RuleDef]" = {}
+
+
+def rule(name: str, doc: str, schema: bool = False, fix=None):
+    def register(func):
+        RULES[name] = RuleDef(name, doc, func, schema_tier=schema,
+                              fix=fix)
+        return func
+    return register
+
+
+def load_all_rules() -> None:
+    """Import every rule module (registration side effect)."""
+    from . import (flags_rules, lock_rules, merge_rules,  # noqa: F401
+                   offpath_rules, schema_rules, wire_rules)
+
+
+def run_rules(project: Project, names: "list[str] | None" = None,
+              schema_only: bool = False,
+              use_allowlist: bool = True) -> "list[Finding]":
+    """Run the selected rules, apply the allowlist, append allowlist
+    hygiene findings. Returns every finding (allowed ones marked)."""
+    load_all_rules()
+    if names:
+        unknown = [n for n in names if n not in RULES]
+        if unknown:
+            raise LintError(f"unknown rule(s): {', '.join(unknown)} "
+                            f"(known: {', '.join(sorted(RULES))})")
+        selected = [RULES[n] for n in names]
+    elif schema_only:
+        selected = [r for r in RULES.values() if r.schema_tier]
+    else:
+        selected = list(RULES.values())
+    findings: "list[Finding]" = []
+    for rd in selected:
+        findings.extend(rd.check(project))
+    if use_allowlist:
+        allow = Allowlist.load(project)
+        allow.apply(findings)
+        # allowlist hygiene only when the whole catalog ran: a partial
+        # run (--schema, --rule X) legitimately leaves entries unused
+        if not names and not schema_only:
+            findings.extend(allow.hygiene_findings())
+    return findings

@@ -1,0 +1,226 @@
+"""flags-parity: every registered flag is accounted in FLAGS-PARITY.md
+and the generated usage docs are regenerated, not hand-edited.
+
+``tools/gen-flags-parity`` can only run where the reference tree exists
+(it parses the reference ProgArgs.h); this rule checks the half that is
+provable from the repo alone, everywhere:
+
+- every FLAG_DEFS long flag appears somewhere in FLAGS-PARITY.md
+  (implemented row, alias row, or the "Beyond the reference" table) —
+  a new flag cannot land unaccounted;
+- every "Beyond the reference" row names a real FLAG_DEFS flag
+  (stale rows flagged);
+- ``docs/usage/*.md`` equal exactly what the generator produces from
+  FLAG_DEFS (drift means someone hand-edited a generated file, or
+  forgot ``make docs``).
+
+``--fix`` regenerates the usage pages and appends a minimally-documented
+Beyond-the-reference row per missing flag (polish the wording — and
+mirror it into gen-flags-parity's BEYOND_REFERENCE table — before
+review).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .core import Finding, LintError, rule
+
+PARITY_FILE = "FLAGS-PARITY.md"
+USAGE_DIR = "docs/usage"
+
+_TIERS = {
+    "essential": ("help", "Basic options"),
+    "multi": ("help-multi", "Multi-directory & custom-tree options"),
+    "large": ("help-large", "Large file / random I/O options"),
+    "dist": ("help-dist", "Distributed mode options"),
+    "s3": ("help-s3", "S3 / object storage options"),
+    "tpu": ("help-tpu", "TPU HBM data path options"),
+    "misc": ("help-misc", "Miscellaneous options"),
+}
+
+
+def generate_usage_pages(flag_defs) -> "dict[str, str]":
+    """{repo-relative path: content} for every docs/usage page — THE
+    generator; tools/generate-usage-docs writes exactly this."""
+    pages: "dict[str, str]" = {}
+    all_lines = ["# elbencho-tpu — all options\n"]
+    for cat, (name, title) in _TIERS.items():
+        lines = [f"# {title}\n"]
+        lines.append("| Option | Argument | Description |")
+        lines.append("|---|---|---|")
+        for flag, short, _dest, kind, default, fcat, help_txt \
+                in flag_defs:
+            if fcat != cat:
+                continue
+            help_txt = help_txt.replace("|", "\\|")  # keep md tables
+            names = f"`--{flag}`" + (f", `-{short}`" if short else "")
+            arg = "" if kind == "bool" else \
+                {"int": "N", "size": "SIZE", "float": "X",
+                 "str": "STR"}.get(kind, "V")
+            lines.append(f"| {names} | {arg} | {help_txt} "
+                         f"(default: `{default}`) |"
+                         if default not in ("", False, None) else
+                         f"| {names} | {arg} | {help_txt} |")
+        text = "\n".join(lines) + "\n"
+        pages[f"{USAGE_DIR}/{name}.md"] = text
+        all_lines.append(text)
+    pages[f"{USAGE_DIR}/help-all.md"] = "\n".join(all_lines)
+    return pages
+
+
+def _load_flag_defs(project):
+    """FLAG_DEFS via runtime import — defaults are expressions
+    (``1 << 20``), so AST extraction cannot reproduce them. Returns
+    None outside the real repo (fixture trees test the pure checkers)."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.abspath(project.root) != here:
+        return None
+    from ..config.args import FLAG_DEFS
+    return FLAG_DEFS
+
+
+def parity_accounted_tokens(parity_text: str) -> "set[str]":
+    """Every backticked flag spelling in the parity doc, dashes
+    stripped: implemented rows, alias targets, Beyond-table rows."""
+    return {m.lstrip("-") for m in
+            re.findall(r"`-{0,2}([A-Za-z0-9_-]+)`", parity_text)}
+
+
+def beyond_table_flags(parity_text: str) -> "list[tuple[int, str]]":
+    """(line, flag) for each "Beyond the reference" table row."""
+    out = []
+    in_beyond = False
+    for lineno, line in enumerate(parity_text.splitlines(), 1):
+        if line.startswith("## Beyond the reference"):
+            in_beyond = True
+            continue
+        if in_beyond and line.startswith("## "):
+            in_beyond = False
+        if in_beyond:
+            m = re.match(r"\|\s*`--([A-Za-z0-9_-]+)`\s*\|", line)
+            if m:
+                out.append((lineno, m.group(1)))
+    return out
+
+
+def check_parity(flag_defs, parity_text: "str | None",
+                 parity_file: str = PARITY_FILE) -> "list[Finding]":
+    out: "list[Finding]" = []
+    if parity_text is None:
+        return [Finding("flags-parity", parity_file, 0, "missing",
+                        f"{parity_file} is missing — regenerate with "
+                        f"tools/gen-flags-parity (needs the reference "
+                        f"tree) or restore the committed copy")]
+    tokens = parity_accounted_tokens(parity_text)
+    long_flags = {fd[0] for fd in flag_defs}
+    for flag in sorted(long_flags):
+        # scenario-opt is registered as `scenario-opt` but documented
+        # with its canonical spelling; compare dash-insensitively
+        if flag in tokens or flag.replace("-", "") in {
+                t.replace("-", "") for t in tokens}:
+            continue
+        out.append(Finding(
+            "flags-parity", parity_file, 0, f"unaccounted:{flag}",
+            f"flag --{flag} is registered in FLAG_DEFS but appears "
+            f"nowhere in {parity_file} — account it (reference parity "
+            f"row, alias, or the Beyond-the-reference table); "
+            f"`elbencho-tpu-lint --fix` appends a stub row"))
+    for lineno, flag in beyond_table_flags(parity_text):
+        if flag not in long_flags:
+            out.append(Finding(
+                "flags-parity", parity_file, lineno,
+                f"stale-beyond:{flag}",
+                f"Beyond-the-reference row names --{flag} which is not "
+                f"a registered FLAG_DEFS flag — remove or rename the "
+                f"row (and gen-flags-parity's BEYOND_REFERENCE entry)"))
+    return out
+
+
+def check_usage_docs(project, pages: "dict[str, str]") \
+        -> "list[Finding]":
+    out: "list[Finding]" = []
+    for rel, want in pages.items():
+        have = project.source(rel)
+        if have is None:
+            out.append(Finding(
+                "flags-parity", rel, 0, f"usage-missing:{rel}",
+                f"generated usage page {rel} is missing — run "
+                f"`make docs` (or `elbencho-tpu-lint --fix`)"))
+        elif have != want:
+            idx = next((i for i, (a, b) in enumerate(
+                zip(have.splitlines(), want.splitlines()), 1)
+                if a != b), 0)
+            out.append(Finding(
+                "flags-parity", rel, idx, f"usage-drift:{rel}",
+                f"generated usage page {rel} drifted from FLAG_DEFS "
+                f"(first differing line {idx}) — regenerate with "
+                f"`make docs` (or `elbencho-tpu-lint --fix`); never "
+                f"hand-edit generated pages"))
+    return out
+
+
+def fix(project) -> "list[str]":
+    flag_defs = _load_flag_defs(project)
+    if flag_defs is None:
+        raise LintError("flags-parity --fix only runs on the real repo")
+    msgs = []
+    pages = generate_usage_pages(flag_defs)
+    for rel, text in pages.items():
+        if project.source(rel) != text:
+            os.makedirs(os.path.dirname(project.abspath(rel)),
+                        exist_ok=True)
+            with open(project.abspath(rel), "w") as f:
+                f.write(text)
+            msgs.append(f"regenerated {rel}")
+    parity_text = project.source(PARITY_FILE)
+    if parity_text is not None:
+        missing = [f for f in
+                   (fi.key.split(":", 1)[1] for fi in
+                    check_parity(flag_defs, parity_text)
+                    if fi.key.startswith("unaccounted:"))]
+        if missing:
+            by_flag = {fd[0]: fd for fd in flag_defs}
+            rows = []
+            for flag in missing:
+                help_txt = by_flag[flag][6].split(". ")[0] \
+                    .replace("|", "\\|")
+                rows.append(f"| `--{flag}` | (lint --fix stub — "
+                            f"document the mapping and mirror it into "
+                            f"gen-flags-parity BEYOND_REFERENCE) "
+                            f"{help_txt} |")
+            with open(project.abspath(PARITY_FILE), "w") as f:
+                f.write(insert_beyond_stub_rows(parity_text, rows))
+            msgs.append(f"inserted {len(missing)} stub row(s) into "
+                        f"{PARITY_FILE}: {', '.join(missing)}")
+    return msgs
+
+
+def insert_beyond_stub_rows(parity_text: str,
+                            rows: "list[str]") -> str:
+    """Insert stub rows INSIDE the Beyond-the-reference table (after
+    its last row) — appending at end-of-file would land them in
+    whatever section is last (e.g. the internal-wire table), where
+    ``beyond_table_flags()`` and gen-flags-parity would never see
+    them."""
+    lines = parity_text.splitlines()
+    beyond_rows = beyond_table_flags(parity_text)
+    at = beyond_rows[-1][0] if beyond_rows else len(lines)
+    lines[at:at] = rows
+    return "\n".join(lines) + "\n"
+
+
+@rule("flags-parity",
+      "every registered flag is accounted in FLAGS-PARITY.md and "
+      "docs/usage matches the generator; --fix rewrites both",
+      fix=fix)
+def check(project) -> "list[Finding]":
+    flag_defs = _load_flag_defs(project)
+    if flag_defs is None:
+        return []  # fixture tree: the pure checkers are unit-tested
+    out = check_parity(flag_defs, project.source(PARITY_FILE))
+    out.extend(check_usage_docs(project,
+                                generate_usage_pages(flag_defs)))
+    return out

@@ -1,0 +1,206 @@
+"""lock-discipline: the two static lock invariants of the control plane.
+
+**Route handlers** (service/http_service.py): the server is threaded so
+``/livestream`` push sessions cannot block the control plane, but every
+stateful route must run under the one ``route_lock`` — the reference's
+single-threaded no-concurrent-pool-mutation invariant, kept by
+construction. The rule: inside ``do_*`` handler methods, any touch of
+``state`` (attribute access or passing ``state`` onward) outside the
+``with state.route_lock:`` block is a violation. The ``/livestream``
+carve-out is an audited allowlist entry, not an engine blind spot.
+
+**WorkersSharedData writes**: its fields are the phase barrier — every
+mutation must happen inside the class's own methods (which take
+``self.cond``) or lexically under ``with <shared>.cond:`` at the call
+site. A bare ``shared.x = ...`` elsewhere is the race the threaded
+control plane (PR 8) made possible. Lock-free *reads* of monotonic
+flags (``interrupt_requested`` etc.) are an accepted idiom and not
+flagged.
+
+The runtime complement — lock-order cycles, route_lock held across a
+blocking service request — is testing/lockgraph.py; this rule is the
+part provable without running anything.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (Finding, dotted_name, enclosing_class,
+                   enclosing_function, ordered_walk, parent, rule)
+
+HTTP_SERVICE_FILE = "elbencho_tpu/service/http_service.py"
+SHARED_FILE = "elbencho_tpu/workers/shared.py"
+
+#: WorkersSharedData attributes that are handles wired once at
+#: construction, not mutable phase state — reading/calling through them
+#: is not a shared-state touch
+SHARED_EXEMPT_FIELDS = frozenset({
+    "config", "cond", "cpu_util", "tracer", "stream_control",
+    "rwmix_balancer",
+})
+
+#: receiver spellings that (by project convention) name a
+#: WorkersSharedData instance
+_SHARED_RECEIVERS = ("shared", "shared_data")
+
+#: mutating container methods: calling one on a shared field is a write
+_MUTATING_METHODS = frozenset({
+    "add", "append", "extend", "remove", "discard", "clear", "pop",
+    "update", "insert",
+})
+
+
+def shared_mutable_fields(project) -> "set[str]":
+    """Instance fields assigned in WorkersSharedData.__init__, minus the
+    construction-time handles — extracted from the AST so the rule and
+    the class can never drift apart."""
+    tree = project.tree(SHARED_FILE)
+    fields: "set[str]" = set()
+    if tree is None:
+        return fields
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "WorkersSharedData"):
+            continue
+        for item in node.body:
+            if not (isinstance(item, ast.FunctionDef)
+                    and item.name == "__init__"):
+                continue
+            for sub in ast.walk(item):
+                targets = []
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [sub.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        fields.add(t.attr)
+    return fields - SHARED_EXEMPT_FIELDS
+
+
+def _receiver_is_shared(recv: str) -> bool:
+    last = recv.rsplit(".", 1)[-1]
+    return last in _SHARED_RECEIVERS
+
+
+def _under_with(node: ast.AST, ctx_suffix: str,
+                receiver: "str | None" = None) -> bool:
+    """True when node sits inside ``with <expr>:`` where the context
+    expression's dotted text is ``<receiver>.<ctx_suffix>`` (receiver
+    None accepts any base)."""
+    n = node
+    while True:
+        p = parent(n)
+        if p is None:
+            return False
+        if isinstance(p, (ast.With, ast.AsyncWith)) and n in p.body:
+            for item in p.items:
+                d = dotted_name(item.context_expr)
+                if d is None:
+                    continue
+                if receiver is not None:
+                    if d == f"{receiver}.{ctx_suffix}":
+                        return True
+                elif d.endswith("." + ctx_suffix) or d == ctx_suffix:
+                    return True
+        n = p
+
+
+def check_shared_writes(project, files: "list[str] | None" = None) \
+        -> "list[Finding]":
+    """Project-wide scan for WorkersSharedData field writes outside the
+    class and outside ``with <shared>.cond:``."""
+    fields = shared_mutable_fields(project)
+    if not fields:
+        return []
+    out: "list[Finding]" = []
+    for rel in files if files is not None else project.py_files():
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            write_target = None
+            verb = "assigns"
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets \
+                    if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in fields:
+                        recv = dotted_name(t.value)
+                        if recv and _receiver_is_shared(recv):
+                            write_target = (recv, t.attr, t)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATING_METHODS \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and node.func.value.attr in fields:
+                recv = dotted_name(node.func.value.value)
+                if recv and _receiver_is_shared(recv):
+                    write_target = (recv, node.func.value.attr, node)
+                    verb = f"mutates (.{node.func.attr})"
+            if write_target is None:
+                continue
+            recv, fname, t = write_target
+            cls = enclosing_class(t)
+            if rel == SHARED_FILE and cls is not None \
+                    and cls.name == "WorkersSharedData":
+                continue  # the class's own methods hold self.cond
+            if _under_with(t, "cond", receiver=recv):
+                continue  # flagged lock at the call site
+            func = enclosing_function(t)
+            where = func.name if func is not None else "<module>"
+            out.append(Finding(
+                "lock-discipline", rel, t.lineno,
+                f"shared-write:{where}:{fname}",
+                f"{verb} WorkersSharedData.{fname} outside the class "
+                f"and outside `with {recv}.cond:` — phase-barrier state "
+                f"may only change under its condition lock (add a "
+                f"WorkersSharedData method, or wrap the write)"))
+    return out
+
+
+def check_route_handlers(project,
+                         rel: str = HTTP_SERVICE_FILE) \
+        -> "list[Finding]":
+    """Inside ``do_*`` HTTP handler methods every use of ``state`` must
+    sit under ``with state.route_lock:``."""
+    tree = project.tree(rel)
+    if tree is None:
+        return []
+    out: "list[Finding]" = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name.startswith("do_")):
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Name) and sub.id == "state"
+                    and isinstance(sub.ctx, ast.Load)):
+                continue
+            # `state.route_lock` in the with-statement itself is the
+            # serialization point, not a touch
+            p = parent(sub)
+            if isinstance(p, ast.Attribute) and p.attr == "route_lock":
+                continue
+            if _under_with(sub, "route_lock", receiver="state"):
+                continue
+            touch = dotted_name(p) if isinstance(p, ast.Attribute) \
+                else "state"
+            out.append(Finding(
+                "lock-discipline", rel, sub.lineno,
+                f"route-unlocked:{node.name}:{touch}",
+                f"{node.name} touches `{touch}` outside `with "
+                f"state.route_lock:` — stateful route work must "
+                f"serialize under the route lock (the reference's "
+                f"single-threaded invariant)"))
+    return out
+
+
+@rule("lock-discipline",
+      "stateful HTTP routes run under route_lock; WorkersSharedData "
+      "fields change only inside the class or under its condition lock")
+def check(project) -> "list[Finding]":
+    return check_route_handlers(project) + check_shared_writes(project)

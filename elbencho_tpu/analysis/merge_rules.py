@@ -1,0 +1,347 @@
+"""merge-rules: every wire counter has exactly one declared merge rule.
+
+Tree-merge == flat-merge is the control plane's provable-by-schema
+property (docs/control-plane.md): the service wire, ``RemoteWorker``
+ingest, the ``--svcfanout`` subtree merge, the flight recorder, and the
+``/metrics`` fleet aggregation all merge counters by the SAME two
+tables — ``PATH_AUDIT_COUNTERS`` + ``PATH_AUDIT_MAX_KEYS`` and
+``CONTROL_AUDIT_COUNTERS``. This rule makes the cross-checks machine-
+enforced:
+
+- no duplicate wire keys / context attrs / ingest attrs across the two
+  schemas (a duplicate silently double-merges);
+- ``PATH_AUDIT_MAX_KEYS`` / ``PATH_AUDIT_WORKER_ATTRS`` /
+  ``PATH_AUDIT_POOL_ATTRS`` contain no stale names (a typo there turns
+  a MAX counter into a sum without any test noticing);
+- every ``CONTROL_AUDIT_COUNTERS`` mode is ``sum`` or ``max``;
+- ``stream.MERGE_MAX_KEYS`` equals exactly the union of the schemas'
+  MAX keys (the subtree merge can never diverge from the flat merge);
+- ``flightrec.counter_schema()`` carries every schema key with the
+  matching mode;
+- merge/aggregation modules never hardcode a wire-key string literal —
+  they must derive from the schema tables, so appending a counter to
+  the table plumbs it everywhere (the invariant ROADMAP item 3's
+  binary wire codec will lean on).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .core import Finding, LintError, rule
+from .schema_rules import extract_counter_keys
+
+DEVICE_FILE = "elbencho_tpu/tpu/device.py"
+CONTROL_FILE = "elbencho_tpu/service/fault_tolerance.py"
+
+#: modules that MERGE or re-serialize counters: hardcoding a wire key
+#: here (instead of iterating the schema tables) is how tree-merge and
+#: flat-merge drift apart. Consumers that only *read* merged results
+#: (doctor verdicts, chart lanes, summarize columns) are not listed —
+#: naming a specific counter is their whole job.
+MERGE_SITE_FILES = (
+    "elbencho_tpu/service/stream.py",
+    "elbencho_tpu/service/remote_worker.py",
+    "elbencho_tpu/telemetry/flightrec.py",
+    "elbencho_tpu/telemetry/registry.py",
+    "elbencho_tpu/telemetry/exporter.py",
+    "elbencho_tpu/stats/statistics.py",
+)
+
+
+@dataclass
+class MergeSchema:
+    """Everything the pure checker needs, with file anchors so findings
+    point at the declaring table (tests feed synthetic instances)."""
+
+    path_entries: "list[tuple[str, str, str]]"   # (attr, key, ingest)
+    path_file: str
+    path_line: int
+    max_keys: "set[str]"
+    max_keys_line: int
+    worker_attrs: "set[str]"
+    worker_attrs_line: int
+    pool_attrs: "set[str]"
+    pool_attrs_line: int
+    control_entries: "list[tuple[str, str, str]]"  # (attr, key, mode)
+    control_file: str
+    control_line: int
+    # None = not extracted (fixture trees); cross-checks skip
+    stream_max_keys: "set[str] | None" = None
+    stream_file: str = "elbencho_tpu/service/stream.py"
+    stream_line: int = 1
+    flightrec_schema: "dict[str, str] | None" = None
+    flightrec_file: str = "elbencho_tpu/telemetry/flightrec.py"
+    histo_keys: "set[str]" = field(default_factory=set)
+
+    @property
+    def path_keys(self) -> "list[str]":
+        return [k for _a, k, _i in self.path_entries]
+
+    @property
+    def control_keys(self) -> "list[str]":
+        return [k for _a, k, _m in self.control_entries]
+
+    def all_keys(self) -> "set[str]":
+        return set(self.path_keys) | set(self.control_keys)
+
+    def declared_max(self) -> "set[str]":
+        return self.max_keys | {k for _a, k, m in self.control_entries
+                                if m == "max"}
+
+
+def _assign_line(tree: ast.AST, name: str, default: int = 1) -> int:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.lineno
+    return default
+
+
+def _extract_entries(src: str, name: str, width: int) \
+        -> "list[tuple] | None":
+    """Rows of a ``NAME = ((a, b, c), ...)`` literal table, as tuples of
+    the first ``width`` constant elements."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return None
+        rows = []
+        for elt in node.value.elts:
+            if not isinstance(elt, (ast.Tuple, ast.List)) \
+                    or len(elt.elts) < width:
+                return None
+            vals = []
+            for sub in elt.elts[:width]:
+                if not isinstance(sub, ast.Constant):
+                    return None
+                vals.append(sub.value)
+            rows.append(tuple(vals))
+        return rows
+    return None
+
+
+def _extract_frozenset(src: str, name: str) -> "set[str] | None":
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            continue
+        call = node.value
+        if isinstance(call, ast.Call) and len(call.args) == 1:
+            call = call.args[0]
+        if not isinstance(call, (ast.Set, ast.Tuple, ast.List)):
+            return None
+        out = set()
+        for elt in call.elts:
+            if not isinstance(elt, ast.Constant):
+                return None
+            out.add(elt.value)
+        return out
+    return None
+
+
+def _is_real_repo(project) -> bool:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.abspath(project.root) == here
+
+
+def extract_merge_schema(project) -> MergeSchema:
+    """The live schema tables, AST-extracted (so fixture trees work) —
+    plus the two *computed* derivations (stream merge keys, flightrec
+    schema) via runtime import when linting the real repo."""
+    dev_src = project.source(DEVICE_FILE)
+    ctl_src = project.source(CONTROL_FILE)
+    if dev_src is None or ctl_src is None:
+        raise LintError("merge-rules: schema files missing "
+                        f"({DEVICE_FILE}, {CONTROL_FILE})")
+    path_entries = _extract_entries(dev_src, "PATH_AUDIT_COUNTERS", 3)
+    control_entries = _extract_entries(ctl_src,
+                                       "CONTROL_AUDIT_COUNTERS", 3)
+    max_keys = _extract_frozenset(dev_src, "PATH_AUDIT_MAX_KEYS")
+    worker_attrs = _extract_frozenset(dev_src, "PATH_AUDIT_WORKER_ATTRS")
+    pool_attrs = _extract_frozenset(dev_src, "PATH_AUDIT_POOL_ATTRS")
+    if None in (path_entries, control_entries, max_keys, worker_attrs,
+                pool_attrs):
+        raise LintError(
+            "merge-rules: cannot extract the audit schema tables — a "
+            "schema moved/renamed; update analysis/merge_rules.py with "
+            "it (that is part of the merge-rule contract)")
+    dev_tree, ctl_tree = ast.parse(dev_src), ast.parse(ctl_src)
+    ms = MergeSchema(
+        path_entries=path_entries, path_file=DEVICE_FILE,
+        path_line=_assign_line(dev_tree, "PATH_AUDIT_COUNTERS"),
+        max_keys=max_keys,
+        max_keys_line=_assign_line(dev_tree, "PATH_AUDIT_MAX_KEYS"),
+        worker_attrs=worker_attrs,
+        worker_attrs_line=_assign_line(dev_tree,
+                                       "PATH_AUDIT_WORKER_ATTRS"),
+        pool_attrs=pool_attrs,
+        pool_attrs_line=_assign_line(dev_tree, "PATH_AUDIT_POOL_ATTRS"),
+        control_entries=control_entries, control_file=CONTROL_FILE,
+        control_line=_assign_line(ctl_tree, "CONTROL_AUDIT_COUNTERS"),
+    )
+    if _is_real_repo(project):
+        from ..service import stream
+        from ..telemetry import flightrec
+        ms.stream_max_keys = set(stream.MERGE_MAX_KEYS)
+        ms.stream_line = _assign_line(
+            ast.parse(project.source(ms.stream_file) or ""),
+            "MERGE_MAX_KEYS")
+        ms.flightrec_schema = dict(flightrec.counter_schema())
+        ms.histo_keys = set(stream.MERGE_HISTO_KEYS)
+    return ms
+
+
+def check_merge_schema(ms: MergeSchema) -> "list[Finding]":
+    """Pure checker over an extracted MergeSchema (unit-testable with
+    synthetic violations)."""
+    out: "list[Finding]" = []
+    R = "merge-rules"
+
+    def dup_names(seq):
+        seen, dups = set(), []
+        for name in seq:
+            if name in seen:
+                dups.append(name)
+            seen.add(name)
+        return dups
+
+    for key in dup_names(ms.path_keys):
+        out.append(Finding(R, ms.path_file, ms.path_line,
+                           f"dup-key:{key}",
+                           f"wire key {key!r} appears more than once in "
+                           f"PATH_AUDIT_COUNTERS — it would be merged "
+                           f"twice into every record"))
+    for key in dup_names(ms.control_keys):
+        out.append(Finding(R, ms.control_file, ms.control_line,
+                           f"dup-key:{key}",
+                           f"wire key {key!r} appears more than once in "
+                           f"CONTROL_AUDIT_COUNTERS"))
+    for key in sorted(set(ms.path_keys) & set(ms.control_keys)):
+        out.append(Finding(R, ms.control_file, ms.control_line,
+                           f"cross-dup-key:{key}",
+                           f"wire key {key!r} is declared by BOTH "
+                           f"PATH_AUDIT_COUNTERS and "
+                           f"CONTROL_AUDIT_COUNTERS — exactly one table "
+                           f"may own a counter's merge rule"))
+    for attr in dup_names(a for a, _k, _i in ms.path_entries):
+        out.append(Finding(R, ms.path_file, ms.path_line,
+                           f"dup-attr:{attr}",
+                           f"context attribute {attr!r} appears twice in "
+                           f"PATH_AUDIT_COUNTERS"))
+    for ing in dup_names(i for _a, _k, i in ms.path_entries):
+        out.append(Finding(R, ms.path_file, ms.path_line,
+                           f"dup-ingest:{ing}",
+                           f"RemoteWorker ingest attribute {ing!r} "
+                           f"appears twice in PATH_AUDIT_COUNTERS — two "
+                           f"wire keys would overwrite one mirror"))
+    path_keys = set(ms.path_keys)
+    for key in sorted(ms.max_keys - path_keys):
+        out.append(Finding(R, ms.path_file, ms.max_keys_line,
+                           f"stale-max:{key}",
+                           f"PATH_AUDIT_MAX_KEYS names {key!r} which is "
+                           f"not a PATH_AUDIT_COUNTERS wire key — a "
+                           f"renamed counter would silently fall back "
+                           f"to sum-merge"))
+    path_attrs = {a for a, _k, _i in ms.path_entries}
+    for attr in sorted(ms.worker_attrs - path_attrs):
+        out.append(Finding(R, ms.path_file, ms.worker_attrs_line,
+                           f"stale-worker-attr:{attr}",
+                           f"PATH_AUDIT_WORKER_ATTRS names {attr!r} "
+                           f"which is not a PATH_AUDIT_COUNTERS "
+                           f"attribute"))
+    for attr in sorted(ms.pool_attrs - path_attrs):
+        out.append(Finding(R, ms.path_file, ms.pool_attrs_line,
+                           f"stale-pool-attr:{attr}",
+                           f"PATH_AUDIT_POOL_ATTRS names {attr!r} which "
+                           f"is not a PATH_AUDIT_COUNTERS attribute"))
+    for attr, key, mode in ms.control_entries:
+        if mode not in ("sum", "max"):
+            out.append(Finding(R, ms.control_file, ms.control_line,
+                               f"bad-mode:{key}",
+                               f"CONTROL_AUDIT_COUNTERS entry {key!r} "
+                               f"declares merge mode {mode!r} — only "
+                               f"'sum' and 'max' exist on the wire"))
+    declared_max = ms.declared_max()
+    if ms.stream_max_keys is not None \
+            and ms.stream_max_keys != declared_max:
+        extra = sorted(ms.stream_max_keys - declared_max)
+        missing = sorted(declared_max - ms.stream_max_keys)
+        out.append(Finding(
+            R, ms.stream_file, ms.stream_line, "stream-max-drift",
+            f"stream.MERGE_MAX_KEYS diverged from the schemas' MAX "
+            f"keys (extra: {extra or '-'}, missing: {missing or '-'}) "
+            f"— the --svcfanout subtree merge would disagree with the "
+            f"flat merge"))
+    if ms.flightrec_schema is not None:
+        for key in sorted(ms.all_keys()):
+            want = "max" if key in declared_max else "sum"
+            got = ms.flightrec_schema.get(key)
+            if got is None:
+                out.append(Finding(
+                    R, ms.flightrec_file, 1, f"flightrec-missing:{key}",
+                    f"flightrec.counter_schema() does not record "
+                    f"{key!r} — the flight recorder would silently "
+                    f"drop the counter from every recording"))
+            elif got != want:
+                out.append(Finding(
+                    R, ms.flightrec_file, 1, f"flightrec-mode:{key}",
+                    f"flightrec.counter_schema() merges {key!r} as "
+                    f"{got!r} but the wire schema says {want!r}"))
+    for key in sorted(ms.histo_keys & ms.all_keys()):
+        out.append(Finding(R, ms.stream_file, ms.stream_line,
+                           f"histo-collision:{key}",
+                           f"{key!r} is both a histogram merge key and "
+                           f"a counter wire key"))
+    return out
+
+
+def scan_hardcoded_keys(project, wire_keys: "set[str]",
+                        files=MERGE_SITE_FILES) -> "list[Finding]":
+    """String literals equal to a wire key inside merge/aggregation
+    modules: those modules must iterate the schema tables instead, or
+    an appended counter stops short of their site."""
+    out: "list[Finding]" = []
+    for rel in files:
+        tree = project.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in wire_keys:
+                out.append(Finding(
+                    "merge-rules", rel, node.lineno,
+                    f"literal:{rel}:{node.value}",
+                    f"merge site hardcodes wire key {node.value!r} — "
+                    f"derive from PATH_AUDIT_COUNTERS / "
+                    f"CONTROL_AUDIT_COUNTERS so an appended counter "
+                    f"plumbs through this site automatically"))
+    return out
+
+
+@rule("merge-rules",
+      "every counter reachable over the wire has exactly one declared "
+      "sum/MAX merge rule, consistent across the service wire, the "
+      "subtree merge, flightrec, and /metrics")
+def check(project) -> "list[Finding]":
+    ms = extract_merge_schema(project)
+    findings = check_merge_schema(ms)
+    findings.extend(scan_hardcoded_keys(project, ms.all_keys()))
+    return findings

@@ -1,0 +1,265 @@
+"""Append-only schema rules (the absorbed ``tools/check-schema``).
+
+The repo's hardest output invariant — "counters are appended, never
+reordered" (PATH_AUDIT_COUNTERS, CONTROL_AUDIT_COUNTERS, the CSV result
+columns, TAIL_ANALYSIS_KEYS, the summarize-json column tail) — used to
+live in a standalone script; it is now the ``schema-append-only`` rule,
+with the same git discipline: each schema's ordered key list is
+extracted from the WORKING TREE and from the previous commit (``git
+show HEAD:<file>``; on a clean checkout where tree == HEAD it lints
+HEAD against HEAD~1 instead, so a post-commit CI run is never vacuous)
+and must keep the old list as a strict prefix.
+
+``summarize-columns`` additionally pins the summarize-json column tail
+against a committed manifest (``tools/summarize-columns.txt``) so tail
+drift shows up in the PR diff itself — and is one of the two mechanical
+rules ``elbencho-tpu-lint --fix`` can rewrite.
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+
+from .core import Finding, LintError, ordered_walk, rule
+
+SUMMARIZE_TOOL = "tools/elbencho-tpu-summarize-json"
+COLUMNS_MANIFEST = "tools/summarize-columns.txt"
+
+
+# -- extractors (API kept for tools/check-schema's importers) ---------------
+
+def extract_counter_keys(src: str, name: str) -> "list[str] | None":
+    """The ordered wire-key list (second tuple element) of a
+    ``NAME = ( (attr, key, ...), ... )`` schema assignment."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ordered_walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return None
+        keys = []
+        for elt in node.value.elts:
+            if not isinstance(elt, (ast.Tuple, ast.List)) \
+                    or len(elt.elts) < 2 \
+                    or not isinstance(elt.elts[1], ast.Constant):
+                return None
+            keys.append(elt.elts[1].value)
+        return keys
+    return None
+
+
+def extract_string_tuple(src: str, name: str) -> "list[str] | None":
+    """The ordered strings of a ``NAME = ("a", "b", ...)`` assignment
+    (e.g. Statistics.CSV_RESULT_COLUMNS). Accepts a frozenset call too
+    (order still source order — callers decide if that matters)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ordered_walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            return None
+        out = []
+        for elt in node.value.elts:
+            if not isinstance(elt, ast.Constant) \
+                    or not isinstance(elt.value, str):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def extract_header_columns(src: str) -> "list[str] | None":
+    """The ordered column-name constants of every ``header = [...]`` /
+    ``header += [...]`` statement in elbencho-tpu-summarize-json, in
+    source order — the tool's documented append-only column tail.
+    Conditional single appends (``header.append("Degr")``) are part of
+    the flow, not the fixed tail, and are deliberately not collected."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    cols: "list[str]" = []
+    for node in ordered_walk(tree):
+        value = None
+        if isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "header":
+            value = node.value
+        elif isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "header"
+                        for t in node.targets):
+            value = node.value
+        if value is None:
+            continue
+        for sub in ordered_walk(value):
+            if isinstance(sub, ast.List):
+                for elt in sub.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        cols.append(elt.value)
+    return cols or None
+
+
+#: (relative path, human label, extractor) — adding a schema here is
+#: part of the append-only contract (see docs/static-analysis.md)
+TARGETS = (
+    ("elbencho_tpu/tpu/device.py", "PATH_AUDIT_COUNTERS",
+     lambda src: extract_counter_keys(src, "PATH_AUDIT_COUNTERS")),
+    ("elbencho_tpu/service/fault_tolerance.py", "CONTROL_AUDIT_COUNTERS",
+     lambda src: extract_counter_keys(src, "CONTROL_AUDIT_COUNTERS")),
+    ("elbencho_tpu/stats/statistics.py", "CSV_RESULT_COLUMNS",
+     lambda src: extract_string_tuple(src, "CSV_RESULT_COLUMNS")),
+    (SUMMARIZE_TOOL, "summarize-json column tail",
+     extract_header_columns),
+    ("elbencho_tpu/telemetry/slowops.py", "TAIL_ANALYSIS_KEYS",
+     lambda src: extract_string_tuple(src, "TAIL_ANALYSIS_KEYS")),
+)
+
+
+def _git_show(project, ref: str, rel_path: str) -> "str | None":
+    try:
+        out = subprocess.run(
+            ["git", "show", f"{ref}:{rel_path}"], cwd=project.root,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def run_schema_report(project) -> "tuple[list[Finding], list[str]]":
+    """The append-only check plus the human progress lines the old
+    ``tools/check-schema`` printed (its callers assert on them).
+    Memoized per Project: the --schema CLI path needs both the rule's
+    findings and the report lines, and each extraction spawns git
+    subprocesses — once is enough."""
+    cached = getattr(project, "_schema_report", None)
+    if cached is not None:
+        return cached
+    findings: "list[Finding]" = []
+    report: "list[str]" = []
+    for rel_path, label, extract in TARGETS:
+        new_src = project.source(rel_path)
+        if new_src is None:
+            raise LintError(
+                f"cannot read {rel_path} — the schema moved/renamed; "
+                f"update analysis/schema_rules.TARGETS with it (that is "
+                f"part of the append-only contract)")
+        new = extract(new_src)
+        if new is None:
+            raise LintError(
+                f"cannot extract {label} from {rel_path} — the schema "
+                f"moved/renamed; update analysis/schema_rules.TARGETS "
+                f"with it (that is part of the append-only contract)")
+        old_ref = "HEAD"
+        old_src = _git_show(project, "HEAD", rel_path)
+        if old_src == new_src:
+            # clean checkout: tree == HEAD and the diff-vs-HEAD check
+            # would be vacuous — lint the last COMMIT instead, so a CI
+            # run after the commit still catches a reorder
+            prev = _git_show(project, "HEAD~1", rel_path)
+            if prev is not None:
+                old_src, old_ref = prev, "HEAD~1"
+        if old_src is None:
+            report.append(f"  {label}: no HEAD version (new file / "
+                          f"no git) — ok")
+            continue
+        old = extract(old_src)
+        if old is None:
+            report.append(f"  {label}: unextractable at {old_ref} — ok "
+                          f"(schema introduced by this change)")
+            continue
+        if new[:len(old)] != old:
+            idx = next((i for i, (a, b)
+                        in enumerate(zip(old, new)) if a != b), len(new))
+            findings.append(Finding(
+                "schema-append-only", rel_path, 1,
+                f"{label}",
+                f"{label} is NOT append-only against {old_ref} — first "
+                f"divergence at index {idx}: {old_ref} has "
+                f"{old[idx] if idx < len(old) else '<end>'!r}, tree has "
+                f"{new[idx] if idx < len(new) else '<end>'!r}. Existing "
+                f"keys/columns must never be reordered, renamed, or "
+                f"removed; add new entries at the END."))
+        else:
+            added = len(new) - len(old)
+            report.append(
+                f"  {label}: ok vs {old_ref} ({len(old)} -> {len(new)} "
+                f"entries" + (f", +{added} appended" if added else "")
+                + ")")
+    project._schema_report = (findings, report)
+    return findings, report
+
+
+@rule("schema-append-only",
+      "counter lists / result columns / column tails are append-only "
+      "against the previous commit (no reorder, rename, or removal)",
+      schema=True)
+def check_append_only(project) -> "list[Finding]":
+    findings, _report = run_schema_report(project)
+    return findings
+
+
+# -- summarize-json column-tail manifest (fixable) --------------------------
+
+def current_column_tail(project) -> "list[str]":
+    src = project.source(SUMMARIZE_TOOL)
+    if src is None:
+        raise LintError(f"cannot read {SUMMARIZE_TOOL}")
+    cols = extract_header_columns(src)
+    if cols is None:
+        raise LintError(f"cannot extract the column tail from "
+                        f"{SUMMARIZE_TOOL}")
+    return cols
+
+
+def fix_columns_manifest(project) -> "list[str]":
+    cols = current_column_tail(project)
+    with open(project.abspath(COLUMNS_MANIFEST), "w") as f:
+        f.write("# generated by `elbencho-tpu-lint --fix` — the "
+                "summarize-json column tail,\n# one column per line; "
+                "tests and downstream CSV consumers index into this "
+                "order.\n")
+        f.write("\n".join(cols) + "\n")
+    return [f"rewrote {COLUMNS_MANIFEST} ({len(cols)} columns)"]
+
+
+@rule("summarize-columns",
+      "the summarize-json column tail matches the committed manifest "
+      "(tools/summarize-columns.txt); --fix regenerates it",
+      schema=True, fix=fix_columns_manifest)
+def check_columns_manifest(project) -> "list[Finding]":
+    cols = current_column_tail(project)
+    manifest_src = project.source(COLUMNS_MANIFEST)
+    if manifest_src is None:
+        return [Finding(
+            "summarize-columns", COLUMNS_MANIFEST, 0, "missing",
+            f"column-tail manifest {COLUMNS_MANIFEST} is missing — run "
+            f"`tools/elbencho-tpu-lint --fix` to generate it")]
+    manifest = [line for line in manifest_src.splitlines()
+                if line and not line.startswith("#")]
+    if manifest == cols:
+        return []
+    idx = next((i for i, (a, b) in enumerate(zip(manifest, cols))
+                if a != b), min(len(manifest), len(cols)))
+    a = manifest[idx] if idx < len(manifest) else "<end>"
+    b = cols[idx] if idx < len(cols) else "<end>"
+    return [Finding(
+        "summarize-columns", COLUMNS_MANIFEST, idx + 1, "drift",
+        f"summarize-json column tail drifted from the manifest at "
+        f"index {idx}: manifest has {a!r}, {SUMMARIZE_TOOL} produces "
+        f"{b!r} — if the change is a deliberate APPEND, run "
+        f"`tools/elbencho-tpu-lint --fix` and commit the manifest; a "
+        f"reorder/rename/removal must be reverted")]

@@ -1,0 +1,231 @@
+"""wire-hygiene: to_service_dict and FINGERPRINT_EXCLUDE match the
+declared per-field classification (config/wire_policy.py).
+
+The regression class this kills: a new flag lands, ships to services by
+default (to_service_dict serializes every dataclass field), and months
+later someone discovers it re-derives differently on the service side,
+or that changing it invalidates --resume journals it shouldn't — the
+"scenario_epoch is wire-relevant only when…" one-offs. Now the author
+declares the class once; the rule proves the implementation agrees:
+
+- every BenchConfig field appears in exactly one policy class, and
+  every policy name is a real field (stale names flagged);
+- the set of field keys assigned inside ``to_service_dict`` equals
+  exactly {master-only ∪ master-fingerprinted ∪ per-host};
+- ``FINGERPRINT_EXCLUDE`` equals exactly
+  {master-only ∪ wire-observability}.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintError, rule
+
+ARGS_FILE = "elbencho_tpu/config/args.py"
+JOURNAL_FILE = "elbencho_tpu/journal.py"
+POLICY_FILE = "elbencho_tpu/config/wire_policy.py"
+
+
+def _to_service_dict_assigned(project) -> "tuple[set[str], int]":
+    """Field keys assigned as ``d["key"] = ...`` (incl. chained
+    assignments) inside BenchConfig.to_service_dict, with the def's
+    line for anchoring."""
+    tree = project.tree(ARGS_FILE)
+    if tree is None:
+        raise LintError(f"wire-hygiene: {ARGS_FILE} missing")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "to_service_dict":
+            keys: "set[str]" = set()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "d" \
+                            and isinstance(t.slice, ast.Constant) \
+                            and isinstance(t.slice.value, str):
+                        keys.add(t.slice.value)
+            return keys, node.lineno
+    raise LintError("wire-hygiene: BenchConfig.to_service_dict not "
+                    "found — the wire serializer moved; update "
+                    "analysis/wire_rules.py")
+
+
+def _fingerprint_exclude(project) -> "tuple[set[str], int]":
+    tree = project.tree(JOURNAL_FILE)
+    if tree is None:
+        raise LintError(f"wire-hygiene: {JOURNAL_FILE} missing")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name)
+                and t.id == "FINGERPRINT_EXCLUDE"
+                for t in node.targets):
+            call = node.value
+            if isinstance(call, ast.Call) and call.args:
+                call = call.args[0]
+            if isinstance(call, (ast.Set, ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) for e in call.elts):
+                return ({e.value for e in call.elts}, node.lineno)
+            raise LintError("wire-hygiene: FINGERPRINT_EXCLUDE is no "
+                            "longer a literal set — update "
+                            "analysis/wire_rules.py")
+    raise LintError("wire-hygiene: journal.FINGERPRINT_EXCLUDE not "
+                    "found — update analysis/wire_rules.py")
+
+
+def check_wire_policy(fields: "list[str]",
+                      policy_classes: "dict[str, frozenset]",
+                      assigned: "set[str]", assigned_line: int,
+                      excluded: "set[str]", excluded_line: int,
+                      args_file: str = ARGS_FILE,
+                      journal_file: str = JOURNAL_FILE,
+                      policy_file: str = POLICY_FILE) \
+        -> "list[Finding]":
+    """Pure checker (unit-testable with synthetic classifications)."""
+    out: "list[Finding]" = []
+    R = "wire-hygiene"
+    fieldset = set(fields)
+    seen: "dict[str, str]" = {}
+    for cls_name, members in policy_classes.items():
+        for name in sorted(members):
+            if name in seen:
+                out.append(Finding(
+                    R, policy_file, 1, f"dual-class:{name}",
+                    f"config field {name!r} is classified as both "
+                    f"{seen[name]!r} and {cls_name!r} — exactly one "
+                    f"class per field"))
+            seen[name] = cls_name
+            if name not in fieldset:
+                out.append(Finding(
+                    R, policy_file, 1, f"stale:{name}",
+                    f"wire_policy classifies {name!r} which is not a "
+                    f"BenchConfig field — remove or rename the entry"))
+    for name in fields:
+        if name not in seen:
+            out.append(Finding(
+                R, policy_file, 1, f"unclassified:{name}",
+                f"config field {name!r} has no wire_policy class — "
+                f"declare whether it ships to services and whether it "
+                f"is parity-relevant for --resume "
+                f"(config/wire_policy.py)"))
+    want_assigned = (policy_classes.get("master-only", frozenset())
+                     | policy_classes.get("master-fingerprinted",
+                                          frozenset())
+                     | policy_classes.get("per-host", frozenset())) \
+        & fieldset
+    for name in sorted((assigned & fieldset) - want_assigned):
+        out.append(Finding(
+            R, args_file, assigned_line, f"strips-wire-field:{name}",
+            f"to_service_dict assigns {name!r} but wire_policy "
+            f"classifies it as {seen.get(name, 'unclassified')!r} — "
+            f"either the field ships untouched or its class is wrong"))
+    for name in sorted(want_assigned - assigned):
+        out.append(Finding(
+            R, args_file, assigned_line, f"unstripped:{name}",
+            f"wire_policy classifies {name!r} as "
+            f"{seen.get(name)!r} but to_service_dict does not "
+            f"neutralize/rewrite it — the master would ship its own "
+            f"value to every service"))
+    want_excluded = (policy_classes.get("master-only", frozenset())
+                     | policy_classes.get("wire-observability",
+                                          frozenset())) & fieldset
+    for name in sorted((excluded & fieldset) - want_excluded):
+        out.append(Finding(
+            R, journal_file, excluded_line,
+            f"over-excluded:{name}",
+            f"FINGERPRINT_EXCLUDE lists {name!r} but wire_policy "
+            f"classifies it as {seen.get(name, 'unclassified')!r} — a "
+            f"--resume would silently accept a run whose "
+            f"parity-relevant config changed"))
+    for name in sorted(want_excluded - excluded):
+        out.append(Finding(
+            R, journal_file, excluded_line, f"under-excluded:{name}",
+            f"wire_policy classifies {name!r} as observability/"
+            f"master-only but FINGERPRINT_EXCLUDE does not list it — "
+            f"changing how a run is watched would invalidate its "
+            f"journal"))
+    for name in sorted(excluded - fieldset):
+        out.append(Finding(
+            R, journal_file, excluded_line, f"excluded-stale:{name}",
+            f"FINGERPRINT_EXCLUDE lists {name!r} which is not a "
+            f"BenchConfig field"))
+    return out
+
+
+def _dataclass_fields(project) -> "list[str]":
+    """BenchConfig field names: the dest (3rd element) of every
+    FLAG_DEFS row plus the positional ``paths`` list — exactly how
+    args.py builds the dataclass (_CONFIG_FIELDS). AST-extracted so
+    fixture trees work and import side effects stay out of the rule."""
+    tree = project.tree(ARGS_FILE)
+    if tree is None:
+        raise LintError(f"wire-hygiene: {ARGS_FILE} missing")
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FLAG_DEFS"
+                for t in node.targets)):
+            continue
+        if not isinstance(node.value, (ast.List, ast.Tuple)):
+            break
+        fields: "list[str]" = []
+        for elt in node.value.elts:
+            if not isinstance(elt, (ast.Tuple, ast.List)) \
+                    or len(elt.elts) < 3 \
+                    or not isinstance(elt.elts[2], ast.Constant):
+                raise LintError("wire-hygiene: FLAG_DEFS row without a "
+                                "constant dest — update "
+                                "analysis/wire_rules.py")
+            dest = elt.elts[2].value
+            if dest not in fields:
+                fields.append(dest)
+        fields.append("paths")
+        return fields
+    raise LintError("wire-hygiene: config FLAG_DEFS table not found — "
+                    "update analysis/wire_rules.py")
+
+
+def _policy_classes(project) -> "dict[str, frozenset]":
+    """The declared classification. AST-extracted (literal frozensets)
+    so the rule works on fixture trees too."""
+    tree = project.tree(POLICY_FILE)
+    if tree is None:
+        raise LintError(f"wire-hygiene: {POLICY_FILE} missing — the "
+                        f"classification is part of the contract")
+    names = {"MASTER_ONLY": "master-only",
+             "MASTER_FINGERPRINTED": "master-fingerprinted",
+             "PER_HOST": "per-host",
+             "WIRE_OBSERVABILITY": "wire-observability",
+             "WIRE": "wire"}
+    out: "dict[str, frozenset]" = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in names:
+                call = node.value
+                if isinstance(call, ast.Call) and call.args:
+                    call = call.args[0]
+                if isinstance(call, (ast.Set, ast.Tuple, ast.List)):
+                    out[names[t.id]] = frozenset(
+                        e.value for e in call.elts
+                        if isinstance(e, ast.Constant))
+    missing = set(names.values()) - set(out)
+    if missing:
+        raise LintError(f"wire-hygiene: wire_policy classes missing "
+                        f"from {POLICY_FILE}: {sorted(missing)}")
+    return out
+
+
+@rule("wire-hygiene",
+      "to_service_dict stripping and FINGERPRINT_EXCLUDE coverage "
+      "match the declared per-field wire/fingerprint classification")
+def check(project) -> "list[Finding]":
+    fields = _dataclass_fields(project)
+    policy = _policy_classes(project)
+    assigned, assigned_line = _to_service_dict_assigned(project)
+    excluded, excluded_line = _fingerprint_exclude(project)
+    return check_wire_policy(fields, policy, assigned, assigned_line,
+                             excluded, excluded_line)

@@ -1,0 +1,169 @@
+"""Wire/fingerprint classification of every BenchConfig field.
+
+THE single source of truth the ``wire-hygiene`` lint rule checks the
+implementation against: every config field is declared in exactly one
+class below, and the rule cross-checks the declaration against what
+``BenchConfig.to_service_dict`` actually strips/rewrites and what
+``journal.FINGERPRINT_EXCLUDE`` actually excludes. Adding a field
+without classifying it here fails ``make lint`` — the mechanical end of
+the "is this knob wire-relevant?" review question that used to be
+re-litigated one regression at a time.
+
+The two independent axes:
+
+- **wire**: does the field ship meaningfully to services over POST
+  /preparephase, or does the master neutralize it first?
+- **fingerprint**: does the field change *what data the run produces*
+  (parity-relevant, fingerprinted for --resume) or only *how the run
+  is watched* (excluded)?
+
+Classes (field appears in exactly one):
+
+``MASTER_ONLY``     neutralized in to_service_dict AND excluded from
+                    the fingerprint — pure master-side machinery
+                    (result files, hosts lists, the journal itself,
+                    the streaming-transport shape).
+``MASTER_FINGERPRINTED``
+                    neutralized on the wire but still fingerprinted —
+                    the scenario plan: services receive each step's
+                    EFFECTIVE config, never the plan, yet a changed
+                    plan must invalidate a --resume.
+``PER_HOST``        rewritten (not neutralized) per service instance
+                    by to_service_dict — rank offsets, per-service
+                    chip pinning, netbench topology.
+``WIRE_OBSERVABILITY``
+                    ships untouched but excluded from the fingerprint
+                    — shapes how a run is watched (live stats, traces,
+                    telemetry, control-plane resilience), never what
+                    it produces.
+``WIRE``            ships untouched and fingerprinted — workload
+                    geometry, access pattern, backends, TPU path: the
+                    parity-relevant payload.
+"""
+
+from __future__ import annotations
+
+MASTER_ONLY = frozenset({
+    "csv_file_path", "flightrec_file_path", "hosts_file_path",
+    "hosts_str", "journal_file_path", "json_file_path", "res_file_path",
+    "resume_run", "run_as_service", "svc_fanout", "svc_stalled_secs",
+    "svc_stream", "svc_tolerant_hosts",
+})
+
+MASTER_FINGERPRINTED = frozenset({
+    "scenario", "scenario_opts_str",
+})
+
+PER_HOST = frozenset({
+    "netbench_servers_str", "netbench_total_hosts",
+    "num_dataset_threads_override", "rank_offset", "tpu_ids_str",
+    "tpu_multihost",
+})
+
+WIRE_OBSERVABILITY = frozenset({
+    "config_file_path", "disable_live_stats", "do_dry_run",
+    "ignore_0usec_errors", "interrupt_services", "live_csv_extended",
+    "live_csv_file_path", "live_json_extended", "live_json_file_path",
+    "live_stats_interval_ms", "log_level", "no_csv_labels",
+    "num_latency_percentile_9s", "op_sample_rate", "ops_log_lock",
+    "ops_log_path", "quit_services", "run_service_in_foreground",
+    "show_all_elapsed", "show_cpu_util", "show_latency",
+    "show_latency_histogram", "show_latency_percentiles",
+    "show_svc_elapsed", "show_svc_ping",
+    "single_line_live_stats_no_erase", "slow_ops_k", "svc_lease_secs",
+    "svc_num_retries", "svc_password_file", "svc_retry_budget_secs",
+    "svc_update_interval_ms", "svc_wait_secs", "telemetry",
+    "telemetry_port", "tpu_profile_dir", "trace_file_path",
+    "trace_fleet", "trace_sample", "trace_ship_cap_mib",
+    "use_single_line_live_stats",
+})
+
+WIRE = frozenset({
+    # workload selection + geometry
+    "run_create_files", "run_read_files", "run_create_dirs",
+    "run_delete_dirs", "run_delete_files", "run_stat_files",
+    "run_stat_dirs", "run_sync_phase", "run_drop_caches_phase",
+    "run_netbench", "num_threads", "num_dirs", "num_files", "file_size",
+    "block_size", "paths",
+    # I/O engine + resilience knobs that change op sequencing
+    "io_depth", "io_engine", "io_num_retries", "io_retry_budget_secs",
+    "io_timeout_secs", "io_sqpoll", "io_sqpoll_idle_ms",
+    "pool_registration",
+    # access pattern
+    "use_random_offsets", "random_amount", "no_random_align",
+    "rand_offset_algo", "do_reverse_seq_offsets", "do_strided_access",
+    "do_infinite_io_loop",
+    # file handling
+    "use_direct_io", "no_direct_io_check", "use_mmap", "use_file_locks",
+    "fadvise_flags", "madvise_flags", "do_truncate",
+    "do_truncate_to_size", "do_prealloc_file", "no_fd_sharing",
+    "do_dir_sharing", "show_dirs_stats", "ignore_delete_errors",
+    "use_hdfs", "no_path_expansion", "integrity_check_salt",
+    "do_direct_verify", "do_read_inline", "block_variance_pct",
+    "block_variance_algo", "rwmix_read_pct", "num_rwmix_read_threads",
+    "rwmix_thr_read_pct", "limit_read_bps", "limit_write_bps",
+    "iterations", "time_limit_secs", "next_phase_delay_secs",
+    "bench_label", "use_base10_units",
+    # distributed topology (what services do, not how they're watched)
+    "num_hosts_limit", "service_port", "no_shared_service_path",
+    "rotate_hosts_num", "start_time_utc", "netdevs_str", "servers_str",
+    "clients_str", "servers_file_path", "clients_file_path",
+    "num_netbench_servers", "netbench_response_size",
+    "sock_recv_buf_size", "sock_send_buf_size",
+    # TPU data path
+    "assign_tpu_per_service", "use_tpu_direct", "tpu_batch_blocks",
+    "tpu_depth", "tpu_stream", "tpu_dispatch_budget_usec",
+    "tpu_fallback", "do_tpu_verify", "tpu_hbm_limit_pct",
+    "run_tpu_bench", "tpu_bench_pattern", "run_tpu_slice",
+    "mesh_shape_str", "redist_spec", "use_pod_hosts", "numa_zones_str",
+    "cpu_cores_str",
+    # custom tree
+    "tree_file_path", "use_custom_tree_rand",
+    "use_custom_tree_round_robin", "tree_round_up_size",
+    "file_share_size", "tree_scan_path", "do_stat_inline",
+    # object storage
+    "s3_endpoints_str", "s3_access_key", "s3_secret_key",
+    "s3_session_token", "s3_region", "s3_object_prefix",
+    "s3_rand_obj_select", "s3_no_mpu", "use_s3_client_singleton",
+    "run_list_objects_num", "run_list_objects_parallel",
+    "do_list_objects_verify", "run_multi_delete_num",
+    "s3_virtual_hosted", "s3_sign_policy", "s3_max_connections",
+    "s3_mpu_sharing", "run_s3_mpu_complete_phase", "s3_cred_file_path",
+    "s3_cred_list", "s3_num_retries", "run_s3_acl_put",
+    "run_s3_acl_get", "s3_acl_grantee", "s3_acl_grantee_type",
+    "s3_acl_grants", "do_s3_acl_put_inline", "do_s3_acl_verify",
+    "s3_checksum_algo", "s3_no_mpu_completion",
+    "s3_ignore_part_num_check", "s3_ignore_mpu_completion_404",
+    "s3_fast_get", "s3_fast_put", "s3_no_compression",
+    "s3_mpu_size_variance", "s3_log_level", "s3_log_prefix",
+    "run_s3_bucket_acl_put", "run_s3_bucket_acl_get",
+    "run_s3_object_tagging", "do_s3_object_tagging_verify",
+    "run_s3_bucket_tagging", "do_s3_bucket_tagging_verify",
+    "run_s3_bucket_versioning", "do_s3_bucket_versioning_verify",
+    "run_s3_object_lock_cfg", "do_s3_object_lock_cfg_verify", "s3_sse",
+    "s3_sse_customer_key", "s3_sse_kms_key_id", "s3_ignore_errors",
+    "gcs_endpoint_str", "gcs_project", "gcs_token", "gcs_resumable",
+    "gcs_anonymous", "object_backend",
+    # scenario per-step overlay knobs: ship with each step's effective
+    # config (the plan itself is MASTER_FINGERPRINTED) and are
+    # parity-relevant — a changed shuffle window is a different run
+    "shuffle_window", "scenario_step_label", "scenario_epoch",
+    "scenario_prefetch", "scenario_decode_usec", "scenario_step_usec",
+    "scenario_batch_blocks", "scenario_creates_files",
+})
+
+#: every class, for exhaustiveness checks
+ALL_CLASSES = {
+    "master-only": MASTER_ONLY,
+    "master-fingerprinted": MASTER_FINGERPRINTED,
+    "per-host": PER_HOST,
+    "wire-observability": WIRE_OBSERVABILITY,
+    "wire": WIRE,
+}
+
+
+def classify(field_name: str) -> "str | None":
+    for cls_name, members in ALL_CLASSES.items():
+        if field_name in members:
+            return cls_name
+    return None

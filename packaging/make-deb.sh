@@ -44,7 +44,8 @@ chmod 755 "$PKGROOT/usr/bin/elbencho-tpu"
 for tool in elbencho-tpu-chart elbencho-tpu-summarize-json \
         elbencho-tpu-doctor elbencho-tpu-trace \
         elbencho-tpu-scan-path elbencho-tpu-sweep elbencho-tpu-dgen \
-        elbencho-tpu-blockdev-rand elbencho-tpu-cleanup-mpu; do
+        elbencho-tpu-blockdev-rand elbencho-tpu-cleanup-mpu \
+        elbencho-tpu-lint; do
     # the tools' repo-relative sys.path bootstrap resolves to /usr when
     # installed — harmless, dist-packages provides the real package
     cp "$REPO/tools/$tool" "$PKGROOT/usr/bin/$tool"
