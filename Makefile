@@ -3,7 +3,7 @@
 # engine lives in csrc/)
 
 .PHONY: all native native-tsan native-asan tsan asan check check-schema \
-	test test-fast test-chaos test-scale test-mesh test-obs \
+	lint test test-fast test-chaos test-scale test-mesh test-obs \
 	test-scenario test-examples fuzz bench docs clean deb rpm docker
 
 all: native
@@ -47,13 +47,20 @@ asan: native-asan
 	$(MAKE) native
 
 # the single green command (SURVEY.md section 5.2 sanitizer/robustness
-# gate): pytest + seeded fuzz sweeps + asan/tsan engine builds each
-# re-running the native test file + the end-to-end example suite.
-# Exits nonzero on the first failing stage; ends by restoring the
-# normal (unsanitized) engine build.
+# gate): static analysis + pytest + seeded fuzz sweeps + the lockgraph-
+# armed chaos suite (runtime lock-order detector beside the native
+# sanitizers) + asan/tsan engine builds each re-running the native test
+# file + the end-to-end example suite. Exits nonzero on the first
+# failing stage; ends by restoring the normal (unsanitized) engine
+# build.
 check: native
+	tools/elbencho-tpu-lint
 	python -m pytest tests/ -q
 	tools/fuzz-sweep
+	env ELBENCHO_TPU_TESTING=1 ELBENCHO_TPU_LOCKGRAPH=1 \
+		python -m pytest tests/test_fault_tolerance.py \
+		tests/test_io_fault_tolerance.py tests/test_run_lifecycle.py \
+		tests/test_svc_stream.py -q -m chaos
 	$(MAKE) native-asan
 	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
 		ASAN_OPTIONS=detect_leaks=0 \
@@ -69,14 +76,25 @@ check: native
 fuzz:
 	tools/fuzz-sweep
 
-# append-only lint for the wire/JSON counter schemas (PATH_AUDIT /
-# CONTROL_AUDIT lists, CSV columns, summarize-json column tail) against
-# the previous commit — the "appended, never reordered" rule as a
-# mechanical gate instead of a convention
+# project-invariant static analysis (elbencho_tpu/analysis/, rule
+# catalog: docs/static-analysis.md): merge-rule completeness, append-
+# only schemas, route_lock/WorkersSharedData lock discipline, off-path
+# telemetry guards, to_service_dict/FINGERPRINT_EXCLUDE wire hygiene,
+# flags-parity drift — the conventions every "review-hardened"
+# paragraph since PR 10 re-fixed by hand, as a machine gate. Audited
+# exceptions: tools/lint-allowlist. `--fix` rewrites the generated
+# files the two mechanical rules check.
+lint:
+	tools/elbencho-tpu-lint
+
+# append-only schema tier alone (PATH_AUDIT / CONTROL_AUDIT lists, CSV
+# columns, summarize-json column tail) against the previous commit —
+# kept as its own entrypoint; since the rule engine landed this is
+# `elbencho-tpu-lint --schema` behind the historical shim
 check-schema:
 	tools/check-schema
 
-test: native check-schema
+test: native lint
 	python -m pytest tests/ -q
 
 test-fast: native
@@ -86,9 +104,13 @@ test-fast: native
 # chaos gates alone: the fault-injection suites that drive control-plane
 # retry/watchdog/degradation, data-plane I/O faults, and the crash-safe
 # run lifecycle (lease orphaning, journal/resume, signal shutdown)
-# through real master/service processes (pytest marker `chaos`)
+# through real master/service processes (pytest marker `chaos`) — armed
+# with the runtime lock-order detector (testing/lockgraph.py): the
+# session fails on any lock-order cycle or route_lock-across-RPC in the
+# union of every fleet process's lock graph
 test-chaos: native
-	python -m pytest tests/test_fault_tolerance.py \
+	env ELBENCHO_TPU_TESTING=1 ELBENCHO_TPU_LOCKGRAPH=1 \
+		python -m pytest tests/test_fault_tolerance.py \
 		tests/test_io_fault_tolerance.py tests/test_run_lifecycle.py \
 		tests/test_svc_stream.py -q -m chaos
 
@@ -107,6 +129,7 @@ test-mesh: native
 # >= 10x vs polling (pytest marker `scale`; docs/control-plane.md)
 test-scale:
 	env JAX_PLATFORMS=cpu ELBENCHO_TPU_NO_NATIVE=1 \
+		ELBENCHO_TPU_TESTING=1 ELBENCHO_TPU_LOCKGRAPH=1 \
 		python -m pytest tests/test_stream_scale.py -q -m scale
 
 # observability gate: the telemetry + flight-recorder + run-doctor +
@@ -129,7 +152,9 @@ test-obs: check-schema
 # pytest marker `scenario`; docs/scenarios.md). Also part of the default
 # `make test` pytest sweep.
 test-scenario: native check-schema
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios.py \
+	env JAX_PLATFORMS=cpu ELBENCHO_TPU_TESTING=1 \
+		ELBENCHO_TPU_LOCKGRAPH=1 \
+		python -m pytest tests/test_scenarios.py \
 		-q -m scenario
 
 # end-to-end example suite against real resources (loopdevs, services)

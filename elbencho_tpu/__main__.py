@@ -1,4 +1,17 @@
+import os
 import sys
+
+# lock-order detector arming for fleet subprocesses: the pytest session
+# fixture (tests/conftest.py) arms ITS process and exports the dump dir;
+# every service/master process spawned with that environment arms here —
+# before cli/config imports so ServiceState's locks are created tracked.
+# Both variables are required: the detector is a test-harness seam, never
+# a production feature (same contract as the slowops/tracefleet injection
+# gates).
+if (os.environ.get("ELBENCHO_TPU_TESTING") == "1"
+        and os.environ.get("ELBENCHO_TPU_LOCKGRAPH_DIR")):
+    from elbencho_tpu.testing import lockgraph
+    lockgraph.install()
 
 from .cli import main
 

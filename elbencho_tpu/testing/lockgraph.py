@@ -1,0 +1,499 @@
+"""Runtime lock-order detector for ELBENCHO_TPU_TESTING=1 fleets.
+
+The control plane went threaded in PR 8 (ThreadingHTTPServer +
+``route_lock`` + the lease watchdog + stream push sessions) and the
+native engine got ``make tsan`` for its races — but the Python side
+never got a race detector of its own. This module is that detector,
+for the one class of Python-level concurrency bug the GIL does NOT
+forgive: lock-order inversions (deadlocks) and blocking RPCs issued
+while holding the control-plane route lock.
+
+Armed (``install()``), it:
+
+- wraps ``threading.Lock`` / ``threading.RLock`` construction so every
+  lock created afterwards is tracked. Locks are identified by their
+  CREATION SITE (``file:line (name)``), not object identity — two
+  processes of the same fleet then agree on node names, which is what
+  makes the merged graph *fleet-wide*;
+- records, per thread, the stack of currently-held locks and adds a
+  site-A -> site-B order edge whenever B is acquired under A (edges
+  between two locks of the SAME site are skipped: per-instance locks of
+  one class cannot be ordered by site identity);
+- checks each new edge against the accumulated graph and records a
+  violation when it closes a cycle — the classic ABBA inversion, caught
+  even when the interleaving never actually deadlocked this run;
+- wraps ``http.client.HTTPConnection.request`` (the
+  transport under ``RemoteWorker``, the stream relays and gcs_tk) and
+  records a violation when a thread drives them while holding the
+  service ``route_lock`` — a parked peer would then stall every control
+  route for the full request timeout (exactly the bug the
+  /interruptphase subtree forwarding had before it moved out from
+  under the lock, service/http_service.py do_GET);
+- dumps its edge list + violations as JSON into
+  ``$ELBENCHO_TPU_LOCKGRAPH_DIR`` at process exit, and
+  ``merge_check()`` unions the dumps of every fleet process (master +
+  service subprocesses, see ``__main__.py``) and re-runs cycle
+  detection on the union — an order established master-side and
+  reversed service-side is a real inversion even though neither
+  process saw both edges.
+
+Arming is an explicit test-harness opt-in, the same contract as the
+slowops/tracefleet injection seams: ``ELBENCHO_TPU_TESTING=1`` plus
+either the pytest session fixture (tests/conftest.py, enabled by
+``ELBENCHO_TPU_LOCKGRAPH=1``, e.g. via ``make test-chaos``) or, for
+fleet subprocesses, ``ELBENCHO_TPU_LOCKGRAPH_DIR`` inherited through
+the service environment. Production runs never import this module.
+
+Violations are RECORDED, not raised at the acquisition site: raising
+inside a service route would tear down the very run whose interleaving
+is the evidence. The armed suites fail at session teardown with every
+cycle and route-lock RPC spelled out (conftest), and unit tests assert
+on ``violations()`` directly.
+"""
+
+from __future__ import annotations
+
+import atexit
+import http.client
+import json
+import linecache
+import os
+import re
+import threading
+import _thread
+
+ENV_TESTING = "ELBENCHO_TPU_TESTING"
+ENV_DUMP_DIR = "ELBENCHO_TPU_LOCKGRAPH_DIR"
+
+#: creation-site source lines matching this are flagged as THE route
+#: lock (service/http_service.py names the attribute route_lock); tests
+#: use mark_route_lock() instead of replaying the naming convention
+_ROUTE_LOCK_RE = re.compile(r"\broute_lock\b")
+_ASSIGN_RE = re.compile(r"([A-Za-z_][\w.]*)\s*=[^=]")
+
+# the detector's own state lock comes straight from _thread so it is
+# never itself tracked (tracking it would re-enter the bookkeeping)
+_state_lock = _thread.allocate_lock()
+_tls = threading.local()
+
+_installed = False
+_orig_lock = None
+_orig_rlock = None
+_orig_request = None
+
+#: site -> set of successor sites (the order graph), with one sample
+#: (thread name, held-stack) per edge for the failure message
+_edges: "dict[str, set[str]]" = {}
+_edge_samples: "dict[tuple[str, str], str]" = {}
+_violations: "list[dict]" = []
+_seen_cycles: "set[frozenset]" = set()
+
+
+class LockOrderError(AssertionError):
+    """Raised by merge_check(strict=True) / the conftest teardown when
+    the armed run recorded a lock-order cycle or a route-lock RPC."""
+
+
+# -- tracked lock wrapper ----------------------------------------------------
+
+class _TrackedLock:
+    """Wraps one _thread lock/RLock. Forwards the Condition integration
+    surface (_is_owned/_acquire_restore/_release_save) so
+    threading.Condition treats it exactly like the raw lock."""
+
+    def __init__(self, raw, site: str, is_route: bool):
+        self._raw = raw
+        self.lg_site = site
+        self.lg_is_route = is_route
+
+    def __repr__(self):
+        return f"<lockgraph {self.lg_site} wrapping {self._raw!r}>"
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            _note_acquired(self)
+        return got
+
+    def release(self):
+        _note_released(self)
+        self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._raw.locked()
+
+    # Condition(lock) integration: Condition lifts these off the lock
+    # when present; the RLock forms must keep our per-thread bookkeeping
+    # in step with the full release/reacquire around wait()
+    def _is_owned(self):
+        if hasattr(self._raw, "_is_owned"):
+            return self._raw._is_owned()
+        if self._raw.acquire(False):
+            self._raw.release()
+            return False
+        return True
+
+    def _release_save(self):
+        _note_released(self, all_depths=True)
+        if hasattr(self._raw, "_release_save"):
+            return self._raw._release_save()
+        self._raw.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._raw, "_acquire_restore"):
+            self._raw._acquire_restore(state)
+        else:
+            self._raw.acquire()
+        _note_acquired(self)
+
+
+def _creation_site() -> "tuple[str, bool]":
+    """``file:line (target)`` of the frame that called threading.Lock /
+    threading.RLock, plus whether the source line names route_lock.
+    Frames inside threading.py itself are skipped so a
+    ``threading.Condition()`` (whose RLock is minted inside
+    ``Condition.__init__``) attributes to the USER call site — otherwise
+    every Condition in the fleet would collapse onto one threading.py
+    node and their mutual ordering would be invisible."""
+    import sys
+    frame = sys._getframe(2)  # caller -> factory -> here
+    thr_file = getattr(threading, "__file__", "")
+    while frame.f_back is not None \
+            and frame.f_code.co_filename == thr_file:
+        frame = frame.f_back
+    fname = frame.f_code.co_filename
+    lineno = frame.f_lineno
+    text = linecache.getline(fname, lineno).strip()
+    short = os.sep.join(fname.split(os.sep)[-3:])
+    m = _ASSIGN_RE.match(text)
+    label = f" ({m.group(1)})" if m else ""
+    return f"{short}:{lineno}{label}", bool(_ROUTE_LOCK_RE.search(text))
+
+
+def _make_lock():
+    site, is_route = _creation_site()
+    return _TrackedLock(_orig_lock(), site, is_route)
+
+
+def _make_rlock():
+    site, is_route = _creation_site()
+    return _TrackedLock(_orig_rlock(), site, is_route)
+
+
+def mark_route_lock(lock) -> None:
+    """Flag a tracked lock as the route lock (unit tests; production
+    detection rides the creation-site source line)."""
+    lock.lg_is_route = True
+
+
+# -- per-thread bookkeeping + graph ------------------------------------------
+
+#: id(lock) -> owning thread ident, for 0->1 holds only. A plain Lock
+#: may legally be released by a DIFFERENT thread (handoff patterns);
+#: the owner map lets the original thread prune such stale stack
+#: entries instead of attributing every later acquisition to them.
+_owners: "dict[int, int]" = {}
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []   # [lock, ...] outermost first
+        _tls.depth = {}           # id(lock) -> reentrancy count
+    return stack
+
+
+def _prune_stack(stack: list) -> None:
+    me = threading.get_ident()
+    stale = [lk for lk in stack if _owners.get(id(lk)) != me]
+    for lk in stale:
+        stack.remove(lk)
+        _tls.depth.pop(id(lk), None)
+
+
+def _note_acquired(lock: "_TrackedLock") -> None:
+    stack = _held_stack()
+    depth = _tls.depth
+    key = id(lock)
+    me = threading.get_ident()
+    if depth.get(key) and _owners.get(key) == me:
+        depth[key] += 1
+        return  # reentrant re-acquire: no new ordering information
+    # fresh hold — including a re-acquire after a cross-thread release
+    # invalidated our stale bookkeeping (depth says held, owner map
+    # says not ours): re-register, or the hold would be invisible to
+    # the route-lock check and record no order edges
+    depth[key] = 1
+    with _state_lock:
+        _owners[key] = me
+    if lock in stack:
+        stack.remove(lock)
+    _prune_stack(stack)
+    for held in stack:
+        _add_edge(held, lock)
+    stack.append(lock)
+
+
+def _note_released(lock: "_TrackedLock", all_depths: bool = False) -> None:
+    stack = _held_stack()
+    depth = _tls.depth
+    key = id(lock)
+    if key not in depth:
+        # released by a thread that never acquired it (cross-thread
+        # handoff): clear the owner so the acquirer prunes its entry
+        with _state_lock:
+            _owners.pop(key, None)
+        return
+    depth[key] = 0 if all_depths else depth[key] - 1
+    if depth[key] <= 0:
+        del depth[key]
+        with _state_lock:
+            _owners.pop(key, None)
+        try:
+            stack.remove(lock)
+        except ValueError:
+            pass
+
+
+def _add_edge(a: "_TrackedLock", b: "_TrackedLock") -> None:
+    if a is b or a.lg_site == b.lg_site:
+        return  # same creation site: not orderable by site identity
+    with _state_lock:
+        succ = _edges.setdefault(a.lg_site, set())
+        if b.lg_site in succ:
+            return
+        succ.add(b.lg_site)
+        _edge_samples[(a.lg_site, b.lg_site)] = threading.current_thread().name
+        cycle = _find_cycle(_edges, b.lg_site, a.lg_site)
+        if cycle:
+            _record_cycle(cycle + [b.lg_site],
+                          threading.current_thread().name)
+
+
+def _find_cycle(edges: "dict[str, set[str]]", start: str,
+                target: str) -> "list[str] | None":
+    """Path start -> ... -> target through ``edges`` (DFS), or None.
+    Called with the just-added edge target->start already in the graph,
+    so a hit means a cycle."""
+    seen = set()
+    path: "list[str]" = []
+
+    def dfs(node: str) -> bool:
+        if node == target:
+            path.append(node)
+            return True
+        if node in seen:
+            return False
+        seen.add(node)
+        for nxt in edges.get(node, ()):
+            if dfs(nxt):
+                path.append(node)
+                return True
+        return False
+
+    if dfs(start):
+        return list(reversed(path))
+    return None
+
+
+def _record_cycle(cycle: "list[str]", thread_name: str,
+                  source: str = "") -> None:
+    ident = frozenset(cycle)
+    if ident in _seen_cycles:
+        return
+    _seen_cycles.add(ident)
+    _violations.append({
+        "kind": "lock-order-cycle",
+        "cycle": cycle,
+        "thread": thread_name,
+        **({"source": source} if source else {}),
+    })
+
+
+# -- route_lock across a blocking service request ----------------------------
+
+def _route_lock_held() -> "str | None":
+    me = threading.get_ident()
+    for lock in getattr(_tls, "stack", ()) or ():
+        if lock.lg_is_route and _owners.get(id(lock)) == me:
+            return lock.lg_site
+    return None
+
+
+def _check_route_rpc(what: str) -> None:
+    site = _route_lock_held()
+    if site is None:
+        return
+    with _state_lock:
+        _violations.append({
+            "kind": "route-lock-across-request",
+            "route_lock": site,
+            "request": what,
+            "thread": threading.current_thread().name,
+        })
+
+
+def _patched_request(self, method, url, *args, **kwargs):
+    # one violation per exchange: the send is where the thread commits
+    # to waiting on the peer (getresponse blocks on the same socket)
+    _check_route_rpc(f"{method} {url.split('?')[0]}")
+    return _orig_request(self, method, url, *args, **kwargs)
+
+
+# -- install / dump / merge --------------------------------------------------
+
+def install() -> None:
+    """Arm the detector in THIS process. Idempotent. Locks created
+    before arming stay untracked (module-import locks: logging etc.) —
+    the control-plane locks all come up with ServiceState / the worker
+    pool, well after arming."""
+    global _installed, _orig_lock, _orig_rlock, _orig_request
+    if _installed:
+        return
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    _orig_request = http.client.HTTPConnection.request
+    http.client.HTTPConnection.request = _patched_request
+    if os.environ.get(ENV_DUMP_DIR):
+        atexit.register(dump)
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the patched factories. Locks already created keep
+    working (they wrap real primitives); they just stop reporting."""
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    http.client.HTTPConnection.request = _orig_request
+    _installed = False
+
+
+def reset() -> None:
+    """Drop accumulated edges/violations (unit-test isolation)."""
+    with _state_lock:
+        _edges.clear()
+        _edge_samples.clear()
+        _violations.clear()
+        _seen_cycles.clear()
+
+
+def installed() -> bool:
+    return _installed
+
+
+def violations() -> "list[dict]":
+    with _state_lock:
+        return list(_violations)
+
+
+def edges() -> "list[tuple[str, str]]":
+    with _state_lock:
+        return sorted((a, b) for a, succ in _edges.items() for b in succ)
+
+
+def dump(path: "str | None" = None) -> "str | None":
+    """Write this process's graph + violations as one JSON file into
+    ``path`` or ``$ELBENCHO_TPU_LOCKGRAPH_DIR``. Registered atexit when
+    the env var is set, so every fleet subprocess reports."""
+    directory = path or os.environ.get(ENV_DUMP_DIR)
+    if not directory:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        out = os.path.join(
+            directory, f"lockgraph-{os.getpid()}-{id(_edges):x}.json")
+        with _state_lock:
+            payload = {
+                "pid": os.getpid(),
+                "edges": sorted((a, b) for a, succ in _edges.items()
+                                for b in succ),
+                "violations": list(_violations),
+            }
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+        return out
+    except OSError:
+        return None  # a dying subprocess must not mask the real failure
+
+
+def merge_check(directory: "str | None" = None,
+                strict: bool = False) -> "list[dict]":
+    """Fleet-wide verdict: union this process's live graph with every
+    dump in ``directory`` and re-run cycle detection on the union.
+    Returns all violations (per-process ones plus any cycle only the
+    union exhibits); raises LockOrderError instead when ``strict``."""
+    union: "dict[str, set[str]]" = {}
+    problems: "list[dict]" = []
+    with _state_lock:
+        for a, succ in _edges.items():
+            union.setdefault(a, set()).update(succ)
+        problems.extend(_violations)
+    if directory and os.path.isdir(directory):
+        for name in sorted(os.listdir(directory)):
+            if not name.startswith("lockgraph-") \
+                    or not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for a, b in payload.get("edges", ()):
+                union.setdefault(a, set()).add(b)
+            problems.extend(payload.get("violations", ()))
+    # dedup per-process cycle reports, then hunt union-only cycles
+    seen = {frozenset(v["cycle"]) for v in problems
+            if v.get("kind") == "lock-order-cycle"}
+    uniq, seen_keys = [], set()
+    for v in problems:
+        key = json.dumps(v, sort_keys=True)
+        if key not in seen_keys:
+            seen_keys.add(key)
+            uniq.append(v)
+    problems = uniq
+    for a in sorted(union):
+        for b in sorted(union[a]):
+            cycle = _find_cycle(union, b, a)
+            if cycle:
+                ident = frozenset(cycle + [b])
+                if ident not in seen:
+                    seen.add(ident)
+                    problems.append({
+                        "kind": "lock-order-cycle",
+                        "cycle": cycle + [b],
+                        "thread": "",
+                        "source": "fleet-union",
+                    })
+    if strict and problems:
+        raise LockOrderError(render(problems))
+    return problems
+
+
+def render(problems: "list[dict]") -> str:
+    lines = [f"lockgraph: {len(problems)} lock-order violation(s)"]
+    for v in problems:
+        if v.get("kind") == "lock-order-cycle":
+            where = f" [{v['source']}]" if v.get("source") else ""
+            lines.append(
+                f"  cycle{where}: " + " -> ".join(v["cycle"])
+                + (f"  (thread {v['thread']})" if v.get("thread") else ""))
+        else:
+            lines.append(
+                f"  {v['route_lock']} held across blocking request "
+                f"{v['request']} (thread {v['thread']}) — the route lock "
+                f"must never wait on a remote peer")
+    return "\n".join(lines)

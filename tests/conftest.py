@@ -12,6 +12,9 @@ and sets the virtual device count.
 
 import os
 import sys
+import tempfile
+
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -22,3 +25,30 @@ import _axon_mitigation
 os.environ["ELBENCHO_TPU_NO_DEFAULT_RESFILES"] = "1"
 
 _axon_mitigation.apply_in_process(n_devices=8)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockgraph_fleet():
+    """Runtime lock-order detector (testing/lockgraph.py), armed when
+    the suite runs with ELBENCHO_TPU_LOCKGRAPH=1 (make test-chaos /
+    test-scale / test-scenario and the `make check` gate). Arms THIS
+    process, exports a dump dir so fleet subprocesses arm themselves
+    (elbencho_tpu/__main__.py) and report their graphs at exit, then
+    fails the session on any lock-order cycle or route_lock-across-RPC
+    across the union of every process's graph."""
+    if os.environ.get("ELBENCHO_TPU_LOCKGRAPH") != "1":
+        yield
+        return
+    from elbencho_tpu.testing import lockgraph
+    dump_dir = tempfile.mkdtemp(prefix="elbencho-lockgraph-")
+    os.environ["ELBENCHO_TPU_TESTING"] = "1"
+    os.environ["ELBENCHO_TPU_LOCKGRAPH_DIR"] = dump_dir
+    lockgraph.install()
+    try:
+        yield
+    finally:
+        problems = lockgraph.merge_check(dump_dir)
+        lockgraph.uninstall()
+        os.environ.pop("ELBENCHO_TPU_LOCKGRAPH_DIR", None)
+    if problems:
+        pytest.fail(lockgraph.render(problems), pytrace=False)
