@@ -190,7 +190,32 @@ _STATE = {
     "active_proc": None,
     "pending_success": None,
     "emitted": False,
+    "lint_clean": None,  # elbencho-tpu-lint verdict, stamped at startup
 }
+
+
+def _probe_lint_clean() -> "bool | None":
+    """One run of the project-invariant analyzer (docs/static-analysis.md)
+    at bench startup, so every artifact records whether the static gate
+    was green for the tree that produced the number (the trajectory then
+    shows exactly when the gate went green). None = the lint itself
+    could not run — never confused with a red gate. Computed HERE, not
+    at emission: _emit_record can fire from a signal handler, where
+    spawning a subprocess is off the table."""
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "elbencho-tpu-lint"),
+             "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode in (0, 1):
+        try:
+            return bool(json.loads(out.stdout)["clean"])
+        except (ValueError, KeyError):
+            return None
+    return None  # exit 2: the engine itself could not run
 
 
 def _mask_signals():
@@ -247,6 +272,9 @@ def _emit_record(rec: dict) -> None:
         if _STATE["emitted"]:
             return
         _STATE["emitted"] = True
+        # the static-gate verdict rides EVERY record (success, failure,
+        # stale-replay) under the same key; None = lint did not run
+        rec.setdefault("lint_clean", _STATE["lint_clean"])
         print(json.dumps(rec), flush=True)
     finally:
         if old_mask is not None:
@@ -984,6 +1012,8 @@ def main() -> int:
         print(json.dumps(capture_multichip(n)), flush=True)
         return 0
     _install_signal_handlers()
+    _STATE["stage"] = "lint_gate"
+    _STATE["lint_clean"] = _probe_lint_clean()
     if _FORCE_FALLBACK:
         # bench-trajectory guard path: no probe, straight to the ladder
         print("# ELBENCHO_TPU_BENCH_FORCE_FALLBACK=1: skipping the TPU "

@@ -75,6 +75,9 @@ def test_unreachable_tpu_degrades_to_host_path_ladder():
         assert "utc" in entry and "outcome" in entry
     # the A/B slot contract is machine-written in EVERY record
     assert "pipeline_ab" in rec and rec["pipeline_ab"] is None
+    # the static-gate verdict rides every record (true on this tree:
+    # tests/test_lint.py asserts the catalog itself is clean)
+    assert rec["lint_clean"] is True
     # the doctor rider: a tier-labeled verdict over the median pass's
     # flight recording, so the artifact records WHY, not just what
     doctor = rec["doctor"]
