@@ -4,7 +4,8 @@
 
 .PHONY: all native native-tsan native-asan tsan asan check check-schema \
 	lint test test-fast test-chaos test-scale test-mesh test-obs \
-	test-scenario test-examples fuzz bench docs clean deb rpm docker
+	test-scenario test-tune test-examples fuzz bench docs clean deb rpm \
+	docker
 
 all: native
 
@@ -61,6 +62,9 @@ check: native
 		python -m pytest tests/test_fault_tolerance.py \
 		tests/test_io_fault_tolerance.py tests/test_run_lifecycle.py \
 		tests/test_svc_stream.py -q -m chaos
+	env JAX_PLATFORMS=cpu ELBENCHO_TPU_TESTING=1 \
+		ELBENCHO_TPU_LOCKGRAPH=1 \
+		python -m pytest tests/test_autotune.py -q -m tune
 	$(MAKE) native-asan
 	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
 		ASAN_OPTIONS=detect_leaks=0 \
@@ -156,6 +160,20 @@ test-scenario: native check-schema
 		ELBENCHO_TPU_LOCKGRAPH=1 \
 		python -m pytest tests/test_scenarios.py \
 		-q -m scenario
+
+# closed-loop autotuning gate: fake-doctor convergence units (each
+# verdict moves the axis it names, plateau/budget/probe-cap stops,
+# repeat-median noise rejection), knob-space config validation
+# (tpudirect clamp, service-mode-only axes), tuned-profile round-trip,
+# and the chaos e2e where an injected per-op delay on an in-process
+# 2-host fleet makes the tuner provably beat the defaults (pytest
+# marker `tune`; docs/autotuning.md). Lockgraph-armed — the probe loop
+# exercises repeated master-mode rebuilds, exactly where lock-order
+# bugs hide — and part of the chaos stage of `make check`.
+test-tune: native
+	env JAX_PLATFORMS=cpu ELBENCHO_TPU_TESTING=1 \
+		ELBENCHO_TPU_LOCKGRAPH=1 \
+		python -m pytest tests/test_autotune.py -q -m tune
 
 # end-to-end example suite against real resources (loopdevs, services)
 test-examples: native

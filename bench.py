@@ -689,6 +689,65 @@ def _tail_attach(med_rec, tmpdir, target, tier, extra_args=None,
     return out
 
 
+# the tuned profile the autotune rider emits, persisted next to
+# bench.py like the flight recording (auditable + reusable after the
+# run's tmpdir is cleaned up)
+TUNED_PROFILE_OUT = os.environ.get(
+    "ELBENCHO_TPU_BENCH_TUNED_PROFILE",
+    os.path.join(REPO, ".bench_last_tuned.conf"))
+
+
+def _autotune_attach(tmpdir, target, tier, extra_args=None,
+                     extra_env=None):
+    """Autotune rider: one SHORT budgeted --autotune run per measured
+    tier, so every artifact carries tuned-vs-default throughput, the
+    chosen knobs and the persisted profile path — the number that can
+    climb round over round without hand-picked flags (ROADMAP item 5).
+    The rider starts from -t 1 (deliberately untuned) so the search has
+    headroom; tier-labeled like the doctor dict; failures are context,
+    never fatal."""
+    jf = os.path.join(tmpdir, "autotune.json")
+    profile = os.path.join(tmpdir, "tuned.conf")
+    budget = _int_env("ELBENCHO_TPU_BENCH_TUNE_SECS",
+                      20 if (_SELFTEST or _FORCE_FALLBACK) else 60)
+    if _remaining_s() < DEADLINE_RESERVE_S + budget + 30:
+        return {"tier": tier, "error": "skipped: deadline too close"}
+    try:
+        recs = _run_cli(["-r", "-t", "1", "-s", FILE_SIZE,
+                         "-b", BLOCK_SIZE,
+                         "--autotune", str(budget),
+                         "--autotune-probesecs", "2",
+                         "--autotune-profile", profile,
+                         *(extra_args or []), target], jf,
+                        extra_env=extra_env,
+                        timeout=max(240, 2 * budget))
+        block = next((r["Autotune"] for r in recs if r.get("Autotune")),
+                     None)
+        if block is None:
+            return {"tier": tier, "error": "no Autotune block in run"}
+        out_path = None if _SELFTEST else TUNED_PROFILE_OUT
+        if out_path is not None and os.path.exists(profile):
+            import shutil
+            shutil.copyfile(profile, out_path)
+        else:
+            # never point auditors at a file this run did not write (a
+            # failed profile emit, or the self-test): a stale path here
+            # would name a PREVIOUS run/tier's knobs
+            out_path = None
+        return {
+            "tier": tier,
+            "default_mibs": (block.get("Default") or {}).get("MiBPerSec"),
+            "tuned_mibs": (block.get("Chosen") or {}).get("MiBPerSec"),
+            "gain_pct": block.get("GainPct", 0),
+            "chosen": (block.get("Chosen") or {}).get("Values", {}),
+            "stop_reason": block.get("StopReason", ""),
+            "probes": block.get("ProbesUsed", 0),
+            "profile": out_path,
+        }
+    except Exception as err:  # noqa: BLE001 - rider must never kill a record
+        return {"tier": tier, "error": str(err)[-300:]}
+
+
 def _fixedbuf_ab(target, jsonfile, extra_env=None):
     """Fixed-buffers-vs-malloc A/B rider: one read pass on the unified
     staging pool's registered ring (--ioengine uring where the kernel
@@ -896,6 +955,15 @@ def _run_fallback_ladder(probe_err) -> int:
             # + a short --slowops rider's top-op context, tier-labeled
             "tail": _tail_attach(
                 med_rec, tmpdir, target, tier,
+                extra_args=["--tpuids", "0"] if tier == "host_staging"
+                else [],
+                extra_env=_FALLBACK_ENV),
+            # tuned-vs-default throughput (closed-loop autotuning
+            # rider): the budgeted --autotune search + its persisted
+            # profile, tier-labeled like everything above. The tier-1
+            # forced-fallback guard asserts a non-null gain_pct lands.
+            "autotune": _autotune_attach(
+                tmpdir, target, tier,
                 extra_args=["--tpuids", "0"] if tier == "host_staging"
                 else [],
                 extra_env=_FALLBACK_ENV),
@@ -1208,6 +1276,14 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
             # + a short --slowops rider's top-op context, tier-labeled
             "tail": _tail_attach(
                 med_rec, tmpdir, target,
+                "tpu" if platform in TPU_PLATFORMS
+                else f"selftest_{platform}",
+                extra_args=["--tpuids", "0", "--tpudirect"]),
+            # tuned-vs-default throughput (closed-loop autotuning
+            # rider): budgeted --autotune search + persisted profile,
+            # tier-labeled like the doctor dict
+            "autotune": _autotune_attach(
+                tmpdir, target,
                 "tpu" if platform in TPU_PLATFORMS
                 else f"selftest_{platform}",
                 extra_args=["--tpuids", "0", "--tpudirect"]),

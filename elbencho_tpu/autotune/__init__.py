@@ -1,0 +1,211 @@
+"""Closed-loop autotuning (``--autotune``; docs/autotuning.md).
+
+The observability stack names the bottleneck (flight recorder -> run
+doctor); this package acts on it: short bounded probe phases through
+the unchanged coordinator/worker/service machinery, a doctor-driven
+coordinate hill-climb over the bounded knob space, and a reproducible
+tuned profile in the config-file format ``--configfile`` already loads
+— plus the before/after doctor decomposition as proof of WHY the tuned
+point wins. ROADMAP item 5; the sweep-tool face of the same executor
+lives in ``tools/elbencho-tpu-sweep --knob``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..toolkits import logger
+from .probe import ProbeExecutor, probe_phase_for, standalone_session
+from .search import (NOISE_PCT, ProbeOutcome, STOP_EMPTY, TuneResult,
+                     hill_climb)
+from .space import AXIS_ATTRS, AXIS_FLAGS, KnobSpace
+
+__all__ = [
+    "AUTOTUNE_SCHEMA", "AXIS_ATTRS", "AXIS_FLAGS", "KnobSpace",
+    "NOISE_PCT", "ProbeExecutor", "ProbeOutcome", "TuneResult",
+    "build_autotune_block", "hill_climb", "probe_phase_for",
+    "run_autotune", "standalone_session", "write_profile",
+]
+
+#: Autotune run-JSON block schema; keys are append-only like every
+#: other schema-versioned block (Analysis, TailAnalysis, ...)
+AUTOTUNE_SCHEMA = 1
+
+
+def default_profile_path(cfg) -> str:
+    """Default tuned-profile location: beside the JSON results when the
+    run writes them, else the working directory."""
+    if cfg.json_file_path:
+        return os.path.join(os.path.dirname(cfg.json_file_path) or ".",
+                            "elbencho-tpu-tuned.conf")
+    return "elbencho-tpu-tuned.conf"
+
+
+def write_profile(path: str, chosen: "dict[str, int]", cfg,
+                  gain_pct: float, verdict: str) -> str:
+    """Emit the tuned profile as an ini config file (``flag = value``
+    lines) the CLI already loads via ``--configfile``/``-c`` — the
+    reproducibility contract: re-running with the profile and WITHOUT
+    --autotune runs at the tuned point."""
+    lines = [
+        "# elbencho-tpu tuned profile (written by --autotune)",
+        f"# {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+        f"gain {gain_pct:+.1f}% vs defaults; final verdict: {verdict}",
+        "# load with: elbencho-tpu -c THIS_FILE <your workload flags>",
+    ]
+    for name in sorted(chosen):
+        lines.append(f"{AXIS_FLAGS[name]} = {chosen[name]}")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _compact_analysis(ana: "dict | None") -> "dict | None":
+    """The doctor fields the before/after diff compares (the full
+    Analysis blocks stay in the trajectory's probe recordings)."""
+    if not ana:
+        return None
+    return {"Verdict": ana.get("Verdict", ""),
+            "BottleneckStage": ana.get("BottleneckStage", ""),
+            "StagePct": dict(ana.get("StagePct", {})),
+            "StallsPerTpuOp": ana.get("StallsPerTpuOp", 0.0),
+            "TuneHint": list(ana.get("TuneHint", []))}
+
+
+def doctor_diff(baseline, best) -> "dict | None":
+    """Before/after proof: the default point's doctor decomposition vs
+    the tuned point's, with the stage shares that shrank/grew."""
+    ana_a = _compact_analysis(baseline.analysis)
+    ana_b = _compact_analysis(best.analysis)
+    if ana_a is None and ana_b is None:
+        return None
+    causes: "list[str]" = []
+    if ana_a and ana_b:
+        for stage, pct_a in ana_a["StagePct"].items():
+            pct_b = ana_b["StagePct"].get(stage, 0.0)
+            if abs(pct_b - pct_a) >= 5.0:
+                causes.append(f"{stage} share {pct_a:g}% -> {pct_b:g}%")
+        if ana_a["Verdict"] != ana_b["Verdict"]:
+            causes.append(f"verdict {ana_a['Verdict']} -> "
+                          f"{ana_b['Verdict']}")
+    return {"Default": ana_a, "Tuned": ana_b, "Changes": causes}
+
+
+def build_autotune_block(result: TuneResult, axes_desc: "list[dict]",
+                         phase_label: str, cfg,
+                         profile_path: str) -> dict:
+    """The schema-versioned Autotune run-JSON block. Keys are
+    append-only, never reordered."""
+    base, best = result.baseline, result.best
+
+    def point(p):
+        if p is None:
+            return None
+        return {"Values": dict(p.values),
+                "MiBPerSec": round(p.rate_mibs, 2),
+                "Verdict": p.verdict}
+
+    # trajectory probes carry the doctor outcome that steered each move
+    return {
+        "Schema": AUTOTUNE_SCHEMA,
+        "Phase": phase_label,
+        "BudgetSecs": cfg.autotune_secs,
+        "ProbeSecs": cfg.autotune_probe_secs,
+        "Repeat": cfg.autotune_repeat,
+        "ProbesUsed": result.probes_used,
+        "StopReason": result.stop_reason,
+        "Axes": axes_desc,
+        "Default": point(base),
+        "Chosen": point(best),
+        "GainPct": result.gain_pct,
+        "Trajectory": [p.describe() for p in result.trajectory],
+        "ProfilePath": profile_path,
+        "DoctorDiff": doctor_diff(base, best)
+        if base is not None and best is not None else None,
+    }
+
+
+def run_autotune(coordinator) -> "dict | None":
+    """The coordinator seam: probe, climb, emit the profile, apply the
+    chosen values (fleet rebuilt so the REAL phases run tuned), and
+    return the Autotune block. Returns None when this config admits no
+    axes (nothing to tune — logged, never fatal)."""
+    cfg = coordinator.cfg
+    space = KnobSpace(cfg)
+    phase = probe_phase_for(cfg)
+    from ..phases import BenchMode, BenchPhase, phase_name
+    if not space.axes or phase is None:
+        logger.log(0, "AUTOTUNE: nothing to tune for this config "
+                      "(no applicable axes) — running untuned")
+        return None
+    label = phase_name(phase, cfg.bench_mode == BenchMode.S3)
+    logger.log(0, f"AUTOTUNE: budget {cfg.autotune_secs}s, "
+                  f"{cfg.autotune_probe_secs}s probes "
+                  f"(x{cfg.autotune_repeat}) on phase {label}; axes: "
+                  + ", ".join(space.names()))
+    axes_desc = space.describe()  # the PRE-tuning starting point
+    executor = ProbeExecutor(
+        coordinator, phase, cfg.autotune_probe_secs,
+        # dir-mode write probes need the rank/dir namespace the run's
+        # own MKDIRS phase would only create AFTER tuning — and the
+        # namespace is per-RANK, so every probe that changes the thread
+        # count needs it refreshed (the phase is idempotent: makedirs
+        # exist_ok; the main run's journaled MKDIRS still runs after)
+        ensure_dirs=(cfg.run_create_dirs
+                     and phase == BenchPhase.CREATEFILES))
+    try:
+        result = hill_climb(
+            space, executor.run, budget_secs=cfg.autotune_secs,
+            now=time.monotonic, max_probes=cfg.autotune_probes,
+            repeat=cfg.autotune_repeat,
+            log=lambda msg: logger.log(0, f"AUTOTUNE: {msg}"))
+    except BaseException:
+        # restore, never leave probe state; no rebuild — the run is
+        # aborting and the coordinator only interrupts/joins from here
+        executor.finish(chosen=None, rebuild=False)
+        raise
+    chosen = result.chosen
+    gain = result.gain_pct
+    if gain <= 0 and result.baseline is not None \
+            and result.baseline.ok and result.baseline.rate_mibs > 0:
+        # never ship a config that lost to a MEASURED default: the
+        # climb only adopts improvements, but a budget expiry right
+        # after a noisy baseline could leave best == baseline with
+        # gain 0 — keep the default values then (the block still
+        # records the search so the trajectory is auditable). A FAILED
+        # or zero-rate baseline must NOT reclaim the win: the climb's
+        # best is a point that provably worked where the defaults did
+        # not (gain stays 0 — no measured baseline to compare against).
+        chosen = dict(result.baseline.values)
+        result.best = result.baseline
+    executor.finish(chosen=chosen)
+    profile_path = cfg.autotune_profile_path or default_profile_path(cfg)
+    best_verdict = result.best.verdict if result.best else "inconclusive"
+    try:
+        write_profile(profile_path, chosen, cfg, gain, best_verdict)
+    except OSError as err:
+        logger.log_error(f"--autotune-profile: cannot write "
+                         f"{profile_path}: {err}")
+        profile_path = ""
+    block = build_autotune_block(result, axes_desc, label, cfg,
+                                 profile_path)
+    # stamp every later phase record of this run (JSON-only keys
+    # AutotuneTuned/AutotuneGainPct; summarize-json Tuned/Gain% columns)
+    cfg.autotune_applied = {"gain_pct": gain, "chosen": chosen,
+                            "profile": profile_path}
+    base_r = result.baseline.rate_mibs if result.baseline else 0.0
+    best_r = result.best.rate_mibs if result.best else 0.0
+    logger.log(0, f"AUTOTUNE: done ({result.stop_reason}, "
+                  f"{result.probes_used} probes): "
+                  f"{base_r:.1f} -> {best_r:.1f} MiB/s "
+                  f"({gain:+.1f}%) at {chosen}"
+               + (f"; profile: {profile_path}" if profile_path else ""))
+    diff = block["DoctorDiff"] or {}
+    for change in diff.get("Changes", []):
+        logger.log(1, f"AUTOTUNE: doctor diff: {change}")
+    return block

@@ -1,0 +1,227 @@
+"""Probe executor: short bounded benchmark phases at candidate configs.
+
+A probe is ONE existing table phase run through the UNCHANGED
+coordinator/worker/service machinery — exactly the scenario engine's
+per-step overlay discipline (apply attrs from a base snapshot, rebuild
+the worker fleet so master mode re-ships the changed config over
+/preparephase, run, restore). What makes it a probe rather than a
+measured phase:
+
+- it is TIME-BOXED (``--autotune-probesecs`` via the existing
+  ``--timelimit`` interrupt machinery, so a probe at a terrible config
+  costs seconds, not the workload's natural length);
+- its results never reach the run's result files (res/csv/json paths
+  are blanked for the probe's duration) — probes are search traffic,
+  not published numbers;
+- the flight recorder is ALWAYS armed (a private recording in the
+  run's temp dir when the user didn't pass ``--flightrec``) because the
+  doctor's stage decomposition is the search signal; the user's own
+  recording, when present, is parked during probes so tuning traffic
+  never pollutes it;
+- probes are unjournaled (run_benchmark_phase directly, never the
+  journaled wrapper) and every ``--autotune-*`` knob is
+  FINGERPRINT_EXCLUDEd, so --journal/--resume semantics are untouched.
+
+The same executor drives both ``--autotune`` (search.hill_climb picks
+the points) and ``tools/elbencho-tpu-sweep --knob`` (an explicit grid
+picks them).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+from ..phases import BenchPhase
+from ..toolkits import logger
+from ..workers.shared import WorkerException
+from .search import ProbeOutcome
+from .space import AXIS_ATTRS
+
+#: config attrs forced for the duration of every probe (beyond the
+#: candidate's axis values); saved/restored with the same base-snapshot
+#: discipline the scenario engine uses
+_PROBE_CONTROL_ATTRS = (
+    "time_limit_secs", "res_file_path", "csv_file_path",
+    "json_file_path", "disable_live_stats", "next_phase_delay_secs",
+)
+
+
+def probe_phase_for(cfg) -> "BenchPhase | None":
+    """The phase probes run: the FIRST data phase of this run's plan, so
+    a write-then-read run probes the self-sufficient write leg and a
+    read-only run probes the read leg against its existing dataset."""
+    if cfg.run_create_files:
+        return BenchPhase.CREATEFILES
+    if cfg.run_read_files:
+        return BenchPhase.READFILES
+    return None
+
+
+class ProbeExecutor:
+    """Runs probes against a live Coordinator. Construction parks the
+    user's flight recorder and arms a probe recorder; callers MUST end
+    with ``finish()`` — it restores the base config/recorder and
+    applies the chosen values for the real run. The context-manager
+    form only covers the ABNORMAL exit (restore-without-apply on an
+    in-flight exception); a clean exit still requires finish()."""
+
+    def __init__(self, coordinator, phase: BenchPhase,
+                 probe_secs: int, keep_flightrec_path: str = "",
+                 ensure_dirs: bool = False):
+        self.coord = coordinator
+        self.phase = phase
+        self.probe_secs = max(int(probe_secs), 1)
+        # dir-mode write probes: refresh the per-rank dir namespace
+        # after every fleet rebuild (a threads move changes the ranks)
+        self.ensure_dirs = ensure_dirs
+        self.num_probes = 0
+        self._base: "dict[str, object]" = {}
+        cfg = coordinator.cfg
+        for attr in _PROBE_CONTROL_ATTRS:
+            self._base[attr] = getattr(cfg, attr)
+        # the fleet the coordinator prepared was built against the BASE
+        # config; the first probe at base values can reuse it
+        self._built_values: "dict | None" = None
+        self._saved_flightrec = coordinator._flightrec
+        rec_dir = keep_flightrec_path or tempfile.mkdtemp(
+            prefix="elbencho_tpu_autotune_")
+        self._rec_dir_owned = not keep_flightrec_path
+        self._rec_dir = rec_dir
+        self._probe_rec_path = os.path.join(rec_dir, "probe.rec")
+
+    # -- probing -------------------------------------------------------------
+
+    def run(self, values: "dict[str, int]") -> ProbeOutcome:
+        """One probe at the full axis-value map. A worker error marks
+        the outcome failed (the search treats it as a rejected move)
+        and rebuilds the fleet so the next probe starts clean."""
+        from ..telemetry.flightrec import FlightRecorder
+        coord = self.coord
+        cfg = coord.cfg
+        self.num_probes += 1
+        for name, val in values.items():
+            attr = AXIS_ATTRS[name]
+            self._base.setdefault(attr, getattr(cfg, attr))
+            setattr(cfg, attr, val)
+        cfg.time_limit_secs = self.probe_secs
+        cfg.res_file_path = cfg.csv_file_path = cfg.json_file_path = ""
+        cfg.disable_live_stats = True
+        cfg.next_phase_delay_secs = 0
+        # fresh probe recording each probe: finish_phase reads only the
+        # in-memory series/totals, the file is just the doctor contract
+        probe_rec = FlightRecorder(self._probe_rec_path, cfg,
+                                   role="autotune")
+        coord._flightrec = probe_rec
+        try:
+            if self._built_values != values:
+                # geometry and wire-relevant knobs changed: re-prepare
+                # the fleet (master mode re-ships the config exactly
+                # like a scenario overlay step)
+                coord._rebuild_manager()
+                if self.ensure_dirs:
+                    coord.run_benchmark_phase(BenchPhase.CREATEDIRS)
+                self._built_values = dict(values)
+            else:
+                coord.statistics.flightrec = probe_rec
+            coord.run_benchmark_phase(self.phase)
+        except WorkerException as err:
+            self._built_values = None  # failed fleet: rebuild next time
+            if cfg.hosts:
+                with contextlib.suppress(WorkerException, OSError):
+                    coord._rebuild_manager()
+                    self._built_values = dict(values)
+            return ProbeOutcome(0.0, ok=False, error=str(err))
+        finally:
+            # the fleet-merged counter state at probe end, BEFORE the
+            # recorder is dropped — the truncated-probe re-analysis
+            # below needs it
+            from ..telemetry.flightrec import FLEET
+            probe_totals = dict(probe_rec._prev.get(FLEET, {}))
+            probe_rec.close()
+            coord._flightrec = self._saved_flightrec
+        res = coord._last_phase_results
+        if res is None:
+            return ProbeOutcome(0.0, ok=False, error="no phase results")
+        elapsed_usec = res.last_done_usec
+        analysis = res.analysis or {}
+        if not elapsed_usec:
+            # the probe hit its time limit: interrupted workers record
+            # no elapsed, so the honest window is the probe box itself
+            # — and the doctor's verdict must be recomputed against it
+            # (the in-run analysis saw wall 0 and said inconclusive)
+            elapsed_usec = self.probe_secs * 1_000_000
+            from ..telemetry.doctor import analyze_phase
+            analysis = analyze_phase(res.phase_name, probe_totals,
+                                     elapsed_usec, res.num_workers)
+        rate = res.final["bytes"] / (elapsed_usec / 1e6) / (1 << 20)
+        return ProbeOutcome(
+            rate_mibs=round(rate, 2),
+            verdict=analysis.get("Verdict", "inconclusive"),
+            analysis=analysis or None)
+
+    # -- teardown ------------------------------------------------------------
+
+    def finish(self, chosen: "dict[str, int] | None" = None,
+               rebuild: bool = True) -> None:
+        """Restore the base config (and the user's flight recorder),
+        then apply ``chosen`` axis values and rebuild the fleet so the
+        real run executes at the tuned point. ``rebuild=False`` skips
+        the fleet re-prepare — for callers that tear the coordinator
+        down right after (sweep-tool teardown, abort paths), where a
+        rebuilt fleet would only be joined again immediately."""
+        coord = self.coord
+        cfg = coord.cfg
+        for attr, val in self._base.items():
+            setattr(cfg, attr, val)
+        coord._flightrec = self._saved_flightrec
+        if chosen:
+            for name, val in chosen.items():
+                setattr(cfg, AXIS_ATTRS[name], val)
+        try:
+            if rebuild:
+                coord._rebuild_manager()
+        finally:
+            if self._rec_dir_owned:
+                import shutil
+                shutil.rmtree(self._rec_dir, ignore_errors=True)
+
+    def __enter__(self) -> "ProbeExecutor":
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb) -> None:
+        if exc_type is None:
+            return
+        # abnormal exit: restore without applying anything (no rebuild
+        # — the caller is aborting/tearing down)
+        self.finish(chosen=None, rebuild=False)
+
+
+@contextlib.contextmanager
+def standalone_session(cfg, probe_secs: int):
+    """Probe session for tools (elbencho-tpu-sweep --knob): owns the
+    whole coordinator lifecycle around a bare ProbeExecutor. The config
+    must be derived+checked; phases come from probe_phase_for."""
+    from ..coordinator import Coordinator
+    phase = probe_phase_for(cfg)
+    if phase is None:
+        raise ValueError("knob sweep needs a write or read phase "
+                         "(-w/-r) to probe")
+    coord = Coordinator(cfg)
+    if cfg.hosts:
+        from ..service.remote_worker import wait_for_services_ready
+        wait_for_services_ready(cfg.hosts, cfg.service_port,
+                                cfg.svc_wait_secs)
+    coord.manager.prepare_threads()
+    executor = ProbeExecutor(coord, phase, probe_secs)
+    try:
+        yield executor
+    finally:
+        try:
+            # no rebuild: the fleet is joined right below anyway
+            executor.finish(chosen=None, rebuild=False)
+        except WorkerException as err:  # teardown must not mask results
+            logger.log_error(f"knob sweep teardown: {err}")
+        coord.manager.join_all_threads()
+        coord.statistics.close()

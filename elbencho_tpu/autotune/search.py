@@ -1,0 +1,204 @@
+"""Doctor-driven coordinate hill-climb over the knob space.
+
+The loop is deliberately dumb and auditable: probe the current point,
+read the doctor's verdict, move the ONE axis the verdict names (the
+machine-readable hint table telemetry/doctor.VERDICT_TUNE_AXES), keep
+the move iff the repeat-probe MEDIAN beats the incumbent by more than
+the noise margin, and stop on plateau (every admissible move rejected),
+budget expiry, or the probe cap. An unhinted verdict (inconclusive,
+retry/tail/straggler-bound — problems no knob fixes) falls back to
+round-robin over the remaining axes, so the tuner still makes progress
+when the doctor cannot point.
+
+Everything here is pure over two injected callables — ``run_probe``
+(one bounded probe at a full value map -> ProbeOutcome) and ``now`` —
+which is what lets tests/test_autotune.py prove convergence against a
+deterministic fake doctor without ever running a benchmark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: default noise margin: a candidate must beat the incumbent's median
+#: rate by this many percent to be adopted — repeat-probe medians plus
+#: this gate are what keep filesystem-cache jitter from walking the
+#: tuner to a random corner of the space
+NOISE_PCT = 3.0
+
+#: stop reasons (Autotune block "StopReason"; appended, never renamed)
+STOP_PLATEAU = "plateau"
+STOP_BUDGET = "budget"
+STOP_PROBES = "probe-limit"
+STOP_EMPTY = "no-axes"
+
+
+@dataclasses.dataclass
+class ProbeOutcome:
+    """One probe's result as the search sees it."""
+
+    rate_mibs: float
+    verdict: str = "inconclusive"
+    ok: bool = True
+    error: str = ""
+    analysis: "dict | None" = None
+
+
+@dataclasses.dataclass
+class TrajectoryPoint:
+    index: int
+    values: "dict[str, int]"
+    rate_mibs: float
+    verdict: str
+    repeats: "list[float]"
+    ok: bool
+    axis: str = ""          # the axis this probe moved ("" = baseline)
+    accepted: bool = False
+    error: str = ""
+    # the median repeat's full doctor Analysis (None when the probe ran
+    # without one) — what the before/after DoctorDiff compares
+    analysis: "dict | None" = None
+
+    def describe(self) -> dict:
+        return {"Probe": self.index, "Values": dict(self.values),
+                "MiBPerSec": round(self.rate_mibs, 2),
+                "Verdict": self.verdict,
+                "Repeats": [round(r, 2) for r in self.repeats],
+                "Axis": self.axis, "Accepted": self.accepted,
+                "Ok": self.ok, **({"Error": self.error}
+                                  if self.error else {})}
+
+
+@dataclasses.dataclass
+class TuneResult:
+    baseline: "TrajectoryPoint | None"
+    best: "TrajectoryPoint | None"
+    trajectory: "list[TrajectoryPoint]"
+    stop_reason: str
+    probes_used: int
+
+    @property
+    def gain_pct(self) -> float:
+        if self.baseline is None or self.best is None \
+                or self.baseline.rate_mibs <= 0:
+            return 0.0
+        return round(100.0 * (self.best.rate_mibs
+                              / self.baseline.rate_mibs - 1.0), 1)
+
+    @property
+    def chosen(self) -> "dict[str, int]":
+        return dict(self.best.values) if self.best is not None else {}
+
+
+def _median_outcome(outcomes: "list[ProbeOutcome]") \
+        -> "tuple[float, ProbeOutcome]":
+    """(median rate, the outcome carrying it) over the OK repeats; a
+    fully failed set keeps the last failure for its error text."""
+    oks = sorted((o for o in outcomes if o.ok), key=lambda o: o.rate_mibs)
+    if not oks:
+        return 0.0, outcomes[-1]
+    med = oks[len(oks) // 2]
+    return med.rate_mibs, med
+
+
+def hill_climb(space, run_probe, budget_secs: float, now,
+               max_probes: int = 0, repeat: int = 1,
+               noise_pct: float = NOISE_PCT,
+               verdict_axes=None, log=None) -> TuneResult:
+    """Coordinate hill-climb. ``space`` is a KnobSpace (or anything with
+    ``names()``/``current_values()``/``step()``), ``run_probe(values)``
+    returns a ProbeOutcome, ``now()`` is the clock the budget is
+    measured on. ``verdict_axes`` maps a doctor verdict to the axis
+    preference list (defaults to doctor.VERDICT_TUNE_AXES)."""
+    if verdict_axes is None:
+        from ..telemetry.doctor import VERDICT_TUNE_AXES
+        verdict_axes = VERDICT_TUNE_AXES
+    log = log or (lambda _msg: None)
+    repeat = max(int(repeat), 1)
+    t0 = now()
+    trajectory: "list[TrajectoryPoint]" = []
+    probes_used = 0
+
+    def measure(values: "dict[str, int]", axis: str) -> TrajectoryPoint:
+        nonlocal probes_used
+        outcomes = []
+        for _ in range(repeat):
+            outcomes.append(run_probe(dict(values)))
+            probes_used += 1
+        med_rate, med = _median_outcome(outcomes)
+        point = TrajectoryPoint(
+            index=len(trajectory), values=dict(values),
+            rate_mibs=med_rate, verdict=med.verdict,
+            repeats=[o.rate_mibs for o in outcomes if o.ok],
+            ok=any(o.ok for o in outcomes), axis=axis,
+            error=med.error, analysis=med.analysis)
+        trajectory.append(point)
+        return point
+
+    names = space.names()
+    if not names:
+        return TuneResult(None, None, trajectory, STOP_EMPTY, 0)
+
+    cur = space.current_values()
+    baseline = measure(cur, "")
+    baseline.accepted = True
+    best = baseline
+    log(f"baseline: {baseline.rate_mibs:.1f} MiB/s "
+        f"(verdict: {baseline.verdict}) at {cur}")
+
+    # (axis, direction) moves rejected since the last improvement;
+    # when every admissible move is in here, the climb has plateaued
+    exhausted: "set[tuple[str, int]]" = set()
+    rr = 0  # round-robin pointer for unhinted verdicts
+
+    def pick_move(verdict: str) -> "tuple[str, int] | None":
+        nonlocal rr
+        hinted = [a for a in verdict_axes.get(verdict, ()) if a in names]
+        for axis in hinted:
+            for direction in (1, -1):
+                if (axis, direction) not in exhausted:
+                    return axis, direction
+        # round-robin fallback: unhinted (or fully exhausted hint set)
+        order = [(names[(rr + i) % len(names)], d)
+                 for i in range(len(names)) for d in (1, -1)]
+        for axis, direction in order:
+            if (axis, direction) not in exhausted:
+                rr = (names.index(axis) + 1) % len(names)
+                return axis, direction
+        return None
+
+    stop = STOP_PLATEAU
+    while True:
+        if now() - t0 >= budget_secs:
+            stop = STOP_BUDGET
+            break
+        if max_probes and probes_used + repeat > max_probes:
+            stop = STOP_PROBES
+            break
+        move = pick_move(best.verdict)
+        if move is None:
+            stop = STOP_PLATEAU
+            break
+        axis, direction = move
+        cand_val = space.step(cur, axis, direction)
+        if cand_val is None:
+            exhausted.add((axis, direction))
+            continue
+        cand = dict(cur)
+        cand[axis] = cand_val
+        point = measure(cand, axis)
+        improved = point.ok and point.rate_mibs \
+            > best.rate_mibs * (1.0 + noise_pct / 100.0)
+        log(f"probe {point.index}: {axis} {cur[axis]} -> {cand_val}: "
+            f"{point.rate_mibs:.1f} MiB/s (verdict: {point.verdict}) "
+            f"{'ACCEPTED' if improved else 'rejected'}")
+        if improved:
+            point.accepted = True
+            cur = cand
+            best = point
+            # a new incumbent reopens every direction: moves that lost
+            # against the OLD point may win from here
+            exhausted = set()
+        else:
+            exhausted.add((axis, direction))
+    return TuneResult(baseline, best, trajectory, stop, probes_used)

@@ -701,6 +701,34 @@ FLAG_DEFS = [
      "flags stay off under --scenario (set by validate_scenario on the "
      "master, shipped to services on the wire)"),
 
+    # closed-loop autotuning (docs/autotuning.md)
+    ("autotune", None, "autotune_secs", "optint", 0, "misc",
+     "Before the measured phases run, spend up to SECS seconds (bare "
+     "flag = 60) hill-climbing --threads/--iodepth/--tpudepth/"
+     "--tpubatch (and --svcupint/--svcfanout on master-mode fleets) "
+     "with short bounded probe phases steered by the run doctor's "
+     "bottleneck verdicts, then run the real phases at the tuned "
+     "point; emits a reproducible tuned profile (--configfile format) "
+     "plus a schema-versioned Autotune block with the probe "
+     "trajectory and the before/after doctor diff as proof (probes "
+     "are unjournaled and never land in result files; 0 = off)"),
+    ("autotune-profile", None, "autotune_profile_path", "str", "",
+     "misc",
+     "Path for the tuned profile --autotune emits (default: "
+     "elbencho-tpu-tuned.conf beside the JSON results); load it with "
+     "-c to reproduce the tuned run without re-tuning"),
+    ("autotune-probes", None, "autotune_probes", "int", 0, "misc",
+     "Hard cap on total --autotune probe phases (0 = bounded by the "
+     "time budget only)"),
+    ("autotune-probesecs", None, "autotune_probe_secs", "int", 3,
+     "misc",
+     "Length of each --autotune probe phase in seconds (the probe "
+     "rides the --timelimit interrupt machinery, so a probe at a bad "
+     "config costs this much, not the workload's natural length)"),
+    ("autotune-repeat", None, "autotune_repeat", "int", 1, "misc",
+     "Probes per candidate config; the search compares repeat-probe "
+     "MEDIANS, so values > 1 buy noise rejection at probe-budget cost"),
+
     # misc
     ("configfile", "c", "config_file_path", "str", "", "misc",
      "Read benchmark settings from this file (ini-style: flag = value)"),
@@ -710,17 +738,30 @@ FLAG_DEFS = [
 
 _KIND_PARSERS = {
     "int": int,
+    "optint": int,
     "float": float,
     "str": str,
     "size": parse_size,
 }
+
+#: bare value of "optint" flags (value optional on the CLI): using the
+#: flag without a number means this
+OPTINT_BARE = {
+    "autotune": 60,
+}
+
+#: registry default per dest — THE source any code comparing against or
+#: resetting to "the default" must use (a literal copy would silently
+#: drift when the FLAG_DEFS default changes)
+FLAG_DEFAULTS = {dest: default
+                 for _f, _s, dest, _k, default, _c, _h in FLAG_DEFS}
 
 
 def _make_field(flag_def):
     _, _, dest, kind, default, _, _ = flag_def
     if kind in ("strlist", "intlist"):
         return (dest, list, field(default_factory=list))
-    py_type = {"bool": bool, "int": int, "float": float,
+    py_type = {"bool": bool, "int": int, "optint": int, "float": float,
                "str": str, "size": int}[kind]
     return (dest, py_type, field(default=default))
 
@@ -1563,6 +1604,47 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--svcleasesecs must exceed the --svcupint poll interval "
                 "(every /status poll renews the lease)")
+        if self.autotune_secs < 0:
+            raise ConfigError("--autotune must be >= 0 seconds (0 = off)")
+        if self.autotune_probes < 0:
+            raise ConfigError("--autotune-probes must be >= 0 (0 = "
+                              "bounded by the time budget only)")
+        if self.autotune_probe_secs < 1:
+            raise ConfigError("--autotune-probesecs must be >= 1")
+        if self.autotune_repeat < 1:
+            raise ConfigError("--autotune-repeat must be >= 1")
+        if not self.autotune_secs and (
+                self.autotune_profile_path or self.autotune_probes
+                or self.autotune_probe_secs
+                != FLAG_DEFAULTS["autotune_probe_secs"]
+                or self.autotune_repeat
+                != FLAG_DEFAULTS["autotune_repeat"]):
+            raise ConfigError(
+                "--autotune-profile/--autotune-probes/"
+                "--autotune-probesecs/--autotune-repeat tune the "
+                "--autotune search — give --autotune [SECS]")
+        if self.autotune_secs:
+            if self.run_as_service:
+                raise ConfigError(
+                    "--autotune runs at the master/local coordinator "
+                    "(services execute probes like any phase, they "
+                    "never tune) — arm it on the master instead")
+            if self.resume_run:
+                raise ConfigError(
+                    "--autotune cannot be combined with --resume: the "
+                    "journaled phases ran at a tuned point this resume "
+                    "would not reproduce — re-run with -c PROFILE "
+                    "instead of re-tuning")
+            if self.scenario:
+                raise ConfigError(
+                    "--autotune and --scenario both drive per-step "
+                    "config overlays through the coordinator — tune a "
+                    "plain -w/-r run first, then run the scenario with "
+                    "the emitted -c PROFILE")
+            if not self.run_create_files and not self.run_read_files:
+                raise ConfigError(
+                    "--autotune probes the run's first write or read "
+                    "phase — it needs -w or -r")
         if self.resume_run and not self.journal_file_path:
             raise ConfigError(
                 "--resume replays a run journal — give --journal FILE "
@@ -1711,6 +1793,16 @@ class BenchConfig(BenchConfigBase):
         # not re-expand and re-run the whole scenario per step
         d["scenario"] = ""
         d["scenario_opts_str"] = ""
+        # the autotune search is master-side orchestration: services run
+        # probe phases exactly like measured phases (each probe's tuned
+        # candidate arrives via the normal re-prepare), they never tune.
+        # Sub-knobs reset to their DEFAULTS so the service-side check()
+        # never trips the "--autotune-* without --autotune" gate.
+        d["autotune_secs"] = 0
+        d["autotune_profile_path"] = ""
+        d["autotune_probes"] = 0
+        d["autotune_probe_secs"] = FLAG_DEFAULTS["autotune_probe_secs"]
+        d["autotune_repeat"] = FLAG_DEFAULTS["autotune_repeat"]
         d["num_dataset_threads_override"] = self.num_dataset_threads
         if self.assign_tpu_per_service and self.tpu_ids:
             # --tpuperservice: round-robin chips across service instances —
@@ -1852,6 +1944,12 @@ def build_arg_parser():
         if kind == "bool":
             parser.add_argument(*names, dest=dest, action="store_true",
                                 default=default, help=help_txt)
+        elif kind == "optint":
+            # optional value: the bare flag means OPTINT_BARE[flag]
+            parser.add_argument(*names, dest=dest, metavar="V",
+                                type=int, nargs="?",
+                                const=OPTINT_BARE[flag],
+                                default=default, help=help_txt)
         else:
             parser.add_argument(*names, dest=dest, metavar="V",
                                 type=_KIND_PARSERS[kind], default=default,
@@ -1885,11 +1983,28 @@ def _apply_config_file(cfg_path: str, namespace, parser) -> None:
             setattr(namespace, dest, parsed)
 
 
+def _normalize_optint_argv(argv: "list[str]") -> "list[str]":
+    """optint flags take an OPTIONAL integer: when the next token is
+    not a plain integer (usually the bench path), the flag is bare —
+    rewrite it to its =BARE form so argparse never eats the path."""
+    out: "list[str]" = []
+    flags = {f"--{flag}": bare for flag, bare in OPTINT_BARE.items()}
+    for i, tok in enumerate(argv):
+        if tok in flags and not (i + 1 < len(argv)
+                                 and argv[i + 1].isdigit()):
+            out.append(f"{tok}={flags[tok]}")
+            continue
+        out.append(tok)
+    return out
+
+
 def parse_cli(argv: "list[str] | None" = None) -> "tuple[BenchConfig, object]":
     """Parse CLI into (BenchConfig, raw_namespace). Help/version handling is
     the caller's job (cli.py) so it can render tiered help."""
+    import sys as sys_mod
     parser = build_arg_parser()
-    ns = parser.parse_args(argv)
+    argv = list(sys_mod.argv[1:]) if argv is None else list(argv)
+    ns = parser.parse_args(_normalize_optint_argv(argv))
     if ns.config_file_path:
         _apply_config_file(ns.config_file_path, ns, parser)
     ns.paths = list(ns.paths) + list(ns.path_opts)  # merge --path options

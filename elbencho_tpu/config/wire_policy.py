@@ -44,8 +44,10 @@ Classes (field appears in exactly one):
 from __future__ import annotations
 
 MASTER_ONLY = frozenset({
-    "csv_file_path", "flightrec_file_path", "hosts_file_path",
-    "hosts_str", "journal_file_path", "json_file_path", "res_file_path",
+    "autotune_probe_secs", "autotune_probes", "autotune_profile_path",
+    "autotune_repeat", "autotune_secs", "csv_file_path",
+    "flightrec_file_path", "hosts_file_path", "hosts_str",
+    "journal_file_path", "json_file_path", "res_file_path",
     "resume_run", "run_as_service", "svc_fanout", "svc_stalled_secs",
     "svc_stream", "svc_tolerant_hosts",
 })

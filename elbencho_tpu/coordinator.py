@@ -85,6 +85,27 @@ class Coordinator:
                                         cfg.svc_wait_secs)
             self._wait_for_sync_start()
             self.manager.prepare_threads()
+            if cfg.autotune_secs:
+                # closed-loop autotuning (docs/autotuning.md): probe ->
+                # doctor verdict -> hill-climb, then apply the tuned
+                # point (fleet rebuilt) so the REAL phases below run it
+                self._run_autotune()
+                if cfg.journal_file_path:
+                    # journal the run NOW, against the TUNED effective
+                    # config (see _setup_journal's autotune deferral);
+                    # the unjournaled probes above left no records. The
+                    # tuned profile is already on disk, so a refused/
+                    # unwritable journal aborts without wasting the
+                    # spent tune budget.
+                    try:
+                        from .journal import RunJournal
+                        self._journal = RunJournal(
+                            cfg.journal_file_path, cfg)
+                        self._journal.start_fresh(cfg.enabled_phases(),
+                                                  cfg.iterations)
+                    except (ConfigError, OSError) as err:
+                        logger.log_error(str(err))
+                        return 1
             self.run_benchmarks()
             if self._journal is not None:
                 self._journal_write(self._journal.run_complete)
@@ -132,6 +153,13 @@ class Coordinator:
         incompatible datasets."""
         cfg = self.cfg
         if not cfg.journal_file_path:
+            return False
+        if cfg.autotune_secs:
+            # a fresh tuned run journals AFTER the tuner applied its
+            # knobs (--resume next to --autotune is rejected at config
+            # time), so the fingerprint describes the config the phases
+            # actually ran — which makes `--resume -c PROFILE` the
+            # working recovery path instead of a guaranteed mismatch
             return False
         from .journal import RunJournal, load_resume_plan
         if cfg.resume_run:
@@ -519,6 +547,26 @@ class Coordinator:
                    "Scenario": plan.name,
                    "ScenarioStep": "summary",
                    "ScenarioAnalysis": analysis}
+            with open(cfg.json_file_path, "a") as f:
+                f.write(json_mod.dumps(rec) + "\n")
+
+    def _run_autotune(self) -> None:
+        """--autotune: verdict-guided knob search BEFORE the measured
+        phases (elbencho_tpu/autotune/). The Autotune block lands in
+        the run JSON as its own terminal-style record immediately, so
+        even an aborted main run keeps the search's trajectory and the
+        emitted profile path."""
+        from .autotune import run_autotune
+        block = run_autotune(self)
+        if block is None:
+            return
+        cfg = self.cfg
+        if cfg.json_file_path:
+            import json as json_mod
+            rec = {"ISODate": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                   "Label": cfg.bench_label,
+                   "Phase": "AUTOTUNE",
+                   "Autotune": block}
             with open(cfg.json_file_path, "a") as f:
                 f.write(json_mod.dumps(rec) + "\n")
 

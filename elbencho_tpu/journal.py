@@ -55,6 +55,12 @@ REC_RUN_COMPLETE = "run_complete"
 FINGERPRINT_EXCLUDE = frozenset({
     # the journal/resume machinery itself
     "journal_file_path", "resume_run",
+    # autotune search knobs: probes are unjournaled and the search is
+    # master-side orchestration — the values the tuner APPLIES mutate
+    # live config after the fingerprint is taken, and --resume next to
+    # --autotune is rejected outright (args.check)
+    "autotune_secs", "autotune_profile_path", "autotune_probes",
+    "autotune_probe_secs", "autotune_repeat",
     # result/observability outputs
     "res_file_path", "csv_file_path", "json_file_path", "no_csv_labels",
     "live_csv_file_path", "live_json_file_path", "live_csv_extended",

@@ -843,6 +843,14 @@ class Statistics:
             if getattr(self.cfg, "scenario_epoch", 0) else 0
         # the epoch number itself is JSON-only (popped for CSV)
         rec["ScenarioEpoch"] = getattr(self.cfg, "scenario_epoch", 0)
+        # --autotune (JSON-only): whether this phase ran at a tuned
+        # point and the search's measured gain over the defaults — the
+        # summarize tool's Tuned/Gain% columns; the full Autotune block
+        # (trajectory, chosen config, doctor diff) is its own terminal
+        # AUTOTUNE record (docs/autotuning.md)
+        tuned = getattr(self.cfg, "autotune_applied", None)
+        rec["AutotuneTuned"] = bool(tuned)
+        rec["AutotuneGainPct"] = tuned["gain_pct"] if tuned else 0
         return rec
 
     #: fixed result columns of the CSV schema (docs/result-columns.md);
@@ -914,7 +922,8 @@ class Statistics:
         for _attr, key, _mode in CONTROL_AUDIT_COUNTERS:  # JSON-only keys
             rec.pop(key)
         for key in ("HostCPUUtil", "TelemetryScrapes", "TraceEvents",
-                    "TraceDropped", "Resumed", "ScenarioEpoch"):
+                    "TraceDropped", "Resumed", "ScenarioEpoch",
+                    "AutotuneTuned", "AutotuneGainPct"):
             rec.pop(key)  # telemetry + lifecycle keys are JSON-only
         assert tuple(rec) == self.CSV_RESULT_COLUMNS, "CSV schema drift"
         labels = {} if self.cfg.no_csv_labels else self.cfg.config_labels()

@@ -52,6 +52,32 @@ STAGE_VERDICTS = {
     "io_retry": "retry-bound",
 }
 
+#: machine-readable verdict -> knob-axis hints for the closed-loop
+#: autotuner (elbencho_tpu/autotune/; axis names are KnobSpace axes):
+#: the ordered axes worth moving when a phase carries this verdict. An
+#: EMPTY tuple is deliberate — retry/ici/straggler/tail problems are
+#: not fixed by any of these knobs, and ``inconclusive`` tells the
+#: tuner to fall back to round-robin. Attached to every Analysis block
+#: as the appended ``TuneHint`` key.
+VERDICT_TUNE_AXES = {
+    # storage can't keep up: more ops in flight, then more workers
+    "storage-bound": ("iodepth", "threads"),
+    # per-transfer submit cost dominates: amortize it over batches
+    "dispatch-bound": ("tpubatch",),
+    # DMA wall dominates: deepen the in-flight window so transfers
+    # overlap instead of serializing
+    "dma-bound": ("tpudepth",),
+    # producer kept finding the transfer ring full: widen the window
+    "stall-bound": ("tpudepth", "iodepth"),
+    # control round-trips cap the fleet: aggregate harder, poll slower
+    "control-bound": ("svcfanout", "svcupint"),
+    "retry-bound": (),
+    "ici-bound": (),
+    "straggler-bound": (),
+    "tail-bound": (),
+    "inconclusive": (),
+}
+
 #: TPU transfer-op counters (denominator of the stall ratio)
 TPU_OP_KEYS = ("TpuH2dDirectOps", "TpuH2dStagedOps",
                "TpuD2hDirectOps", "TpuD2hStagedOps")
@@ -280,7 +306,8 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
         if t_rise is not None:
             evidence.append(f"pipe_full_stalls rising after "
                             f"t={t_rise:g}s")
-    else:
+    inconclusive_why: "list[str]" = []
+    if verdict == "inconclusive":  # the gated verdicts above all missed
         dominant = max(stage_usec, key=lambda n: stage_usec[n]) \
             if any(stage_usec.values()) else ""
         if dominant and shares[dominant] >= DOMINANT_SHARE_PCT:
@@ -308,11 +335,29 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
                 f"control-plane requests "
                 f"({totals.get('SvcCtlBytes', 0)} bytes)")
         else:
-            evidence.append(
-                "no instrumented stage reaches "
-                f"{DOMINANT_SHARE_PCT:g}% of worker time — the phase is "
-                "bounded outside the measured stages (page cache, CPU, "
-                "metadata syscalls)")
+            # an inconclusive verdict must say WHY — which gate failed
+            # — both for humans and for the autotuner, whose
+            # round-robin fallback keys off this verdict
+            if not wall:
+                inconclusive_why.append("phase wall time is 0 — "
+                                        "nothing to decompose")
+            if not any(stage_usec.values()):
+                inconclusive_why.append(
+                    "no instrumented stage recorded any time (the "
+                    "phase ran entirely outside the measured stages)")
+            else:
+                inconclusive_why.append(
+                    f"no stage >= {DOMINANT_SHARE_PCT:g}% of worker "
+                    f"time (max: {dominant} at {shares[dominant]:g}%) "
+                    f"— the phase is bounded outside the measured "
+                    f"stages (page cache, CPU, metadata syscalls)")
+            if series is not None and len(series) < 2:
+                inconclusive_why.append(
+                    f"phase shorter than 2 recorded ticks "
+                    f"({len(series)} sample row(s)) — lengthen the "
+                    f"phase or shorten the live-stats interval for "
+                    f"trend evidence")
+            evidence.extend(inconclusive_why)
     if verdict not in ("stall-bound",) and stalls:
         evidence.append(f"pipe_full_stalls {stalls} "
                         f"(~{stall_ratio:.2f}/op, below the "
@@ -372,6 +417,13 @@ def analyze_phase(phase_name: str, totals: dict, elapsed_usec: int,
         # lives beside this Analysis in the run JSON / phase_end row.
         # Appended key, never reordered.
         "Tail": tail_summary,
+        # machine-readable verdict -> knob-axis hints for the
+        # closed-loop autotuner (VERDICT_TUNE_AXES; appended key)
+        "TuneHint": list(VERDICT_TUNE_AXES.get(verdict, ())),
+        # which gate(s) failed when the verdict is inconclusive (empty
+        # otherwise) — the tuner's round-robin trigger, and the human
+        # answer to "why won't the doctor commit?" (appended key)
+        "InconclusiveWhy": inconclusive_why,
     }
 
 

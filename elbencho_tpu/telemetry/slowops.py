@@ -79,6 +79,25 @@ def test_op_delay(cfg) -> "tuple[int, int] | None":
     return TEST_OP_DELAY_BY_PORT.get(getattr(cfg, "service_port", 0))
 
 
+#: test-only EVERY-op delay injection, per service port: the autotune
+#: chaos suite seeds this (same ELBENCHO_TPU_TESTING gate as
+#: TEST_OP_DELAY_BY_PORT) to give storage a deterministic per-op
+#: latency floor — a constructed storage-bound bottleneck the tuner
+#: provably beats by raising parallelism: {service_port: delay_usec}
+TEST_UNIFORM_OP_DELAY_BY_PORT: "dict[int, int]" = {}
+
+
+def test_uniform_op_delay(cfg) -> int:
+    """Per-op delay (usec) every storage op of this worker must inject,
+    0 outside an opted-in test fleet. Resolved once per phase like
+    test_op_delay, so production hot paths pay one dict test."""
+    if not TEST_UNIFORM_OP_DELAY_BY_PORT \
+            or os.environ.get("ELBENCHO_TPU_TESTING") != "1":
+        return 0
+    return TEST_UNIFORM_OP_DELAY_BY_PORT.get(
+        getattr(cfg, "service_port", 0), 0)
+
+
 class SlowOpRecorder:
     """Per-worker slow-op capture. Owned and written by the worker thread
     (no locks — like every live counter, snapshot readers ride the GIL);

@@ -843,10 +843,13 @@ class LocalWorker(Worker):
         # be throttled against zero writer bytes
         balancer = (self.shared.rwmix_balancer
                     if (is_write or is_rwmix_reader) else None)
-        # chaos-test seam: a deterministic per-op delay for exactly one
-        # (port, op_index) — None outside ELBENCHO_TPU_TESTING fleets
-        from ..telemetry.slowops import test_op_delay
+        # chaos-test seams: a deterministic per-op delay for exactly one
+        # (port, op_index), and a uniform every-op latency floor (the
+        # autotune suite's constructed storage bottleneck) — both None/0
+        # outside ELBENCHO_TPU_TESTING fleets
+        from ..telemetry.slowops import test_op_delay, test_uniform_op_delay
         fault_delay = test_op_delay(cfg)
+        uniform_delay_usec = test_uniform_op_delay(cfg)
         for off, length in gen:
             # rotate buffers so pipelined TPU transfers never race a reuse
             buf = self._io_bufs[self._num_iops_submitted % num_bufs]
@@ -882,10 +885,11 @@ class LocalWorker(Worker):
 
             def one_op(fd=fd, real_off=real_off, length=length,
                        do_read=do_read_this_op, buf=buf,
-                       delay=(fault_delay[1]
-                              if fault_delay is not None
-                              and self._num_iops_submitted
-                              == fault_delay[0] else 0)):
+                       delay=uniform_delay_usec + (
+                           fault_delay[1]
+                           if fault_delay is not None
+                           and self._num_iops_submitted
+                           == fault_delay[0] else 0)):
                 """One positional I/O attempt; a short transfer raises
                 the (transient) ShortIOError so --ioretries covers it."""
                 t0 = time.perf_counter_ns()

@@ -347,6 +347,18 @@ def test_fallback_ladder_lands_tier_labeled_number_fast():
         assert tail["tail_vs_median"] >= 1
     if "rider_error" not in tail and "error" not in tail:
         assert tail["top_slow_op"].get("LatUsec", 0) > 0
+    # the autotune rider (closed-loop tuning satellite): every measured
+    # tier carries a tier-labeled tuned-vs-default dict with a NON-NULL
+    # gain — round r06+ finally shows a real, climbing tuned figure
+    tune = rec.get("autotune")
+    assert isinstance(tune, dict)
+    assert tune["tier"] == rec["fallback_tier"]
+    assert "error" not in tune, tune
+    assert tune["default_mibs"] is not None and tune["default_mibs"] > 0
+    assert tune["tuned_mibs"] is not None and tune["tuned_mibs"] > 0
+    assert isinstance(tune["gain_pct"], (int, float))
+    assert tune["chosen"], "tuned knob map missing"
+    assert tune["probes"] >= 1
 
 
 @pytest.mark.slow

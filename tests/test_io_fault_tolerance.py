@@ -500,15 +500,15 @@ def test_summarize_json_columns_and_degraded_tpu_banner(tmp_path):
     # staging-pool, run-lifecycle, streaming-control-plane, pod-slice,
     # and latency-percentile columns append after the fault-tolerance
     # block)
-    assert header[-27:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    assert header[-29:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                             "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                             "PoolReuse", "RegOps", "SqpollOps",
                             "LeaseExp", "Resumed", "StreamB", "DeltaSave",
                             "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
                             "LatP50", "LatP99", "LatP99.9",
                             "Scenario", "Step", "EpochRate",
-                            "TailX", "TailOwner"]
-    assert row[-22:-19] == ["4", "2", "1"]
+                            "TailX", "TailOwner", "Tuned", "Gain%"]
+    assert row[-24:-21] == ["4", "2", "1"]
     assert "DEGRADED-TPU" in out.stderr
     # clean records: no banner
     jf.write_text(json.dumps({"Phase": "READ"}) + "\n")

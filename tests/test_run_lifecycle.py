@@ -703,9 +703,10 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     assert res.returncode == 0, res.stderr
     header = res.stdout.splitlines()[0].split(",")
     # the streaming-control-plane trio + pod-slice trio append after the
-    # lifecycle pair (never reordered)
-    assert header[-16:-14] == ["LeaseExp", "Resumed"]
+    # lifecycle pair (never reordered; the --autotune Tuned/Gain% pair
+    # shifted the tail by two)
+    assert header[-18:-16] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-16:-14] == ["2", "3"]
+    assert row[-18:-16] == ["2", "3"]
     assert "RESUMED" in res.stderr

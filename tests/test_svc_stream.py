@@ -566,8 +566,8 @@ def test_summarize_json_stream_columns(tmp_path):
     row = out.stdout.splitlines()[1].split(",")
     # the pod-slice and latency-percentile trios append after the
     # streaming trio
-    assert header[-14:-11] == ["StreamB", "DeltaSave", "AggDepth"]
-    assert row[-14:-11] == ["123", "456", "2"]
+    assert header[-16:-13] == ["StreamB", "DeltaSave", "AggDepth"]
+    assert row[-16:-13] == ["123", "456", "2"]
 
 
 # ---------------------------------------------------------------------------

@@ -339,12 +339,12 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     # with the (later) data-plane fault-tolerance, staging-pool,
     # run-lifecycle, streaming-control-plane, pod-slice, and
     # latency-percentile columns after them
-    assert cols[-27:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    assert cols[-29:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                           "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                           "PoolReuse", "RegOps", "SqpollOps",
                           "LeaseExp", "Resumed", "StreamB", "DeltaSave",
                           "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
                           "LatP50", "LatP99", "LatP99.9",
                           "Scenario", "Step", "EpochRate",
-                          "TailX", "TailOwner"]
-    assert row.split(",")[-27:-22] == ["3", "7", "2", "5", "11"]
+                          "TailX", "TailOwner", "Tuned", "Gain%"]
+    assert row.split(",")[-29:-24] == ["3", "7", "2", "5", "11"]
