@@ -841,6 +841,124 @@ def _scenario_rider(basedir, extra_env=None):
             pass
 
 
+def _takeover_attach(basedir, tier, extra_env=None):
+    """Master-failover rider: one SHORT two-service fleet run on
+    localhost whose master is SIGKILLed mid-phase, then adopted by a
+    successor run (``--resume --adopt``). The dict proves the failover
+    path end to end — the services entered the adoption grace, the
+    successor claimed them via /adopt, and the in-flight phase
+    completed WITHOUT being restarted — so every artifact carries
+    failover evidence next to a measured tier. Tier-labeled and
+    budget-guarded like the other riders; failures are context, never
+    fatal."""
+    import shutil
+    import socket
+    if _remaining_s() < DEADLINE_RESERVE_S + 90:
+        return {"tier": tier, "error": "skipped: deadline too close"}
+    fleet_dir = os.path.join(basedir, "takeover_bench")
+    jf = os.path.join(basedir, "takeover.json")
+    journal = os.path.join(basedir, "takeover.journal")
+    env = _subproc_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
+    services = []
+    victim = None
+    try:
+        os.makedirs(fleet_dir, exist_ok=True)
+        ports = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        for port in ports:
+            services.append(subprocess.Popen(
+                [sys.executable, "-m", "elbencho_tpu", "--service",
+                 "--foreground", "--port", str(port)],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+        # ONE long-running phase: a rate-limited file-mode write, so the
+        # crash window is wide and deterministic (--timelimit is
+        # per-phase; a separate mkdirs leg would eat it)
+        fleet_args = ["--hosts", hosts, "--journal", journal,
+                      "--svcleasesecs", "2", "--svcadoptsecs", "60",
+                      "-w", "-t", "1", "-s", "32M", "-b", "64K",
+                      "--limitwrite", "2M", "--timelimit", "10",
+                      os.path.join(fleet_dir, "takeover.dat")]
+        victim = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "--nolive",
+             "--jsonfile", jf] + fleet_args,
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        # SIGKILL the master the moment the journal proves a phase is in
+        # flight (fsync'd phase_start, no finish) — the crash window the
+        # takeover machinery exists for
+        deadline = time.monotonic() + 30
+        killed = False
+        while time.monotonic() < deadline:
+            try:
+                with open(journal) as f:
+                    jrecs = [json.loads(ln) for ln in f if ln.strip()]
+            except (OSError, ValueError):
+                jrecs = []
+            if any(r.get("rec") == "phase_start"
+                   and r.get("name") == "WRITE" for r in jrecs) \
+                    and not any(r.get("rec") == "phase_finish"
+                                and r.get("name") == "WRITE"
+                                for r in jrecs):
+                time.sleep(1.0)  # let the fleet actually move bytes
+                victim.kill()
+                victim.wait()
+                killed = True
+                break
+            if victim.poll() is not None:
+                raise RuntimeError(
+                    f"victim master exited rc={victim.returncode} before "
+                    f"a phase was in flight")
+            time.sleep(0.2)
+        if not killed:
+            raise RuntimeError("victim master never journaled an "
+                               "in-flight phase to kill")
+        open(jf, "w").close()
+        recs = _run_cli(["--resume", "--adopt"] + fleet_args, jf,
+                        extra_env=extra_env, timeout=180)
+        write_rec = next((r for r in recs if r.get("Phase") == "WRITE"),
+                         {})
+        with open(journal) as f:
+            jrecs = [json.loads(ln) for ln in f if ln.strip()]
+        takeover = next((r for r in jrecs if r.get("rec") == "takeover"),
+                        {})
+        return {
+            "tier": tier,
+            "hosts": len(ports),
+            "killed_mid_phase": True,
+            "adopted_hosts": takeover.get("adopted_hosts", 0),
+            "inflight_phase": (takeover.get("inflight") or {}).get(
+                "name", ""),
+            # sum over workers: hosts that completed the phase under the
+            # successor master / /adopt handshakes the services served
+            "master_takeovers": write_rec.get("MasterTakeovers", 0),
+            "svc_adoptions": write_rec.get("SvcAdoptions", 0),
+            "completed": any(r.get("rec") == "run_complete"
+                             for r in jrecs),
+        }
+    except Exception as err:  # noqa: BLE001 - rider must never kill a record
+        return {"tier": tier, "error": str(err)[-300:]}
+    finally:
+        for proc in [victim, *services]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+        for path in (jf, journal):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
 def _run_fallback_ladder(probe_err) -> int:
     """No chip: host-memory staging tier (jax CPU backend serves as the
     staging sink, so the WHOLE data path incl. TpuWorkerContext runs and
@@ -985,6 +1103,13 @@ def _run_fallback_ladder(probe_err) -> int:
             _STATE["stage"] = "scenario_rider"
             rec["scenario_curve"] = _scenario_rider(
                 tmpdir, extra_env=_FALLBACK_ENV)
+        # master-failover rider: SIGKILL a fleet master mid-phase and
+        # prove a successor adopts + completes it (--resume --adopt) —
+        # failover evidence lands next to the measured tier
+        if _remaining_s() > DEADLINE_RESERVE_S + 120:
+            _STATE["stage"] = "takeover_rider"
+            rec["takeover"] = _takeover_attach(tmpdir, tier,
+                                               extra_env=_FALLBACK_ENV)
         _emit_record(rec)  # NEVER cached: not TPU evidence
         _STATE["pending_success"] = None
         return 0
@@ -1412,6 +1537,17 @@ def _run_bench(platform: str, probe_timeline: list) -> int:
         if not truncated and _remaining_s() > DEADLINE_RESERVE_S + 90:
             _STATE["stage"] = "scenario_rider"
             rec["scenario_curve"] = _scenario_rider(tmpdir)
+
+        # master-failover rider: SIGKILL a fleet master mid-phase and
+        # prove a successor adopts + completes it (--resume --adopt) —
+        # failover evidence rides the TPU tier too (storage-only fleet,
+        # no tunnel traffic or idle gap needed)
+        if not truncated and _remaining_s() > DEADLINE_RESERVE_S + 120:
+            _STATE["stage"] = "takeover_rider"
+            rec["takeover"] = _takeover_attach(
+                tmpdir,
+                "tpu" if platform in TPU_PLATFORMS
+                else f"selftest_{platform}")
 
         # emit FIRST: a SIGTERM landing between these two calls must lose
         # at worst the cache update, never the measured record (a handler

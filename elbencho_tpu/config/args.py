@@ -355,6 +355,14 @@ FLAG_DEFS = [
      "interrupts its workers, logs ORPHANED, and returns to idle so the "
      "host is immediately reusable by a new run (0 = off, the default; "
      "must exceed --svcupint when set)"),
+    ("svcadoptsecs", None, "svc_adopt_secs", "int", 0, "dist",
+     "Adoption grace window in seconds after a --svcleasesecs lease "
+     "expiry: instead of orphan recovery the service enters an "
+     "awaiting-adoption state — workers keep running, per-run state is "
+     "NOT scrubbed — so a replacement master (--resume --adopt) can "
+     "claim the host via /adopt; grace expiry with no adopter falls "
+     "through to the normal orphan recovery (0 = off, the default: "
+     "immediate-orphan parity)"),
     ("svcstream", None, "svc_stream", "bool", False, "dist",
      "Replace master-mode /status polling with one persistent "
      "server-push live-stats stream per attached host (chunked HTTP, "
@@ -652,6 +660,20 @@ FLAG_DEFS = [
      "phases with finish records are skipped, the first incomplete "
      "phase re-runs from scratch, and a config-fingerprint mismatch "
      "against the journal is a hard error"),
+    ("adopt", None, "adopt_run", "bool", False, "misc",
+     "With --resume: instead of re-running the first incomplete phase "
+     "from scratch, take over the crashed master's live fleet — "
+     "claim every awaiting-adoption service host via /adopt (journal "
+     "fingerprint + takeover token), adopt the in-flight phase at "
+     "whatever completion state it reached (never restarting it), and "
+     "continue the journaled plan from the takeover point (requires "
+     "--hosts services armed with --svcleasesecs + --svcadoptsecs)"),
+    ("standby", None, "standby_str", "str", "", "misc",
+     "Warm-standby master (HOST:PORT of one fleet service): observe "
+     "that service's /status as a liveness proxy for the primary "
+     "master and auto-run the --resume --adopt takeover the moment "
+     "the host reports awaiting-adoption — no human in the loop "
+     "(requires --journal FILE on storage this standby can read)"),
 
     # training-ingest scenario layer (docs/scenarios.md)
     ("scenario", None, "scenario", "str", "", "essential",
@@ -1649,6 +1671,27 @@ class BenchConfig(BenchConfigBase):
             raise ConfigError(
                 "--resume replays a run journal — give --journal FILE "
                 "(the same path the interrupted run journaled to)")
+        if self.svc_adopt_secs < 0:
+            raise ConfigError("--svcadoptsecs must be >= 0")
+        if self.adopt_run and not self.resume_run:
+            raise ConfigError(
+                "--adopt is a takeover mode of --resume (the journal "
+                "names the fleet and the in-flight phase) — give "
+                "--resume --adopt --journal FILE")
+        if self.standby_str:
+            if not self.journal_file_path:
+                raise ConfigError(
+                    "--standby takes over by replaying the primary's "
+                    "journal — give --journal FILE on storage this "
+                    "standby can read")
+            if self.resume_run or self.adopt_run:
+                raise ConfigError(
+                    "--standby arms --resume --adopt by itself at "
+                    "takeover time — do not combine them")
+            if self.run_as_service:
+                raise ConfigError(
+                    "--standby is a master role (a warm replacement "
+                    "coordinator) — it cannot run as --service")
         if self.scenario_opts_str and not self.scenario:
             raise ConfigError(
                 "--scenario-opt tunes a --scenario; give --scenario NAME")
@@ -1786,6 +1829,11 @@ class BenchConfig(BenchConfigBase):
         # the lease advertisement the service watchdog arms on)
         d["journal_file_path"] = ""
         d["resume_run"] = False
+        # takeover orchestration is master-side; svc_adopt_secs stays on
+        # the wire like svc_lease_secs (the /preparephase IS the grace
+        # advertisement the awaiting-adoption state arms on)
+        d["adopt_run"] = False
+        d["standby_str"] = ""
         # scenario composition is master-side: services receive each
         # step's EFFECTIVE config (the overlay knobs below stay on the
         # wire: shuffle_window, scenario_epoch, the loader pacing set,

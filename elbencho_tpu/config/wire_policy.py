@@ -44,12 +44,12 @@ Classes (field appears in exactly one):
 from __future__ import annotations
 
 MASTER_ONLY = frozenset({
-    "autotune_probe_secs", "autotune_probes", "autotune_profile_path",
-    "autotune_repeat", "autotune_secs", "csv_file_path",
-    "flightrec_file_path", "hosts_file_path", "hosts_str",
-    "journal_file_path", "json_file_path", "res_file_path",
-    "resume_run", "run_as_service", "svc_fanout", "svc_stalled_secs",
-    "svc_stream", "svc_tolerant_hosts",
+    "adopt_run", "autotune_probe_secs", "autotune_probes",
+    "autotune_profile_path", "autotune_repeat", "autotune_secs",
+    "csv_file_path", "flightrec_file_path", "hosts_file_path",
+    "hosts_str", "journal_file_path", "json_file_path", "res_file_path",
+    "resume_run", "run_as_service", "standby_str", "svc_fanout",
+    "svc_stalled_secs", "svc_stream", "svc_tolerant_hosts",
 })
 
 MASTER_FINGERPRINTED = frozenset({
@@ -72,7 +72,8 @@ WIRE_OBSERVABILITY = frozenset({
     "show_all_elapsed", "show_cpu_util", "show_latency",
     "show_latency_histogram", "show_latency_percentiles",
     "show_svc_elapsed", "show_svc_ping",
-    "single_line_live_stats_no_erase", "slow_ops_k", "svc_lease_secs",
+    "single_line_live_stats_no_erase", "slow_ops_k", "svc_adopt_secs",
+    "svc_lease_secs",
     "svc_num_retries", "svc_password_file", "svc_retry_budget_secs",
     "svc_update_interval_ms", "svc_wait_secs", "telemetry",
     "telemetry_port", "tpu_profile_dir", "trace_file_path",

@@ -55,7 +55,65 @@ class Coordinator:
                                     quit=cfg.quit_services,
                                     fanout=cfg.svc_fanout)
             return 0
+        if cfg.standby_str:
+            return self._run_standby()
         return self._run_master_or_local()
+
+    def _run_standby(self) -> int:
+        """--standby HOST:PORT warm standby (docs/fault-tolerance.md
+        "Master failover"): observe one sentinel service's /status — an
+        observer poll carries no bench UUID, so it can never renew the
+        primary's lease — and auto-take-over (--resume --adopt) the
+        moment the sentinel reports AwaitingAdoption. The watch ends
+        cleanly when the shared journal gains its run_complete record:
+        the primary finished without needing us."""
+        from .journal import REC_RUN_COMPLETE, read_journal
+        from .service import protocol as proto
+        from .service.remote_worker import ServiceClient
+        from .workers.shared import WorkerRemoteException
+        cfg = self.cfg
+        client = ServiceClient(cfg.standby_str, cfg.service_port)
+        logger.log(0, f"STANDBY: watching {client.hostname}:{client.port} "
+                      f"for a master lease expiry (--standby); journal: "
+                      f"{cfg.journal_file_path}")
+        poll_secs = max(cfg.svc_update_interval_ms, 500) / 1000.0
+        try:
+            while True:
+                try:
+                    if os.path.exists(cfg.journal_file_path) and any(
+                            r.get("rec") == REC_RUN_COMPLETE
+                            for r in read_journal(cfg.journal_file_path)):
+                        logger.log(0, "STANDBY: journal shows "
+                                      "run_complete — primary finished; "
+                                      "standing down")
+                        return 0
+                except Exception:  # noqa: BLE001 - torn journal mid-append
+                    pass
+                try:
+                    status, stats = client.get_json(proto.PATH_STATUS,
+                                                    timeout=5)
+                except WorkerRemoteException:
+                    status, stats = 0, {}
+                if status == 200 \
+                        and stats.get(proto.KEY_AWAITING_ADOPTION):
+                    logger.log(0, "STANDBY: sentinel host is awaiting "
+                                  "adoption — taking over the fleet "
+                                  "(--resume --adopt)")
+                    client.close()
+                    # shed the standby role BEFORE assuming mastership:
+                    # later config re-checks (the manager's path-type
+                    # pass) must see a plain --resume --adopt master,
+                    # not the standby+resume combination check() forbids
+                    cfg.standby_str = ""
+                    cfg.resume_run = True
+                    cfg.adopt_run = True
+                    return self._run_master_or_local()
+                time.sleep(poll_secs)
+        except KeyboardInterrupt:
+            logger.log(0, "STANDBY: interrupted; standing down")
+            return 3
+        finally:
+            client.close()
 
     def _run_master_or_local(self) -> int:
         from .config.args import ConfigError
@@ -84,7 +142,9 @@ class Coordinator:
                 wait_for_services_ready(cfg.hosts, cfg.service_port,
                                         cfg.svc_wait_secs)
             self._wait_for_sync_start()
+            self._arm_takeover_credentials()
             self.manager.prepare_threads()
+            self._note_takeover()
             if cfg.autotune_secs:
                 # closed-loop autotuning (docs/autotuning.md): probe ->
                 # doctor verdict -> hill-climb, then apply the tuned
@@ -184,6 +244,32 @@ class Coordinator:
         self._journal = RunJournal(cfg.journal_file_path, cfg)
         if cfg.resume_run:
             self._journal.resume(self._resume_plan.num_finished)
+            plan = self._resume_plan
+            if plan.takeover_token:
+                # the run was armed for failover: every resume (plain or
+                # --adopt) keeps presenting the journaled token so the
+                # fleet's adoption grace re-arms for the continuation
+                cfg.takeover_token = plan.takeover_token
+                cfg.journal_fingerprint = self._journal.fingerprint
+            if cfg.adopt_run:
+                if not plan.takeover_token:
+                    logger.log_error(
+                        "ADOPT: journal has no fleet record (the run was "
+                        "not armed with --svcadoptsecs) — falling back "
+                        "to a plain --resume; the fleet is re-prepared")
+                    cfg.adopt_run = False
+                else:
+                    inf = plan.inflight
+                    cfg.adopt_bench_uuid = \
+                        inf.get("bench_uuid", "") if inf else ""
+                    what = (f"in-flight phase {inf.get('name', '?')} "
+                            f"(iteration {inf.get('iteration', 0)}) is "
+                            f"adopted mid-run" if inf
+                            else "no phase was in flight")
+                    logger.log(0, f"ADOPT: taking over "
+                                  f"{len(plan.fleet_hosts) or len(cfg.hosts)}"
+                                  f" host(s) from the journaled fleet; "
+                                  f"{what}")
         else:
             # a fresh run refuses to append after an incomplete journal
             # (that restart point is someone's resume) and truncates a
@@ -197,6 +283,57 @@ class Coordinator:
                 self._journal.start_fresh(cfg.enabled_phases(),
                                           cfg.iterations)
         return False
+
+    def _arm_takeover_credentials(self) -> None:
+        """Master failover arming (docs/fault-tolerance.md): a journaled
+        fleet run with --svcadoptsecs > 0 mints a takeover token, ships
+        it (plus the journal fingerprint) on /preparephase, and journals
+        the fleet topology — the three things a successor master needs
+        to /adopt the hosts after this process dies."""
+        cfg = self.cfg
+        if getattr(cfg, "takeover_token", ""):
+            return  # --resume: credentials already came from the journal
+        if self._journal is None or not cfg.hosts \
+                or cfg.svc_adopt_secs <= 0:
+            return
+        cfg.takeover_token = os.urandom(16).hex()
+        cfg.journal_fingerprint = self._journal.fingerprint
+        self._journal_write(self._journal.fleet, cfg.hosts,
+                            cfg.takeover_token)
+
+    def _adopt_inflight(self) -> "dict | None":
+        """The dead master's in-flight phase record, when this run is a
+        --resume --adopt takeover (None otherwise)."""
+        if getattr(self.cfg, "adopt_run", False) \
+                and self._resume_plan is not None:
+            return self._resume_plan.inflight
+        return None
+
+    def _note_takeover(self) -> None:
+        """Post-handshake bookkeeping of a --resume --adopt takeover:
+        prepare_threads ran the /adopt handshake per host instead of
+        /preparephase; journal the takeover record and mark the event as
+        a trace span. The MasterTakeovers counter itself lands via the
+        adopted phase's audit counters (RemoteWorker)."""
+        if not getattr(self.cfg, "adopt_run", False):
+            return
+        adopted = sum(1 for w in self.manager.workers
+                      if getattr(w, "_took_over", False))
+        if not adopted:
+            return
+        inf = self._adopt_inflight()
+        if self._journal is not None:
+            self._journal_write(self._journal.takeover, adopted, inf)
+        tracer = self.manager.shared.tracer
+        if tracer is not None:
+            t0 = tracer.now_ns()
+            tracer.record("takeover", "phase", t0, 1,
+                          AdoptedHosts=adopted)
+        logger.log(0, f"TAKEOVER: adopted {adopted} host(s); "
+                      + (f"the in-flight phase continues under the "
+                         f"journaled bench UUID "
+                         f"{inf.get('bench_uuid', '')[:8]}..." if inf
+                         else "no phase was in flight"))
 
     def _merge_fleet_trace(self) -> None:
         """--tracefleet: fold the master trace + the per-host rings
@@ -374,6 +511,18 @@ class Coordinator:
             return eff
 
         shipped = wire_relevant(base)
+        inf = self._adopt_inflight()
+        if inf is not None and 0 <= inf.get("index", -1) < len(plan.steps):
+            # --resume --adopt skipped the fleet /preparephase: the
+            # services still run the dead master's LAST shipped config —
+            # the in-flight step's effective overlay, not the base.
+            # Seeding `shipped` with it keeps the adopted step from
+            # bouncing the fleet mid-flight; later differing steps still
+            # re-prepare as usual.
+            step0 = plan.steps[inf["index"]]
+            shipped = wire_relevant({**base, **step0.overlay,
+                                     "scenario_step_label": step0.label,
+                                     "scenario_epoch": step0.epoch})
         summaries: "list[dict]" = []
         ran_any = False
         try:
@@ -605,10 +754,23 @@ class Coordinator:
         if self._journal is None or phase in UNJOURNALED_PHASES:
             self.run_benchmark_phase(phase)
             return
+        bench_uuid = ""
+        if self.cfg.hosts and getattr(self.cfg, "takeover_token", ""):
+            # failover-armed fleet run: pre-mint the phase's bench UUID
+            # so it is journaled BEFORE /startphase — an adopting master
+            # then re-presents it and the fleet's duplicate-start
+            # idempotency keeps the in-flight phase running
+            import uuid as uuid_mod
+            bench_uuid = str(uuid_mod.uuid4())
+            inf = self._adopt_inflight()
+            if inf is not None and inf.get("bench_uuid") \
+                    and (inf.get("iteration"), inf.get("index")) \
+                    == (iteration, idx):
+                bench_uuid = inf["bench_uuid"]
         self._journal_write(self._journal.phase_start, iteration, idx,
-                            phase, step_label)
+                            phase, step_label, bench_uuid)
         try:
-            self.run_benchmark_phase(phase)
+            self.run_benchmark_phase(phase, bench_uuid=bench_uuid)
         except BaseException as err:
             reason = f"{type(err).__name__}: {err}" if str(err) \
                 else type(err).__name__
@@ -655,16 +817,19 @@ class Coordinator:
         if self.cfg.run_drop_caches_phase:
             self.run_benchmark_phase(BenchPhase.DROPCACHES)
 
-    def run_benchmark_phase(self, phase: BenchPhase) -> None:
+    def run_benchmark_phase(self, phase: BenchPhase,
+                            bench_uuid: str = "") -> None:
         """Start phase -> live stats -> wait done -> print results
-        (reference: runBenchmarkPhase, Coordinator.cpp:249)."""
+        (reference: runBenchmarkPhase, Coordinator.cpp:249). A nonempty
+        bench_uuid forces the phase's UUID (journal pre-mint / adoption,
+        see _run_journaled_phase)."""
         from .phases import phase_name
         phase_start = time.monotonic()
         tracer = self.manager.shared.tracer
         trace_t0 = tracer.now_ns() if tracer is not None else 0
         profiling = self._start_tpu_profile(phase)
         try:
-            self.manager.start_next_phase(phase)
+            self.manager.start_next_phase(phase, bench_uuid=bench_uuid)
             self.statistics.live_stats_loop(phase, phase_start)
             self.manager.wait_for_workers_done(phase_start)
         finally:

@@ -44,6 +44,14 @@ REC_PHASE_FINISH = "phase_finish"
 REC_PHASE_INTERRUPTED = "phase_interrupted"
 REC_RESUME = "resume"
 REC_RUN_COMPLETE = "run_complete"
+#: master-failover records (docs/fault-tolerance.md "Master failover"):
+#: ``fleet`` pins the service topology + the run's secret takeover
+#: token (possession of the journal IS the authorization to /adopt);
+#: ``takeover`` marks the point a --resume --adopt master claimed the
+#: fleet. Replay ignores record types it does not know, so journals
+#: with these records stay readable by older readers and vice versa.
+REC_FLEET = "fleet"
+REC_TAKEOVER = "takeover"
 
 #: config fields excluded from the fingerprint: outputs, observability,
 #: and control-plane resilience knobs shape how a run is *watched*, not
@@ -79,6 +87,10 @@ FINGERPRINT_EXCLUDE = frozenset({
     "svc_num_retries", "svc_retry_budget_secs", "svc_stalled_secs",
     "svc_tolerant_hosts", "svc_lease_secs", "svc_update_interval_ms",
     "svc_wait_secs", "svc_password_file",
+    # master failover: the takeover machinery must not invalidate the
+    # journal it resumes from — a --resume --adopt (or a standby's
+    # auto-takeover) replays the SAME workload by definition
+    "svc_adopt_secs", "adopt_run", "standby_str",
     # streaming control plane: pure transport (polling parity when off),
     # so a --resume may freely flip stream/tree shape
     "svc_stream", "svc_fanout",
@@ -202,6 +214,23 @@ class RunJournal:
         self._append(REC_RESUME, fingerprint=self.fingerprint,
                      skipped_phases=num_skipped)
 
+    def fleet(self, hosts: "list[str]", takeover_token: str) -> None:
+        """Fleet topology + the run's takeover token, written once after
+        run_start on journaled master runs. The token is minted fresh
+        per run and never printed; whoever holds the journal file holds
+        the credential a service requires on /adopt."""
+        self._append(REC_FLEET, hosts=list(hosts),
+                     takeover_token=takeover_token)
+
+    def takeover(self, num_adopted_hosts: int,
+                 inflight: "dict | None") -> None:
+        """A --resume --adopt run claimed the fleet: journal-append the
+        takeover point so a SECOND takeover (or a post-mortem) sees
+        where the run changed masters."""
+        self._append(REC_TAKEOVER, fingerprint=self.fingerprint,
+                     adopted_hosts=num_adopted_hosts,
+                     inflight=inflight or {})
+
     @staticmethod
     def _step_fields(step_label: str) -> dict:
         # scenario runs label their phase records with the step identity
@@ -210,11 +239,18 @@ class RunJournal:
         return {"step": step_label} if step_label else {}
 
     def phase_start(self, iteration: int, idx: int, phase: BenchPhase,
-                    step_label: str = "") -> None:
+                    step_label: str = "", bench_uuid: str = "") -> None:
         from .phases import phase_name
+        fields = self._step_fields(step_label)
+        if bench_uuid:
+            # master runs pre-mint the phase's bench UUID and journal it
+            # BEFORE /startphase, so an adopting master can present the
+            # exact UUID the fleet is running under — the service-side
+            # duplicate-start idempotency then makes re-starting the
+            # in-flight phase a provable no-op
+            fields["bench_uuid"] = bench_uuid
         self._append(REC_PHASE_START, iteration=iteration, index=idx,
-                     code=int(phase), name=phase_name(phase),
-                     **self._step_fields(step_label))
+                     code=int(phase), name=phase_name(phase), **fields)
 
     def phase_finish(self, iteration: int, idx: int, phase: BenchPhase,
                      host_summaries: "dict[str, dict]",
@@ -262,6 +298,18 @@ class ResumePlan:
     partial_dataset: bool
     #: terminal run_complete record present — nothing to resume
     run_complete: bool
+    #: the journal's takeover token (fleet record; "" on journals from
+    #: non-master or pre-failover runs) — the /adopt credential
+    takeover_token: str = ""
+    #: the journaled fleet topology ([] when no fleet record)
+    fleet_hosts: "list[str]" = dataclasses.field(default_factory=list)
+    #: the in-flight phase a --resume --adopt can take over: the LAST
+    #: phase_start with neither a finish nor an interrupted record, as
+    #: {"iteration", "index", "code", "name", "step", "bench_uuid"} —
+    #: None when every started phase terminated (a deliberately
+    #: interrupted phase is NOT adoptable: the dying master already
+    #: tore its workers down)
+    inflight: "dict | None" = None
 
     @property
     def num_finished(self) -> int:
@@ -316,7 +364,11 @@ def load_resume_plan(path: str, cfg) -> ResumePlan:
     finished: "set[tuple[int, int]]" = set()
     started: "set[tuple[int, int]]" = set()
     started_code: "dict[tuple[int, int], int]" = {}
+    start_recs: "dict[tuple[int, int], dict]" = {}
+    interrupted: "set[tuple[int, int]]" = set()
     complete = False
+    takeover_token = ""
+    fleet_hosts: "list[str]" = []
     for rec in records:
         key = (rec.get("iteration", 0), rec.get("index", 0))
         if rec.get("rec") == REC_PHASE_FINISH:
@@ -324,12 +376,30 @@ def load_resume_plan(path: str, cfg) -> ResumePlan:
         elif rec.get("rec") == REC_PHASE_START:
             started.add(key)
             started_code[key] = rec.get("code", 0)
+            start_recs[key] = rec
+        elif rec.get("rec") == REC_PHASE_INTERRUPTED:
+            interrupted.add(key)
         elif rec.get("rec") == REC_RUN_COMPLETE:
             complete = True
+        elif rec.get("rec") == REC_FLEET:
+            takeover_token = rec.get("takeover_token", "")
+            fleet_hosts = list(rec.get("hosts", []))
     # a write/delete phase that started (or was interrupted) without
     # finishing left a partial dataset behind
     partial_dataset = any(
         started_code.get(key) in _PARTIAL_DATASET_PHASES
         for key in started - finished)
+    # the adoptable in-flight phase: started, never finished, never
+    # deliberately interrupted — a SIGKILL'd master writes neither
+    inflight = None
+    for key in sorted(started - finished - interrupted):
+        rec = start_recs[key]
+        inflight = {"iteration": key[0], "index": key[1],
+                    "code": rec.get("code", 0),
+                    "name": rec.get("name", ""),
+                    "step": rec.get("step", ""),
+                    "bench_uuid": rec.get("bench_uuid", "")}
     return ResumePlan(finished=finished, partial_dataset=partial_dataset,
-                      run_complete=complete)
+                      run_complete=complete,
+                      takeover_token=takeover_token,
+                      fleet_hosts=fleet_hosts, inflight=inflight)

@@ -152,6 +152,16 @@ CONTROL_AUDIT_COUNTERS = (
     # for local runs and single-host fleets. Appended, never reordered.
     ("straggler_skew_usec", "StragglerSkewUsec", "max"),
     ("barrier_wait_usec", "BarrierWaitUSec", "sum"),
+    # master failover (--svcadoptsecs / --resume --adopt; docs/
+    # fault-tolerance.md "Master failover"): MasterTakeovers is
+    # MASTER-observed (1 per host claimed via /adopt on the takeover
+    # phase); SvcAdoptions / SvcAdoptWaitUsec are observed SERVICE-side
+    # and shipped back like the lease counters — service-lifetime
+    # values (adoptions survived + the longest awaiting-adoption wait
+    # any grace window saw). Appended entries, never reordered.
+    ("master_takeovers", "MasterTakeovers", "sum"),
+    ("svc_adoptions", "SvcAdoptions", "sum"),
+    ("svc_adopt_wait_usec", "SvcAdoptWaitUsec", "max"),
 )
 
 

@@ -85,6 +85,20 @@ class ServiceState:
         self._lease_thread: "threading.Thread | None" = None
         self.lease_expiries = 0
         self.lease_age_hwm_usec = 0
+        # master failover (--svcadoptsecs + /adopt): takeover credentials
+        # stashed at /preparephase (token + journal fingerprint ride the
+        # config wire as protocol extras — absent unless the master armed
+        # them), the awaiting-adoption grace state the lease watchdog
+        # enters instead of orphan recovery, and the SERVICE-lifetime
+        # adoption counters (ship like the lease counters, but only when
+        # nonzero — flags-off wire traffic stays byte-identical)
+        self._adopt_token = ""
+        self._adopt_fingerprint = ""
+        self._adopt_grace_secs = 0
+        self._awaiting_adoption = False
+        self._adopt_wait_started = 0.0
+        self.svc_adoptions = 0
+        self.svc_adopt_wait_usec = 0
         # per-host --tracefile paths this service wrote (fleet tracing):
         # scrubbed together with the upload temp dir on quit/orphan so
         # service hosts don't accumulate stale trace rings — but ONLY
@@ -136,6 +150,11 @@ class ServiceState:
             raise ConfigError(
                 f"protocol version mismatch: master={version!r} "
                 f"service={HTTP_PROTOCOL_VERSION!r}")
+        # master-failover credentials: protocol extras on the config
+        # wire, present ONLY when the master armed --svcadoptsecs with a
+        # journal (popped before config parsing — they are not fields)
+        adopt_token = cfg_dict.pop(proto.KEY_TAKEOVER_TOKEN, "")
+        adopt_fingerprint = cfg_dict.pop(proto.KEY_JOURNAL_FINGERPRINT, "")
         # overrides are applied BEFORE derive(): deriving first would
         # probe (open, size-check) the MASTER's paths on this host even
         # when a pinned --path means they are never used here
@@ -175,6 +194,15 @@ class ServiceState:
         # default for masters that don't set one
         lease_secs = cfg.svc_lease_secs or self.base_cfg.svc_lease_secs
         self._arm_lease(lease_secs)
+        # a fresh /preparephase supersedes any earlier adoption state;
+        # grace arms only when the master advertised a takeover token
+        # (a service-side --svcadoptsecs default without credentials
+        # would leave a host no master could ever claim)
+        self._adopt_token = adopt_token
+        self._adopt_fingerprint = adopt_fingerprint
+        self._awaiting_adoption = False
+        grace_secs = cfg.svc_adopt_secs or self.base_cfg.svc_adopt_secs
+        self._adopt_grace_secs = grace_secs if adopt_token else 0
         reply = {
             proto.KEY_BENCH_PATH_TYPE: int(cfg.bench_path_type),
             proto.KEY_NUM_BENCH_PATHS: len(cfg.paths),
@@ -185,13 +213,22 @@ class ServiceState:
         }
         if lease_secs:
             reply[proto.KEY_SVC_LEASE_SECS] = lease_secs
+        if self._adopt_grace_secs:
+            reply[proto.KEY_SVC_ADOPT_SECS] = self._adopt_grace_secs
         return reply
 
     # -- master liveness lease (--svcleasesecs) -----------------------------
 
     def lease_counters(self) -> dict:
-        return {"SvcLeaseExpiries": self.lease_expiries,
-                "SvcLeaseAgeHwmUsec": self.lease_age_hwm_usec}
+        counters = {"SvcLeaseExpiries": self.lease_expiries,
+                    "SvcLeaseAgeHwmUsec": self.lease_age_hwm_usec}
+        # adoption counters ship ONLY when nonzero: a run without the
+        # failover flags keeps byte-identical wire replies
+        if self.svc_adoptions:
+            counters[proto.KEY_SVC_ADOPTIONS] = self.svc_adoptions
+        if self.svc_adopt_wait_usec:
+            counters[proto.KEY_SVC_ADOPT_WAIT] = self.svc_adopt_wait_usec
+        return counters
 
     def note_master_contact(self) -> None:
         """A master request arriving AFTER a /benchresult that attached
@@ -219,6 +256,72 @@ class ServiceState:
         (/interruptphase at run end / teardown), which must not count as
         a crashed master."""
         self._lease_secs = 0
+
+    def adopt(self, params: dict) -> "tuple[int, dict]":
+        """Master-failover takeover handshake (/adopt): a new master
+        claims this host's in-flight run. Validated against the
+        credentials the DEAD master advertised at /preparephase — bench
+        UUID, takeover token, and journal fingerprint all come from its
+        journal, so only a master resuming the very same journal can
+        adopt. Runs under route_lock (handler) plus the teardown lock
+        (the lease watchdog contends for the awaiting state). Legal
+        even before lease expiry: a warm standby may beat the grace
+        window."""
+        with self._teardown_lock:
+            manager = self.manager
+            if manager is None:
+                return (409, {"Error": "nothing to adopt: no worker pool"
+                                       " (orphan recovery already ran?)"})
+            if not self._adopt_token:
+                return (403, {"Error": "host holds no takeover "
+                                       "credentials (--svcadoptsecs was "
+                                       "not armed at /preparephase)"})
+            if params.get(proto.KEY_TAKEOVER_TOKEN, "") != \
+                    self._adopt_token:
+                return (403, {"Error": "takeover token mismatch (stale "
+                                       "token from an older run?)"})
+            fingerprint = params.get(proto.KEY_JOURNAL_FINGERPRINT, "")
+            if self._adopt_fingerprint \
+                    and fingerprint != self._adopt_fingerprint:
+                return (403, {"Error": "journal fingerprint mismatch: "
+                                       "the adopter resumed a different "
+                                       "journal than the dead master's"})
+            shared = manager.shared
+            bench_id = params.get(proto.KEY_BENCH_ID, "")
+            if shared.bench_uuid and bench_id != shared.bench_uuid:
+                return (409, {"Error": "bench UUID mismatch: this host "
+                                       "runs a different phase than the "
+                                       "adopter's journal describes"})
+            self.svc_adoptions += 1
+            if self._awaiting_adoption:
+                wait_usec = int(
+                    (time.monotonic() - self._adopt_wait_started) * 1e6)
+                if wait_usec > self.svc_adopt_wait_usec:
+                    self.svc_adopt_wait_usec = wait_usec
+                self._awaiting_adoption = False
+            # any pending span-ring ship went to the DEAD master: drop
+            # the mark WITHOUT promoting it, so the scrub keeps treating
+            # the local ring file as the only copy
+            self._trace_ship_pending = ""
+            cfg = self.cfg
+            lease_secs = cfg.svc_lease_secs or self.base_cfg.svc_lease_secs
+            self._arm_lease(lease_secs)
+            reply = {
+                proto.KEY_BENCH_PATH_TYPE: int(cfg.bench_path_type),
+                proto.KEY_NUM_BENCH_PATHS: len(cfg.paths),
+                "FileSize": cfg.file_size,
+                "BlockSize": cfg.block_size,
+                "RandomAmount": cfg.random_amount,
+                proto.KEY_BENCH_ID: shared.bench_uuid,
+                proto.KEY_PHASE_CODE: int(shared.current_phase),
+                proto.KEY_NUM_WORKERS_DONE: shared.num_workers_done,
+                proto.KEY_ERROR_HISTORY: logger.get_error_history(),
+            }
+            if lease_secs:
+                reply[proto.KEY_SVC_LEASE_SECS] = lease_secs
+            if self._adopt_grace_secs:
+                reply[proto.KEY_SVC_ADOPT_SECS] = self._adopt_grace_secs
+            return (200, reply)
 
     def cheap_live_signature(self) -> tuple:
         """Completion-relevant snapshot for the stream session's tick
@@ -263,6 +366,26 @@ class ServiceState:
                 secs = self._lease_secs
                 if not secs or self.manager is None:
                     continue
+                if self._awaiting_adoption:
+                    # adoption grace (--svcadoptsecs): workers stay
+                    # alive and nothing is scrubbed — a takeover
+                    # master's /adopt clears this state; expiry falls
+                    # through to the unchanged orphan recovery
+                    wait = time.monotonic() - self._adopt_wait_started
+                    if wait < self._adopt_grace_secs:
+                        continue
+                    self._awaiting_adoption = False
+                    wait_usec = int(wait * 1e6)
+                    if wait_usec > self.svc_adopt_wait_usec:
+                        self.svc_adopt_wait_usec = wait_usec
+                    logger.log_error(
+                        f"adoption grace expired: no master adopted "
+                        f"this host within --svcadoptsecs "
+                        f"{self._adopt_grace_secs}s; falling back to "
+                        f"orphan recovery")
+                    self._orphan_recover(
+                        time.monotonic() - self._lease_last_contact, secs)
+                    continue
                 # the expiry clock runs only while a phase is ACTIVE on
                 # this host: once our workers finished (or before the
                 # first /startphase) the master legitimately goes silent
@@ -280,6 +403,16 @@ class ServiceState:
                     continue
                 age = time.monotonic() - self._lease_last_contact
                 if age < secs:
+                    continue
+                if self._adopt_grace_secs and self._adopt_token:
+                    self._awaiting_adoption = True
+                    self._adopt_wait_started = time.monotonic()
+                    logger.log_error(
+                        f"AWAITING ADOPTION — master lease expired (no "
+                        f"master contact for {age:.1f}s, --svcleasesecs "
+                        f"{secs}); keeping workers and run state alive "
+                        f"for --svcadoptsecs {self._adopt_grace_secs}s "
+                        f"so a takeover master may /adopt this host")
                     continue
                 self._orphan_recover(age, secs)
 
@@ -312,6 +445,12 @@ class ServiceState:
         re-arms tracing per /preparephase). The master's COLLECTED
         copies — the fleet-trace inputs — live on the master and are
         untouched by this."""
+        if self._awaiting_adoption:
+            # a takeover master may still claim this run: its uploaded
+            # prep files, per-host trace rings, and slow-op state must
+            # survive the grace window (the scrub re-runs on grace
+            # expiry via orphan recovery, or at the adopted run's end)
+            return
         d = os.path.join(SVC_TMP_DIR,
                          f"elbencho_tpu_{getpass.getuser()}"
                          f"_p{self.base_cfg.service_port}")
@@ -379,6 +518,11 @@ class ServiceState:
             manager.check_phase_time_limit(self.phase_start_monotonic)
         stats = statistics.get_live_stats_dict()
         stats.update(self.lease_counters())
+        if self._awaiting_adoption:
+            # present ONLY during the grace window — the standby's (and
+            # any observer's) takeover trigger; absent otherwise so
+            # flags-off status replies stay byte-identical
+            stats[proto.KEY_AWAITING_ADOPTION] = 1
         return stats
 
     def bench_result(self, params: "dict | None" = None) -> dict:
@@ -670,6 +814,9 @@ def _make_handler(state: ServiceState, server_holder: dict):
                         body = (body[:-1] + "," if body != "{}"
                                 else "{") + ",".join(splices) + "}"
                         self._reply(200, body)
+                elif route == proto.PATH_ADOPT:
+                    code, reply = state.adopt(params)
+                    self._reply(code, reply)
                 elif route == proto.PATH_START_PHASE:
                     code, msg = state.start_phase(
                         int(params.get(proto.KEY_PHASE_CODE, 0)),
@@ -777,6 +924,7 @@ class HTTPService:
             print(f"ERROR: cannot bind service port {cfg.service_port}: "
                   f"{err}", file=sys.stderr)
             return 1
+        self._install_signal_handlers(state, holder)
         logger.log(0, f"elbencho-tpu service listening on port "
                       f"{cfg.service_port}")
         try:
@@ -785,9 +933,43 @@ class HTTPService:
         except KeyboardInterrupt:
             pass
         finally:
+            # deliberate exit: release the lease (never an expiry) and
+            # scrub temp state like --quit does — the scrub itself
+            # spares a host parked in the awaiting-adoption state
+            state.release_lease()
             state.close()  # lease watchdog + worker pool
+            state._cleanup_run_temp_files()
             server.server_close()
         return 0
+
+    @staticmethod
+    def _install_signal_handlers(state: ServiceState, holder: dict) -> None:
+        """Two-stage SIGTERM/SIGINT for the service role (the service
+        analogue of the coordinator's master-side handler): the FIRST
+        signal requests a graceful exit — finish the in-flight request,
+        release the lease deliberately so the shutdown never counts as a
+        crashed master, scrub temp state, exit 0. A SECOND signal
+        restores the default disposition and re-raises it, so a wedged
+        teardown can always be killed the hard way."""
+        import signal
+
+        def _handle(signum, _frame):
+            if holder.get("signal_seen"):
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+                return
+            holder["signal_seen"] = True
+            holder["shutdown"] = True
+            state.release_lease()
+            logger.log(0, "service: shutdown signal received — finishing "
+                          "in-flight request, then exiting (signal again "
+                          "to force-kill)")
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _handle)
+            except ValueError:
+                pass  # not the main thread (embedded/test harness use)
 
     def _daemonize(self) -> None:
         """Double-fork daemonization with logfile + single-instance lock

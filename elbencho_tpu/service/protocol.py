@@ -25,6 +25,10 @@ PATH_METRICS = "/metrics"
 # live-stats stream of delta-encoded ndjson frames (--svcstream), also
 # the parent->child attachment point of the --svcfanout aggregation tree
 PATH_LIVE_STREAM = "/livestream"
+# master failover (ours; docs/fault-tolerance.md "Master failover"): a
+# replacement master claims an awaiting-adoption host — validated by
+# bench UUID + journal fingerprint + takeover token under route_lock
+PATH_ADOPT = "/adopt"
 
 # transferred parameter keys (reference: XFER_*, Common.h:251-298)
 KEY_PROTOCOL_VERSION = "ProtocolVersion"
@@ -85,6 +89,20 @@ HDR_SVC_CLOCK = "X-Svc-Clock-Usec"
 KEY_SHIP_SLOWOPS = "ShipSlowOps"
 KEY_SLOWOPS = "SlowOps"
 KEY_SLOWOPS_REFUSED = "SlowOpsRefused"
+# master failover (--svcadoptsecs / --resume --adopt; docs/
+# fault-tolerance.md "Master failover"): the takeover token + journal
+# fingerprint ride /preparephase (stashed by the service as the /adopt
+# credentials) and /adopt (presented by the claiming master);
+# AwaitingAdoption appears in /status ONLY while a host is in the
+# adoption grace window, and the service-observed adoption counters
+# ride /status + /benchresult ONLY when nonzero — flags-off wire
+# traffic stays byte-identical
+KEY_TAKEOVER_TOKEN = "TakeoverToken"
+KEY_JOURNAL_FINGERPRINT = "JournalFingerprint"
+KEY_SVC_ADOPT_SECS = "SvcAdoptSecs"
+KEY_AWAITING_ADOPTION = "AwaitingAdoption"
+KEY_SVC_ADOPTIONS = "SvcAdoptions"
+KEY_SVC_ADOPT_WAIT = "SvcAdoptWaitUsec"
 
 
 def make_pw_hash(secret: str) -> str:

@@ -410,6 +410,15 @@ class RemoteWorker(Worker):
         self.svc_heartbeat_age_hwm_usec = 0
         self.svc_lease_expiries = 0
         self.svc_lease_age_hwm_usec = 0
+        # master failover (--resume --adopt; CONTROL_AUDIT_COUNTERS):
+        # MasterTakeovers is master-observed (1 on the phase this worker
+        # claimed its host via /adopt); the SvcAdopt pair mirrors
+        # SERVICE-observed lifetime values ingested like the lease pair
+        self.master_takeovers = 0
+        self.svc_adoptions = 0
+        self.svc_adopt_wait_usec = 0
+        self._took_over = False       # this worker claimed its host
+        self._takeover_counted = False
         # streaming control plane audit (--svcstream; master-observed,
         # CONTROL_AUDIT_COUNTERS schema — docs/control-plane.md)
         self.svc_requests = 0
@@ -482,6 +491,9 @@ class RemoteWorker(Worker):
         self.svc_heartbeat_age_hwm_usec = 0
         self.svc_lease_expiries = 0
         self.svc_lease_age_hwm_usec = 0
+        self.master_takeovers = 0
+        self.svc_adoptions = 0
+        self.svc_adopt_wait_usec = 0
         self.svc_requests = 0
         self.svc_ctl_bytes = 0
         self.svc_stream_frames = 0
@@ -515,8 +527,15 @@ class RemoteWorker(Worker):
 
     def _run_phases(self) -> None:
         self._check_protocol_version()
-        self._prepare_remote_files()
-        self._prepare_phase_remote()
+        if getattr(self.cfg, "adopt_run", False) \
+                and getattr(self.cfg, "takeover_token", ""):
+            # --resume --adopt: claim the dead master's live service via
+            # /adopt — the pool-rebuilding /preparephase would kill the
+            # very in-flight work the takeover exists to preserve
+            self._adopt_remote_phase()
+        else:
+            self._prepare_remote_files()
+            self._prepare_phase_remote()
         last_uuid = self.shared.bench_uuid
         self.shared.inc_num_workers_done()  # prep barrier
         while True:
@@ -720,6 +739,15 @@ class RemoteWorker(Worker):
         pool): retried on connect-level failures only."""
         cfg_dict = self.cfg.to_service_dict(
             service_rank_offset=self.host_idx * self.cfg.num_threads)
+        token = getattr(self.cfg, "takeover_token", "")
+        if token:
+            # master failover: the takeover credentials ride the config
+            # wire as protocol extras, present ONLY when the coordinator
+            # armed them (--svcadoptsecs > 0 with a journal) — without
+            # them the POST body stays byte-identical
+            cfg_dict[proto.KEY_TAKEOVER_TOKEN] = token
+            cfg_dict[proto.KEY_JOURNAL_FINGERPRINT] = getattr(
+                self.cfg, "journal_fingerprint", "")
         trace_params, flow_id = self._trace_params()
         tracer = self.shared.tracer
         t0_ns = tracer.now_ns() if tracer is not None else 0
@@ -735,6 +763,35 @@ class RemoteWorker(Worker):
                 f"preparation on {self.host} failed: "
                 f"{reply.get('Error', reply)}")
         self.bench_path_info = reply
+
+    def _adopt_remote_phase(self) -> None:
+        """Takeover handshake (--resume --adopt): GET /adopt with the
+        dead master's journaled credentials — bench UUID, takeover
+        token, journal fingerprint — so the service re-arms its lease
+        for THIS master and keeps its in-flight phase running. The reply
+        doubles as the bench path info a /preparephase would have
+        returned. Non-idempotent retry shape: a lost reply must not
+        double-count the service's SvcAdoptions."""
+        params = {
+            proto.KEY_BENCH_ID: getattr(self.cfg, "adopt_bench_uuid", ""),
+            proto.KEY_TAKEOVER_TOKEN: self.cfg.takeover_token,
+            proto.KEY_JOURNAL_FINGERPRINT:
+                getattr(self.cfg, "journal_fingerprint", ""),
+        }
+        status, reply = self.client.get_json(proto.PATH_ADOPT, params,
+                                             idempotent=False)
+        self._replay_error_history(reply)
+        if status != 200:
+            raise WorkerRemoteException(
+                f"takeover of {self.host} failed ({status}): "
+                f"{reply.get('Error', reply)}")
+        self.bench_path_info = reply
+        self._ingest_lease_counters(reply)
+        self._took_over = True
+        logger.log(0, f"adopted {self.host}: service accepted takeover "
+                      f"(phase code {reply.get(proto.KEY_PHASE_CODE, 0)}, "
+                      f"{reply.get(proto.KEY_NUM_WORKERS_DONE, 0)} "
+                      f"worker(s) already done)")
 
     def _start_remote_phase(self, phase: BenchPhase, bench_id: str) -> None:
         self._expected_bench_id = bench_id
@@ -752,6 +809,11 @@ class RemoteWorker(Worker):
             raise WorkerRemoteException(
                 f"phase start on {self.host} failed: "
                 f"{reply.get('Message', reply)}")
+        if self._took_over and not self._takeover_counted:
+            # lands exactly once, on the adopted phase: reset_stats
+            # zeroed the counter before this worker woke for the phase
+            self._takeover_counted = True
+            self.master_takeovers = 1
         if getattr(self.shared, "stream_control", None) is not None:
             # streaming mode: live stats ride the stream connection; an
             # idle parked request socket per host would defeat the
@@ -1137,7 +1199,9 @@ class RemoteWorker(Worker):
         self._ingest_live_telemetry({
             "TpuHbmBytes": 0, "IOLatHisto": {}, "EntLatHisto": {},
             proto.KEY_SVC_LEASE_EXPIRIES: 0,
-            proto.KEY_SVC_LEASE_AGE_HWM: 0})
+            proto.KEY_SVC_LEASE_AGE_HWM: 0,
+            proto.KEY_SVC_ADOPTIONS: 0,
+            proto.KEY_SVC_ADOPT_WAIT: 0})
 
     def _raise_host_failure(self, kind: str, stalled_secs: int = 0):
         """The per-host failure exceptions, shared by the polling loop
@@ -1164,6 +1228,12 @@ class RemoteWorker(Worker):
             self.svc_lease_expiries = reply[proto.KEY_SVC_LEASE_EXPIRIES]
             self.svc_lease_age_hwm_usec = reply.get(
                 proto.KEY_SVC_LEASE_AGE_HWM, 0)
+        # adoption counters are on the wire only when nonzero (master
+        # failover); absent keys leave the mirrors untouched
+        if proto.KEY_SVC_ADOPTIONS in reply:
+            self.svc_adoptions = reply[proto.KEY_SVC_ADOPTIONS]
+        if proto.KEY_SVC_ADOPT_WAIT in reply:
+            self.svc_adopt_wait_usec = reply[proto.KEY_SVC_ADOPT_WAIT]
 
     def _replay_error_history(self, reply: dict) -> "list[str]":
         """Log the service's error-history lines under this host's prefix

@@ -532,6 +532,12 @@ def diff_recordings(rec_a: dict, rec_b: dict) -> "list[dict]":
             if ana_b["Verdict"] != ana_a["Verdict"]:
                 causes.append(f"verdict changed {ana_a['Verdict']} -> "
                               f"{ana_b['Verdict']}")
+        # a phase finished by a successor master (--resume --adopt) is
+        # not comparable like-for-like: part of it ran masterless, so a
+        # rate delta may be the takeover, not the workload
+        if end_b.get("Totals", {}).get("MasterTakeovers", 0):
+            causes.append("completed after takeover (a successor master "
+                          "adopted the phase mid-flight)")
         regressed = rate_a > 0 and ratio is not None \
             and ratio <= (1.0 - REGRESSION_RATE_DROP)
         report.append({

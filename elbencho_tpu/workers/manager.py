@@ -126,11 +126,12 @@ class WorkerManager:
                     f"worker preparation failed: {shared.first_error}")
             shared.num_workers_done = 0
 
-    def start_next_phase(self, phase: BenchPhase) -> str:
+    def start_next_phase(self, phase: BenchPhase,
+                         bench_uuid: str = "") -> str:
         for worker in self.workers:
             worker.reset_stats()  # keeps degraded hosts excluded
         self._error_interrupt_sent = False
-        return self.shared.start_phase(phase)
+        return self.shared.start_phase(phase, bench_uuid=bench_uuid)
 
     def check_fail_fast_interrupt(self) -> None:
         """True fail-fast: the moment one worker errors out, interrupt the

@@ -101,9 +101,15 @@ class WorkersSharedData:
 
     # -- phase control (coordinator side) -----------------------------------
 
-    def start_phase(self, phase: BenchPhase) -> str:
+    def start_phase(self, phase: BenchPhase,
+                    bench_uuid: str = "") -> str:
         """Set new phase + fresh bench UUID and wake all workers
-        (reference: WorkerManager::startNextPhase, WorkerManager.cpp:292)."""
+        (reference: WorkerManager::startNextPhase, WorkerManager.cpp:292).
+        ``bench_uuid`` forces a specific UUID instead of minting one:
+        master runs pre-mint the UUID so it can be journaled before
+        /startphase, and a --resume --adopt takeover re-presents the
+        dead master's UUID so the fleet's duplicate-start idempotency
+        keeps the in-flight phase running instead of restarting it."""
         with self.cond:
             # latch BEFORE the flags reset: a write phase that ended via
             # --timelimit expiry, an interrupt, or a worker error left a
@@ -114,7 +120,7 @@ class WorkersSharedData:
                     or self.num_workers_done_with_error):
                 self.partial_dataset = True
             self.current_phase = phase
-            self.bench_uuid = str(uuid_mod.uuid4())
+            self.bench_uuid = bench_uuid or str(uuid_mod.uuid4())
             self.num_workers_done = 0
             self.num_workers_done_with_error = 0
             self.stonewall_triggered = False

@@ -584,13 +584,14 @@ def test_summarize_appends_tuned_columns_and_banners(tmp_path):
          str(jf), "--csv"], capture_output=True, text=True)
     assert res.returncode == 0, res.stderr
     header = res.stdout.splitlines()[0].split(",")
-    assert header[-2:] == ["Tuned", "Gain%"]
+    # the master-failover Adopt/Takeover pair appends after Tuned/Gain%
+    assert header[-4:-2] == ["Tuned", "Gain%"]
     rows = [ln.split(",") for ln in res.stdout.splitlines()[1:]]
     assert all(row[0] != "AUTOTUNE" for row in rows)  # bannered out
     read_row = next(r for r in rows if r[0] == "READ")
-    assert read_row[-2:] == ["yes", "12.5"]
+    assert read_row[-4:-2] == ["yes", "12.5"]
     write_row = next(r for r in rows if r[0] == "WRITE")
-    assert write_row[-2:] == ["", ""]
+    assert write_row[-4:-2] == ["", ""]
     assert "AUTOTUNE [plateau, 7 probes]: +12.5%" in res.stderr
     assert "threads=4" in res.stderr
 

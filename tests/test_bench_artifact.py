@@ -359,6 +359,20 @@ def test_fallback_ladder_lands_tier_labeled_number_fast():
     assert isinstance(tune["gain_pct"], (int, float))
     assert tune["chosen"], "tuned knob map missing"
     assert tune["probes"] >= 1
+    # the takeover rider (master-failover satellite): every measured
+    # tier also carries tier-labeled failover evidence — a short fleet
+    # run whose master was SIGKILLed mid-phase, adopted by a successor
+    # (--resume --adopt), and completed without restarting the phase
+    takeover = rec.get("takeover")
+    assert isinstance(takeover, dict)
+    assert takeover["tier"] == rec["fallback_tier"]
+    if "error" not in takeover:
+        assert takeover["killed_mid_phase"] is True
+        assert takeover["adopted_hosts"] == 2
+        assert takeover["inflight_phase"] == "WRITE"
+        assert takeover["master_takeovers"] == 2
+        assert takeover["svc_adoptions"] == 2
+        assert takeover["completed"] is True
 
 
 @pytest.mark.slow

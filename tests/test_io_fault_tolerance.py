@@ -498,17 +498,18 @@ def test_summarize_json_columns_and_degraded_tpu_banner(tmp_path):
     row = out.stdout.splitlines()[1].split(",")
     # appended after every pre-existing column, never reordered (the
     # staging-pool, run-lifecycle, streaming-control-plane, pod-slice,
-    # and latency-percentile columns append after the fault-tolerance
-    # block)
-    assert header[-29:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    # latency-percentile, and master-failover columns append after the
+    # fault-tolerance block)
+    assert header[-31:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                             "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                             "PoolReuse", "RegOps", "SqpollOps",
                             "LeaseExp", "Resumed", "StreamB", "DeltaSave",
                             "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
                             "LatP50", "LatP99", "LatP99.9",
                             "Scenario", "Step", "EpochRate",
-                            "TailX", "TailOwner", "Tuned", "Gain%"]
-    assert row[-24:-21] == ["4", "2", "1"]
+                            "TailX", "TailOwner", "Tuned", "Gain%",
+                            "Adopt", "Takeover"]
+    assert row[-26:-23] == ["4", "2", "1"]
     assert "DEGRADED-TPU" in out.stderr
     # clean records: no banner
     jf.write_text(json.dumps({"Phase": "READ"}) + "\n")

@@ -433,8 +433,8 @@ def test_summarize_json_slice_columns(tmp_path):
     assert out.returncode == 0, out.stderr
     header = out.stdout.splitlines()[0].split(",")
     row = out.stdout.splitlines()[1].split(",")
-    assert header[-13:-10] == ["ShardMiB", "IciMiB", "IciGbps"]
-    assert row[-13:-10] == ["16", "16", "12.5"]
+    assert header[-15:-12] == ["ShardMiB", "IciMiB", "IciGbps"]
+    assert row[-15:-12] == ["16", "16", "12.5"]
     # pre-existing columns keep their positions (appended, not inserted)
     assert header.index("Stalls") < header.index("ShardMiB")
 

@@ -330,14 +330,215 @@ def test_control_audit_schema_gained_lease_counters_appended():
                         "SvcAggDepthHwm", "SvcConnHwm",
                         # fleet straggler attribution appended by the
                         # fleet-tracing PR — again at the END only
-                        "StragglerSkewUsec", "BarrierWaitUSec"]
+                        "StragglerSkewUsec", "BarrierWaitUSec",
+                        # master-failover trio appended by the takeover
+                        # PR — again at the END only
+                        "MasterTakeovers", "SvcAdoptions",
+                        "SvcAdoptWaitUsec"]
     w1 = types.SimpleNamespace(svc_lease_expiries=2,
-                               svc_lease_age_hwm_usec=5000)
+                               svc_lease_age_hwm_usec=5000,
+                               master_takeovers=1, svc_adoptions=1,
+                               svc_adopt_wait_usec=4000)
     w2 = types.SimpleNamespace(svc_lease_expiries=1,
-                               svc_lease_age_hwm_usec=9000)
+                               svc_lease_age_hwm_usec=9000,
+                               master_takeovers=1, svc_adoptions=1,
+                               svc_adopt_wait_usec=1500)
     merged = merge_control_audit_counters([w1, w2])
     assert merged["SvcLeaseExpiries"] == 3       # sum
     assert merged["SvcLeaseAgeHwmUsec"] == 9000  # max
+    # failover trio: takeover/adoption counts sum across hosts, the
+    # adoption wait is a fleet-wide high-water mark — and because sum
+    # and max are both associative, a --svcfanout tree merge equals the
+    # flat merge by construction
+    assert merged["MasterTakeovers"] == 2        # sum
+    assert merged["SvcAdoptions"] == 2           # sum
+    assert merged["SvcAdoptWaitUsec"] == 4000    # max
+    inner = merge_control_audit_counters([w1])
+    leaf = types.SimpleNamespace(
+        **{attr: inner[key]
+           for attr, key, _mode in CONTROL_AUDIT_COUNTERS})
+    assert merge_control_audit_counters([leaf, w2]) == merged, \
+        "tree merge (aggregated leaf + sibling) must equal flat merge"
+
+
+# ---------------------------------------------------------------------------
+# unit layer: master failover — /adopt handshake + adoption grace state
+# ---------------------------------------------------------------------------
+
+def test_adopt_validates_token_fingerprint_and_bench_uuid():
+    """/adopt refusal chain (docs/fault-tolerance.md "Master failover"):
+    only a master resuming the DEAD master's journal — same token, same
+    fingerprint, same in-flight bench UUID — may claim the host."""
+    from elbencho_tpu.service import protocol as proto
+    state = _service_state()
+    # nothing prepared on this host
+    code, body = state.adopt({proto.KEY_TAKEOVER_TOKEN: "tok"})
+    assert code == 409 and "nothing to adopt" in body["Error"]
+    mgr = _FakeManager()
+    mgr.shared.num_workers_done = 1
+    state.manager = mgr
+    state.cfg = state.base_cfg  # adopt replies with bench-path info
+    # pool alive, but the dead master never armed --svcadoptsecs
+    code, body = state.adopt({proto.KEY_TAKEOVER_TOKEN: "tok"})
+    assert code == 403 and "no takeover credentials" in body["Error"]
+    state._adopt_token = "tok"
+    state._adopt_fingerprint = "fp"
+    state._adopt_grace_secs = 30
+    # stale token (e.g. journal from an OLDER run against this host)
+    code, body = state.adopt({proto.KEY_TAKEOVER_TOKEN: "old",
+                              proto.KEY_JOURNAL_FINGERPRINT: "fp",
+                              proto.KEY_BENCH_ID: "x"})
+    assert code == 403 and "token mismatch" in body["Error"]
+    # right token, different journal
+    code, body = state.adopt({proto.KEY_TAKEOVER_TOKEN: "tok",
+                              proto.KEY_JOURNAL_FINGERPRINT: "other",
+                              proto.KEY_BENCH_ID: "x"})
+    assert code == 403 and "fingerprint mismatch" in body["Error"]
+    # right credentials, wrong in-flight phase
+    code, body = state.adopt({proto.KEY_TAKEOVER_TOKEN: "tok",
+                              proto.KEY_JOURNAL_FINGERPRINT: "fp",
+                              proto.KEY_BENCH_ID: "zzz"})
+    assert code == 409 and "bench UUID mismatch" in body["Error"]
+    assert state.svc_adoptions == 0, "refusals must not count"
+    # the real handshake: clears the grace state, records the wait HWM,
+    # re-arms the lease for the NEW master, echoes the run snapshot
+    state._awaiting_adoption = True
+    state._adopt_wait_started = time.monotonic() - 1.5
+    code, body = state.adopt({proto.KEY_TAKEOVER_TOKEN: "tok",
+                              proto.KEY_JOURNAL_FINGERPRINT: "fp",
+                              proto.KEY_BENCH_ID: "x"})
+    assert code == 200
+    assert body[proto.KEY_BENCH_ID] == "x"
+    assert body[proto.KEY_PHASE_CODE] == int(BenchPhase.CREATEFILES)
+    assert body[proto.KEY_NUM_WORKERS_DONE] == 1
+    assert state.svc_adoptions == 1
+    assert not state._awaiting_adoption
+    assert state.svc_adopt_wait_usec >= 1_000_000
+    assert state.manager is mgr and mgr.joins == 0, \
+        "adoption must keep the in-flight pool untouched"
+    # nonzero adoption counters now ride the lease-counter reply
+    counters = state.lease_counters()
+    assert counters["SvcAdoptions"] == 1
+    assert counters["SvcAdoptWaitUsec"] >= 1_000_000
+    state._lease_stop.set()
+
+
+def test_lease_expiry_with_grace_awaits_then_falls_back_to_orphan():
+    """Armed grace (--svcadoptsecs + token): lease expiry parks the host
+    in awaiting-adoption — workers alive, nothing scrubbed, the state
+    visible in /status — and grace expiry falls through to the
+    UNCHANGED orphan recovery."""
+    state = _service_state()
+    mgr = _FakeManager()
+    mgr.shared.num_workers_done = 0
+    cleared = []
+    mgr.shared.clear_bench_uuid = lambda: cleared.append(True)
+    state.manager = mgr
+    state.statistics = types.SimpleNamespace(
+        get_live_stats_dict=lambda: {"PhaseCode": 1})
+    state._adopt_token = "tok"
+    state._adopt_grace_secs = 30
+    state._arm_lease(1)
+    state._lease_last_contact -= 10  # lease long expired
+    _wait_for(lambda: state._awaiting_adoption, timeout=5,
+              what="awaiting-adoption grace state")
+    assert state.manager is mgr and mgr.joins == 0, \
+        "grace must keep the worker pool alive"
+    assert state.lease_expiries == 0, "grace is not an expiry (yet)"
+    assert state.status().get("AwaitingAdoption") == 1, \
+        "/status must advertise the grace window (standby trigger)"
+    # the temp-file scrub is deferred while a takeover master may still
+    # claim the run's upload dir / trace rings / slow-op state
+    state._trace_files.add("/tmp/_rl_adopt_trace.r0.json")
+    state._trace_shipped.add("/tmp/_rl_adopt_trace.r0.json")
+    state._cleanup_run_temp_files()
+    assert state._trace_files, "scrub must be deferred during grace"
+    # no adopter within the grace window => plain orphan recovery
+    state._adopt_wait_started -= 60
+    _wait_for(lambda: state.manager is None, timeout=5,
+              what="orphan recovery after grace expiry")
+    assert state.lease_expiries == 1
+    assert cleared, "orphan recovery must clear the bench UUID"
+    assert not state._awaiting_adoption
+    assert state.svc_adopt_wait_usec >= 30_000_000, \
+        "the futile grace wait must land in the HWM counter"
+    assert not state._trace_files, \
+        "grace expiry must run the scrub it deferred"
+    state.statistics = None
+    assert "AwaitingAdoption" not in state.status()
+    state._lease_stop.set()
+
+
+def test_failover_state_is_invisible_without_master_credentials():
+    """Off-path parity: no token => no grace, no adoption keys in any
+    reply — a service-side --svcadoptsecs default alone must NOT arm
+    grace (a host without credentials could never be adopted), and the
+    zero counters never ride the wire."""
+    state = _service_state()
+    assert set(state.lease_counters()) == {"SvcLeaseExpiries",
+                                           "SvcLeaseAgeHwmUsec"}
+    assert "AwaitingAdoption" not in state.status()
+    state.base_cfg.svc_adopt_secs = 60  # service-side default, no token
+    mgr = _FakeManager()
+    state.manager = mgr
+    state._arm_lease(1)
+    state._lease_last_contact -= 10
+    _wait_for(lambda: state.manager is None, timeout=5,
+              what="straight-to-orphan recovery")
+    assert state.lease_expiries == 1
+    assert not state._awaiting_adoption, \
+        "no credentials => the grace state must never arm"
+    assert state.svc_adoptions == 0 and state.svc_adopt_wait_usec == 0
+    state._lease_stop.set()
+
+
+def test_service_dict_never_carries_master_failover_state(tmp_path):
+    """The config wire stays clean: takeover credentials are protocol
+    extras added by RemoteWorker ONLY when armed, and master-side
+    failover orchestration flags are neutralized for the service."""
+    from elbencho_tpu.config.args import BenchConfig
+    from elbencho_tpu.service import protocol as proto
+    cfg = _cfg(extra=["--svcadoptsecs", "30"])
+    cfg.adopt_run = True     # master-side only; must not ship
+    cfg.standby_str = "x:1"  # master-side only; must not ship
+    d = cfg.to_service_dict()
+    assert proto.KEY_TAKEOVER_TOKEN not in d
+    assert proto.KEY_JOURNAL_FINGERPRINT not in d
+    svc_cfg = BenchConfig.from_service_dict(d, derive=False)
+    assert svc_cfg.adopt_run is False
+    assert svc_cfg.standby_str == ""
+
+
+def test_standby_stands_down_on_a_complete_journal(tmp_path):
+    """The standby's end-of-watch signal is the journal's run_complete
+    record — reached before any HTTP poll, so a finished primary never
+    leaves a standby spinning against a dead port."""
+    from elbencho_tpu.coordinator import Coordinator
+    journal = tmp_path / "j.jsonl"
+    cfg = _cfg(extra=["--journal", str(journal)])
+    j = RunJournal(str(journal), cfg)
+    j.run_start([BenchPhase.CREATEFILES], 1)
+    j.phase_start(0, 0, BenchPhase.CREATEFILES)
+    j.phase_finish(0, 0, BenchPhase.CREATEFILES, {})
+    j.run_complete()
+    j.close()
+    # port 1 has no listener — a poll would fail loudly; run_complete
+    # must win before the standby ever polls
+    cfg.standby_str = "127.0.0.1:1"
+    rc = Coordinator(cfg)._run_standby()
+    assert rc == 0
+
+
+def test_standby_flag_validation():
+    """--standby is a dedicated role: it needs the shared journal and
+    excludes the roles it would itself assume (or serve)."""
+    with pytest.raises(ConfigError, match="journal"):
+        _cfg(extra=["--standby", "127.0.0.1:1"]).check()
+    with pytest.raises(ConfigError):
+        _cfg(extra=["--standby", "127.0.0.1:1", "--journal", "/tmp/_rl_j",
+                    "--resume"]).check()
+    with pytest.raises(ConfigError, match="--resume"):
+        _cfg(extra=["--adopt"]).check()
 
 
 def test_abort_cleanup_removes_only_headeronly_live_files(tmp_path):
@@ -693,7 +894,7 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     reordered) and a resumed record triggers the RESUMED banner."""
     import subprocess as sp
     rec = {"Phase": "WRITE", "EntriesLast": 1, "SvcLeaseExpiries": 2,
-           "Resumed": 3}
+           "Resumed": 3, "SvcAdoptions": 2, "MasterTakeovers": 2}
     f = tmp_path / "r.json"
     f.write_text(json.dumps(rec) + "\n")
     res = sp.run([sys.executable,
@@ -704,9 +905,216 @@ def test_summarize_appends_lease_and_resumed_columns(tmp_path, capsys):
     header = res.stdout.splitlines()[0].split(",")
     # the streaming-control-plane trio + pod-slice trio append after the
     # lifecycle pair (never reordered; the --autotune Tuned/Gain% pair
-    # shifted the tail by two)
-    assert header[-18:-16] == ["LeaseExp", "Resumed"]
+    # and the failover Adopt/Takeover pair each shifted the tail by two)
+    assert header[-20:-18] == ["LeaseExp", "Resumed"]
     assert header.index("Stalls") < header.index("LeaseExp")
+    # the master-failover pair appends at the very END
+    assert header[-2:] == ["Adopt", "Takeover"]
     row = res.stdout.splitlines()[1].split(",")
-    assert row[-18:-16] == ["2", "3"]
+    assert row[-20:-18] == ["2", "3"]
+    assert row[-2:] == ["2", "2"]
     assert "RESUMED" in res.stderr
+    # a takeover-completed record also triggers the ADOPTED banner
+    assert "ADOPTED" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# acceptance: master SIGKILL'd mid-phase => a --resume --adopt successor
+# takes over the fleet and the in-flight phase completes WITHOUT restarting
+# ---------------------------------------------------------------------------
+
+def _journal_recs_tolerant(path):
+    """Journal records with a possibly-torn final line (the writer may be
+    mid-append while we poll)."""
+    recs = []
+    with open(path) as f:
+        for ln in f:
+            with contextlib.suppress(ValueError):
+                recs.append(json.loads(ln))
+    return recs
+
+
+def _failover_env():
+    env = default_env()
+    env["ELBENCHO_TPU_NO_NATIVE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELBENCHO_TPU_NO_DEFAULT_RESFILES"] = "1"
+    return env
+
+
+def _failover_fleet_args(ports, journal, data, adopt_secs=60,
+                         timelimit=10):
+    """Single long WRITE phase with a wide crash window: 2MB/s/thread
+    rate limit over a 32M file => ~8s of writing (16M per host on a
+    2-host fleet), no setup legs that would eat the per-phase
+    --timelimit before the kill can land."""
+    return ["--hosts", ",".join(f"127.0.0.1:{p}" for p in ports),
+            "--journal", str(journal), "--svcleasesecs", "2",
+            "--svcadoptsecs", str(adopt_secs), "--svcupint", "100",
+            "-w", "-t", "1", "-s", "32M", "-b", "64K",
+            "--limitwrite", "2M", "--timelimit", str(timelimit),
+            str(data)]
+
+
+def _wait_write_inflight(journal, master):
+    """Wait until the journal shows an in-flight WRITE (started, neither
+    finished nor interrupted) while the master is still alive."""
+    def _inflight():
+        if master.poll() is not None:
+            raise AssertionError(
+                f"master exited rc={master.returncode} before the kill")
+        if not journal.exists():
+            return False
+        recs = _journal_recs_tolerant(journal)
+        started = any(r["rec"] == "phase_start" and r.get("name") == "WRITE"
+                      for r in recs)
+        ended = any(r["rec"] in ("phase_finish", "phase_interrupted")
+                    for r in recs)
+        return started and not ended
+    _wait_for(_inflight, timeout=30, what="journaled in-flight WRITE")
+
+
+def test_master_sigkill_then_adopt_completes_inflight_phase(tmp_path):
+    """The tentpole end to end: SIGKILL the master mid-WRITE on a 2-host
+    fleet, run `--resume --adopt` against the same journal, and prove
+    the fleet was adopted rather than restarted — both journaled
+    phase_start records carry the SAME bench UUID, the takeover record
+    names the in-flight phase, and the adopted run completes."""
+    env = _failover_env()
+    ports = free_ports(2)
+    journal = tmp_path / "j.jsonl"
+    jf_adopter = tmp_path / "adopter.json"
+    fleet = _failover_fleet_args(ports, journal, tmp_path / "takeover.dat")
+    with _logged_service(ports[0], env), _logged_service(ports[1], env):
+        victim = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "--nolive"] + fleet,
+            env=env, cwd=REPO_DIR, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_write_inflight(journal, victim)
+            time.sleep(1.0)  # let some rate-limited I/O happen
+            victim.kill()  # SIGKILL: no goodbye, lease simply expires
+            victim.wait()
+            rc = _master(["--resume", "--adopt",
+                          "--jsonfile", str(jf_adopter)] + fleet)
+            assert rc == 0, "takeover master must complete the run"
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+                victim.wait()
+    recs = _journal_recs(journal)
+    kinds = [r["rec"] for r in recs]
+    assert kinds[-1] == "run_complete"
+    # the fresh run armed the credentials; the successor adopted
+    fleet_rec = next(r for r in recs if r["rec"] == "fleet")
+    assert len(fleet_rec["hosts"]) == 2 and fleet_rec["takeover_token"]
+    takeover = next(r for r in recs if r["rec"] == "takeover")
+    assert takeover["adopted_hosts"] == 2
+    assert takeover["inflight"]["name"] == "WRITE"
+    # no-restart proof: the victim's journaled WRITE start and the
+    # adopter's journaled WRITE start name the SAME bench UUID — the
+    # /startphase re-presentation was a duplicate-start no-op, never a
+    # fresh phase
+    starts = [r for r in recs
+              if r["rec"] == "phase_start" and r["name"] == "WRITE"]
+    assert len(starts) == 2, "victim + adopter each journal the start"
+    assert starts[0]["bench_uuid"] == starts[1]["bench_uuid"] \
+        == takeover["inflight"]["bench_uuid"]
+    assert any(r["rec"] == "phase_finish" and r["name"] == "WRITE"
+               for r in recs)
+    # the takeover surfaces in the adopted run's merged results
+    jrecs = _json_recs(jf_adopter)
+    write = next(r for r in jrecs if r.get("Phase") == "WRITE")
+    assert write["MasterTakeovers"] == 2, "sum over both adopted hosts"
+    assert write["SvcAdoptions"] == 2
+    assert (tmp_path / "takeover.dat").exists()
+
+
+def test_adoption_grace_expiry_falls_back_to_orphan_recovery(tmp_path):
+    """No adopter shows up: the host advertises AwaitingAdoption for
+    --svcadoptsecs, then falls through to the UNCHANGED orphan recovery
+    (ORPHANED log, back to idle)."""
+    env = _failover_env()
+    port = free_ports(1)[0]
+    journal = tmp_path / "j.jsonl"
+    fleet = _failover_fleet_args([port], journal, tmp_path / "data.bin",
+                                 adopt_secs=4, timelimit=30)
+    with _logged_service(port, env) as (svc, log_path):
+        master = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "--nolive"] + fleet,
+            env=env, cwd=REPO_DIR, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_write_inflight(journal, master)
+            master.kill()
+            master.wait()
+            # lease (2s) expires => grace, visible over the wire (the
+            # standby's takeover trigger)
+            _wait_for(lambda: _status(port).get("AwaitingAdoption") == 1,
+                      timeout=15, what="AwaitingAdoption in /status")
+            st = _status(port)
+            assert st.get("PhaseCode") != int(BenchPhase.IDLE), \
+                "grace must keep the phase alive for a would-be adopter"
+            # grace (4s) expires with no /adopt => orphan recovery
+            _wait_for(lambda: (_status(port).get("PhaseCode")
+                               == int(BenchPhase.IDLE)),
+                      timeout=15, what="orphan recovery after grace")
+            st = _status(port)
+            assert "AwaitingAdoption" not in st
+            assert st.get("SvcLeaseExpiries") == 1
+            with open(log_path) as f:
+                log = f.read()
+            assert "AWAITING ADOPTION" in log
+            assert "adoption grace expired" in log
+            assert "ORPHANED" in log
+            assert svc.poll() is None, "service stays up and reusable"
+        finally:
+            if master.poll() is None:
+                master.kill()
+                master.wait()
+
+
+def test_standby_auto_takes_over_when_primary_dies(tmp_path):
+    """Warm standby: `--standby HOST:PORT` watches the sentinel host and
+    assumes the master role (--resume --adopt) the moment it reports
+    AwaitingAdoption — the killed primary's run completes under the
+    standby with the takeover on the record."""
+    env = _failover_env()
+    ports = free_ports(2)
+    journal = tmp_path / "j.jsonl"
+    jf_standby = tmp_path / "standby.json"
+    standby_log = tmp_path / "standby.log"
+    fleet = _failover_fleet_args(ports, journal, tmp_path / "takeover.dat")
+    with _logged_service(ports[0], env), _logged_service(ports[1], env):
+        with open(standby_log, "wb") as log_fh:
+            standby = subprocess.Popen(
+                [sys.executable, "-m", "elbencho_tpu", "--nolive",
+                 "--standby", f"127.0.0.1:{ports[0]}",
+                 "--jsonfile", str(jf_standby)] + fleet,
+                env=env, cwd=REPO_DIR, stdout=log_fh,
+                stderr=subprocess.STDOUT)
+        victim = subprocess.Popen(
+            [sys.executable, "-m", "elbencho_tpu", "--nolive"] + fleet,
+            env=env, cwd=REPO_DIR, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            _wait_write_inflight(journal, victim)
+            time.sleep(1.0)
+            victim.kill()
+            victim.wait()
+            rc = standby.wait(timeout=60)
+            assert rc == 0, ("standby must take over and finish the "
+                             f"run; log:\n{standby_log.read_text()}")
+        finally:
+            for proc in (victim, standby):
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+    recs = _journal_recs(journal)
+    assert recs[-1]["rec"] == "run_complete"
+    takeover = next(r for r in recs if r["rec"] == "takeover")
+    assert takeover["adopted_hosts"] == 2
+    write = next(r for r in _json_recs(jf_standby)
+                 if r.get("Phase") == "WRITE")
+    assert write["MasterTakeovers"] == 2
+    assert "STANDBY" in standby_log.read_text()

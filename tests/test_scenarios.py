@@ -710,17 +710,19 @@ def test_summarize_appends_scenario_columns_and_banners(tmp_path):
     header = res.stdout.splitlines()[0].split(",")
     # the scenario trio appends AFTER every pre-existing column
     # (the --slowops TailX/TailOwner pair appends after it, the
-    # --autotune Tuned/Gain% pair after THAT)
-    assert header[-7:] == ["Scenario", "Step", "EpochRate",
-                           "TailX", "TailOwner", "Tuned", "Gain%"]
+    # --autotune Tuned/Gain% pair after THAT, and the master-failover
+    # Adopt/Takeover pair last)
+    assert header[-9:] == ["Scenario", "Step", "EpochRate",
+                           "TailX", "TailOwner", "Tuned", "Gain%",
+                           "Adopt", "Takeover"]
     assert header.index("LatP99.9") < header.index("Scenario")
     rows = [ln.split(",") for ln in res.stdout.splitlines()[1:]]
     # the terminal SCENARIO record is bannered, not tabulated
     assert all(row[0] != "SCENARIO" for row in rows)
-    epoch_rows = [r for r in rows if r[-6].startswith("epoch")]
+    epoch_rows = [r for r in rows if r[-8].startswith("epoch")]
     assert len(epoch_rows) == 2
-    assert all(r[-7] == "epochs" for r in epoch_rows)
-    assert float(epoch_rows[0][-5]) > 0
+    assert all(r[-9] == "epochs" for r in epoch_rows)
+    assert float(epoch_rows[0][-7]) > 0
     assert "SCENARIO epochs [cache-warmup]" in res.stderr
     # CSV result columns carry the appended trio too (schema check)
     csv_header = csvf.read_text().splitlines()[0].split(",")

@@ -741,8 +741,9 @@ def test_summarize_json_tail_columns(tmp_path):
          str(jf)], capture_output=True, text=True, timeout=60)
     assert out.returncode == 0, out.stderr
     header = out.stdout.splitlines()[0]
-    # the --autotune Tuned/Gain% pair appends after the tail pair
-    assert header.rstrip().endswith("Gain%")
+    # the --autotune Tuned/Gain% pair appends after the tail pair, the
+    # master-failover Adopt/Takeover pair after THAT
+    assert header.rstrip().endswith("Takeover")
     assert header.split().index("TailOwner") \
         == header.split().index("TailX") + 1
     write_row = next(ln for ln in out.stdout.splitlines()

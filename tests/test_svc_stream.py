@@ -564,10 +564,10 @@ def test_summarize_json_stream_columns(tmp_path):
         capture_output=True, text=True, check=True)
     header = out.stdout.splitlines()[0].split(",")
     row = out.stdout.splitlines()[1].split(",")
-    # the pod-slice and latency-percentile trios append after the
-    # streaming trio
-    assert header[-16:-13] == ["StreamB", "DeltaSave", "AggDepth"]
-    assert row[-16:-13] == ["123", "456", "2"]
+    # the pod-slice, latency-percentile, and later column groups append
+    # after the streaming trio
+    assert header[-18:-15] == ["StreamB", "DeltaSave", "AggDepth"]
+    assert row[-18:-15] == ["123", "456", "2"]
 
 
 # ---------------------------------------------------------------------------

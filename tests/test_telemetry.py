@@ -337,14 +337,15 @@ def test_summarize_json_appends_telemetry_columns(tmp_path):
     cols = header.split(",")
     # appended, never reordered: the telemetry columns keep their order,
     # with the (later) data-plane fault-tolerance, staging-pool,
-    # run-lifecycle, streaming-control-plane, pod-slice, and
-    # latency-percentile columns after them
-    assert cols[-29:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
+    # run-lifecycle, streaming-control-plane, pod-slice,
+    # latency-percentile, and master-failover columns after them
+    assert cols[-31:] == ["Stalls", "Fused", "SvcRetry", "Scrapes",
                           "TraceEv", "IoRetry", "IoTmo", "ChipFail",
                           "PoolReuse", "RegOps", "SqpollOps",
                           "LeaseExp", "Resumed", "StreamB", "DeltaSave",
                           "AggDepth", "ShardMiB", "IciMiB", "IciGbps",
                           "LatP50", "LatP99", "LatP99.9",
                           "Scenario", "Step", "EpochRate",
-                          "TailX", "TailOwner", "Tuned", "Gain%"]
-    assert row.split(",")[-29:-24] == ["3", "7", "2", "5", "11"]
+                          "TailX", "TailOwner", "Tuned", "Gain%",
+                          "Adopt", "Takeover"]
+    assert row.split(",")[-31:-26] == ["3", "7", "2", "5", "11"]
